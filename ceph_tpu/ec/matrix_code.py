@@ -1,0 +1,136 @@
+"""Shared machinery for matrix-based (MDS) erasure codes.
+
+Models what ISA-L/jerasure matrix codes do around the GF matmul
+(ref: src/erasure-code/isa/ErasureCodeIsa.cc isa_encode/isa_decode,
+src/erasure-code/jerasure/ErasureCodeJerasure.cc jerasure_encode/decode):
+
+* encode: coding chunks = (m x k coding submatrix) x (k data chunks);
+* decode: pick the first k surviving chunks in index order
+  ("decode_index", ref: ErasureCodeIsa.cc:231-247), invert the k x k
+  survivor submatrix, build decode rows for erased data chunks directly
+  from the inverse and for erased coding chunks by re-projecting through
+  the encode matrix (ref: ErasureCodeIsa.cc:281-294), then one matmul;
+* decode tables are cached per erasure signature, mirroring the ISA-L
+  table cache (ref: src/erasure-code/isa/ErasureCodeIsaTableCache.cc).
+
+The byte matmul itself is pluggable (`matmul`), so the same orchestration
+drives the numpy CPU oracle and the TPU (JAX/Pallas) kernels.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable, Iterable, Mapping
+
+import numpy as np
+
+from . import gf
+from .interface import ErasureCode, ErasureCodeError
+
+MatmulFn = Callable[[np.ndarray, np.ndarray], np.ndarray]
+
+
+class DecodeTableCache:
+    """LRU of decode matrices keyed by erasure signature
+    (ref: ErasureCodeIsaTableCache.cc, decoding_tables_lru_length)."""
+
+    def __init__(self, capacity: int = 2516):
+        self.capacity = capacity
+        self._lru: OrderedDict[str, np.ndarray] = OrderedDict()
+
+    def get(self, sig: str) -> np.ndarray | None:
+        m = self._lru.get(sig)
+        if m is not None:
+            self._lru.move_to_end(sig)
+        return m
+
+    def put(self, sig: str, mat: np.ndarray) -> None:
+        self._lru[sig] = mat
+        self._lru.move_to_end(sig)
+        while len(self._lru) > self.capacity:
+            self._lru.popitem(last=False)
+
+
+def erasure_signature(decode_index: list[int], erasures: list[int]) -> str:
+    """"+r..-e.." signature string (ref: ErasureCodeIsa.cc:231-247)."""
+    return "".join(f"+{r}" for r in decode_index) + \
+           "".join(f"-{e}" for e in erasures)
+
+
+def make_decode_matrix(encode_matrix: np.ndarray, k: int,
+                       decode_index: list[int], erasures: list[int]
+                       ) -> np.ndarray:
+    """(nerrs x k) decode matrix applied to the k survivor chunks.
+
+    encode_matrix is the full (k+m) x k matrix (identity top).  Mirrors the
+    ISA-L construction: invert the survivor submatrix b; for an erased data
+    chunk e the decode row is inv_b[e]; for an erased coding chunk c the row
+    is encode_row(c) @ inv_b (ref: ErasureCodeIsa.cc:252-294).
+    """
+    b = encode_matrix[decode_index, :]  # (k x k) survivor rows
+    inv_b = gf.gf_invert_matrix(b)
+    if inv_b is None:
+        raise ErasureCodeError("EIO: singular survivor matrix")
+    rows = []
+    for e in erasures:
+        if e < k:
+            rows.append(inv_b[e])
+        else:
+            rows.append(gf.gf_matmul(encode_matrix[e][None, :], inv_b)[0])
+    return np.stack(rows).astype(np.uint8)
+
+
+class MatrixErasureCode(ErasureCode):
+    """Systematic MDS matrix code over GF(2^8) with pluggable matmul."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.k = 0
+        self.m = 0
+        self.encode_matrix: np.ndarray | None = None  # (k+m) x k, identity top
+        self.table_cache = DecodeTableCache()
+
+    # subclasses set self.k/self.m and call _prepare with the full matrix
+    def _prepare(self, encode_matrix: np.ndarray) -> None:
+        assert encode_matrix.shape == (self.k + self.m, self.k)
+        self.encode_matrix = np.ascontiguousarray(encode_matrix, dtype=np.uint8)
+
+    # the byte matmul backend; TPU plugin overrides
+    def matmul(self, mat: np.ndarray, data: np.ndarray) -> np.ndarray:
+        return gf.gf_matmul_bytes(mat, data)
+
+    def get_chunk_count(self) -> int:
+        return self.k + self.m
+
+    def get_data_chunk_count(self) -> int:
+        return self.k
+
+    # -- math --------------------------------------------------------------
+    def encode_chunks(self, want_to_encode: Iterable[int],
+                      encoded: dict[int, np.ndarray]) -> None:
+        k, m = self.k, self.m
+        data = np.stack([encoded[self.chunk_index(i)] for i in range(k)])
+        coding = self.matmul(self.encode_matrix[k:], data)
+        for i in range(m):
+            encoded[self.chunk_index(k + i)][...] = coding[i]
+
+    def decode_chunks(self, want_to_read: Iterable[int],
+                      chunks: Mapping[int, np.ndarray],
+                      decoded: dict[int, np.ndarray]) -> None:
+        k, m = self.k, self.m
+        avail = set(chunks)
+        erasures = [i for i in range(k + m) if i not in avail]
+        if len(erasures) > m:
+            raise ErasureCodeError("EIO: too many erasures")
+        # first k surviving chunks in index order (ErasureCodeIsa.cc:231)
+        decode_index = [i for i in range(k + m) if i in avail][:k]
+        if len(decode_index) < k:
+            raise ErasureCodeError("EIO: fewer than k chunks available")
+        sig = erasure_signature(decode_index, erasures)
+        dmat = self.table_cache.get(sig)
+        if dmat is None:
+            dmat = make_decode_matrix(self.encode_matrix, k, decode_index, erasures)
+            self.table_cache.put(sig, dmat)
+        survivors = np.stack([decoded[i] for i in decode_index])
+        out = self.matmul(dmat, survivors)
+        for row, e in enumerate(erasures):
+            decoded[e][...] = out[row]

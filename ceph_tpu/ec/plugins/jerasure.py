@@ -1,0 +1,192 @@
+"""jerasure-compatible CPU plugin (numpy backend).
+
+Matches the technique set and chunk-size semantics of the jerasure plugin
+(ref: src/erasure-code/jerasure/ErasureCodeJerasure.{h,cc}):
+
+* techniques: reed_sol_van (Vandermonde systematized), reed_sol_r6_op
+  (RAID-6 P+Q), cauchy_orig, cauchy_good (improved Cauchy);
+* w=8 matrix codes (the Ceph default; prime-w bitmatrix techniques
+  liberation/blaum_roth/liber8tion are bit-scheduled variants of different
+  constructions and are not yet implemented);
+* chunk size: object padded to a multiple of k*w*sizeof(int) (w*16-aligned
+  per-chunk when jerasure-per-chunk-alignment=true); cauchy variants align
+  to k*w*packetsize*sizeof(int) with packetsize default 2048
+  (ref: ErasureCodeJerasure.cc:80-102 get_chunk_size, :174-184,:300 get_alignment).
+
+jerasure's bitmatrix/schedule encode (cauchy) computes the same GF(2^8)
+linear map as the plain matrix product, so chunk bytes here are identical
+to the reference for all four techniques.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import gf
+from ..interface import ErasureCodeProfile, ErasureCodeError, to_int, to_bool, \
+    sanity_check_k_m
+from ..matrix_code import MatrixErasureCode
+from ..registry import ErasureCodePlugin
+
+LARGEST_VECTOR_WORDSIZE = 16  # ref: ErasureCodeJerasure.cc:30
+SIZEOF_INT = 4
+
+
+class ErasureCodeJerasure(MatrixErasureCode):
+    DEFAULT_K = "2"
+    DEFAULT_M = "1"
+    DEFAULT_W = "8"
+    technique = "reed_sol_van"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.w = 8
+        self.per_chunk_alignment = False
+
+    def init(self, profile: ErasureCodeProfile) -> None:
+        profile.setdefault("plugin", "jerasure")
+        profile.setdefault("technique", self.technique)
+        self.parse(profile)
+        self.prepare()
+        super().init(profile)
+
+    def parse(self, profile: ErasureCodeProfile) -> None:
+        super().parse(profile)
+        self.k = to_int("k", profile, self.DEFAULT_K)
+        self.m = to_int("m", profile, self.DEFAULT_M)
+        self.w = to_int("w", profile, self.DEFAULT_W)
+        if self.chunk_mapping and len(self.chunk_mapping) != self.k + self.m:
+            self.chunk_mapping = []
+            raise ErasureCodeError("bad mapping size")
+        sanity_check_k_m(self.k, self.m)
+        if self.w != 8:
+            # w=16/32 matrix codes exist in jerasure; the TPU framework is a
+            # byte (w=8) field end-to-end, which is also the Ceph default.
+            raise ErasureCodeError(f"w={self.w} not supported (only w=8)")
+        self.per_chunk_alignment = to_bool(
+            "jerasure-per-chunk-alignment", profile, "false")
+
+    def get_alignment(self) -> int:
+        # ref: ErasureCodeJerasure.cc:174-184
+        if self.per_chunk_alignment:
+            return self.w * LARGEST_VECTOR_WORDSIZE
+        alignment = self.k * self.w * SIZEOF_INT
+        if (self.w * SIZEOF_INT) % LARGEST_VECTOR_WORDSIZE:
+            alignment = self.k * self.w * LARGEST_VECTOR_WORDSIZE
+        return alignment
+
+    def get_chunk_size(self, object_size: int) -> int:
+        # ref: ErasureCodeJerasure.cc:80-102
+        alignment = self.get_alignment()
+        if self.per_chunk_alignment:
+            chunk_size = (object_size + self.k - 1) // self.k
+            modulo = chunk_size % alignment
+            if modulo:
+                chunk_size += alignment - modulo
+            return chunk_size
+        tail = object_size % alignment
+        padded = object_size + (alignment - tail if tail else 0)
+        assert padded % self.k == 0
+        return padded // self.k
+
+    def prepare(self) -> None:
+        raise NotImplementedError
+
+
+class ReedSolomonVandermonde(ErasureCodeJerasure):
+    technique = "reed_sol_van"
+
+    def prepare(self) -> None:
+        coding = gf.jerasure_vandermonde_coding_matrix(self.k, self.m)
+        self._prepare(np.vstack([np.eye(self.k, dtype=np.uint8), coding]))
+
+
+class ReedSolomonRAID6(ErasureCodeJerasure):
+    technique = "reed_sol_r6_op"
+
+    def parse(self, profile: ErasureCodeProfile) -> None:
+        profile.pop("m", None)
+        super().parse(profile)
+        self.m = 2
+
+    def prepare(self) -> None:
+        coding = gf.jerasure_r6_coding_matrix(self.k)
+        self._prepare(np.vstack([np.eye(self.k, dtype=np.uint8), coding]))
+
+
+class Cauchy(ErasureCodeJerasure):
+    DEFAULT_K = "7"
+    DEFAULT_M = "3"
+    DEFAULT_PACKETSIZE = "2048"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.packetsize = 2048
+
+    def parse(self, profile: ErasureCodeProfile) -> None:
+        super().parse(profile)
+        self.packetsize = to_int("packetsize", profile, self.DEFAULT_PACKETSIZE)
+
+    def get_alignment(self) -> int:
+        # ref: ErasureCodeJerasure.cc:280-293
+        if self.per_chunk_alignment:
+            alignment = self.w * self.packetsize
+            modulo = alignment % LARGEST_VECTOR_WORDSIZE
+            if modulo:
+                alignment += LARGEST_VECTOR_WORDSIZE - modulo
+            return alignment
+        alignment = self.k * self.w * self.packetsize * SIZEOF_INT
+        if (self.w * self.packetsize * SIZEOF_INT) % LARGEST_VECTOR_WORDSIZE:
+            alignment = self.k * self.w * self.packetsize * LARGEST_VECTOR_WORDSIZE
+        return alignment
+
+
+class CauchyOrig(Cauchy):
+    technique = "cauchy_orig"
+
+    def prepare(self) -> None:
+        coding = gf.cauchy_original_coding_matrix(self.k, self.m)
+        self._prepare(np.vstack([np.eye(self.k, dtype=np.uint8), coding]))
+
+
+class CauchyGood(Cauchy):
+    technique = "cauchy_good"
+
+    def prepare(self) -> None:
+        coding = gf.cauchy_good_coding_matrix(self.k, self.m)
+        self._prepare(np.vstack([np.eye(self.k, dtype=np.uint8), coding]))
+
+
+TECHNIQUES = {
+    "reed_sol_van": ReedSolomonVandermonde,
+    "reed_sol_r6_op": ReedSolomonRAID6,
+    "cauchy_orig": CauchyOrig,
+    "cauchy_good": CauchyGood,
+}
+
+
+class _JerasureFactory:
+    """Dispatch on profile['technique'] like ErasureCodePluginJerasure::factory
+    (ref: src/erasure-code/jerasure/ErasureCodePluginJerasure.cc)."""
+
+    def __call__(self) -> ErasureCodeJerasure:
+        return _TechniqueDispatch()
+
+
+class _TechniqueDispatch(ErasureCodeJerasure):
+    """Thin shim: picks the concrete technique class at init() time."""
+
+    def __new__(cls):
+        return object.__new__(cls)
+
+    def init(self, profile: ErasureCodeProfile) -> None:
+        technique = profile.setdefault("technique", "reed_sol_van")
+        impl_cls = TECHNIQUES.get(technique)
+        if impl_cls is None:
+            raise ErasureCodeError(
+                f"ENOENT: technique={technique!r} is not supported")
+        self.__class__ = impl_cls
+        impl_cls.__init__(self)
+        impl_cls.init(self, profile)
+
+
+PLUGIN = ErasureCodePlugin("jerasure", _JerasureFactory())

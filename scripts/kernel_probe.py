@@ -1,0 +1,54 @@
+"""Scratch probe for Mosaic-friendly GF kernel formulations on the real TPU."""
+import functools
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+import sys
+sys.path.insert(0, "/root/repo")
+from ceph_tpu.ec import gf
+
+rng = np.random.default_rng(0)
+r, k, n = 4, 8, 8192
+mat = rng.integers(0, 256, (r, k)).astype(np.uint8)
+data = rng.integers(0, 256, (k, n)).astype(np.uint8)
+want = gf.gf_matmul_bytes(mat, data)
+B = gf.expand_to_bitmatrix(mat).astype(np.int8)  # (8r, 8k)
+
+
+def kernel_v1(bitmat_ref, data_ref, out_ref):
+    data = data_ref[...].astype(jnp.int32)        # (k, tn)
+    kk, tn = data.shape
+    shifts = jax.lax.broadcasted_iota(jnp.int32, (1, 8, 1), 1)
+    bits = ((data[:, None, :] >> shifts) & 1).astype(jnp.int8)
+    bits = bits.reshape(8 * kk, tn)
+    acc = jax.lax.dot_general(bitmat_ref[...], bits, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+    acc = acc & 1
+    r8, _ = acc.shape
+    w = jnp.int32(1) << jax.lax.broadcasted_iota(jnp.int32, (1, 8, 1), 1)
+    out_ref[...] = (acc.reshape(r8 // 8, 8, tn) * w).sum(axis=1).astype(jnp.uint8)
+
+
+def run(kernel, tile_n=2048):
+    grid = (n // tile_n,)
+    f = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((r, n), jnp.uint8),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((8 * r, 8 * k), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, tile_n), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((r, tile_n), lambda i: (0, i),
+                               memory_space=pltpu.VMEM),
+    )
+    return np.asarray(jax.jit(f)(B, data))
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "v1"
+    got = run({"v1": kernel_v1}[which])
+    print(which, "MATCH" if np.array_equal(got, want) else "MISMATCH")

@@ -1,0 +1,31 @@
+"""Single-dispatch throughput measurement (no async-queue ambiguity)."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ceph_tpu.ec import gf
+from ceph_tpu.ec.kernels import bitmatmul
+
+k, m = 8, 4
+chunk = 128 * 1024
+rng = np.random.default_rng(0)
+mat = gf.isa_rs_matrix(k, m)[k:]
+B = jnp.asarray(gf.expand_to_bitmatrix(mat).astype(np.int8))
+
+for stripes in (64, 256, 512):
+    data = jnp.asarray(rng.integers(0, 256, (stripes, k, chunk), dtype=np.uint8))
+    for label, fn in (("xla", bitmatmul.gf_matmul_xla),
+                      ("pallas", bitmatmul.gf_matmul_pallas)):
+        out = jax.block_until_ready(fn(B, data))  # warm compile
+        reps = 5
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(B, data)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / reps
+        total_in = stripes * k * chunk
+        total_out = stripes * m * chunk
+        print(f"stripes={stripes:4d} {label:6s}: {dt*1e3:8.3f} ms  "
+              f"in {total_in/dt/1e9:8.2f} GB/s  io {(total_in+total_out)/dt/1e9:8.2f} GB/s")

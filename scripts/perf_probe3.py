@@ -1,0 +1,42 @@
+"""Cache-proof timing: unique input per rep + scalar readback."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ceph_tpu.ec import gf
+from ceph_tpu.ec.kernels import bitmatmul
+
+k, m = 8, 4
+chunk = 128 * 1024
+rng = np.random.default_rng(0)
+mat = gf.isa_rs_matrix(k, m)[k:]
+B = jnp.asarray(gf.expand_to_bitmatrix(mat).astype(np.int8))
+
+
+@jax.jit
+def step_xla(B, data, i):
+    out = bitmatmul.gf_matmul_xla(B, data ^ i)
+    return jnp.sum(out, dtype=jnp.int32)
+
+
+@jax.jit
+def step_pallas(B, data, i):
+    out = bitmatmul.gf_matmul_pallas(B, data ^ i)
+    return jnp.sum(out, dtype=jnp.int32)
+
+
+for stripes in (64, 256):
+    data = jnp.asarray(rng.integers(0, 256, (stripes, k, chunk), dtype=np.uint8))
+    for label, fn in (("xla", step_xla), ("pallas", step_pallas)):
+        float(fn(B, data, jnp.uint8(255)))  # warm
+        reps = 10
+        t0 = time.perf_counter()
+        for i in range(reps):
+            s = float(fn(B, data, jnp.uint8(i)))
+        dt = (time.perf_counter() - t0) / reps
+        total_in = stripes * k * chunk
+        total_out = stripes * m * chunk
+        print(f"stripes={stripes:4d} {label:6s}: {dt*1e3:8.3f} ms  "
+              f"in {total_in/dt/1e9:8.2f} GB/s  io {(total_in+total_out)/dt/1e9:8.2f} GB/s")

@@ -1,0 +1,42 @@
+"""Amortized timing: R unique encodes inside one jitted scan, one readback."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import functools
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ceph_tpu.ec import gf
+from ceph_tpu.ec.kernels import bitmatmul
+
+k, m = 8, 4
+chunk = 128 * 1024
+rng = np.random.default_rng(0)
+mat = gf.isa_rs_matrix(k, m)[k:]
+B = jnp.asarray(gf.expand_to_bitmatrix(mat).astype(np.int8))
+R = 50
+
+
+@functools.partial(jax.jit, static_argnames=("which",))
+def many(B, data, which):
+    fn = {"xla": bitmatmul.gf_matmul_xla,
+          "pallas": bitmatmul.gf_matmul_pallas}[which]
+    def body(c, i):
+        out = fn(B, data ^ i)
+        return c + jnp.sum(out, dtype=jnp.int32), None
+    acc, _ = lax.scan(body, jnp.int32(0), jnp.arange(R, dtype=jnp.uint8))
+    return acc
+
+
+for stripes in (64, 256):
+    data = jnp.asarray(rng.integers(0, 256, (stripes, k, chunk), dtype=np.uint8))
+    for label in ("xla", "pallas"):
+        float(many(B, data, label))  # warm
+        t0 = time.perf_counter()
+        s = float(many(B, data, label))
+        dt = (time.perf_counter() - t0) / R
+        total_in = stripes * k * chunk
+        total_out = stripes * m * chunk
+        print(f"stripes={stripes:4d} {label:6s}: {dt*1e3:8.3f} ms/encode  "
+              f"in {total_in/dt/1e9:8.2f} GB/s  io {(total_in+total_out)/dt/1e9:8.2f} GB/s")

@@ -3,13 +3,22 @@
 Multi-chip TPU hardware is not available in CI; all sharding/collective
 tests run on a virtual 8-device CPU platform (the driver separately
 dry-run-compiles the multi-chip path via __graft_entry__.dryrun_multichip).
-Must run before jax is imported anywhere.
+
+Note: the axon sitecustomize sets jax.config jax_platforms='axon,cpu' at
+interpreter start, so the JAX_PLATFORMS env var alone is NOT enough — we
+must override the config value before any backend initializes.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu", jax.default_backend()
+assert len(jax.devices()) == 8, jax.devices()

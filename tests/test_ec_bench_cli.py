@@ -1,0 +1,29 @@
+"""CLI smoke tests for the ceph_erasure_code_benchmark-compatible harness."""
+import subprocess
+import sys
+
+
+def run_cli(*args):
+    out = subprocess.run(
+        [sys.executable, "-m", "ceph_tpu.tools.ec_bench", *args],
+        capture_output=True, text=True, check=True)
+    line = out.stdout.strip().splitlines()[-1]
+    seconds, kib = line.split("\t")
+    return float(seconds), float(kib)
+
+
+def test_encode_output_format():
+    seconds, kib = run_cli("--plugin", "jerasure", "--workload", "encode",
+                           "--size", "65536", "--iterations", "3",
+                           "--parameter", "k=4", "--parameter", "m=2")
+    assert seconds > 0
+    assert kib == 65536 / 1024 * 3
+
+
+def test_decode_exhaustive_verifies():
+    seconds, kib = run_cli("--plugin", "isa", "--workload", "decode",
+                           "--size", "65536", "--iterations", "10",
+                           "--erasures", "2",
+                           "--erasures-generation", "exhaustive",
+                           "--parameter", "k=4", "--parameter", "m=2")
+    assert seconds > 0

@@ -1,0 +1,58 @@
+"""Helpers to rebuild CrushMaps from fixture specs (shared by tests and the
+fixture generator).  Fixture format: see scripts/gen_crush_fixtures.py."""
+from __future__ import annotations
+
+from .types import CRUSH_BUCKET_TREE, CrushBucket, CrushMap, CrushRule, \
+    CrushRuleStep
+
+
+def tree_node_weights(items: list[int], weights: list[int]) -> list[int]:
+    """Tree-bucket node weights, replicating builder.c
+    crush_make_tree_bucket's layout (leaves at odd nodes (i+1)*2-1)."""
+    n = len(items)
+    depth = 0
+    t = 1
+    while t < n:
+        t <<= 1
+        depth += 1
+    num_nodes = 1 << (depth + 1)
+    nw = [0] * num_nodes
+    for i, w in enumerate(weights):
+        node = ((i + 1) << 1) - 1
+        nw[node] = w
+        while node != (num_nodes >> 1):
+            h = 0
+            nn = node
+            while (nn & 1) == 0:
+                h += 1
+                nn >>= 1
+            if (node >> (h + 1)) & 1:
+                parent = node - (1 << h)
+            else:
+                parent = node + (1 << h)
+            nw[parent] += w
+            node = parent
+    return nw
+
+
+def map_from_spec(spec: dict) -> CrushMap:
+    """Build a CrushMap from a fixture spec (buckets get ids -1, -2, ...
+    in order, matching crush_add_bucket)."""
+    m = CrushMap()
+    (m.choose_local_tries, m.choose_local_fallback_tries,
+     m.choose_total_tries, m.chooseleaf_descend_once,
+     m.chooseleaf_vary_r, m.chooseleaf_stable) = spec["tunables"]
+    m.straw_calc_version = spec.get("straw_calc_version", 0)
+    for i, (alg, type_, items, weights) in enumerate(spec["buckets"]):
+        b = CrushBucket(id=-(i + 1), type=type_, alg=alg,
+                        items=list(items), item_weights=list(weights),
+                        weight=sum(weights))
+        if alg == CRUSH_BUCKET_TREE:
+            b.node_weights = tree_node_weights(items, weights)
+        m.add_bucket(b)
+        for it in items:
+            if it >= 0:
+                m.max_devices = max(m.max_devices, it + 1)
+    for steps in spec["rules"]:
+        m.rules.append(CrushRule(steps=[CrushRuleStep(*s) for s in steps]))
+    return m

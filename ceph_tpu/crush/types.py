@@ -1,0 +1,137 @@
+"""CRUSH map data model.
+
+Python rendering of the crush_map structures (ref: src/crush/crush.h:
+crush_bucket :229, crush_rule/crush_rule_step :44-97, crush_map :425-521).
+Buckets are identified by negative ids (-1-index into buckets[]); devices by
+non-negative ids.  Weights are 16.16 fixed point.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+# bucket algorithms (crush.h:140-190)
+CRUSH_BUCKET_UNIFORM = 1
+CRUSH_BUCKET_LIST = 2
+CRUSH_BUCKET_TREE = 3
+CRUSH_BUCKET_STRAW = 4
+CRUSH_BUCKET_STRAW2 = 5
+
+# rule step opcodes (crush.h:52-69)
+CRUSH_RULE_NOOP = 0
+CRUSH_RULE_TAKE = 1
+CRUSH_RULE_CHOOSE_FIRSTN = 2
+CRUSH_RULE_CHOOSE_INDEP = 3
+CRUSH_RULE_EMIT = 4
+CRUSH_RULE_CHOOSELEAF_FIRSTN = 6
+CRUSH_RULE_CHOOSELEAF_INDEP = 7
+CRUSH_RULE_SET_CHOOSE_TRIES = 8
+CRUSH_RULE_SET_CHOOSELEAF_TRIES = 9
+CRUSH_RULE_SET_CHOOSE_LOCAL_TRIES = 10
+CRUSH_RULE_SET_CHOOSE_LOCAL_FALLBACK_TRIES = 11
+CRUSH_RULE_SET_CHOOSELEAF_VARY_R = 12
+CRUSH_RULE_SET_CHOOSELEAF_STABLE = 13
+
+# sentinels (crush.h:33-37)
+CRUSH_ITEM_UNDEF = 0x7FFFFFFE
+CRUSH_ITEM_NONE = 0x7FFFFFFF
+
+CRUSH_MAX_DEPTH = 10
+CRUSH_HASH_RJENKINS1 = 0
+
+
+@dataclass
+class CrushBucket:
+    id: int                     # negative
+    type: int                   # bucket type id (host/rack/... from type map)
+    alg: int = CRUSH_BUCKET_STRAW2
+    hash: int = CRUSH_HASH_RJENKINS1
+    weight: int = 0             # 16.16 total weight
+    items: list[int] = field(default_factory=list)
+    item_weights: list[int] = field(default_factory=list)  # 16.16
+    # tree-bucket node weights (crush.h:318-321); built on demand
+    node_weights: list[int] | None = None
+
+    @property
+    def size(self) -> int:
+        return len(self.items)
+
+
+@dataclass
+class CrushRuleStep:
+    op: int
+    arg1: int = 0
+    arg2: int = 0
+
+
+@dataclass
+class CrushRuleMask:
+    ruleset: int = 0
+    type: int = 1               # pg_pool type: 1=replicated, 3=erasure
+    min_size: int = 1
+    max_size: int = 10
+
+
+@dataclass
+class CrushRule:
+    steps: list[CrushRuleStep] = field(default_factory=list)
+    mask: CrushRuleMask = field(default_factory=CrushRuleMask)
+
+
+@dataclass
+class ChooseArg:
+    """choose_args override for one bucket (crush.h:281-295):
+    optional id remap + per-position weight sets."""
+    ids: list[int] | None = None
+    weight_set: list[list[int]] | None = None   # [position][item] 16.16
+
+
+@dataclass
+class CrushMap:
+    buckets: list[CrushBucket | None] = field(default_factory=list)
+    rules: list[CrushRule | None] = field(default_factory=list)
+    max_devices: int = 0
+    # tunables (jewel profile defaults, ref: CrushWrapper.h:186-194)
+    choose_local_tries: int = 0
+    choose_local_fallback_tries: int = 0
+    choose_total_tries: int = 50
+    chooseleaf_descend_once: int = 1
+    chooseleaf_vary_r: int = 1
+    chooseleaf_stable: int = 1
+    straw_calc_version: int = 1
+    # choose_args sets: name -> {bucket_id: ChooseArg}
+    choose_args: dict = field(default_factory=dict)
+
+    @property
+    def max_buckets(self) -> int:
+        return len(self.buckets)
+
+    def bucket(self, item_id: int) -> CrushBucket | None:
+        idx = -1 - item_id
+        if 0 <= idx < len(self.buckets):
+            return self.buckets[idx]
+        return None
+
+    def add_bucket(self, bucket: CrushBucket) -> int:
+        if bucket.id is None or bucket.id >= 0:
+            bucket.id = -1 - len(self.buckets)
+            self.buckets.append(bucket)
+        else:
+            idx = -1 - bucket.id
+            while len(self.buckets) <= idx:
+                self.buckets.append(None)
+            self.buckets[idx] = bucket
+        return bucket.id
+
+    def set_tunables_profile(self, profile: str) -> None:
+        """argonaut/bobtail/firefly/hammer/jewel
+        (ref: CrushWrapper.h:146-194)."""
+        vals = {
+            "argonaut": (2, 5, 19, 0, 0, 0),
+            "bobtail": (0, 0, 50, 1, 0, 0),
+            "firefly": (0, 0, 50, 1, 1, 0),
+            "hammer": (0, 0, 50, 1, 1, 0),
+            "jewel": (0, 0, 50, 1, 1, 1),
+        }[profile]
+        (self.choose_local_tries, self.choose_local_fallback_tries,
+         self.choose_total_tries, self.chooseleaf_descend_once,
+         self.chooseleaf_vary_r, self.chooseleaf_stable) = vals

@@ -1,0 +1,269 @@
+"""Dev-time fixture generator: runs the reference CRUSH C core (compiled as
+/tmp/crush_oracle/libcrush_oracle.so from /root/reference/src/crush) and my
+Python mapper side by side, verifies they agree, and writes fixture vectors
+to tests/fixtures/ so CI never needs the reference tree.
+
+Usage: python scripts/gen_crush_fixtures.py
+"""
+import ctypes
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from ceph_tpu.crush import mapper, types
+from ceph_tpu.crush.types import (
+    CRUSH_BUCKET_LIST, CRUSH_BUCKET_STRAW, CRUSH_BUCKET_STRAW2,
+    CRUSH_BUCKET_TREE, CRUSH_BUCKET_UNIFORM, CRUSH_RULE_CHOOSELEAF_FIRSTN,
+    CRUSH_RULE_CHOOSELEAF_INDEP, CRUSH_RULE_CHOOSE_FIRSTN,
+    CRUSH_RULE_CHOOSE_INDEP, CRUSH_RULE_EMIT, CRUSH_RULE_TAKE,
+    CrushBucket, CrushMap, CrushRule, CrushRuleMask, CrushRuleStep,
+)
+
+LIB = ctypes.CDLL("/tmp/crush_oracle/libcrush_oracle.so")
+LIB.oracle_create.restype = ctypes.c_void_p
+LIB.oracle_add_bucket.restype = ctypes.c_int
+LIB.oracle_add_rule.restype = ctypes.c_int
+LIB.oracle_do_rule.restype = ctypes.c_int
+LIB.oracle_hash32_3.restype = ctypes.c_uint
+LIB.oracle_hash32_2.restype = ctypes.c_uint
+
+
+class Oracle:
+    def __init__(self, tunables):
+        self.h = ctypes.c_void_p(LIB.oracle_create())
+        LIB.oracle_set_tunables(self.h, *[ctypes.c_int(v) for v in tunables])
+
+    def add_bucket(self, alg, type_, items, weights, want_id=0):
+        n = len(items)
+        ia = (ctypes.c_int * n)(*items)
+        wa = (ctypes.c_int * n)(*weights)
+        return LIB.oracle_add_bucket(self.h, alg, type_, n, ia, wa, want_id)
+
+    def add_rule(self, steps):
+        n = len(steps)
+        ops = (ctypes.c_int * n)(*[s[0] for s in steps])
+        a1 = (ctypes.c_int * n)(*[s[1] for s in steps])
+        a2 = (ctypes.c_int * n)(*[s[2] for s in steps])
+        return LIB.oracle_add_rule(self.h, n, ops, a1, a2)
+
+    def finalize(self):
+        LIB.oracle_finalize(self.h)
+
+    def do_rule(self, ruleno, x, result_max, weights):
+        res = (ctypes.c_int * result_max)()
+        wa = (ctypes.c_uint * len(weights))(*weights)
+        n = LIB.oracle_do_rule(self.h, ruleno, x, res, result_max,
+                               wa, len(weights))
+        return list(res[:n])
+
+
+def build_case(spec):
+    """spec: {tunables, buckets: [(alg, type, items, weights)], rules, ...}
+    Builds both oracle and python maps.  Bucket ids assigned in order
+    -1, -2, ... matching crush_add_bucket(want_id=0)."""
+    oracle = Oracle(spec["tunables"])
+    pymap = CrushMap()
+    (pymap.choose_local_tries, pymap.choose_local_fallback_tries,
+     pymap.choose_total_tries, pymap.chooseleaf_descend_once,
+     pymap.chooseleaf_vary_r, pymap.chooseleaf_stable) = spec["tunables"]
+    pymap.straw_calc_version = 0  # crush_create() default in the oracle
+    for alg, type_, items, weights in spec["buckets"]:
+        bid = oracle.add_bucket(alg, type_, items, weights)
+        b = CrushBucket(id=bid, type=type_, alg=alg,
+                        items=list(items), item_weights=list(weights),
+                        weight=sum(weights))
+        if alg == CRUSH_BUCKET_TREE:
+            b.node_weights = tree_node_weights(items, weights)
+        pymap.add_bucket(b)
+        for it in items:
+            if it >= 0:
+                pymap.max_devices = max(pymap.max_devices, it + 1)
+    for steps in spec["rules"]:
+        oracle.add_rule(steps)
+        pymap.rules.append(CrushRule(
+            steps=[CrushRuleStep(*s) for s in steps]))
+    oracle.finalize()
+    return oracle, pymap
+
+
+def tree_node_weights(items, weights):
+    """Replicates builder.c crush_make_tree_bucket node weight layout."""
+    n = len(items)
+    depth = 0
+    t = 1
+    while t < n:
+        t <<= 1
+        depth += 1
+    num_nodes = 1 << (depth + 1)
+    nw = [0] * num_nodes
+    for i, w in enumerate(weights):
+        node = ((i + 1) << 1) - 1
+        nw[node] = w
+        # parents accumulate
+        while node != (num_nodes >> 1):
+            # climb: parent of node
+            h = 0
+            nn = node
+            while (nn & 1) == 0:
+                h += 1
+                nn >>= 1
+            # parent is node +- (1<<h)
+            if (node >> (h + 1)) & 1:
+                parent = node - (1 << h)
+            else:
+                parent = node + (1 << h)
+            nw[parent] += w
+            node = parent
+    return nw
+
+
+def gen(spec, name, xs, result_max, weights, out):
+    oracle, pymap = build_case(spec)
+    expected = []
+    mismatches = 0
+    for x in xs:
+        want = oracle.do_rule(spec.get("ruleno", 0), x, result_max, weights)
+        got = mapper.do_rule(pymap, spec.get("ruleno", 0), x, result_max,
+                             list(weights))
+        if got != want:
+            mismatches += 1
+            if mismatches <= 5:
+                print(f"  MISMATCH {name} x={x}: oracle={want} py={got}")
+        expected.append(want)
+    status = "OK" if mismatches == 0 else f"{mismatches}/{len(xs)} MISMATCH"
+    print(f"{name}: {status}")
+    out[name] = {"spec": spec, "xs": list(map(int, xs)),
+                 "result_max": result_max, "weights": list(weights),
+                 "expected": expected}
+    return mismatches
+
+
+def main():
+    JEWEL = [0, 0, 50, 1, 1, 1]
+    ARGONAUT = [2, 5, 19, 0, 0, 0]
+    rng = np.random.default_rng(0)
+    xs = [int(v) for v in rng.integers(0, 2**31, 200)]
+    out = {}
+    bad = 0
+
+    # --- case 1: flat straw2, 16 osds, firstn 3 osd -----------------------
+    items = list(range(16))
+    weights = [0x10000] * 16
+    spec = {"tunables": JEWEL,
+            "buckets": [(CRUSH_BUCKET_STRAW2, 11, items, weights)],
+            "rules": [[(CRUSH_RULE_TAKE, -1, 0),
+                       (CRUSH_RULE_CHOOSE_FIRSTN, 0, 0),
+                       (CRUSH_RULE_EMIT, 0, 0)]]}
+    bad += gen(spec, "flat_straw2_firstn", xs, 3, [0x10000] * 16, out)
+
+    # --- case 2: flat straw2 with varied weights --------------------------
+    w2 = [int(w) for w in rng.integers(1, 8, 16) * 0x10000]
+    spec = {"tunables": JEWEL,
+            "buckets": [(CRUSH_BUCKET_STRAW2, 11, items, w2)],
+            "rules": [[(CRUSH_RULE_TAKE, -1, 0),
+                       (CRUSH_RULE_CHOOSE_FIRSTN, 0, 0),
+                       (CRUSH_RULE_EMIT, 0, 0)]]}
+    bad += gen(spec, "flat_straw2_weighted", xs, 3, [0x10000] * 16, out)
+
+    # --- case 3: two-level hosts, chooseleaf firstn -----------------------
+    # hosts -2..-9 each with 4 osds; root -1... build order: root must know
+    # child ids; add hosts first (ids -1..-8), then root (-9).
+    buckets = []
+    host_ids = []
+    osd = 0
+    host_weights = []
+    for h in range(8):
+        hitems = list(range(osd, osd + 4))
+        hw = [0x10000] * 4
+        buckets.append((CRUSH_BUCKET_STRAW2, 1, hitems, hw))
+        host_ids.append(-(h + 1))
+        host_weights.append(sum(hw))
+        osd += 4
+    buckets.append((CRUSH_BUCKET_STRAW2, 11, host_ids, host_weights))
+    rule_cl = [[(CRUSH_RULE_TAKE, -9, 0),
+                (CRUSH_RULE_CHOOSELEAF_FIRSTN, 0, 1),
+                (CRUSH_RULE_EMIT, 0, 0)]]
+    spec = {"tunables": JEWEL, "buckets": buckets, "rules": rule_cl}
+    bad += gen(spec, "hosts_chooseleaf_firstn", xs, 3, [0x10000] * 32, out)
+
+    # --- case 4: same topology, chooseleaf indep (EC) ---------------------
+    rule_indep = [[(CRUSH_RULE_TAKE, -9, 0),
+                   (CRUSH_RULE_CHOOSELEAF_INDEP, 0, 1),
+                   (CRUSH_RULE_EMIT, 0, 0)]]
+    spec = {"tunables": JEWEL, "buckets": buckets, "rules": rule_indep}
+    bad += gen(spec, "hosts_chooseleaf_indep", xs, 6, [0x10000] * 32, out)
+
+    # --- case 5: reweighted devices (probabilistic out test) --------------
+    devw = [0x10000] * 32
+    devw[3] = 0x8000
+    devw[7] = 0
+    devw[12] = 0x4000
+    spec = {"tunables": JEWEL, "buckets": buckets, "rules": rule_cl}
+    bad += gen(spec, "hosts_reweighted_firstn", xs, 3, devw, out)
+    spec = {"tunables": JEWEL, "buckets": buckets, "rules": rule_indep}
+    bad += gen(spec, "hosts_reweighted_indep", xs, 6, devw, out)
+
+    # --- case 6: argonaut tunables (local retries + perm fallback) --------
+    spec = {"tunables": ARGONAUT, "buckets": buckets, "rules": rule_cl}
+    bad += gen(spec, "hosts_argonaut_firstn", xs, 3, [0x10000] * 32, out)
+
+    # --- case 7: firefly (vary_r=1, stable=0) -----------------------------
+    FIREFLY = [0, 0, 50, 1, 1, 0]
+    spec = {"tunables": FIREFLY, "buckets": buckets, "rules": rule_cl}
+    bad += gen(spec, "hosts_firefly_firstn", xs, 3, [0x10000] * 32, out)
+
+    # --- case 8: other bucket algs (flat, choose firstn) ------------------
+    for alg, nm in ((CRUSH_BUCKET_UNIFORM, "uniform"),
+                    (CRUSH_BUCKET_LIST, "list"),
+                    (CRUSH_BUCKET_TREE, "tree"),
+                    (CRUSH_BUCKET_STRAW, "straw")):
+        wts = [0x10000] * 16 if alg == CRUSH_BUCKET_UNIFORM else \
+            [int(w) for w in rng.integers(1, 8, 16) * 0x10000]
+        spec = {"tunables": JEWEL,
+                "buckets": [(alg, 11, items, wts)],
+                "rules": [[(CRUSH_RULE_TAKE, -1, 0),
+                           (CRUSH_RULE_CHOOSE_FIRSTN, 0, 0),
+                           (CRUSH_RULE_EMIT, 0, 0)]]}
+        bad += gen(spec, f"flat_{nm}_firstn", xs, 3, [0x10000] * 16, out)
+
+    # --- case 9: deep tree root->rack->host->osd, indep -------------------
+    buckets9 = []
+    osd = 0
+    rack_ids = []
+    rack_w = []
+    bid = 0
+    for r in range(3):
+        hids, hw = [], []
+        for h in range(3):
+            hitems = list(range(osd, osd + 3))
+            buckets9.append((CRUSH_BUCKET_STRAW2, 1, hitems, [0x10000] * 3))
+            bid += 1
+            hids.append(-bid)
+            hw.append(3 * 0x10000)
+            osd += 3
+        buckets9.append((CRUSH_BUCKET_STRAW2, 3, hids, hw))
+        bid += 1
+        rack_ids.append(-bid)
+        rack_w.append(sum(hw))
+    buckets9.append((CRUSH_BUCKET_STRAW2, 11, rack_ids, rack_w))
+    bid += 1
+    root_id = -bid
+    spec = {"tunables": JEWEL, "buckets": buckets9,
+            "rules": [[(CRUSH_RULE_TAKE, root_id, 0),
+                       (CRUSH_RULE_CHOOSELEAF_INDEP, 0, 3),
+                       (CRUSH_RULE_EMIT, 0, 0)]]}
+    bad += gen(spec, "racks_chooseleaf_indep", xs, 3, [0x10000] * 27, out)
+
+    os.makedirs("tests/fixtures", exist_ok=True)
+    with open("tests/fixtures/crush_vectors.json", "w") as f:
+        json.dump(out, f)
+    print(f"\nwrote {len(out)} cases, total mismatching cases: {bad}")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,15 +17,16 @@ _U32 = 0xFFFFFFFF
 
 def _mix(a, b, c):
     """One crush_hashmix round on uint32 numpy values/arrays."""
-    a = a - b; a = a - c; a = a ^ (c >> np.uint32(13))
-    b = b - c; b = b - a; b = b ^ (a << np.uint32(8))
-    c = c - a; c = c - b; c = c ^ (b >> np.uint32(13))
-    a = a - b; a = a - c; a = a ^ (c >> np.uint32(12))
-    b = b - c; b = b - a; b = b ^ (a << np.uint32(16))
-    c = c - a; c = c - b; c = c ^ (b >> np.uint32(5))
-    a = a - b; a = a - c; a = a ^ (c >> np.uint32(3))
-    b = b - c; b = b - a; b = b ^ (a << np.uint32(10))
-    c = c - a; c = c - b; c = c ^ (b >> np.uint32(15))
+    with np.errstate(over="ignore"):
+        a = a - b; a = a - c; a = a ^ (c >> np.uint32(13))
+        b = b - c; b = b - a; b = b ^ (a << np.uint32(8))
+        c = c - a; c = c - b; c = c ^ (b >> np.uint32(13))
+        a = a - b; a = a - c; a = a ^ (c >> np.uint32(12))
+        b = b - c; b = b - a; b = b ^ (a << np.uint32(16))
+        c = c - a; c = c - b; c = c ^ (b >> np.uint32(5))
+        a = a - b; a = a - c; a = a ^ (c >> np.uint32(3))
+        b = b - c; b = b - a; b = b ^ (a << np.uint32(10))
+        c = c - a; c = c - b; c = c ^ (b >> np.uint32(15))
     return a, b, c
 
 

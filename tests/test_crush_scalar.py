@@ -31,10 +31,18 @@ def test_fixture_case(name):
 
 
 def test_crush_ln_reference_points():
-    # crush_ln(0x10000-1) maps the top of the range to ~2^48
-    assert mapper.crush_ln(0xFFFF) == 0x1000000000000
-    # log2(1) = 0 at input 0
-    assert mapper.crush_ln(0) == 0
+    # Ground truth from the reference crush_ln (src/crush/mapper.c:248)
+    # compiled and executed directly against crush_ln_table.h.
+    for xin, want in [
+        (0, 0),
+        (1, 17592186044416),
+        (12345, 239108530962749),
+        (0x7FFF, 263882790666240),
+        (0x8000, 263883565195424),
+        (0xFFFE, 281474932780304),
+        (0xFFFF, 281474708275200),
+    ]:
+        assert mapper.crush_ln(xin) == want, hex(xin)
 
 
 def test_hash_stability():

@@ -525,6 +525,10 @@ def do_rule(map_: CrushMap, ruleno: int, x: int, result_max: int,
                                  CRUSH_RULE_CHOOSE_FIRSTN)
             recurse_to_leaf = step.op in (CRUSH_RULE_CHOOSELEAF_FIRSTN,
                                           CRUSH_RULE_CHOOSELEAF_INDEP)
+            # each take item writes into its own output segment starting
+            # at position 0 (the C core passes o+osize with j=0,
+            # mapper.c:1038-1070): collision scans and the rep counter
+            # are segment-relative
             o: list[int] = [0] * result_max
             c: list[int] = [0] * result_max
             osize = 0
@@ -539,6 +543,9 @@ def do_rule(map_: CrushMap, ruleno: int, x: int, result_max: int,
                 bucket = map_.bucket(wi)
                 if bucket is None:
                     continue
+                seg = result_max - osize
+                seg_o: list[int] = [0] * seg
+                seg_c: list[int] = [0] * seg
                 if firstn:
                     if choose_leaf_tries:
                         recurse_tries = choose_leaf_tries
@@ -546,20 +553,22 @@ def do_rule(map_: CrushMap, ruleno: int, x: int, result_max: int,
                         recurse_tries = 1
                     else:
                         recurse_tries = choose_tries
-                    osize = choose_firstn(
+                    got = choose_firstn(
                         map_, work, bucket, weight, x, numrep, step.arg2,
-                        o, osize, result_max - osize, choose_tries,
+                        seg_o, 0, seg, choose_tries,
                         recurse_tries, choose_local_retries,
                         choose_local_fallback_retries, recurse_to_leaf,
-                        vary_r, stable, c, 0, choose_args)
+                        vary_r, stable, seg_c, 0, choose_args)
                 else:
-                    out_size = min(numrep, result_max - osize)
+                    got = min(numrep, seg)
                     choose_indep(
-                        map_, work, bucket, weight, x, out_size, numrep,
-                        step.arg2, o, osize, choose_tries,
+                        map_, work, bucket, weight, x, got, numrep,
+                        step.arg2, seg_o, 0, choose_tries,
                         choose_leaf_tries if choose_leaf_tries else 1,
-                        recurse_to_leaf, c, 0, choose_args)
-                    osize += out_size
+                        recurse_to_leaf, seg_c, 0, choose_args)
+                o[osize:osize + got] = seg_o[:got]
+                c[osize:osize + got] = seg_c[:got]
+                osize += got
             if recurse_to_leaf:
                 o[:osize] = c[:osize]
             w = o[:osize]

@@ -1,0 +1,18 @@
+#!/bin/sh
+# Build the reference CRUSH C core as a shared library for fixture
+# generation (scripts/gen_crush_fixtures.py).  Reads the read-only
+# reference tree; writes only to /tmp.
+set -e
+REF=${REF:-/root/reference}
+OUT=/tmp/crush_oracle
+mkdir -p "$OUT"
+: > "$OUT/acconfig.h"   # reference headers include it; empty stub suffices
+gcc -O2 -shared -fPIC \
+    -I"$OUT" -I"$REF/src" -I"$REF/src/crush" \
+    "$(dirname "$0")/crush_oracle_shim.c" \
+    "$REF/src/crush/builder.c" \
+    "$REF/src/crush/mapper.c" \
+    "$REF/src/crush/crush.c" \
+    "$REF/src/crush/hash.c" \
+    -o "$OUT/libcrush_oracle.so" -lm
+echo "built $OUT/libcrush_oracle.so"

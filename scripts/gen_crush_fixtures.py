@@ -258,6 +258,46 @@ def main():
                        (CRUSH_RULE_EMIT, 0, 0)]]}
     bad += gen(spec, "racks_chooseleaf_indep", xs, 3, [0x10000] * 27, out)
 
+    # --- case 10: multi-take choose steps (wsize > 1) ---------------------
+    # Pins the per-take output-segment semantics of the C do_rule loop
+    # (mapper.c:1038-1043 passes o+osize with j=0 for each w[i]).
+    two_level_fn = [[(CRUSH_RULE_TAKE, root_id, 0),
+                     (CRUSH_RULE_CHOOSE_FIRSTN, 2, 3),     # 2 racks
+                     (CRUSH_RULE_CHOOSELEAF_FIRSTN, 2, 1),  # 2 hosts each
+                     (CRUSH_RULE_EMIT, 0, 0)]]
+    two_level_ind = [[(CRUSH_RULE_TAKE, root_id, 0),
+                      (CRUSH_RULE_CHOOSE_INDEP, 2, 3),
+                      (CRUSH_RULE_CHOOSELEAF_INDEP, 2, 1),
+                      (CRUSH_RULE_EMIT, 0, 0)]]
+    for tn_name, tn in (("jewel", JEWEL), ("firefly", [0, 0, 50, 1, 1, 0])):
+        spec = {"tunables": tn, "buckets": buckets9,
+                "rules": two_level_fn}
+        bad += gen(spec, f"two_level_firstn_{tn_name}", xs, 4,
+                   [0x10000] * 27, out)
+        spec = {"tunables": tn, "buckets": buckets9,
+                "rules": two_level_ind}
+        bad += gen(spec, f"two_level_indep_{tn_name}", xs, 4,
+                   [0x10000] * 27, out)
+
+    # --- case 11: choose with numrep <= 0 after adjustment ----------------
+    # w must be emptied even though every take item is skipped
+    # (mapper.c:1010-1015 continue, then o/w swap with osize=0).
+    spec = {"tunables": JEWEL, "buckets": buckets9,
+            "rules": [[(CRUSH_RULE_TAKE, root_id, 0),
+                       (CRUSH_RULE_CHOOSE_FIRSTN, -10, 1),
+                       (CRUSH_RULE_EMIT, 0, 0)]]}
+    bad += gen(spec, "choose_numrep_nonpos", xs[:50], 4, [0x10000] * 27, out)
+
+    # --- case 12: two take/choose/emit rounds in one rule -----------------
+    spec = {"tunables": JEWEL, "buckets": buckets9,
+            "rules": [[(CRUSH_RULE_TAKE, rack_ids[0], 0),
+                       (CRUSH_RULE_CHOOSELEAF_FIRSTN, 2, 1),
+                       (CRUSH_RULE_EMIT, 0, 0),
+                       (CRUSH_RULE_TAKE, rack_ids[1], 0),
+                       (CRUSH_RULE_CHOOSELEAF_FIRSTN, 2, 1),
+                       (CRUSH_RULE_EMIT, 0, 0)]]}
+    bad += gen(spec, "double_take_emit", xs, 4, [0x10000] * 27, out)
+
     os.makedirs("tests/fixtures", exist_ok=True)
     with open("tests/fixtures/crush_vectors.json", "w") as f:
         json.dump(out, f)

@@ -728,10 +728,14 @@ def _do_rule_one(cm: CompiledCrushMap, ruleno: int, result_max: int,
             w_max = (min(result_max, max(w_max * numrep, 1))
                      if numrep > 0 else 0)
         elif step.op == CRUSH_RULE_EMIT:
-            emit = (pos_idx < w_count) & ((rcount + pos_idx) < result_max)
-            dst = jnp.where(emit, rcount + pos_idx, result_max)
-            result = result.at[dst].set(
-                jnp.where(emit, w_items, 0), mode="drop")
+            # gather formulation (result[p] = w[p - rcount] for the
+            # emitted range) rather than a scatter with computed
+            # indices: the scatter form miscompiles on the TPU backend
+            # when o/c are dead after this step (wrong operand survives
+            # fusion/DCE); the gather form is also cheaper
+            src_idx = jnp.clip(pos_idx - rcount, 0, result_max - 1)
+            emit = (pos_idx >= rcount) & ((pos_idx - rcount) < w_count)
+            result = jnp.where(emit, w_items[src_idx], result)
             rcount = jnp.minimum(rcount + w_count, result_max)
             w_items = jnp.zeros((result_max,), dtype=jnp.int32)
             w_count = jnp.int32(0)

@@ -101,9 +101,30 @@ class CrushMap:
     # choose_args sets: name -> {bucket_id: ChooseArg}
     choose_args: dict = field(default_factory=dict)
 
+    # choose_args fallback key (CrushWrapper.h:61)
+    DEFAULT_CHOOSE_ARGS = -1
+
     @property
     def max_buckets(self) -> int:
         return len(self.buckets)
+
+    def find_rule(self, ruleset: int, type_: int, size: int) -> int:
+        """First rule whose mask matches (ref: crush_find_rule
+        src/crush/mapper.c:41-54); -1 when none."""
+        for i, r in enumerate(self.rules):
+            if r is not None and r.mask.ruleset == ruleset and \
+                    r.mask.type == type_ and \
+                    r.mask.min_size <= size <= r.mask.max_size:
+                return i
+        return -1
+
+    def choose_args_get_with_fallback(self, index):
+        """choose_args for index, falling back to DEFAULT_CHOOSE_ARGS
+        (ref: CrushWrapper.h:1438-1449)."""
+        args = self.choose_args.get(index)
+        if args is None:
+            args = self.choose_args.get(self.DEFAULT_CHOOSE_ARGS)
+        return args
 
     def bucket(self, item_id: int) -> CrushBucket | None:
         idx = -1 - item_id

@@ -1,0 +1,267 @@
+"""Full-cluster PG→OSD mapping tables — the batch placement path.
+
+TPU-native replacement for OSDMapMapping/ParallelPGMapper
+(ref: src/osd/OSDMapMapping.{h,cc}): where the reference shards all PGs
+of all pools across a ThreadPool and runs crush per PG, this module
+computes every pool's placements in one vmapped CRUSH dispatch
+(ceph_tpu.crush.batch) and applies the cheap per-PG epilogue steps
+(upmap overrides, up filtering, primary affinity, temp overrides) as
+vectorized numpy passes with sparse per-row fixups.
+
+Falls back to the scalar OSDMap pipeline per pool when the crush map is
+not batchable (legacy bucket algs etc.).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..crush.batch import BatchUnsupported, compile_map
+from ..crush.types import CRUSH_ITEM_NONE
+from .osdmap import (CEPH_OSD_DEFAULT_PRIMARY_AFFINITY, OSDMap)
+from .types import PG
+
+
+@dataclass
+class PoolMapping:
+    """Placement table for one pool: row = pg.ps.
+
+    acting rows may be wider than pool.size (a backfill pg_temp can
+    name more osds than the pool size) or logically shorter (a partial
+    pg_temp on an EC pool); acting_len holds each row's true length."""
+    pool_id: int
+    up: np.ndarray               # (pg_num, size) int32, NONE holes
+    up_primary: np.ndarray       # (pg_num,) int32 (-1 none)
+    acting: np.ndarray           # (pg_num, acting_width) int32
+    acting_primary: np.ndarray   # (pg_num,) int32
+    acting_len: np.ndarray       # (pg_num,) int32 — true row lengths
+    up_len: np.ndarray           # (pg_num,) int32
+
+
+class OSDMapMapping:
+    """Precomputed pg→osd tables + reverse osd→pg map
+    (ref: src/osd/OSDMapMapping.h:170)."""
+
+    def __init__(self) -> None:
+        self.epoch = -1
+        self.pools: dict[int, PoolMapping] = {}
+        self._shift_flags: dict[int, bool] = {}
+        # compiled crush cache shared across pools of one update
+        self._cc_cache: dict = {}
+
+    # ------------------------------------------------------------------
+    def update(self, osdmap: OSDMap, pool_ids=None) -> None:
+        """Recompute tables for the map's current epoch, optionally for
+        a subset of pools (ref: OSDMapMapping.cc:45 update)."""
+        self.pools = {}
+        self._cc_cache = {}
+        for pool_id in sorted(osdmap.pools):
+            if pool_ids is not None and pool_id not in pool_ids:
+                continue
+            self.pools[pool_id] = self._map_pool(osdmap, pool_id)
+        self.epoch = osdmap.epoch
+
+    def get(self, pg: PG) -> tuple[list[int], int, list[int], int]:
+        """(up, up_primary, acting, acting_primary) for one pg; empty
+        results for unknown pools / out-of-range ps, matching
+        OSDMap.pg_to_up_acting_osds."""
+        pm = self.pools.get(pg.pool)
+        if pm is None or not (0 <= pg.ps < len(pm.up)):
+            return [], -1, [], -1
+        shift = self._shift(pg.pool)
+        up_row = pm.up[pg.ps][:pm.up_len[pg.ps]]
+        acting_row = pm.acting[pg.ps][:pm.acting_len[pg.ps]]
+        up = [int(o) for o in up_row
+              if not (shift and o == CRUSH_ITEM_NONE)]
+        acting = [int(o) for o in acting_row
+                  if not (shift and o == CRUSH_ITEM_NONE)]
+        return (up, int(pm.up_primary[pg.ps]),
+                acting, int(pm.acting_primary[pg.ps]))
+
+    def _shift(self, pool_id: int) -> bool:
+        return self._shift_flags[pool_id]
+
+    def get_osd_acting_pgs(self, osd: int) -> list[PG]:
+        """Reverse map (ref: OSDMapMapping.cc:60 _build_rmap)."""
+        out: list[PG] = []
+        for pool_id, pm in self.pools.items():
+            rows = np.nonzero((pm.acting == osd).any(axis=1))[0]
+            out.extend(PG(pool_id, int(ps)) for ps in rows)
+        return out
+
+    def osd_pg_counts(self, n_osd: int, acting: bool = True) -> np.ndarray:
+        """PGs per OSD across all pools (balancer/score input)."""
+        counts = np.zeros(n_osd, dtype=np.int64)
+        for pm in self.pools.values():
+            t = pm.acting if acting else pm.up
+            vals = t[(t != CRUSH_ITEM_NONE) & (t >= 0)]
+            counts += np.bincount(vals, minlength=n_osd)[:n_osd]
+        return counts
+
+    # ------------------------------------------------------------------
+    def _compiled(self, osdmap: OSDMap, pool_id: int):
+        """CompiledCrushMap shared across pools with identical
+        (crush, resolved choose_args) — avoids per-pool re-jits."""
+        args = osdmap.crush.choose_args_get_with_fallback(pool_id)
+        key = (id(osdmap.crush), id(args) if args is not None else None)
+        cc = self._cc_cache.get(key)
+        if cc is None:
+            cc = compile_map(osdmap.crush, choose_args=args)
+            self._cc_cache[key] = cc
+        return cc
+
+    def _map_pool(self, osdmap: OSDMap, pool_id: int) -> PoolMapping:
+        pool = osdmap.pools[pool_id]
+        self._shift_flags[pool_id] = pool.can_shift_osds()
+        npg = pool.pg_num
+        size = pool.size
+        pss = np.arange(npg, dtype=np.int64)
+        pps = pool.raw_pg_to_pps_batch(pss, pool_id)
+        ruleno = osdmap.crush.find_rule(pool.crush_rule, pool.type, size)
+
+        raw = np.full((npg, size), CRUSH_ITEM_NONE, dtype=np.int32)
+        counts = np.zeros(npg, dtype=np.int32)
+        if ruleno >= 0:
+            try:
+                cc = self._compiled(osdmap, pool_id)
+                res, cnt = cc.map_batch(
+                    pps, np.asarray(osdmap.osd_weight, dtype=np.int64),
+                    ruleno=ruleno, result_max=size, return_counts=True)
+                raw = np.asarray(res).copy()
+                counts = np.asarray(cnt).copy()
+            except BatchUnsupported:
+                from ..crush import mapper as crush_mapper
+                ca = osdmap.crush.choose_args_get_with_fallback(pool_id)
+                for ps in range(npg):
+                    r = crush_mapper.do_rule(
+                        osdmap.crush, ruleno, int(pps[ps]), size,
+                        osdmap.osd_weight, choose_args=ca)
+                    raw[ps, :len(r)] = r
+                    counts[ps] = len(r)
+
+        # mask out positions beyond each row's result count
+        col = np.arange(size)
+        raw = np.where(col[None, :] < counts[:, None], raw,
+                       CRUSH_ITEM_NONE)
+
+        state = np.zeros(max(osdmap.max_osd, 1), dtype=np.int64)
+        state[:osdmap.max_osd] = osdmap.osd_state
+        exists = (state & 1) != 0          # CEPH_OSD_EXISTS
+        up_mask = exists & ((state & 2) != 0)  # CEPH_OSD_UP
+
+        def lookup(table: np.ndarray, t: np.ndarray) -> np.ndarray:
+            idx = np.clip(t, 0, len(table) - 1)
+            ok = (t >= 0) & (t < osdmap.max_osd)
+            return np.where(ok, table[idx], False)
+
+        # _remove_nonexistent_osds (OSDMap.cc:2208)
+        valid = raw != CRUSH_ITEM_NONE
+        keep = valid & lookup(exists, raw)
+        raw, counts = self._filter(pool, raw, keep, counts)
+
+        # _raw_to_up_osds (OSDMap.cc:2309)
+        valid = raw != CRUSH_ITEM_NONE
+        keep = valid & lookup(up_mask, raw)
+        up, up_len = self._filter(pool, raw, keep, counts)
+
+        # primary = first non-NONE (OSDMap.cc:2252)
+        up_primary = self._first_valid(up)
+
+        # _apply_primary_affinity (OSDMap.cc:2334) — skip entirely when
+        # all affinities are default, like the reference
+        if osdmap.osd_primary_affinity is not None:
+            aff = np.asarray(osdmap.osd_primary_affinity, dtype=np.int64)
+            if (aff != CEPH_OSD_DEFAULT_PRIMARY_AFFINITY).any():
+                up, up_primary = self._apply_affinity(
+                    osdmap, pool, pps, up, up_primary, aff)
+
+        acting = up.copy()
+        acting_primary = up_primary.copy()
+        acting_len = up_len.copy()
+
+        # sparse overrides (upmap / pg_temp / primary_temp): recompute
+        # those rows through the scalar pipeline wholesale — exactness
+        # guaranteed, and rows may be wider than pool.size (backfill
+        # pg_temp) or shorter (partial temp on an EC pool)
+        special = {
+            pg.ps for src in (osdmap.pg_upmap, osdmap.pg_upmap_items,
+                              osdmap.pg_temp, osdmap.primary_temp)
+            for pg in src if pg.pool == pool_id and pg.ps < npg}
+        if special:
+            rows = {ps: osdmap.pg_to_up_acting_osds(PG(pool_id, ps))
+                    for ps in sorted(special)}
+            width = max([size] + [max(len(r[0]), len(r[2]))
+                                  for r in rows.values()])
+            if width > size:
+                pad = np.full((npg, width - size), CRUSH_ITEM_NONE,
+                              dtype=np.int32)
+                up = np.concatenate([up, pad], axis=1)
+                acting = np.concatenate([acting, pad], axis=1)
+            for ps, (u, upp, a, actp) in rows.items():
+                up[ps] = CRUSH_ITEM_NONE
+                up[ps, :len(u)] = u
+                up_len[ps] = len(u)
+                up_primary[ps] = upp
+                acting[ps] = CRUSH_ITEM_NONE
+                acting[ps, :len(a)] = a
+                acting_len[ps] = len(a)
+                acting_primary[ps] = actp
+
+        return PoolMapping(pool_id, up, up_primary, acting,
+                           acting_primary, acting_len, up_len)
+
+    @staticmethod
+    def _filter(pool, table: np.ndarray, keep: np.ndarray,
+                lengths: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """Drop filtered entries: EC pools keep position (NONE holes,
+        length unchanged); replicated pools compact left and shrink
+        (OSDMap.cc:2211-2231,2311-2331).  Returns (table, lengths)."""
+        out = np.where(keep, table, CRUSH_ITEM_NONE)
+        if not pool.can_shift_osds():
+            return out, lengths.copy()
+        new_len = keep.sum(axis=1).astype(np.int32)
+        rows = np.nonzero((out == CRUSH_ITEM_NONE).any(axis=1))[0]
+        for r in rows:
+            vals = out[r][out[r] != CRUSH_ITEM_NONE]
+            out[r] = CRUSH_ITEM_NONE
+            out[r, :len(vals)] = vals
+        return out, new_len
+
+    @staticmethod
+    def _first_valid(table: np.ndarray) -> np.ndarray:
+        valid = table != CRUSH_ITEM_NONE
+        has = valid.any(axis=1)
+        first = np.argmax(valid, axis=1)
+        prim = table[np.arange(len(table)), first]
+        return np.where(has, prim, -1).astype(np.int32)
+
+    def _apply_affinity(self, osdmap, pool, pps, up, up_primary, aff):
+        """Vectorized _apply_primary_affinity (OSDMap.cc:2334-2387)."""
+        from ..crush.hashes import hash32_2
+        npg, size = up.shape
+        valid = up != CRUSH_ITEM_NONE
+        idx = np.clip(up, 0, len(aff) - 1)
+        a = np.where(valid, aff[idx], CEPH_OSD_DEFAULT_PRIMARY_AFFINITY)
+        any_custom = (a != CEPH_OSD_DEFAULT_PRIMARY_AFFINITY).any(axis=1)
+        # rejection draw per entry
+        draws = hash32_2(np.broadcast_to(pps[:, None], up.shape).ravel(),
+                         up.ravel()).reshape(up.shape).astype(np.int64)
+        reject = valid & (a < 0x10000) & ((draws >> 16) >= a)
+        accept = valid & ~reject
+        has_accept = accept.any(axis=1)
+        first_accept = np.argmax(accept, axis=1)
+        has_valid = valid.any(axis=1)
+        first_valid = np.argmax(valid, axis=1)
+        pos = np.where(has_accept, first_accept,
+                       np.where(has_valid, first_valid, -1))
+        rows = np.nonzero(any_custom & (pos >= 0))[0]
+        up = up.copy()
+        up_primary = up_primary.copy()
+        for r in rows:
+            p = int(pos[r])
+            up_primary[r] = up[r, p]
+            if pool.can_shift_osds() and p > 0:
+                up[r, 1:p + 1] = up[r, 0:p]
+                up[r, 0] = up_primary[r]
+        return up, up_primary

@@ -1,0 +1,237 @@
+"""osdmaptool equivalent: build simple maps and bulk-map all PGs.
+
+CLI port of the reference's test/inspection tool
+(ref: src/tools/osdmaptool.cc: --createsimple :31, --test-map-pgs
+:38,:198, stats block :491-615) with the bulk mapping computed by the
+batched vmapped CRUSH engine (ceph_tpu.osd.mapping.OSDMapMapping)
+instead of a per-PG loop.
+
+Usage:
+  python -m ceph_tpu.tools.osdmaptool --createsimple 100 /tmp/om.json
+  python -m ceph_tpu.tools.osdmaptool /tmp/om.json --test-map-pgs [--pg-num N]
+  python -m ceph_tpu.tools.osdmaptool /tmp/om.json --test-map-pgs-dump
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+import time
+
+import numpy as np
+
+from ..crush.types import CRUSH_ITEM_NONE
+from ..osd.mapping import OSDMapMapping
+from ..osd.osdmap import OSDMap
+from ..osd.types import PG, PGPool
+
+
+def save_map(m: OSDMap, path: str) -> None:
+    """Serialize the placement-relevant state as JSON."""
+    from ..crush.types import CrushBucket, CrushRule
+    data = {
+        "epoch": m.epoch,
+        "max_osd": m.max_osd,
+        "osd_state": m.osd_state,
+        "osd_weight": m.osd_weight,
+        "osd_primary_affinity": m.osd_primary_affinity,
+        "pools": {str(k): vars(p).copy() for k, p in m.pools.items()},
+        "pool_names": {str(k): v for k, v in m.pool_names.items()},
+        "crush": {
+            "tunables": [m.crush.choose_local_tries,
+                         m.crush.choose_local_fallback_tries,
+                         m.crush.choose_total_tries,
+                         m.crush.chooseleaf_descend_once,
+                         m.crush.chooseleaf_vary_r,
+                         m.crush.chooseleaf_stable],
+            "straw_calc_version": m.crush.straw_calc_version,
+            "max_devices": m.crush.max_devices,
+            "buckets": [
+                None if b is None else {
+                    "id": b.id, "type": b.type, "alg": b.alg,
+                    "hash": b.hash, "weight": b.weight,
+                    "items": b.items, "item_weights": b.item_weights,
+                } for b in m.crush.buckets],
+            "rules": [
+                None if r is None else {
+                    "steps": [[s.op, s.arg1, s.arg2] for s in r.steps],
+                    "mask": [r.mask.ruleset, r.mask.type,
+                             r.mask.min_size, r.mask.max_size],
+                } for r in m.crush.rules],
+        },
+    }
+    with open(path, "w") as f:
+        json.dump(data, f)
+
+
+def load_map(path: str) -> OSDMap:
+    from ..crush.types import (CrushBucket, CrushMap, CrushRule,
+                               CrushRuleMask, CrushRuleStep)
+    with open(path) as f:
+        data = json.load(f)
+    m = OSDMap()
+    m.epoch = data["epoch"]
+    m.max_osd = data["max_osd"]
+    m.osd_state = list(data["osd_state"])
+    m.osd_weight = list(data["osd_weight"])
+    m.osd_primary_affinity = data.get("osd_primary_affinity")
+    for k, pd in data["pools"].items():
+        pool = PGPool()
+        for attr, v in pd.items():
+            setattr(pool, attr, v)
+        m.pools[int(k)] = pool
+    m.pool_names = {int(k): v for k, v in data["pool_names"].items()}
+    c = data["crush"]
+    cm = CrushMap()
+    (cm.choose_local_tries, cm.choose_local_fallback_tries,
+     cm.choose_total_tries, cm.chooseleaf_descend_once,
+     cm.chooseleaf_vary_r, cm.chooseleaf_stable) = c["tunables"]
+    cm.straw_calc_version = c["straw_calc_version"]
+    cm.max_devices = c["max_devices"]
+    for bd in c["buckets"]:
+        cm.buckets.append(None if bd is None else CrushBucket(
+            id=bd["id"], type=bd["type"], alg=bd["alg"], hash=bd["hash"],
+            weight=bd["weight"], items=bd["items"],
+            item_weights=bd["item_weights"]))
+    for rd in c["rules"]:
+        if rd is None:
+            cm.rules.append(None)
+        else:
+            cm.rules.append(CrushRule(
+                steps=[CrushRuleStep(*s) for s in rd["steps"]],
+                mask=CrushRuleMask(*rd["mask"])))
+    m.crush = cm
+    return m
+
+
+def test_map_pgs(m: OSDMap, pool_filter: int, pg_num: int,
+                 dump: bool) -> None:
+    """Stats block of osdmaptool.cc:491-615 (same output shape).
+    --pg-num is a test-only override: operates on a clone so the stored
+    map is never mutated (matching the reference tool)."""
+    if pool_filter != -1 and pool_filter not in m.pools:
+        print(f"There is no pool {pool_filter}", file=sys.stderr)
+        raise SystemExit(1)
+    if pg_num > 0:
+        m = m.clone()
+        for pid, pool in m.pools.items():
+            if pool_filter != -1 and pid != pool_filter:
+                continue
+            pool.pg_num = pool.pgp_num = pg_num
+            pool.calc_pg_masks()
+    n = m.max_osd
+    count = np.zeros(n, dtype=np.int64)
+    first_count = np.zeros(n, dtype=np.int64)
+    primary_count = np.zeros(n, dtype=np.int64)
+    size_hist: dict[int, int] = {}
+
+    t0 = time.time()
+    mapping = OSDMapMapping()
+    mapping.update(m, pool_ids=None if pool_filter == -1
+                   else {pool_filter})
+    elapsed = time.time() - t0
+
+    total_pgs = 0
+    for pid, pool in m.pools.items():
+        if pool_filter != -1 and pid != pool_filter:
+            continue
+        print(f"pool {pid} pg_num {pool.pg_num}")
+        pm = mapping.pools[pid]
+        total_pgs += pool.pg_num
+        acting = pm.acting
+        col = np.arange(acting.shape[1])
+        valid = (acting != CRUSH_ITEM_NONE) & (acting >= 0) & \
+            (col[None, :] < pm.acting_len[:, None])
+        vals = acting[valid]
+        count += np.bincount(vals, minlength=n)[:n]
+        sizes = valid.sum(axis=1)
+        for s, c in zip(*np.unique(sizes, return_counts=True)):
+            size_hist[int(s)] = size_hist.get(int(s), 0) + int(c)
+        has = valid.any(axis=1)
+        firsts = acting[np.arange(len(acting)),
+                        np.argmax(valid, axis=1)][has]
+        first_count += np.bincount(firsts, minlength=n)[:n]
+        prims = pm.acting_primary[pm.acting_primary >= 0]
+        primary_count += np.bincount(prims, minlength=n)[:n]
+        if dump:
+            for ps in range(pool.pg_num):
+                osds = [int(o) for o in acting[ps][valid[ps]]]
+                print(f"{pid}.{ps:x}\t{osds}\t{pm.acting_primary[ps]}")
+
+    print("#osd\tcount\tfirst\tprimary\tc wt\twt")
+    in_osds = [i for i in range(n)
+               if m.is_in(i) and m.osd_weight[i] > 0]
+    for i in in_osds:
+        print(f"osd.{i}\t{count[i]}\t{first_count[i]}\t"
+              f"{primary_count[i]}\t1.0\t{m.osd_weight[i] / 0x10000:g}")
+    n_in = len(in_osds)
+    total = int(count[in_osds].sum()) if in_osds else 0
+    avg = total // n_in if n_in else 0
+    dev = math.sqrt(sum((avg - int(count[i])) ** 2
+                        for i in in_osds) / n_in) if n_in else 0.0
+    edev = math.sqrt(total / n_in * (1.0 - 1.0 / n_in)) if n_in else 0.0
+    print(f" in {n_in}")
+    if avg:
+        print(f" avg {avg} stddev {dev:g} ({dev / avg:g}x) "
+              f"(expected {edev:g} {edev / avg:g}x))")
+    nz = count[in_osds]
+    if n_in and nz.any():
+        min_i = in_osds[int(np.argmin(np.where(nz > 0, nz, nz.max() + 1)))]
+        max_i = in_osds[int(np.argmax(nz))]
+        print(f" min osd.{min_i} {count[min_i]}")
+        print(f" max osd.{max_i} {count[max_i]}")
+    for s in sorted(size_hist):
+        print(f"size {s}\t{size_hist[s]}")
+    rate = total_pgs / elapsed if elapsed > 0 else float("inf")
+    print(f"mapped {total_pgs} pgs in {elapsed:.3f}s "
+          f"({rate:,.0f} pg/s)", file=sys.stderr)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="osdmaptool")
+    ap.add_argument("mapfile")
+    ap.add_argument("--createsimple", type=int, metavar="N")
+    ap.add_argument("--osds-per-host", type=int, default=4)
+    ap.add_argument("--pg-num", type=int, default=0)
+    ap.add_argument("--pool", type=int, default=-1)
+    ap.add_argument("--test-map-pgs", action="store_true")
+    ap.add_argument("--test-map-pgs-dump", action="store_true")
+    ap.add_argument("--mark-down", type=int, action="append", default=[],
+                    metavar="OSD")
+    ap.add_argument("--mark-out", type=int, action="append", default=[],
+                    metavar="OSD")
+    args = ap.parse_args(argv)
+
+    if args.createsimple:
+        m = OSDMap()
+        pool = PGPool(pg_num=args.pg_num or max(64, args.createsimple * 4),
+                      pgp_num=args.pg_num or max(64, args.createsimple * 4))
+        m.build_simple(args.createsimple, pool,
+                       osds_per_host=args.osds_per_host)
+        save_map(m, args.mapfile)
+        print(f"osdmaptool: writing epoch {m.epoch} to {args.mapfile}")
+        return 0
+
+    try:
+        m = load_map(args.mapfile)
+    except FileNotFoundError:
+        print(f"osdmaptool: error opening {args.mapfile}: "
+              "no such file or directory", file=sys.stderr)
+        return 1
+    changed = False
+    for osd in args.mark_down:
+        m.osd_state[osd] &= ~2
+        changed = True
+    for osd in args.mark_out:
+        m.osd_weight[osd] = 0
+        changed = True
+    if args.test_map_pgs or args.test_map_pgs_dump:
+        test_map_pgs(m, args.pool, args.pg_num, args.test_map_pgs_dump)
+    if changed:
+        save_map(m, args.mapfile)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,32 @@
+"""TPU-backend parity sweep: run the batch CRUSH engine on the real
+TPU (or whatever jax.default_backend() resolves) and compare against the
+scalar oracle.  CI runs on CPU only; run this on hardware after any
+batch-engine change — the EMIT scatter miscompile (fixed by the gather
+formulation in batch.py) was only visible here.
+
+Usage: python scripts/tpu_parity_sweep.py"""
+import numpy as np, jax
+import os, sys; sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+print("backend:", jax.default_backend())
+from ceph_tpu.crush.batch import compile_map
+from ceph_tpu.crush import mapper
+from tests.test_crush_batch import build_hierarchy, RULES, make_weight
+from ceph_tpu.crush.types import CrushRule
+bad = 0
+for rule_name in sorted(RULES):
+    for tun in ("jewel", "firefly"):
+        m, root = build_hierarchy(seed=11, tunables=tun)
+        m.rules.append(CrushRule(steps=RULES[rule_name](root)))
+        cc = compile_map(m)
+        w = make_weight(m.max_devices, seed=1)
+        rm = 6 if rule_name == "ec_indep" else 4
+        res, cnt = cc.map_batch(range(60), w, ruleno=0, result_max=rm, return_counts=True)
+        res, cnt = np.asarray(res), np.asarray(cnt)
+        mm = 0
+        for x in range(60):
+            want = mapper.do_rule(m, 0, x, rm, list(w))
+            if list(res[x][:cnt[x]]) != want:
+                mm += 1
+        print(f"{rule_name}/{tun}: {'OK' if mm==0 else f'{mm}/60 MISMATCH'}")
+        bad += mm
+print("TOTAL MISMATCHES:", bad)

@@ -1,0 +1,322 @@
+"""OSDMap layer: types (hashes, masks), mapping pipeline, incrementals,
+and the batched OSDMapMapping vs the scalar pipeline.
+
+String-hash expectations are pinned from the reference C implementation
+(src/common/ceph_hash.cc) compiled and executed directly."""
+import numpy as np
+import pytest
+
+from ceph_tpu.crush.types import (
+    CRUSH_BUCKET_STRAW2, CRUSH_ITEM_NONE, CRUSH_RULE_CHOOSELEAF_INDEP,
+    CRUSH_RULE_EMIT, CRUSH_RULE_TAKE, CrushBucket, CrushRule, CrushRuleMask,
+    CrushRuleStep,
+)
+from ceph_tpu.osd.mapping import OSDMapMapping
+from ceph_tpu.osd.osdmap import (
+    CEPH_OSD_EXISTS, CEPH_OSD_IN, CEPH_OSD_UP, Incremental, OSDMap,
+)
+from ceph_tpu.osd.types import (
+    PG, PGPool, POOL_TYPE_ERASURE, ceph_stable_mod, ceph_str_hash_linux,
+    ceph_str_hash_rjenkins,
+)
+
+# ---------------------------------------------------------------------------
+# types
+
+
+def test_str_hashes_match_reference():
+    # pinned from compiled src/common/ceph_hash.cc
+    cases = {
+        "": (3175731469, 0),
+        "a": (703514648, 17138),
+        "foo": (2143417350, 2415402),
+        "object_123": (1246825749, 3060838109),
+        "rbd_data.1234567890ab.0000000000000000":
+            (307695439, 3910085835),
+        "a-somewhat-longer-object-name-to-cross-12-byte-blocks":
+            (4272807215, 3250342182),
+        "ns\x1fobj": (1307998275, 3435895518),
+    }
+    for s, (rj, lx) in cases.items():
+        assert ceph_str_hash_rjenkins(s.encode()) == rj, s
+        assert ceph_str_hash_linux(s.encode()) == lx, s
+
+
+def test_stable_mod_non_power_of_two():
+    # pg_num=12 -> mask=15: ps in [0,12) maps to itself, 12..15 fold
+    for ps in range(12):
+        assert ceph_stable_mod(ps, 12, 15) == ps
+    for ps in range(12, 16):
+        assert ceph_stable_mod(ps, 12, 15) == (ps & 7)
+
+
+def test_pool_masks():
+    p = PGPool(pg_num=12, pgp_num=12)
+    assert p.pg_num_mask == 15
+    p2 = PGPool(pg_num=64, pgp_num=64)
+    assert p2.pg_num_mask == 63
+
+
+def test_hash_key_namespace_separator():
+    p = PGPool()
+    assert p.hash_key("obj", "ns") == ceph_str_hash_rjenkins(b"ns\x1fobj")
+    assert p.hash_key("obj") == ceph_str_hash_rjenkins(b"obj")
+
+
+# ---------------------------------------------------------------------------
+# osdmap pipeline
+
+
+def make_map(n_osd=16, pg_num=64, osds_per_host=4):
+    m = OSDMap()
+    m.build_simple(n_osd, PGPool(pg_num=pg_num, pgp_num=pg_num),
+                   osds_per_host=osds_per_host)
+    return m
+
+
+def add_ec_pool(m, pool_id=1, k=4, mm=2, pg_num=32):
+    size = k + mm
+    root = None
+    for b in m.crush.buckets:
+        if b is not None and b.type == 10:
+            root = b.id
+    rule = CrushRule(
+        steps=[CrushRuleStep(CRUSH_RULE_TAKE, root),
+               CrushRuleStep(CRUSH_RULE_CHOOSELEAF_INDEP, size, 1),
+               CrushRuleStep(CRUSH_RULE_EMIT)],
+        mask=CrushRuleMask(ruleset=1, type=POOL_TYPE_ERASURE,
+                           min_size=1, max_size=16))
+    m.crush.rules.append(rule)
+    m.pools[pool_id] = PGPool(type=POOL_TYPE_ERASURE, size=size,
+                              min_size=k + 1, crush_rule=1,
+                              pg_num=pg_num, pgp_num=pg_num)
+    m.pool_names[pool_id] = "ecpool"
+    return pool_id
+
+
+def test_object_to_pg_to_osds():
+    m = make_map()
+    pg = m.object_locator_to_pg("myobject", 0)
+    pool = m.pools[0]
+    up, up_p, acting, acting_p = m.pg_to_up_acting_osds(
+        pool.raw_pg_to_pg(pg))
+    assert len(up) == pool.size
+    assert up_p == up[0]
+    assert acting == up
+    assert len(set(up)) == len(up)  # distinct osds
+
+
+def test_mapping_requires_matching_rule_mask():
+    # an EC pool pointing at a replicated-mask rule maps to nothing
+    m = make_map()
+    m.pools[2] = PGPool(type=POOL_TYPE_ERASURE, size=6, crush_rule=0,
+                        pg_num=8, pgp_num=8)
+    up, up_p, acting, acting_p = m.pg_to_up_acting_osds(PG(2, 0))
+    assert up == [] and up_p == -1
+
+
+def test_ec_pool_positional_holes():
+    # one osd per host so 6 EC shards over 8 hosts are placeable
+    m = make_map(n_osd=8, osds_per_host=1)
+    pid = add_ec_pool(m, k=4, mm=2)
+    # take one osd down: EC pools keep the hole positional
+    down = None
+    for ps in range(32):
+        up, _, _, _ = m.pg_to_up_acting_osds(PG(pid, ps))
+        assert len(up) == 6
+        if down is None:
+            down = up[2]
+    m.osd_state[down] &= ~CEPH_OSD_UP
+    saw_hole = False
+    for ps in range(32):
+        up, _, _, _ = m.pg_to_up_acting_osds(PG(pid, ps))
+        assert len(up) == 6
+        assert down not in up
+        if CRUSH_ITEM_NONE in up:
+            saw_hole = True
+    assert saw_hole
+
+
+def test_replicated_pool_shifts_down_osds():
+    m = make_map(n_osd=8)
+    m.osd_state[3] &= ~CEPH_OSD_UP
+    for ps in range(64):
+        up, _, _, _ = m.pg_to_up_acting_osds(PG(0, ps))
+        assert 3 not in up
+        assert CRUSH_ITEM_NONE not in up
+
+
+def test_upmap_items_remap():
+    m = make_map(n_osd=8)
+    pg = PG(0, 5)
+    up0, _, _, _ = m.pg_to_up_acting_osds(pg)
+    src = up0[1]
+    # pick a target not already in the set
+    tgt = next(o for o in range(8) if o not in up0)
+    m.pg_upmap_items[pg] = [(src, tgt)]
+    up1, _, _, _ = m.pg_to_up_acting_osds(pg)
+    assert up1 == [tgt if o == src else o for o in up0]
+
+
+def test_upmap_explicit_rejected_when_target_out():
+    m = make_map(n_osd=8)
+    pg = PG(0, 7)
+    up0, _, _, _ = m.pg_to_up_acting_osds(pg)
+    tgt = next(o for o in range(8) if o not in up0)
+    other = next(o for o in range(8) if o not in up0 and o != tgt)
+    m.osd_weight[tgt] = 0  # marked out
+    m.pg_upmap[pg] = [tgt] + up0[1:]
+    # items would remap up0[0]->other, but the reference returns early
+    # when the explicit upmap is rejected (OSDMap.cc:2271)
+    m.pg_upmap_items[pg] = [(up0[0], other)]
+    up1, _, _, _ = m.pg_to_up_acting_osds(pg)
+    # out-weight osd gets filtered by CRUSH is_out though; the raw
+    # mapping must be untouched by BOTH upmap forms
+    assert tgt not in up1
+    assert other not in up1
+
+
+def test_pg_temp_overrides_acting():
+    m = make_map(n_osd=8)
+    pg = PG(0, 3)
+    up0, upp0, _, _ = m.pg_to_up_acting_osds(pg)
+    temp = [o for o in range(8) if o not in up0][:3]
+    m.pg_temp[pg] = temp
+    up1, upp1, acting1, actp1 = m.pg_to_up_acting_osds(pg)
+    assert up1 == up0 and upp1 == upp0  # up unaffected
+    assert acting1 == temp
+    assert actp1 == temp[0]
+    m.primary_temp[pg] = temp[1]
+    _, _, _, actp2 = m.pg_to_up_acting_osds(pg)
+    assert actp2 == temp[1]
+
+
+def test_primary_affinity_zero_never_primary():
+    m = make_map(n_osd=8)
+    pg = PG(0, 9)
+    up0, upp0, _, _ = m.pg_to_up_acting_osds(pg)
+    m.set_primary_affinity(upp0, 0)
+    up1, upp1, _, _ = m.pg_to_up_acting_osds(pg)
+    assert upp1 != upp0
+    assert upp1 in up0
+    # replicated pool: new primary shifted to front
+    assert up1[0] == upp1
+
+
+def test_incremental_application():
+    m = make_map(n_osd=8)
+    pg = PG(0, 1)
+    inc = Incremental(epoch=2)
+    inc.new_down_osds.append(2)
+    inc.new_weight[5] = 0
+    inc.new_pg_temp[pg] = [6, 7, 1]
+    m.apply_incremental(inc)
+    assert m.epoch == 2
+    assert m.is_down(2)
+    assert m.is_out(5)
+    _, _, acting, _ = m.pg_to_up_acting_osds(pg)
+    assert acting == [6, 7, 1]
+    # removal via empty list
+    inc2 = Incremental(epoch=3)
+    inc2.new_pg_temp[pg] = []
+    m.apply_incremental(inc2)
+    assert pg not in m.pg_temp
+    with pytest.raises(ValueError):
+        m.apply_incremental(Incremental(epoch=10))
+
+
+# ---------------------------------------------------------------------------
+# batched mapping vs scalar pipeline
+
+
+def scramble(m, seed=0):
+    rng = np.random.default_rng(seed)
+    for osd in rng.choice(m.max_osd, m.max_osd // 8, replace=False):
+        m.osd_state[osd] &= ~CEPH_OSD_UP
+    for osd in rng.choice(m.max_osd, m.max_osd // 8, replace=False):
+        m.osd_weight[osd] = int(rng.integers(0, 0x10000))
+    return m
+
+
+@pytest.mark.parametrize("with_affinity", [False, True])
+def test_mapping_matches_scalar(with_affinity):
+    m = make_map(n_osd=32, pg_num=128)
+    pid = add_ec_pool(m, k=4, mm=2, pg_num=64)
+    scramble(m, seed=4)
+    # sparse overrides on both pools
+    m.pg_upmap_items[PG(0, 11)] = [(1, 2)]
+    m.pg_temp[PG(0, 5)] = [9, 10, 11]
+    m.primary_temp[PG(pid, 6)] = 9
+    if with_affinity:
+        m.set_primary_affinity(1, 0x8000)
+        m.set_primary_affinity(4, 0)
+    mapping = OSDMapMapping()
+    mapping.update(m)
+    for pool_id, pool in m.pools.items():
+        for ps in range(pool.pg_num):
+            pg = PG(pool_id, ps)
+            want = m.pg_to_up_acting_osds(pg)
+            got = mapping.get(pg)
+            assert got == want, f"pg {pg}: {got} != {want}"
+
+
+def test_reverse_map_and_counts():
+    m = make_map(n_osd=16, pg_num=64)
+    mapping = OSDMapMapping()
+    mapping.update(m)
+    counts = mapping.osd_pg_counts(m.max_osd)
+    assert counts.sum() == 64 * m.pools[0].size
+    for osd in range(4):
+        pgs = mapping.get_osd_acting_pgs(osd)
+        # an osd appears at most once per PG, so the reverse map length
+        # equals its acting-PG count
+        assert len(pgs) == counts[osd]
+        for pg in pgs:
+            _, _, acting, _ = m.pg_to_up_acting_osds(pg)
+            assert osd in acting
+
+
+# ---------------------------------------------------------------------------
+# osdmaptool CLI (cram-style, ref: src/test/cli/osdmaptool/*.t)
+
+
+def test_osdmaptool_cli(tmp_path, capsys):
+    from ceph_tpu.tools import osdmaptool
+    mapfile = str(tmp_path / "om.json")
+    assert osdmaptool.main(["--createsimple", "16", mapfile]) == 0
+    out = capsys.readouterr().out
+    assert "writing epoch 1" in out
+    assert osdmaptool.main([mapfile, "--test-map-pgs", "--pg-num", "32"]) == 0
+    out = capsys.readouterr().out
+    assert "pool 0 pg_num 32" in out
+    assert "#osd\tcount\tfirst\tprimary" in out
+    assert " in 16" in out
+    assert "size 3\t32" in out
+    # round-trip: loaded map equals built map placements
+    m = osdmaptool.load_map(mapfile)
+    up, upp, acting, actp = m.pg_to_up_acting_osds(PG(0, 0))
+    assert len(up) == 3 and upp == up[0]
+
+
+def test_mapping_temp_width_and_bounds():
+    # backfill pg_temp longer than pool size, and partial temp on EC
+    m = make_map(n_osd=16, pg_num=32)
+    pid = add_ec_pool(m, k=4, mm=2, pg_num=16)
+    m.pg_temp[PG(0, 1)] = [0, 1, 2, 4]        # wider than size 3
+    m.pg_temp[PG(pid, 3)] = [0, 1]            # shorter than size 6
+    mapping = OSDMapMapping()
+    mapping.update(m)
+    for pg in (PG(0, 1), PG(pid, 3)):
+        assert mapping.get(pg) == m.pg_to_up_acting_osds(pg)
+    # out-of-range / unknown pool behave like the scalar pipeline
+    assert mapping.get(PG(0, 999)) == ([], -1, [], -1)
+    assert mapping.get(PG(77, 0)) == ([], -1, [], -1)
+    assert mapping.get(PG(0, -1)) == ([], -1, [], -1)
+
+
+def test_mapping_pool_filter():
+    m = make_map(n_osd=16, pg_num=32)
+    add_ec_pool(m, pool_id=1, pg_num=16)
+    mapping = OSDMapMapping()
+    mapping.update(m, pool_ids={1})
+    assert set(mapping.pools) == {1}

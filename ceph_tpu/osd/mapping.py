@@ -47,19 +47,25 @@ class OSDMapMapping:
         self.epoch = -1
         self.pools: dict[int, PoolMapping] = {}
         self._shift_flags: dict[int, bool] = {}
+        self._fold_params: dict[int, tuple[int, int]] = {}
         # compiled crush cache shared across pools of one update
         self._cc_cache: dict = {}
 
     # ------------------------------------------------------------------
     def update(self, osdmap: OSDMap, pool_ids=None) -> None:
-        """Recompute tables for the map's current epoch, optionally for
-        a subset of pools (ref: OSDMapMapping.cc:45 update)."""
-        self.pools = {}
+        """Recompute tables for the map's current epoch.  With pool_ids
+        given, only those pools are recomputed in place and other pools'
+        tables are kept (ref: OSDMapMapping.cc:45 update(map) /
+        update(map, pool))."""
         self._cc_cache = {}
-        for pool_id in sorted(osdmap.pools):
-            if pool_ids is not None and pool_id not in pool_ids:
-                continue
-            self.pools[pool_id] = self._map_pool(osdmap, pool_id)
+        if pool_ids is None:
+            self.pools = {}
+            pool_ids = set(osdmap.pools)
+        for pool_id in sorted(pool_ids):
+            if pool_id in osdmap.pools:
+                self.pools[pool_id] = self._map_pool(osdmap, pool_id)
+            else:
+                self.pools.pop(pool_id, None)
         self.epoch = osdmap.epoch
 
     def get(self, pg: PG) -> tuple[list[int], int, list[int], int]:
@@ -67,7 +73,11 @@ class OSDMapMapping:
         results for unknown pools / out-of-range ps, matching
         OSDMap.pg_to_up_acting_osds."""
         pm = self.pools.get(pg.pool)
-        if pm is None or not (0 <= pg.ps < len(pm.up)):
+        if pm is None:
+            return [], -1, [], -1
+        # fold a raw ps the same way the scalar pipeline does
+        pg = PG(pg.pool, self._fold(pg.pool, pg.ps & 0xFFFFFFFF))
+        if not (0 <= pg.ps < len(pm.up)):
             return [], -1, [], -1
         shift = self._shift(pg.pool)
         up_row = pm.up[pg.ps][:pm.up_len[pg.ps]]
@@ -81,6 +91,11 @@ class OSDMapMapping:
 
     def _shift(self, pool_id: int) -> bool:
         return self._shift_flags[pool_id]
+
+    def _fold(self, pool_id: int, ps: int) -> int:
+        """ceph_stable_mod with the pool's pg mask (raw_pg_to_pg)."""
+        pg_num, mask = self._fold_params[pool_id]
+        return ps & mask if (ps & mask) < pg_num else ps & (mask >> 1)
 
     def get_osd_acting_pgs(self, osd: int) -> list[PG]:
         """Reverse map (ref: OSDMapMapping.cc:60 _build_rmap)."""
@@ -114,6 +129,7 @@ class OSDMapMapping:
     def _map_pool(self, osdmap: OSDMap, pool_id: int) -> PoolMapping:
         pool = osdmap.pools[pool_id]
         self._shift_flags[pool_id] = pool.can_shift_osds()
+        self._fold_params[pool_id] = (pool.pg_num, pool.pg_num_mask)
         npg = pool.pg_num
         size = pool.size
         pss = np.arange(npg, dtype=np.int64)
@@ -221,11 +237,9 @@ class OSDMapMapping:
         if not pool.can_shift_osds():
             return out, lengths.copy()
         new_len = keep.sum(axis=1).astype(np.int32)
-        rows = np.nonzero((out == CRUSH_ITEM_NONE).any(axis=1))[0]
-        for r in rows:
-            vals = out[r][out[r] != CRUSH_ITEM_NONE]
-            out[r] = CRUSH_ITEM_NONE
-            out[r, :len(vals)] = vals
+        # vectorized stable left-compaction: NONE entries sort last
+        order = np.argsort(out == CRUSH_ITEM_NONE, axis=1, kind="stable")
+        out = np.take_along_axis(out, order, axis=1)
         return out, new_len
 
     @staticmethod

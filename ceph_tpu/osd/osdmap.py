@@ -273,12 +273,16 @@ class OSDMap:
         raw, _ = self._pg_to_raw_osds(pool, pg)
         return raw, self._pick_primary(raw)
 
-    def pg_to_up_acting_osds(self, pg: PG) \
+    def pg_to_up_acting_osds(self, pg: PG, raw_pg_to_pg: bool = True) \
             -> tuple[list[int], int, list[int], int]:
         """OSDMap.cc:2462-2510 _pg_to_up_acting_osds; returns
-        (up, up_primary, acting, acting_primary)."""
+        (up, up_primary, acting, acting_primary).  With raw_pg_to_pg
+        (the default, like the reference) the ps may be a raw hash —
+        every stage folds it; with False the ps must already be folded
+        into [0, pg_num)."""
+        pg = PG(pg.pool, pg.ps & 0xFFFFFFFF)  # ps_t is u32
         pool = self.pools.get(pg.pool)
-        if pool is None or pg.ps >= pool.pg_num:
+        if pool is None or (not raw_pg_to_pg and pg.ps >= pool.pg_num):
             return [], -1, [], -1
         acting, acting_primary = self._get_temp_osds(pool, pg)
         raw, pps = self._pg_to_raw_osds(pool, pg)

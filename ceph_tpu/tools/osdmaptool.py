@@ -29,7 +29,7 @@ from ..osd.types import PG, PGPool
 
 def save_map(m: OSDMap, path: str) -> None:
     """Serialize the placement-relevant state as JSON."""
-    from ..crush.types import CrushBucket, CrushRule
+    from ..crush.types import ChooseArg
     data = {
         "epoch": m.epoch,
         "max_osd": m.max_osd,
@@ -38,6 +38,21 @@ def save_map(m: OSDMap, path: str) -> None:
         "osd_primary_affinity": m.osd_primary_affinity,
         "pools": {str(k): vars(p).copy() for k, p in m.pools.items()},
         "pool_names": {str(k): v for k, v in m.pool_names.items()},
+        "pool_max": m.pool_max,
+        "pg_upmap": [[pg.pool, pg.ps, osds]
+                     for pg, osds in m.pg_upmap.items()],
+        "pg_upmap_items": [[pg.pool, pg.ps, [list(p) for p in items]]
+                           for pg, items in m.pg_upmap_items.items()],
+        "pg_temp": [[pg.pool, pg.ps, osds]
+                    for pg, osds in m.pg_temp.items()],
+        "primary_temp": [[pg.pool, pg.ps, p]
+                         for pg, p in m.primary_temp.items()],
+        "erasure_code_profiles": m.erasure_code_profiles,
+        "choose_args": {
+            str(name): {
+                str(bid): {"ids": arg.ids, "weight_set": arg.weight_set}
+                for bid, arg in args.items()}
+            for name, args in m.crush.choose_args.items()},
         "crush": {
             "tunables": [m.crush.choose_local_tries,
                          m.crush.choose_local_fallback_tries,
@@ -66,7 +81,7 @@ def save_map(m: OSDMap, path: str) -> None:
 
 
 def load_map(path: str) -> OSDMap:
-    from ..crush.types import (CrushBucket, CrushMap, CrushRule,
+    from ..crush.types import (ChooseArg, CrushBucket, CrushMap, CrushRule,
                                CrushRuleMask, CrushRuleStep)
     with open(path) as f:
         data = json.load(f)
@@ -82,6 +97,16 @@ def load_map(path: str) -> OSDMap:
             setattr(pool, attr, v)
         m.pools[int(k)] = pool
     m.pool_names = {int(k): v for k, v in data["pool_names"].items()}
+    m.pool_max = data.get("pool_max", max(m.pools, default=-1))
+    for pool, ps, osds in data.get("pg_upmap", []):
+        m.pg_upmap[PG(pool, ps)] = list(osds)
+    for pool, ps, items in data.get("pg_upmap_items", []):
+        m.pg_upmap_items[PG(pool, ps)] = [tuple(p) for p in items]
+    for pool, ps, osds in data.get("pg_temp", []):
+        m.pg_temp[PG(pool, ps)] = list(osds)
+    for pool, ps, p in data.get("primary_temp", []):
+        m.primary_temp[PG(pool, ps)] = p
+    m.erasure_code_profiles = data.get("erasure_code_profiles", {})
     c = data["crush"]
     cm = CrushMap()
     (cm.choose_local_tries, cm.choose_local_fallback_tries,
@@ -145,7 +170,9 @@ def test_map_pgs(m: OSDMap, pool_filter: int, pg_num: int,
             (col[None, :] < pm.acting_len[:, None])
         vals = acting[valid]
         count += np.bincount(vals, minlength=n)[:n]
-        sizes = valid.sum(axis=1)
+        # reference counts the acting vector length incl. NONE holes
+        # (osdmaptool.cc:534 size[osds.size()]++)
+        sizes = pm.acting_len
         for s, c in zip(*np.unique(sizes, return_counts=True)):
             size_hist[int(s)] = size_hist.get(int(s), 0) + int(c)
         has = valid.any(axis=1)

@@ -1,0 +1,18 @@
+"""Foundation layer: typed config, perf counters, leveled logging.
+
+TPU-native analogue of the reference's `src/common/` foundation
+(ref: src/common/options.cc schema, src/common/config.cc apply logic,
+src/common/perf_counters.h:150, src/common/debug.h:23).
+"""
+from .options import Option, OptionLevel, OptionType, Config, OPTIONS, \
+    global_config
+from .perf_counters import PerfCounters, PerfCountersCollection, \
+    global_perf
+from .log import dout, set_subsys_level
+
+__all__ = [
+    "Option", "OptionLevel", "OptionType", "Config", "OPTIONS",
+    "global_config",
+    "PerfCounters", "PerfCountersCollection", "global_perf",
+    "dout", "set_subsys_level",
+]

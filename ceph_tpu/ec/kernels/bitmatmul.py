@@ -83,17 +83,19 @@ class GFMatmul:
         self.bitmat = jnp.asarray(
             companion_bitmatrix(mat.tobytes(), self.r, self.k))
         if use_pallas is None:
-            use_pallas = jax.default_backend() == "tpu"
+            # config-selected backend; pallas only makes sense on TPU.
+            # Measured: the XLA formulation beats the current Pallas
+            # kernel (PERF_NOTES.md), so the schema default is "xla".
+            from ...common.options import global_config
+            use_pallas = (global_config()["ec_tpu_backend"] == "pallas"
+                          and jax.default_backend() == "tpu")
         self.use_pallas = use_pallas
 
     def __call__(self, data) -> jax.Array:
         """data: (..., k, N) uint8 (device or host) -> (..., r, N) uint8."""
         data = jnp.asarray(data, dtype=jnp.uint8)
         if self.use_pallas:
-            try:
-                return gf_matmul_pallas(self.bitmat, data)
-            except Exception:  # pragma: no cover - fallback guard
-                self.use_pallas = False
+            return gf_matmul_pallas(self.bitmat, data)
         return gf_matmul_xla(self.bitmat, data)
 
 

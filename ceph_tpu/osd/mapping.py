@@ -47,7 +47,6 @@ class OSDMapMapping:
         self.epoch = -1
         self.pools: dict[int, PoolMapping] = {}
         self._shift_flags: dict[int, bool] = {}
-        self._fold_params: dict[int, tuple[int, int]] = {}
         # compiled crush cache shared across pools of one update
         self._cc_cache: dict = {}
 
@@ -70,13 +69,15 @@ class OSDMapMapping:
 
     def get(self, pg: PG) -> tuple[list[int], int, list[int], int]:
         """(up, up_primary, acting, acting_primary) for one pg; empty
-        results for unknown pools / out-of-range ps, matching
-        OSDMap.pg_to_up_acting_osds."""
+        results for unknown pools / out-of-range ps.
+
+        The tables are indexed by *actual* pg ids (ps already in
+        [0, pg_num)); a raw/out-of-range ps is the caller's bug, so it
+        is rejected rather than folded (ref: OSDMapMapping.h:294
+        ceph_assert(pgid.ps() < p->second.pg_num), which never folds)."""
         pm = self.pools.get(pg.pool)
         if pm is None:
             return [], -1, [], -1
-        # fold a raw ps the same way the scalar pipeline does
-        pg = PG(pg.pool, self._fold(pg.pool, pg.ps & 0xFFFFFFFF))
         if not (0 <= pg.ps < len(pm.up)):
             return [], -1, [], -1
         shift = self._shift(pg.pool)
@@ -91,11 +92,6 @@ class OSDMapMapping:
 
     def _shift(self, pool_id: int) -> bool:
         return self._shift_flags[pool_id]
-
-    def _fold(self, pool_id: int, ps: int) -> int:
-        """ceph_stable_mod with the pool's pg mask (raw_pg_to_pg)."""
-        pg_num, mask = self._fold_params[pool_id]
-        return ps & mask if (ps & mask) < pg_num else ps & (mask >> 1)
 
     def get_osd_acting_pgs(self, osd: int) -> list[PG]:
         """Reverse map (ref: OSDMapMapping.cc:60 _build_rmap)."""
@@ -129,7 +125,6 @@ class OSDMapMapping:
     def _map_pool(self, osdmap: OSDMap, pool_id: int) -> PoolMapping:
         pool = osdmap.pools[pool_id]
         self._shift_flags[pool_id] = pool.can_shift_osds()
-        self._fold_params[pool_id] = (pool.pg_num, pool.pg_num_mask)
         npg = pool.pg_num
         size = pool.size
         pss = np.arange(npg, dtype=np.int64)

@@ -29,7 +29,6 @@ from ..osd.types import PG, PGPool
 
 def save_map(m: OSDMap, path: str) -> None:
     """Serialize the placement-relevant state as JSON."""
-    from ..crush.types import ChooseArg
     data = {
         "epoch": m.epoch,
         "max_osd": m.max_osd,
@@ -126,6 +125,17 @@ def load_map(path: str) -> OSDMap:
             cm.rules.append(CrushRule(
                 steps=[CrushRuleStep(*s) for s in rd["steps"]],
                 mask=CrushRuleMask(*rd["mask"])))
+    for name, args in data.get("choose_args", {}).items():
+        # JSON stringifies the keys; choose_args names are ints in
+        # practice (incl. the -1 DEFAULT_CHOOSE_ARGS set)
+        try:
+            key = int(name)
+        except ValueError:
+            key = name
+        cm.choose_args[key] = {
+            int(bid): ChooseArg(ids=arg.get("ids"),
+                                weight_set=arg.get("weight_set"))
+            for bid, arg in args.items()}
     m.crush = cm
     return m
 

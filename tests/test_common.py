@@ -1,0 +1,131 @@
+"""Foundation layer tests: options schema/config, perf counters, dout.
+
+Models the reference's config/perf unit tests
+(ref: src/test/common/test_config.cc, src/test/perf_counters.cc).
+"""
+import json
+
+import pytest
+
+from ceph_tpu.common.options import (Config, Option, OptionLevel,
+                                     OptionType, OPTIONS, _parse_size)
+from ceph_tpu.common.perf_counters import (PerfCounters,
+                                           PerfCountersCollection)
+from ceph_tpu.common.log import dout, set_subsys_level
+
+
+def test_option_parse_types():
+    assert OPTIONS["osd_pool_default_size"].parse("5") == 5
+    assert OPTIONS["mon_osd_down_out_interval"].parse("30") == 30.0
+    assert OPTIONS["objectstore_debug_inject_read_err"].parse("yes") is True
+    assert OPTIONS["objectstore_debug_inject_read_err"].parse("0") is False
+    assert OPTIONS["memstore_device_bytes"].parse("4K") == 4096
+    assert _parse_size("2M") == 2 << 20
+    assert _parse_size("1.5k") == 1536
+
+
+def test_option_validation():
+    with pytest.raises(ValueError):
+        OPTIONS["osd_pool_default_size"].parse("-1")   # uint
+    with pytest.raises(ValueError):
+        OPTIONS["ms_type"].parse("carrier-pigeon")     # enum
+    with pytest.raises(ValueError):
+        OPTIONS["osd_debug_inject_dispatch_delay_probability"].parse("1.5")
+
+
+def test_config_get_set_defaults():
+    cfg = Config()
+    assert cfg.get("osd_pool_default_size") == 3
+    cfg.set("osd_pool_default_size", "5")
+    assert cfg["osd_pool_default_size"] == 5
+    assert cfg.diff() == {"osd_pool_default_size": 5}
+    with pytest.raises(KeyError):
+        cfg.set("nonexistent_option", 1)
+
+
+def test_config_observers_fire_on_change():
+    cfg = Config()
+    seen = []
+    cfg.observe("upmap_max_deviation", lambda k, v: seen.append((k, v)))
+    cfg.set("upmap_max_deviation", 7)
+    cfg.set("upmap_max_deviation", 7)   # unchanged -> no second event
+    cfg.set("upmap_max_deviation", 2)
+    assert seen == [("upmap_max_deviation", 7), ("upmap_max_deviation", 2)]
+
+
+def test_config_env_layer(monkeypatch):
+    monkeypatch.setenv("CEPH_TPU_OSD_POOL_DEFAULT_PG_NUM", "128")
+    cfg = Config()
+    assert cfg.get("osd_pool_default_pg_num") == 128
+
+
+def test_config_file_layer(tmp_path):
+    p = tmp_path / "conf.json"
+    p.write_text(json.dumps({"log_level": 10, "ms_type": "ici"}))
+    cfg = Config()
+    cfg.load_file(str(p))
+    assert cfg.get("log_level") == 10
+    assert cfg.get("ms_type") == "ici"
+
+
+def test_config_dump_levels():
+    cfg = Config()
+    basic = cfg.dump(OptionLevel.BASIC)
+    assert "osd_pool_default_size" in basic
+    assert "mon_min_osdmap_epochs" not in basic
+    assert set(cfg.dump()) == set(OPTIONS)
+
+
+def test_perf_counter_kinds():
+    pc = PerfCounters("osd.0")
+    pc.add_u64_counter("op_w", "writes")
+    pc.add_u64("numpg", "pg count")
+    pc.add_time_avg("op_w_lat", "write latency")
+    pc.add_histogram("op_size")
+    pc.inc("op_w")
+    pc.inc("op_w", 2)
+    pc.set("numpg", 17)
+    pc.tinc("op_w_lat", 0.5)
+    pc.tinc("op_w_lat", 1.5)
+    pc.hinc("op_size", 3000)
+    d = pc.dump()
+    assert d["op_w"] == 3
+    assert d["numpg"] == 17
+    assert d["op_w_lat"] == {"avgcount": 2, "sum": 2.0, "avg": 1.0}
+    assert sum(d["op_size"]) == 1
+
+
+def test_perf_time_block_and_reset():
+    pc = PerfCounters("bench")
+    pc.add_time_avg("encode_lat")
+    with pc.time_block("encode_lat"):
+        pass
+    assert pc.get("encode_lat")["avgcount"] == 1
+    pc.reset()
+    assert pc.get("encode_lat")["avgcount"] == 0
+
+
+def test_perf_collection_dump_json():
+    coll = PerfCountersCollection()
+    a = coll.create("osd.1")
+    a.add_u64_counter("op_r")
+    a.inc("op_r", 9)
+    assert coll.create("osd.1") is a           # idempotent create
+    parsed = json.loads(coll.perf_dump_json())
+    assert parsed["osd.1"]["op_r"] == 9
+    coll.remove("osd.1")
+    assert coll.perf_dump() == {}
+
+
+def test_dout_gating(capsys):
+    set_subsys_level("osd", 1)
+    sink = dout("osd", 20)
+    assert not sink            # gated off -> no-op sink
+    sink.write("should not appear")
+    set_subsys_level("osd", 20)
+    assert dout("osd", 20)
+    dout("osd", 20).write("deep debug visible")
+    err = capsys.readouterr().err
+    assert "deep debug visible" in err
+    assert "should not appear" not in err
+    set_subsys_level("osd", 1)

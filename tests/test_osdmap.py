@@ -298,6 +298,29 @@ def test_osdmaptool_cli(tmp_path, capsys):
     assert len(up) == 3 and upp == up[0]
 
 
+def test_osdmaptool_choose_args_roundtrip(tmp_path):
+    """save/load must preserve choose_args weight-sets (balancer state),
+    and placements computed from the loaded map must match."""
+    from ceph_tpu.crush.types import ChooseArg
+    from ceph_tpu.tools import osdmaptool
+    m = make_map(n_osd=8, pg_num=32)
+    buckets = [b for b in m.crush.buckets if b is not None]
+    bid = buckets[0].id
+    nitems = len(buckets[0].items)
+    ws = [[0x8000 + 0x1000 * i for i in range(nitems)]]
+    m.crush.choose_args[m.crush.DEFAULT_CHOOSE_ARGS] = {
+        bid: ChooseArg(ids=None, weight_set=ws)}
+    mapfile = str(tmp_path / "ca.json")
+    osdmaptool.save_map(m, mapfile)
+    m2 = osdmaptool.load_map(mapfile)
+    assert m.crush.DEFAULT_CHOOSE_ARGS in m2.crush.choose_args
+    arg = m2.crush.choose_args[m.crush.DEFAULT_CHOOSE_ARGS][bid]
+    assert arg.weight_set == ws and arg.ids is None
+    for ps in range(32):
+        assert m2.pg_to_up_acting_osds(PG(0, ps)) == \
+            m.pg_to_up_acting_osds(PG(0, ps))
+
+
 def test_mapping_temp_width_and_bounds():
     # backfill pg_temp longer than pool size, and partial temp on EC
     m = make_map(n_osd=16, pg_num=32)
@@ -308,7 +331,9 @@ def test_mapping_temp_width_and_bounds():
     mapping.update(m)
     for pg in (PG(0, 1), PG(pid, 3)):
         assert mapping.get(pg) == m.pg_to_up_acting_osds(pg)
-    # out-of-range / unknown pool behave like the scalar pipeline
+    # out-of-range ps is *rejected* (OSDMapMapping.h ceph_assert
+    # semantics) — unlike the scalar pipeline, which folds raw ps;
+    # unknown pools return the empty sentinel
     assert mapping.get(PG(0, 999)) == ([], -1, [], -1)
     assert mapping.get(PG(77, 0)) == ([], -1, [], -1)
     assert mapping.get(PG(0, -1)) == ([], -1, [], -1)

@@ -44,9 +44,9 @@ def _parse_size(v) -> int:
         return int(v)
     s = str(v).strip().lower()
     for suf, mult in _SIZE_SUFFIX.items():
-        if s.endswith(suf + "i") or s.endswith(suf):
-            num = s.rstrip("i").rstrip(suf)
-            return int(float(num) * mult)
+        for full in (suf + "i", suf):
+            if s.endswith(full):
+                return int(float(s[:-len(full)]) * mult)
     return int(float(s))
 
 

@@ -97,9 +97,8 @@ class PerfCounters:
             for i, bound in enumerate(self.HIST_BOUNDS):
                 if sample <= bound:
                     c.buckets[i] += 1
-                    break
-            else:
-                c.buckets[-1] += 1
+                    return
+            c.buckets[-1] += 1
 
     def time_block(self, key: str):
         """Context manager timing a block into a time/avg counter."""

@@ -1,0 +1,22 @@
+"""Transport layer — the Messenger analogue (ref: src/msg/).
+
+`Messenger.create(ms_type)` (ref: src/msg/Messenger.cc:21) returns a
+transport backend:
+
+* `local` — in-process entity registry with per-endpoint dispatch
+  queues (threaded or deterministically pumped).  The analogue of the
+  reference's AsyncMessenger+posix stack for the simulated cluster and
+  of its loopback test messenger (src/test/direct_messenger/).
+* `ici` — NOT a host message path: bulk chunk fan-out between
+  co-located "OSD" shards rides XLA collectives inside jitted steps
+  (see ceph_tpu.dist); control metadata still flows over `local`.
+
+Wire framing, epoll loops and ProtocolV2 have no TPU-native purpose —
+the abstraction boundary (entity addressing, typed messages,
+dispatchers, delivery policies, fault injection) is what survives.
+"""
+from .messenger import (Connection, Dispatcher, EntityName, Message,
+                        Messenger, LocalNetwork)
+
+__all__ = ["Connection", "Dispatcher", "EntityName", "Message",
+           "Messenger", "LocalNetwork"]

@@ -1,0 +1,157 @@
+"""Typed wire messages — one dataclass per type (ref: src/messages/).
+
+Payloads carry numpy/bytes chunk buffers directly; there is no
+serialization layer for the in-process transport (a gRPC/DCN backend
+would add one at its boundary, not here).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from .messenger import Message
+
+# ---------------------------------------------------------------- osd/EC
+
+
+@dataclass
+class ECSubWrite(Message):
+    """Per-shard EC write (ref: src/messages/MOSDECSubOpWrite.h,
+    payload struct src/osd/ECMsgTypes.h ECSubWrite)."""
+    pgid: Any = None
+    tid: int = 0
+    reqid: Any = None
+    at_version: Any = None
+    trim_to: Any = None
+    txn: Any = None                 # store Transaction for this shard
+    log_entries: list = field(default_factory=list)
+    shard: int = -1
+
+
+@dataclass
+class ECSubWriteReply(Message):
+    """(ref: src/messages/MOSDECSubOpWriteReply.h, ECMsgTypes.h
+    ECSubWriteReply)."""
+    pgid: Any = None
+    tid: int = 0
+    shard: int = -1
+    committed: bool = True
+
+
+@dataclass
+class ECSubRead(Message):
+    """Per-shard chunk read request (ref: src/messages/MOSDECSubOpRead.h,
+    ECMsgTypes.h ECSubRead: to_read offset/len lists + attrs_to_read)."""
+    pgid: Any = None
+    tid: int = 0
+    shard: int = -1
+    to_read: list = field(default_factory=list)   # [(oid, off, len)]
+    attrs_to_read: list = field(default_factory=list)  # [oid]
+
+
+@dataclass
+class ECSubReadReply(Message):
+    """(ref: src/messages/MOSDECSubOpReadReply.h)."""
+    pgid: Any = None
+    tid: int = 0
+    shard: int = -1
+    buffers_read: dict = field(default_factory=dict)  # oid -> bytes|None
+    attrs_read: dict = field(default_factory=dict)    # oid -> attrs|None
+    errors: dict = field(default_factory=dict)        # oid -> errno str
+
+
+# ---------------------------------------------------------------- client
+
+
+@dataclass
+class OSDOp(Message):
+    """Client op to the primary (ref: src/messages/MOSDOp.h).
+    op: 'write'|'read'|'delete'|'stat' with args."""
+    pgid: Any = None
+    oid: str = ""
+    op: str = ""
+    tid: int = 0
+    epoch: int = 0
+    offset: int = 0
+    length: int = 0
+    data: bytes = b""
+
+
+@dataclass
+class OSDOpReply(Message):
+    """(ref: src/messages/MOSDOpReply.h)."""
+    tid: int = 0
+    result: int = 0
+    errno_name: str = ""
+    data: bytes = b""
+    attrs: dict = field(default_factory=dict)
+    epoch: int = 0
+
+
+# ---------------------------------------------------------------- maps/mon
+
+
+@dataclass
+class MMap(Message):
+    """Map publish (ref: src/messages/MOSDMap.h): full map or
+    incrementals for a range of epochs."""
+    full_map: Any = None
+    incrementals: list = field(default_factory=list)
+    first: int = 0
+    last: int = 0
+
+
+@dataclass
+class MMonCommand(Message):
+    """Mon command (ref: src/messages/MMonCommand.h); cmd is the parsed
+    argv-style dict like the mon's cmdmap."""
+    tid: int = 0
+    cmd: dict = field(default_factory=dict)
+
+
+@dataclass
+class MMonCommandAck(Message):
+    tid: int = 0
+    result: int = 0
+    outs: str = ""                  # human output
+    outb: Any = None                # data payload
+
+
+@dataclass
+class MMonSubscribe(Message):
+    """Map subscription (ref: src/messages/MMonSubscribe.h): ask for
+    osdmap updates starting at `start` epoch."""
+    what: str = "osdmap"
+    start: int = 0
+
+
+@dataclass
+class MOSDBoot(Message):
+    """OSD announces itself to the mon (ref: src/messages/MOSDBoot.h)."""
+    osd: int = -1
+
+
+@dataclass
+class MOSDFailure(Message):
+    """Failure report (ref: src/messages/MOSDFailure.h)."""
+    target_osd: int = -1
+    reporter: int = -1
+    failed_for: float = 0.0
+    epoch: int = 0
+
+
+# ---------------------------------------------------------------- pings
+
+
+@dataclass
+class Ping(Message):
+    """Heartbeat (ref: src/messages/MOSDPing.h PING)."""
+    epoch: int = 0
+    stamp: float = 0.0
+
+
+@dataclass
+class PingReply(Message):
+    """(ref: MOSDPing.h PING_REPLY)."""
+    epoch: int = 0
+    stamp: float = 0.0

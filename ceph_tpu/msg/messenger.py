@@ -1,0 +1,223 @@
+"""Messenger core: entity addressing, typed messages, dispatch.
+
+Shapes mirrored from the reference (ref: src/msg/Messenger.h —
+`Messenger::create` factory :21 in Messenger.cc, `add_dispatcher_head`,
+`Connection::send_message`; src/msg/Dispatcher.h ms_dispatch/
+ms_handle_reset).  The local backend replaces the AsyncMessenger epoll
+machinery with per-entity queues: a "connection" is a handle onto the
+peer's dispatch queue, delivery order per (src, dst) pair is FIFO like
+a TCP stream, and `ms_inject_socket_failures` drops messages the same
+way the reference's injected socket resets lose in-flight traffic
+(ref: src/common/options.cc:987).
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..common.log import dout
+from ..common.options import global_config
+
+EntityName = str      # "osd.3", "mon.0", "client.4121"
+
+
+_seq = itertools.count(1)
+
+
+@dataclass
+class Message:
+    """Base wire message.  Subclasses add payload fields
+    (ref: src/msg/Message.h; one subclass per type like src/messages/)."""
+    # filled in by the transport on send:
+    src: EntityName = field(default="", compare=False)
+    seq: int = field(default=0, compare=False)
+
+    @property
+    def type_name(self) -> str:
+        return type(self).__name__
+
+
+class Dispatcher:
+    """Receiver interface (ref: src/msg/Dispatcher.h)."""
+
+    def ms_dispatch(self, msg: Message) -> bool:
+        raise NotImplementedError
+
+    def ms_handle_reset(self, peer: EntityName) -> None:
+        """Peer endpoint went away with messages possibly lost."""
+
+
+class Connection:
+    """Send handle to one peer (ref: Connection::send_message)."""
+
+    def __init__(self, messenger: "Messenger", peer: EntityName):
+        self.messenger = messenger
+        self.peer = peer
+
+    def send_message(self, msg: Message) -> bool:
+        return self.messenger._send(self.peer, msg)
+
+
+class Messenger:
+    """One endpoint on a network (ref: src/msg/Messenger.h).
+
+    Create via `Messenger.create(network, name)`; register a Dispatcher
+    with `add_dispatcher`; get peers with `connect`.
+    """
+
+    def __init__(self, network: "LocalNetwork", name: EntityName,
+                 threaded: bool = True):
+        self.network = network
+        self.name = name
+        self.dispatchers: list[Dispatcher] = []
+        self.threaded = threaded
+        self._queue: "queue.Queue[Optional[Message]]" = queue.Queue()
+        self._thread: threading.Thread | None = None
+        self._running = False
+
+    # -- factory (ref: Messenger.cc:21 Messenger::create) ---------------
+    @staticmethod
+    def create(network: "LocalNetwork", name: EntityName,
+               ms_type: str | None = None,
+               threaded: bool = True) -> "Messenger":
+        if ms_type is None:
+            ms_type = global_config()["ms_type"]
+        if ms_type in ("local", "ici"):
+            # ici carries bulk arrays inside jitted collectives; its
+            # control/metadata endpoint is identical to local
+            return network.register(Messenger(network, name, threaded))
+        raise ValueError(f"unsupported ms_type {ms_type!r}")
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> None:
+        self._running = True
+        if self.threaded:
+            self._thread = threading.Thread(
+                target=self._dispatch_loop, name=f"ms-{self.name}",
+                daemon=True)
+            self._thread.start()
+
+    def shutdown(self) -> None:
+        self._running = False
+        if self._thread is not None:
+            self._queue.put(None)
+            self._thread.join(timeout=10)
+            self._thread = None
+        self.network.unregister(self.name)
+
+    def add_dispatcher(self, d: Dispatcher) -> None:
+        self.dispatchers.append(d)
+
+    def connect(self, peer: EntityName) -> Connection:
+        return Connection(self, peer)
+
+    # -- send / deliver -------------------------------------------------
+    def _send(self, peer: EntityName, msg: Message) -> bool:
+        # stamp a copy: the caller may reuse its message object (e.g. a
+        # broadcast loop) while earlier sends are still in flight
+        import dataclasses
+        msg = dataclasses.replace(msg, src=self.name, seq=next(_seq))
+        return self.network.route(self.name, peer, msg)
+
+    def enqueue(self, msg: Message) -> None:
+        """Queued for the dispatch thread (threaded) or until poll()."""
+        self._queue.put(msg)
+
+    def poll(self, max_msgs: int = 0) -> int:
+        """Deterministic pump for non-threaded mode: deliver queued
+        messages inline; returns the number delivered."""
+        n = 0
+        while max_msgs == 0 or n < max_msgs:
+            try:
+                msg = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if msg is not None:
+                self._deliver(msg)
+                n += 1
+        return n
+
+    def _dispatch_loop(self) -> None:
+        while self._running:
+            msg = self._queue.get()
+            if msg is None:
+                break
+            try:
+                self._deliver(msg)
+            except Exception:        # dispatcher bug: log, keep serving
+                import traceback
+                dout("ms", 0).write(
+                    "dispatch error on %s: %s", self.name,
+                    traceback.format_exc())
+
+    def _deliver(self, msg: Message) -> None:
+        for d in self.dispatchers:
+            if d.ms_dispatch(msg):
+                return
+        dout("ms", 1).write("%s: unhandled message %s from %s",
+                            self.name, msg.type_name, msg.src)
+
+    def handle_reset(self, peer: EntityName) -> None:
+        for d in self.dispatchers:
+            d.ms_handle_reset(peer)
+
+
+class LocalNetwork:
+    """In-process "wire": entity registry + routing + fault injection.
+
+    One instance per simulated cluster.  Message drop emulation uses
+    `ms_inject_socket_failures` = drop 1 of every N routed messages
+    (ref: src/common/options.cc:987; the reference resets the socket,
+    losing in-flight messages — here the message itself is dropped and
+    both sides get ms_handle_reset)."""
+
+    def __init__(self):
+        self._endpoints: dict[EntityName, Messenger] = {}
+        self._lock = threading.Lock()
+        self._routed = 0
+        self.dropped: list[tuple[EntityName, EntityName, Message]] = []
+        #: optional test hook: (src, dst, msg) -> False to drop
+        self.filter: Callable[[EntityName, EntityName, Message], bool] \
+            | None = None
+
+    def register(self, ms: Messenger) -> Messenger:
+        with self._lock:
+            if ms.name in self._endpoints:
+                raise ValueError(f"entity {ms.name} already bound")
+            self._endpoints[ms.name] = ms
+        return ms
+
+    def unregister(self, name: EntityName) -> None:
+        with self._lock:
+            self._endpoints.pop(name, None)
+
+    def lookup(self, name: EntityName) -> Messenger | None:
+        with self._lock:
+            return self._endpoints.get(name)
+
+    def route(self, src: EntityName, dst: EntityName,
+              msg: Message) -> bool:
+        inject = global_config()["ms_inject_socket_failures"]
+        with self._lock:
+            self._routed += 1
+            drop = bool(inject and self._routed % inject == 0)
+            if not drop and self.filter is not None:
+                drop = not self.filter(src, dst, msg)
+            src_ms = self._endpoints.get(src)
+            dst_ms = self._endpoints.get(dst)
+        if drop:
+            self.dropped.append((src, dst, msg))
+            if src_ms:
+                src_ms.handle_reset(dst)
+            if dst_ms:
+                dst_ms.handle_reset(src)
+            return False
+        if dst_ms is None:
+            if src_ms:
+                src_ms.handle_reset(dst)
+            return False
+        dst_ms.enqueue(msg)
+        return True

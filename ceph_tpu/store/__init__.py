@@ -1,0 +1,11 @@
+"""Object storage engine layer (ref: src/os/).
+
+`ObjectStore` is the abstract transactional API (ObjectStore.h:66);
+`MemStore` is the in-memory implementation used by the OSD shards and
+tests (model: src/os/memstore/MemStore.cc).
+"""
+from .objectstore import ObjectStore, Transaction, ObjectId, StoreError
+from .memstore import MemStore
+
+__all__ = ["ObjectStore", "Transaction", "ObjectId", "StoreError",
+           "MemStore"]

@@ -1,0 +1,65 @@
+/* crc32c (Castagnoli) — slice-by-8, raw seed in/out.
+ *
+ * Native runtime piece of the TPU framework: the per-shard cumulative
+ * chunk hash (HashInfo) and transport frame checksums need CPU-side
+ * crc32c at memory bandwidth, which a Python byte loop cannot provide.
+ * Semantics match the reference's ceph_crc32c(seed, buf, len): the
+ * caller passes the running crc (no implicit pre/post inversion), so
+ * cumulative hashing chains calls directly
+ * (behavioral ref: src/common/sctp_crc32.c, src/common/crc32c.h).
+ *
+ * Build: cc -O3 -shared -fPIC crc32c.c -o libceph_tpu_native.so
+ */
+#include <stddef.h>
+#include <stdint.h>
+
+#define POLY 0x82F63B78u
+
+static uint32_t table[8][256];
+
+/* Built once at dlopen time (constructor) — no lazy-init publication
+ * race when concurrent threads enter with the GIL released. */
+__attribute__((constructor)) static void init_tables(void)
+{
+    uint32_t i, j, crc;
+    for (i = 0; i < 256; i++) {
+        crc = i;
+        for (j = 0; j < 8; j++)
+            crc = (crc & 1) ? (crc >> 1) ^ POLY : crc >> 1;
+        table[0][i] = crc;
+    }
+    for (i = 0; i < 256; i++) {
+        crc = table[0][i];
+        for (j = 1; j < 8; j++) {
+            crc = table[0][crc & 0xff] ^ (crc >> 8);
+            table[j][i] = crc;
+        }
+    }
+}
+
+uint32_t ceph_tpu_crc32c(uint32_t seed, const uint8_t *data, size_t len)
+{
+    uint32_t crc = seed;
+    /* head: align to 8 bytes */
+    while (len && ((uintptr_t)data & 7)) {
+        crc = table[0][(crc ^ *data++) & 0xff] ^ (crc >> 8);
+        len--;
+    }
+    /* body: 8 bytes per step */
+    while (len >= 8) {
+        const uint64_t word = *(const uint64_t *)data ^ (uint64_t)crc;
+        crc = table[7][word & 0xff] ^
+              table[6][(word >> 8) & 0xff] ^
+              table[5][(word >> 16) & 0xff] ^
+              table[4][(word >> 24) & 0xff] ^
+              table[3][(word >> 32) & 0xff] ^
+              table[2][(word >> 40) & 0xff] ^
+              table[1][(word >> 48) & 0xff] ^
+              table[0][(word >> 56) & 0xff];
+        data += 8;
+        len -= 8;
+    }
+    while (len--)
+        crc = table[0][(crc ^ *data++) & 0xff] ^ (crc >> 8);
+    return crc;
+}

@@ -1,0 +1,182 @@
+"""ObjectStore/MemStore tests (behavioral model: src/test/objectstore/
+store_test.cc basic suites — SimpleWrite/SimpleClone/OmapSimple — plus
+the atomicity guarantee this implementation adds on top of the
+reference's assert-mid-apply behavior)."""
+import pytest
+
+from ceph_tpu.common.options import global_config
+from ceph_tpu.store import MemStore, ObjectId, StoreError, Transaction
+
+
+@pytest.fixture
+def store():
+    s = MemStore()
+    s.mkfs()
+    s.mount()
+    t = Transaction().create_collection("cid")
+    s.queue_transaction(t)
+    return s
+
+
+OID = ObjectId("obj1")
+
+
+def test_write_read_extend(store):
+    t = Transaction().write("cid", OID, 0, b"hello")
+    store.queue_transaction(t)
+    assert store.read("cid", OID) == b"hello"
+    # overwrite + extend past EOF zero-fills the gap
+    t = Transaction().write("cid", OID, 8, b"world")
+    store.queue_transaction(t)
+    assert store.read("cid", OID) == b"hello\0\0\0world"
+    assert store.stat("cid", OID)["size"] == 13
+    assert store.read("cid", OID, 8, 5) == b"world"
+    assert store.read("cid", OID, 8) == b"world"
+
+
+def test_zero_truncate(store):
+    store.queue_transaction(Transaction().write("cid", OID, 0, b"x" * 16))
+    store.queue_transaction(Transaction().zero("cid", OID, 4, 8))
+    assert store.read("cid", OID) == b"x" * 4 + b"\0" * 8 + b"x" * 4
+    store.queue_transaction(Transaction().truncate("cid", OID, 6))
+    assert store.read("cid", OID) == b"x" * 4 + b"\0" * 2
+    store.queue_transaction(Transaction().truncate("cid", OID, 10))
+    assert store.stat("cid", OID)["size"] == 10
+
+
+def test_touch_remove_exists(store):
+    assert not store.exists("cid", OID)
+    store.queue_transaction(Transaction().touch("cid", OID))
+    assert store.exists("cid", OID)
+    assert store.read("cid", OID) == b""
+    store.queue_transaction(Transaction().remove("cid", OID))
+    assert not store.exists("cid", OID)
+    with pytest.raises(StoreError):
+        store.queue_transaction(Transaction().remove("cid", OID))
+
+
+def test_attrs(store):
+    store.queue_transaction(
+        Transaction().touch("cid", OID)
+        .setattr("cid", OID, "hinfo", {"a": 1})
+        .setattrs("cid", OID, {"x": b"1", "y": b"2"}))
+    assert store.getattr("cid", OID, "hinfo") == {"a": 1}
+    assert store.getattrs("cid", OID) == {"hinfo": {"a": 1},
+                                          "x": b"1", "y": b"2"}
+    store.queue_transaction(Transaction().rmattr("cid", OID, "x"))
+    assert "x" not in store.getattrs("cid", OID)
+    with pytest.raises(StoreError):
+        store.getattr("cid", OID, "x")
+    store.queue_transaction(Transaction().rmattrs("cid", OID))
+    assert store.getattrs("cid", OID) == {}
+
+
+def test_omap(store):
+    store.queue_transaction(
+        Transaction().omap_setkeys("cid", OID, {"k1": b"v1", "k2": b"v2"}))
+    assert store.omap_get("cid", OID) == {"k1": b"v1", "k2": b"v2"}
+    store.queue_transaction(Transaction().omap_rmkeys("cid", OID, ["k1"]))
+    assert store.omap_get("cid", OID) == {"k2": b"v2"}
+    store.queue_transaction(Transaction().omap_clear("cid", OID))
+    assert store.omap_get("cid", OID) == {}
+
+
+def test_clone_full_and_range(store):
+    c2 = ObjectId("clone")
+    store.queue_transaction(
+        Transaction().write("cid", OID, 0, b"abcdefgh")
+        .setattr("cid", OID, "tag", b"t")
+        .omap_setkeys("cid", OID, {"k": b"v"})
+        .clone("cid", OID, c2))
+    assert store.read("cid", c2) == b"abcdefgh"
+    assert store.getattr("cid", c2, "tag") == b"t"
+    assert store.omap_get("cid", c2) == {"k": b"v"}
+    # clone is independent of the source
+    store.queue_transaction(Transaction().write("cid", OID, 0, b"XXXX"))
+    assert store.read("cid", c2) == b"abcdefgh"
+    c3 = ObjectId("range")
+    store.queue_transaction(
+        Transaction().clone_range("cid", OID, c3, 2, 4, 1))
+    assert store.read("cid", c3) == b"\0XXef"
+
+
+def test_collection_lifecycle(store):
+    t = Transaction().create_collection("cid2")
+    store.queue_transaction(t)
+    assert store.collection_exists("cid2")
+    assert set(store.list_collections()) == {"cid", "cid2"}
+    with pytest.raises(StoreError):          # EEXIST
+        store.queue_transaction(Transaction().create_collection("cid2"))
+    store.queue_transaction(Transaction().touch("cid2", OID))
+    with pytest.raises(StoreError):          # ENOTEMPTY
+        store.queue_transaction(Transaction().remove_collection("cid2"))
+    store.queue_transaction(
+        Transaction().remove("cid2", OID).remove_collection("cid2"))
+    assert not store.collection_exists("cid2")
+    with pytest.raises(StoreError):
+        store.collection_list("cid2")
+
+
+def test_collection_move_rename(store):
+    store.queue_transaction(Transaction().create_collection("dst"))
+    store.queue_transaction(Transaction().write("cid", OID, 0, b"data"))
+    new_oid = ObjectId("renamed")
+    store.queue_transaction(
+        Transaction().collection_move_rename("cid", OID, "dst", new_oid))
+    assert not store.exists("cid", OID)
+    assert store.read("dst", new_oid) == b"data"
+
+
+def test_txn_atomicity_on_failure(store):
+    """A failing op must leave NO effects from earlier ops in the txn."""
+    store.queue_transaction(Transaction().write("cid", OID, 0, b"orig"))
+    bad = (Transaction()
+           .write("cid", OID, 0, b"new!")
+           .touch("cid", ObjectId("side-effect"))
+           .remove("cid", ObjectId("missing")))     # fails: ENOENT
+    with pytest.raises(StoreError):
+        store.queue_transaction(bad)
+    assert store.read("cid", OID) == b"orig"
+    assert not store.exists("cid", ObjectId("side-effect"))
+
+
+def test_txn_order_within_txn(store):
+    t = (Transaction()
+         .write("cid", OID, 0, b"aaaa")
+         .zero("cid", OID, 1, 2)
+         .write("cid", OID, 2, b"Z"))
+    store.queue_transaction(t)
+    assert store.read("cid", OID) == b"a\0Za"
+
+
+def test_collection_list_sorted(store):
+    names = ["b", "a", "c"]
+    t = Transaction()
+    for n in names:
+        t.touch("cid", ObjectId(n))
+    store.queue_transaction(t)
+    assert [o.name for o in store.collection_list("cid")] == ["a", "b", "c"]
+
+
+def test_inject_read_err(store):
+    store.queue_transaction(Transaction().write("cid", OID, 0, b"data"))
+    store.inject_read_err("cid", OID)
+    # gated by config
+    cfg = global_config()
+    old = cfg["objectstore_debug_inject_read_err"]
+    try:
+        cfg.set("objectstore_debug_inject_read_err", True)
+        with pytest.raises(StoreError) as ei:
+            store.read("cid", OID)
+        assert ei.value.errno_name == "EIO"
+        store.clear_read_err("cid", OID)
+        assert store.read("cid", OID) == b"data"
+    finally:
+        cfg.set("objectstore_debug_inject_read_err", old)
+
+
+def test_statfs(store):
+    store.queue_transaction(Transaction().write("cid", OID, 0, b"x" * 100))
+    fs = store.statfs()
+    assert fs["used"] == 100
+    assert fs["available"] == fs["total"] - 100

@@ -1,0 +1,270 @@
+"""PGLog tests — ports of the reference's corner cases.
+
+run_test_case-style cases are transliterated from
+src/test/osd/TestPGLog.cc (merge_log_1..10, merge_log_prior_version_
+have, merge_log_split_missing_entries_at_head, rewind_divergent_log):
+base = shared prefix, div = our divergent suffix, auth = authoritative
+suffix; expectations are the final missing set and the
+remove/rollback side-effect sets.
+"""
+import pytest
+
+from ceph_tpu.osd.pg_log import IndexedLog, LogEntryHandler, PGLog
+from ceph_tpu.osd.pg_types import (DELETE, EVersion, MODIFY, PGLogEntry,
+                                   PGMissing, ZERO_VERSION)
+
+
+def evt(e, v):
+    return EVersion(e, v)
+
+
+def mod(obj, version, prior, rb=False):
+    return PGLogEntry(MODIFY, obj, version, prior, rollbackable=rb)
+
+
+def dt(obj, version, prior):
+    return PGLogEntry(DELETE, obj, version, prior)
+
+
+class Handler(LogEntryHandler):
+    def __init__(self):
+        self.removed = set()
+        self.rolled_back = []
+        self.trimmed = []
+
+    def remove(self, soid):
+        self.removed.add(soid)
+
+    def rollback(self, entry):
+        self.rolled_back.append(entry)
+
+    def trim(self, entry):
+        self.trimmed.append(entry)
+
+
+def run_case(base, div, auth, init_missing=(), may_include_deletes=True,
+             div_bounds=None, auth_bounds=None):
+    """Build ours=base+div, olog=base+auth, merge, return (pglog, handler)."""
+    ours = IndexedLog(base + div)
+    olog = IndexedLog(base + auth)
+    if base:
+        ours.tail = olog.tail = ZERO_VERSION
+    if div_bounds:
+        ours.head, ours.tail = div_bounds
+    if auth_bounds:
+        olog.head, olog.tail = auth_bounds
+    missing = PGMissing(may_include_deletes=may_include_deletes)
+    for soid, need, have in init_missing:
+        missing.add(soid, need, have)
+    pl = PGLog(ours, missing)
+    h = Handler()
+    pl.merge_log(olog, h)
+    return pl, h
+
+
+def assert_missing(pl, expected):
+    """expected: {soid: (need, have, is_delete)}"""
+    assert set(pl.missing.items) == set(expected)
+    for soid, (need, have, is_del) in expected.items():
+        item = pl.missing.items[soid]
+        assert item.need == need, (soid, item)
+        assert item.have == have, (soid, item)
+        assert item.is_delete == is_del, (soid, item)
+
+
+# ---- merge_log_N ports (TestPGLog.cc:1870-2033) ----
+
+
+def test_merge_log_1_unrollbackable_divergent_removed():
+    base = [mod("obj1", evt(10, 100), evt(8, 80))]
+    div = [mod("obj1", evt(10, 101), evt(10, 100))]
+    pl, h = run_case(base, div, [])
+    assert_missing(pl, {"obj1": (evt(10, 100), ZERO_VERSION, False)})
+    assert h.removed == {"obj1"}
+
+
+def test_merge_log_2_rollbackable_divergent_rolled_back():
+    base = [mod("obj1", evt(10, 100), evt(8, 80), rb=True)]
+    div = [mod("obj1", evt(10, 101), evt(10, 100), rb=True),
+           mod("obj1", evt(10, 102), evt(10, 101), rb=True)]
+    pl, h = run_case(base, div, [])
+    assert_missing(pl, {})
+    assert h.removed == set()
+    assert [e.version for e in h.rolled_back] == [evt(10, 102),
+                                                 evt(10, 101)]
+
+
+def test_merge_log_3_mixed_rollbackability_removed():
+    base = [mod("obj1", evt(10, 100), evt(8, 80), rb=True)]
+    div = [mod("obj1", evt(10, 101), evt(10, 100)),
+           mod("obj1", evt(10, 102), evt(10, 101), rb=True)]
+    pl, h = run_case(base, div, [])
+    assert_missing(pl, {"obj1": (evt(10, 100), ZERO_VERSION, False)})
+    assert h.removed == {"obj1"}
+
+
+def test_merge_log_4_already_missing_adjusted():
+    base = [mod("obj1", evt(10, 100), evt(8, 80), rb=True)]
+    div = [mod("obj1", evt(10, 101), evt(10, 100), rb=True),
+           mod("obj1", evt(10, 102), evt(10, 101), rb=True)]
+    init = [("obj1", evt(10, 102), ZERO_VERSION)]
+    pl, h = run_case(base, div, [], init_missing=init)
+    assert_missing(pl, {"obj1": (evt(10, 100), ZERO_VERSION, False)})
+    assert h.removed == set()
+
+
+def test_merge_log_5_auth_ahead_with_divergence():
+    base = [mod("obj1", evt(10, 100), evt(8, 80), rb=True)]
+    div = [mod("obj1", evt(10, 101), evt(10, 100)),
+           mod("obj1", evt(10, 102), evt(10, 101), rb=True)]
+    auth = [mod("obj1", evt(11, 101), evt(10, 100))]
+    pl, h = run_case(base, div, auth)
+    assert_missing(pl, {"obj1": (evt(11, 101), ZERO_VERSION, False)})
+    assert h.removed == {"obj1"}
+
+
+def test_merge_log_6_simple_extend():
+    base = [mod("obj1", evt(10, 100), evt(8, 80), rb=True)]
+    auth = [mod("obj1", evt(11, 101), evt(10, 100))]
+    pl, h = run_case(base, [], auth)
+    assert_missing(pl, {"obj1": (evt(11, 101), evt(10, 100), False)})
+
+
+def test_merge_log_7_extend_already_missing_keeps_have():
+    base = [mod("obj1", evt(10, 100), evt(8, 80), rb=True)]
+    auth = [mod("obj1", evt(11, 101), evt(10, 100))]
+    init = [("obj1", evt(10, 100), evt(8, 80))]
+    pl, h = run_case(base, [], auth, init_missing=init)
+    assert_missing(pl, {"obj1": (evt(11, 101), evt(8, 80), False)})
+
+
+def test_merge_log_8_delete_tracked_in_missing():
+    base = [mod("obj1", evt(10, 100), evt(8, 80), rb=True)]
+    auth = [dt("obj1", evt(11, 101), evt(10, 100))]
+    init = [("obj1", evt(10, 100), evt(8, 80))]
+    pl, h = run_case(base, [], auth, init_missing=init)
+    assert_missing(pl, {"obj1": (evt(11, 101), evt(8, 80), True)})
+
+
+def test_merge_log_9_deletes_during_peering_removed():
+    base = [mod("obj1", evt(10, 100), evt(8, 80), rb=True)]
+    auth = [dt("obj1", evt(11, 101), evt(10, 100))]
+    init = [("obj1", evt(10, 100), evt(8, 80))]
+    pl, h = run_case(base, [], auth, init_missing=init,
+                     may_include_deletes=False)
+    assert_missing(pl, {})
+    assert h.removed == {"obj1"}
+
+
+def test_merge_log_prior_version_have():
+    base = [mod("obj1", evt(10, 100), evt(8, 80), rb=True)]
+    div = [mod("obj1", evt(10, 101), evt(10, 100))]
+    init = [("obj1", evt(10, 101), evt(10, 100))]
+    pl, h = run_case(base, div, [], init_missing=init)
+    assert_missing(pl, {})
+
+
+def test_merge_log_split_missing_entries_at_head():
+    div = [mod("obj1", evt(8, 70), evt(8, 65))]
+    auth = [mod("obj1", evt(10, 100), evt(8, 70), rb=True),
+            mod("obj1", evt(15, 150), evt(10, 100), rb=True)]
+    pl, h = run_case(
+        [], div, auth,
+        div_bounds=(evt(9, 79), evt(8, 69)),
+        auth_bounds=(evt(15, 160), evt(9, 77)))
+    assert_missing(pl, {"obj1": (evt(15, 150), evt(8, 70), False)})
+    assert pl.log.head == evt(15, 160)
+
+
+def test_merge_log_no_overlap_raises():
+    ours = IndexedLog([mod("a", evt(1, 1), ZERO_VERSION)])
+    olog = IndexedLog(
+        [mod("b", evt(5, 50), evt(5, 49))], tail=evt(5, 40))
+    with pytest.raises(ValueError):
+        PGLog(ours, PGMissing()).merge_log(olog)
+
+
+# ---- rewind_divergent_log ports (TestPGLog.cc:360-540) ----
+
+
+def test_rewind_divergent_delete_entry():
+    # log: (1,1) x5 / (1,4) MODIFY x9 / (1,5) DELETE x9; newhead (1,4)
+    entries = [
+        mod("x5", evt(1, 1), ZERO_VERSION),
+        mod("x9", evt(1, 4), ZERO_VERSION),
+        dt("x9", evt(1, 5), evt(1, 4)),
+    ]
+    log = IndexedLog(entries, tail=evt(1, 1))
+    pl = PGLog(log, PGMissing())
+    h = Handler()
+    pl.rewind_divergent_log(evt(1, 4), h)
+    assert "x9" in pl.log.objects
+    assert pl.missing.is_missing("x9")
+    assert pl.missing.items["x9"].need == evt(1, 4)
+    assert len(pl.log.entries) == 2
+    # divergent tail entry was a delete: nothing on disk to remove
+    assert h.removed == set()
+
+
+def test_rewind_divergent_object_before_tail():
+    # log: only (1,5) DELETE x9 prior (0,2); newhead (1,3)
+    log = IndexedLog([dt("x9", evt(1, 5), evt(0, 2))], tail=evt(1, 1))
+    pl = PGLog(log, PGMissing())
+    h = Handler()
+    pl.rewind_divergent_log(evt(1, 3), h)
+    assert pl.missing.is_missing("x9")
+    assert pl.missing.items["x9"].need == evt(0, 2)
+    assert "x9" not in pl.log.objects
+    assert len(pl.log.entries) == 0
+
+
+def test_rewind_divergent_creation_removed():
+    # divergent entry created the object (prior == 0/0) -> delete it
+    entries = [
+        mod("keep", evt(1, 1), ZERO_VERSION),
+        mod("new", evt(1, 5), ZERO_VERSION),
+    ]
+    log = IndexedLog(entries, tail=ZERO_VERSION)
+    pl = PGLog(log, PGMissing())
+    h = Handler()
+    pl.rewind_divergent_log(evt(1, 1), h)
+    assert not pl.missing.is_missing("new")
+    assert h.removed == {"new"}
+
+
+# ---- local machinery ----
+
+
+def test_indexed_log_add_and_trim():
+    log = IndexedLog()
+    log.add(mod("a", evt(1, 1), ZERO_VERSION))
+    log.add(mod("a", evt(1, 2), evt(1, 1)))
+    log.add(mod("b", evt(1, 3), ZERO_VERSION))
+    assert log.objects["a"].version == evt(1, 2)
+    with pytest.raises(AssertionError):
+        log.add(mod("c", evt(1, 2), ZERO_VERSION))   # not past head
+    dropped = log.trim_to(evt(1, 2))
+    assert [e.version for e in dropped] == [evt(1, 1), evt(1, 2)]
+    assert log.tail == evt(1, 2)
+    assert "a" not in log.objects and "b" in log.objects
+
+
+def test_missing_add_next_event_sequence():
+    m = PGMissing()
+    m.add_next_event(mod("o", evt(1, 1), ZERO_VERSION))
+    assert m.items["o"].need == evt(1, 1)
+    assert m.items["o"].have == ZERO_VERSION
+    m.add_next_event(mod("o", evt(1, 5), evt(1, 1)))
+    assert m.items["o"].need == evt(1, 5)
+    assert m.items["o"].have == ZERO_VERSION   # have preserved
+    m.got("o", evt(1, 5))
+    assert not m.is_missing("o")
+
+
+def test_missing_got_partial():
+    m = PGMissing()
+    m.add("o", evt(2, 2), evt(1, 1))
+    m.got("o", evt(2, 1))       # older than need: still missing
+    assert m.is_missing("o")
+    m.got("o", evt(2, 2))
+    assert not m.is_missing("o")

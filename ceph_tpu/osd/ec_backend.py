@@ -1,0 +1,758 @@
+"""ECBackend: the erasure-coded PG data plane.
+
+The write/read/recovery engine of an EC placement group
+(ref: src/osd/ECBackend.{h,cc}).  Two halves:
+
+* `ECPGShard` — runs on every OSD in the acting set: applies per-shard
+  write transactions (`handle_sub_write`, ref: ECBackend.cc:912),
+  serves chunk reads with HashInfo crc verification
+  (`handle_sub_read`, ref: ECBackend.cc:987), and keeps the shard's
+  PGLog.
+* `ECBackend` — runs on the primary: the three-queue RMW write
+  pipeline (`submit_transaction` -> `start_rmw` -> waiting_state ->
+  waiting_reads -> waiting_commit, ref: ECBackend.cc:1479,1832,2138),
+  reconstructing reads (`objects_read_and_reconstruct` +
+  `get_min_avail_to_read_shards`, ref: ECBackend.h:139,
+  ECBackend.cc:1590), and shard recovery (`recover_object`,
+  ref: ECBackend.cc:735).
+
+TPU-first shape: all stripe math/coding goes through ceph_tpu.osd.ecutil
+so every encode/decode is ONE batched device dispatch per op — the
+reference's per-stripe loop and per-shard buffer assembly collapse into
+array reshapes around the kernel.  Chunk fan-out to co-located shards
+can additionally ride ICI collectives (ceph_tpu.dist) when the shards
+are device-resident; this module is the host-side protocol engine.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Optional
+
+from ..common.log import dout
+from ..msg.messages import (ECSubRead, ECSubReadReply, ECSubWrite,
+                            ECSubWriteReply)
+from ..store import ObjectId, StoreError, Transaction
+from . import ecutil
+from .ecutil import HashInfo, StripeInfo
+from .pg_log import PGLog
+from .pg_types import (DELETE, EVersion, MODIFY, PGLogEntry, PGMissing,
+                       ZERO_VERSION)
+
+OI_ATTR = "_"          # object info xattr key (ref: OI_ATTR "_")
+HINFO_ATTR = "hinfo_key"   # (ref: ECUtil.h ECUtil::get_hinfo_key())
+
+
+def pg_cid(pgid) -> str:
+    return f"pg_{pgid}"
+
+
+# --------------------------------------------------------------------- shard
+
+
+class ECPGShard:
+    """Per-OSD shard service for one PG."""
+
+    def __init__(self, pgid, shard: int, store, k: int, m: int):
+        self.pgid = pgid
+        self.shard = shard
+        self.store = store
+        self.k = k
+        self.m = m
+        self.cid = pg_cid(pgid)
+        self.pg_log = PGLog()
+        if not store.collection_exists(self.cid):
+            store.queue_transaction(
+                Transaction().create_collection(self.cid))
+
+    # -- write side (ref: ECBackend.cc:912 handle_sub_write) -----------
+    def handle_sub_write(self, m: ECSubWrite) -> ECSubWriteReply:
+        try:
+            if m.txn is not None and not m.txn.empty():
+                self.store.queue_transaction(m.txn)
+            for e in m.log_entries:
+                if e.version > self.pg_log.log.head:
+                    self.pg_log.append(e)
+            committed = True
+        except StoreError as err:
+            dout("osd", 0).write("%s shard %s sub_write failed: %s",
+                                 self.pgid, self.shard, err)
+            committed = False
+        return ECSubWriteReply(pgid=self.pgid, tid=m.tid,
+                               shard=self.shard, committed=committed)
+
+    # -- read side (ref: ECBackend.cc:987 handle_sub_read) -------------
+    def handle_sub_read(self, m: ECSubRead) -> ECSubReadReply:
+        reply = ECSubReadReply(pgid=self.pgid, tid=m.tid,
+                               shard=self.shard)
+        for oid, off, length in m.to_read:
+            soid = ObjectId(oid, shard=self.shard)
+            try:
+                buf = self.store.read(self.cid, soid, off, length)
+                # integrity gate: full-stream reads verify the
+                # cumulative shard crc (ref: ECBackend.cc:1059-1075)
+                if off == 0 and length == 0:
+                    hd = self._hinfo(soid)
+                    if hd is not None and hd.has_chunk_hash() \
+                            and hd.get_total_chunk_size() == len(buf):
+                        from ..common.crc32c import crc32c
+                        if crc32c(0xFFFFFFFF, buf) != \
+                                hd.get_chunk_hash(self.shard):
+                            raise StoreError(
+                                "EIO", f"shard {self.shard} crc mismatch"
+                                f" on {oid}")
+                reply.buffers_read[oid] = buf
+            except StoreError as err:
+                reply.errors[oid] = err.errno_name
+        for oid in m.attrs_to_read:
+            soid = ObjectId(oid, shard=self.shard)
+            try:
+                reply.attrs_read[oid] = self.store.getattrs(
+                    self.cid, soid)
+            except StoreError as err:
+                reply.errors.setdefault(oid, err.errno_name)
+        return reply
+
+    def _hinfo(self, soid: ObjectId) -> Optional[HashInfo]:
+        try:
+            return HashInfo.from_dict(
+                self.store.getattr(self.cid, soid, HINFO_ATTR))
+        except StoreError:
+            return None
+
+    def object_size(self, oid: str) -> int:
+        """Logical object size from the oi xattr."""
+        soid = ObjectId(oid, shard=self.shard)
+        try:
+            return self.store.getattr(self.cid, soid, OI_ATTR)["size"]
+        except StoreError:
+            return 0
+
+    def objects(self) -> list[str]:
+        return sorted({o.name for o in self.store.collection_list(self.cid)
+                       if o.name != "pgmeta"})
+
+
+# ------------------------------------------------------------------ primary
+
+
+@dataclass
+class _Write:
+    """One RMW pipeline op (ref: ECBackend.h Op)."""
+    tid: int
+    oid: str
+    offset: int
+    data: bytes
+    delete: bool
+    version: EVersion
+    on_all_commit: Callable
+    # pipeline state
+    reads_needed: Optional[tuple[int, int]] = None   # logical (off,len)
+    reads_ready: bool = False    # RMW reads landed (or none needed)
+    read_error: bool = False
+    old_segment: bytes = b""
+    pending_shards: set = field(default_factory=set)
+    failed_shards: set = field(default_factory=set)
+    log_entry: Optional[PGLogEntry] = None
+    phase: str = "state"      # state -> reads -> commit -> done
+
+
+@dataclass
+class _Read:
+    tid: int
+    reads: dict                     # oid -> (off, len)
+    on_complete: Callable
+    for_recovery: bool = False
+    want_attrs: bool = False
+    pending_shards: set = field(default_factory=set)
+    shard_bufs: dict = field(default_factory=dict)   # oid -> {shard: buf}
+    shard_attrs: dict = field(default_factory=dict)  # oid -> {shard: attrs}
+    shard_errs: dict = field(default_factory=dict)   # oid -> {shard: err}
+    retried: bool = False
+    #: oid -> (chunk_off, chunk_len, logical_base); (0,0,0)=full stream
+    chunk_windows: dict = field(default_factory=dict)
+
+
+class ECBackend:
+    """Primary-side engine for one EC PG.
+
+    `send(shard_index, msg)` delivers a message to the acting OSD
+    holding that shard (the harness/daemon wires this to the
+    messenger); the local shard is invoked inline like the reference's
+    self-dispatch (ref: ECBackend.cc:2060,2073).
+    """
+
+    def __init__(self, pgid, ec, whoami: int,
+                 acting: list[int],
+                 local_shard: ECPGShard,
+                 send: Callable[[int, object], bool],
+                 epoch: int = 1):
+        self.pgid = pgid
+        self.ec = ec
+        self.k = ec.get_data_chunk_count()
+        self.m = ec.get_coding_chunk_count()
+        cs = ec.get_chunk_size(self.k * 4096)
+        self.sinfo = StripeInfo(self.k, self.k * cs)
+        self.whoami = whoami
+        self.acting = list(acting)
+        self.local_shard = local_shard
+        self.send = send
+        self.epoch = epoch
+        self.last_version = ZERO_VERSION
+        self.committed_to = ZERO_VERSION
+        # missing per shard index (peering fills this; harness may too)
+        self.peer_missing: dict[int, PGMissing] = {
+            s: PGMissing() for s in range(len(acting))}
+        self._tid = 0
+        self._lock = threading.RLock()
+        # the three-queue pipeline (ref: ECBackend.h waiting_state/
+        # waiting_reads/waiting_commit)
+        self.waiting_state: list[_Write] = []
+        self.waiting_reads: list[_Write] = []
+        self.waiting_commit: list[_Write] = []
+        self._checking = False      # _check_ops re-entrancy guard
+        self._recheck = False
+        self.tid_to_op: dict[int, _Write] = {}
+        self.in_flight_reads: dict[int, _Read] = {}
+
+    # -- utilities ------------------------------------------------------
+    def _next_tid(self) -> int:
+        self._tid += 1
+        return self._tid
+
+    def _next_version(self) -> EVersion:
+        self.last_version = EVersion(self.epoch,
+                                     self.last_version.version + 1)
+        return self.last_version
+
+    def _alive_shards(self) -> list[int]:
+        return [s for s in range(len(self.acting))
+                if self.acting[s] >= 0]
+
+    def _avail_shards(self, oid: str) -> list[int]:
+        """Shards that exist and are not missing the object
+        (ref: ECBackend.cc:1526 get_all_avail_shards)."""
+        out = []
+        for s in self._alive_shards():
+            missing = self.peer_missing.get(s)
+            if missing is not None and missing.is_missing(oid):
+                continue
+            out.append(s)
+        return out
+
+    def object_size(self, oid: str) -> int:
+        return self.local_shard.object_size(oid)
+
+    # ==================================================================
+    # write path (ref: ECBackend.cc:1479 submit_transaction,
+    #             :1832 start_rmw, :2138 check_ops)
+    # ==================================================================
+    def submit_transaction(self, oid: str, offset: int, data: bytes,
+                           on_all_commit: Callable,
+                           delete: bool = False) -> int:
+        with self._lock:
+            tid = self._next_tid()
+            # a write against an object the primary shard is missing
+            # would RMW against a phantom size-0 object and fan out
+            # corrupted stripes; the reference blocks such ops until
+            # recovery (PrimaryLogPG wait_for_unreadable_object) — here
+            # the op is rejected and the caller must recover first
+            pm = self.peer_missing.get(self.local_shard.shard)
+            if pm is not None and pm.is_missing(oid):
+                on_all_commit(False)
+                return tid
+            op = _Write(tid=tid, oid=oid, offset=offset, data=data,
+                        delete=delete, version=self._next_version(),
+                        on_all_commit=on_all_commit)
+            op.log_entry = PGLogEntry(
+                DELETE if delete else MODIFY, oid, op.version,
+                prior_version=self._object_prior_version(oid))
+            self.tid_to_op[tid] = op
+            self.waiting_state.append(op)
+            self._check_ops()
+            return tid
+
+    def _object_prior_version(self, oid: str) -> EVersion:
+        e = self.local_shard.pg_log.log.objects.get(oid)
+        return e.version if e is not None else ZERO_VERSION
+
+    def _check_ops(self) -> None:
+        """Drain the pipeline in order (ref: ECBackend.cc:2138
+        check_ops: state->reads may pipeline, reads->commit is strictly
+        FIFO so sub-writes hit every shard in version order).
+
+        Re-entrancy-safe: inline replies during a fan-out loop recurse
+        into this method; the nested call must NOT advance the pipeline
+        (it would interleave a later op's sub-writes ahead of the
+        current op's remaining sends) — it just flags the outer frame
+        to loop again."""
+        if self._checking:
+            self._recheck = True
+            return
+        self._checking = True
+        try:
+            while True:
+                self._recheck = False
+                progress = self._try_state_to_reads()
+                progress = self._try_reads_to_commit() or progress
+                if not progress and not self._recheck:
+                    break
+        finally:
+            self._checking = False
+        self._try_finish_commits()
+
+    def _try_state_to_reads(self) -> bool:
+        """(ref: ECBackend.cc:1858 try_state_to_reads)"""
+        if not self.waiting_state:
+            return False
+        op = self.waiting_state[0]
+        # per-object ordering: an earlier in-flight op on the same
+        # object must commit first so the RMW read sees its data (the
+        # reference serializes via the ExtentCache)
+        for other in self.waiting_reads + self.waiting_commit:
+            if other.oid == op.oid:
+                return False
+        self.waiting_state.pop(0)
+        op.phase = "reads"
+        self.waiting_reads.append(op)
+        if op.delete:
+            op.reads_ready = True
+            return True
+        plan = self._write_plan(op)
+        if plan is None:
+            op.reads_ready = True         # aligned append: no reads
+            return True
+        op.reads_needed = plan
+        off, length = plan
+        self.objects_read_and_reconstruct(
+            {op.oid: (off, length)},
+            lambda results, errors, op=op: self._rmw_reads_done(
+                op, results, errors))
+        return True
+
+    def _try_reads_to_commit(self) -> bool:
+        """Commit ONLY the front of waiting_reads once its reads are in
+        (ref: ECBackend.cc:1932 try_reads_to_commit operates on
+        waiting_reads.front()) — later ops never overtake, so shards
+        receive sub-writes in version order."""
+        progressed = False
+        while self.waiting_reads and \
+                getattr(self.waiting_reads[0], "reads_ready", False):
+            op = self.waiting_reads.pop(0)
+            if getattr(op, "read_error", False):
+                self._finish(op, ok=False)
+            else:
+                self._start_commit(op)
+            progressed = True
+        return progressed
+
+    def _write_plan(self, op: _Write) -> Optional[tuple[int, int]]:
+        """Which logical range must be read before this write can be
+        encoded (ref: ECTransaction.h get_write_plan: the stripes the
+        write only partially overwrites).  None = no RMW read."""
+        old_size = self.object_size(op.oid)
+        if old_size == 0:
+            return None
+        start, length = self.sinfo.offset_len_to_stripe_bounds(
+            (op.offset, max(len(op.data), 1)))
+        old_aligned = self.sinfo.logical_to_next_stripe_offset(old_size)
+        read_start = start
+        read_end = min(start + length, old_aligned)
+        if read_start >= read_end:
+            return None                  # pure append past old data
+        # full-stripe overwrite of existing stripes still merges with
+        # nothing — skip the read when the write covers those stripes
+        # entirely
+        w_start, w_end = op.offset, op.offset + len(op.data)
+        if w_start <= read_start and w_end >= read_end:
+            return None
+        return (read_start, read_end - read_start)
+
+    def _rmw_reads_done(self, op: _Write, results: dict,
+                        errors: dict) -> None:
+        with self._lock:
+            if errors.get(op.oid):
+                op.read_error = True
+            else:
+                op.old_segment = results.get(op.oid, b"")
+            op.reads_ready = True
+            self._check_ops()
+
+    def _start_commit(self, op: _Write) -> None:
+        """Encode + fan out per-shard transactions."""
+        op.phase = "commit"
+        self.waiting_commit.append(op)
+        if op.delete:
+            shard_txns = {
+                s: Transaction().remove(
+                    pg_cid(self.pgid), ObjectId(op.oid, shard=s))
+                for s in self._alive_shards()}
+            new_size = 0
+            shards = {}
+        else:
+            shards, shard_txns, new_size = self._encode_write(op)
+        op.pending_shards = set(shard_txns)
+        for s, txn in shard_txns.items():
+            msg = ECSubWrite(pgid=self.pgid, tid=op.tid, shard=s,
+                             txn=txn, log_entries=[op.log_entry])
+            if self.acting[s] == self.whoami:
+                reply = self.local_shard.handle_sub_write(msg)
+                self._on_write_reply(op, reply)
+            else:
+                if not self.send(s, msg):
+                    op.failed_shards.add(s)
+                    op.pending_shards.discard(s)
+        self._maybe_commit_done(op)
+
+    def _encode_write(self, op: _Write):
+        """Merge old+new logical bytes, batch-encode, build shard txns."""
+        sinfo = self.sinfo
+        old_size = self.object_size(op.oid)
+        start, length = sinfo.offset_len_to_stripe_bounds(
+            (op.offset, max(len(op.data), 1)))
+        seg = bytearray(length)
+        if op.old_segment:
+            seg[:len(op.old_segment)] = op.old_segment
+        rel = op.offset - start
+        seg[rel:rel + len(op.data)] = op.data
+        shards = ecutil.encode(sinfo, self.ec, bytes(seg))
+        chunk_off = sinfo.aligned_logical_offset_to_chunk_offset(start)
+        new_size = max(old_size, op.offset + len(op.data))
+        cid = pg_cid(self.pgid)
+
+        # cumulative hinfo only survives pure stripe-aligned appends:
+        # start is stripe-aligned, so start == old_size iff the old
+        # object ended exactly on a stripe boundary and this write
+        # begins there (ref: the reference maintains HashInfo for
+        # appends; ec overwrites invalidate it)
+        is_append = start == old_size
+        old_hinfo = self.local_shard._hinfo(
+            ObjectId(op.oid, shard=self.local_shard.shard))
+        # one hinfo for all shards (it carries every shard's hash);
+        # computed once — _next_hinfo advances the cumulative state
+        hi_dict = self._next_hinfo(
+            old_hinfo, chunk_off, shards, is_append).to_dict()
+        txns = {}
+        for s in self._alive_shards():
+            soid = ObjectId(op.oid, shard=s)
+            txn = Transaction()
+            txn.write(cid, soid, chunk_off, shards[s])
+            txn.setattrs(cid, soid, {
+                OI_ATTR: {"size": new_size,
+                          "version": (op.version.epoch,
+                                      op.version.version)},
+                HINFO_ATTR: hi_dict,
+            })
+            txns[s] = txn
+        return shards, txns, new_size
+
+    def _next_hinfo(self, old: Optional[HashInfo], chunk_off: int,
+                    shards: dict, is_append: bool) -> HashInfo:
+        if is_append:
+            hi = old if old is not None else HashInfo(self.k + self.m)
+            if hi.has_chunk_hash() \
+                    and hi.get_total_chunk_size() == chunk_off:
+                hi.append(chunk_off, shards)
+                return hi
+        # overwrite (or inconsistent history): size still tracked,
+        # cumulative chunk hashes invalidated
+        hi = HashInfo(0)
+        sz = chunk_off + (len(next(iter(shards.values()))) if shards else 0)
+        if old is not None:
+            sz = max(sz, old.get_total_chunk_size())
+        hi.total_chunk_size = sz
+        return hi
+
+    def handle_sub_write_reply(self, m: ECSubWriteReply) -> None:
+        """(ref: ECBackend.cc:1122)"""
+        with self._lock:
+            op = self.tid_to_op.get(m.tid)
+            if op is None:
+                return
+            self._on_write_reply(op, m)
+            self._maybe_commit_done(op)
+            self._check_ops()
+
+    def _on_write_reply(self, op: _Write, m: ECSubWriteReply) -> None:
+        op.pending_shards.discard(m.shard)
+        if not m.committed:
+            op.failed_shards.add(m.shard)
+
+    def _maybe_commit_done(self, op: _Write) -> None:
+        if op.phase == "commit" and not op.pending_shards:
+            self._finish(op, ok=not op.failed_shards)
+
+    def _finish(self, op: _Write, ok: bool) -> None:
+        if op in self.waiting_commit:
+            self.waiting_commit.remove(op)
+        op.phase = "done"
+        op.ok = ok
+        self._try_finish_commits()
+
+    def _try_finish_commits(self) -> None:
+        """Complete client callbacks strictly in tid order
+        (ref: the reference completes via in-order check_ops)."""
+        while self.tid_to_op:
+            first_tid = min(self.tid_to_op)
+            op = self.tid_to_op[first_tid]
+            if op.phase != "done":
+                break
+            del self.tid_to_op[first_tid]
+            if getattr(op, "ok", False):
+                self.committed_to = max(self.committed_to, op.version)
+            op.on_all_commit(getattr(op, "ok", False))
+
+    # ==================================================================
+    # read path (ref: ECBackend.h:139 objects_read_and_reconstruct,
+    #            ECBackend.cc:1590 get_min_avail_to_read_shards)
+    # ==================================================================
+    def objects_read_and_reconstruct(
+            self, reads: dict, on_complete: Callable,
+            for_recovery: bool = False,
+            want_attrs: bool = False) -> None:
+        with self._lock:
+            tid = self._next_tid()
+            rd = _Read(tid=tid, reads=dict(reads),
+                       on_complete=on_complete,
+                       for_recovery=for_recovery,
+                       want_attrs=want_attrs)
+            # translate each logical window into a per-shard chunk
+            # window so a small read never pulls whole shard streams
+            # (ref: ECBackend.cc:1590 builds per-shard offset/len
+            # lists the same way); (0, 0) = full stream (crc gate)
+            rd.chunk_windows = {}
+            for oid, window in rd.reads.items():
+                if window is None or window[1] == 0:
+                    rd.chunk_windows[oid] = (0, 0, 0)
+                else:
+                    s_off, s_len = self.sinfo.offset_len_to_stripe_bounds(
+                        window)
+                    rd.chunk_windows[oid] = (
+                        self.sinfo.aligned_logical_offset_to_chunk_offset(
+                            s_off),
+                        self.sinfo.aligned_logical_offset_to_chunk_offset(
+                            s_len),
+                        s_off)
+            # choose shards: minimum_to_decode over available shards
+            want_chunks = set(range(self.k + self.m)) if for_recovery \
+                else {self.ec.chunk_index(i) for i in range(self.k)}
+            per_shard: dict[int, list] = {}
+            errors: dict[str, str] = {}
+            for oid in rd.reads:
+                avail = set(self._avail_shards(oid))
+                try:
+                    need = self.ec.minimum_to_decode(
+                        want_chunks & set(range(self.k + self.m)),
+                        avail)
+                except Exception:
+                    errors[oid] = "EIO"
+                    continue
+                for s in need:
+                    per_shard.setdefault(s, []).append(oid)
+            if errors and len(errors) == len(rd.reads):
+                on_complete({}, errors)
+                return
+            self.in_flight_reads[tid] = rd
+            rd.pending_shards = set(per_shard)
+            for s, oids in per_shard.items():
+                self._dispatch_read(rd, s, self._sub_read_msg(rd, s, oids))
+            self._maybe_read_done(rd)
+
+    def _sub_read_msg(self, rd: _Read, s: int, oids) -> ECSubRead:
+        return ECSubRead(
+            pgid=self.pgid, tid=rd.tid, shard=s,
+            to_read=[(oid,) + rd.chunk_windows[oid][:2] for oid in oids],
+            attrs_to_read=list(oids) if rd.want_attrs else [])
+
+    def _dispatch_read(self, rd: _Read, s: int, msg: ECSubRead) -> None:
+        if self.acting[s] == self.whoami:
+            reply = self.local_shard.handle_sub_read(msg)
+            self._on_read_reply(rd, reply)
+        else:
+            if not self.send(s, msg):
+                rd.pending_shards.discard(s)
+                for oid, _, _ in msg.to_read:
+                    rd.shard_errs.setdefault(oid, {})[s] = "ECONNREFUSED"
+
+    def handle_sub_read_reply(self, m: ECSubReadReply) -> None:
+        """(ref: ECBackend.cc:1155)"""
+        with self._lock:
+            rd = self.in_flight_reads.get(m.tid)
+            if rd is None:
+                return
+            self._on_read_reply(rd, m)
+            self._maybe_read_done(rd)
+
+    def _on_read_reply(self, rd: _Read, m: ECSubReadReply) -> None:
+        rd.pending_shards.discard(m.shard)
+        for oid, buf in m.buffers_read.items():
+            rd.shard_bufs.setdefault(oid, {})[m.shard] = buf
+        for oid, attrs in m.attrs_read.items():
+            rd.shard_attrs.setdefault(oid, {})[m.shard] = attrs
+        for oid, err in m.errors.items():
+            rd.shard_errs.setdefault(oid, {})[m.shard] = err
+
+    def _maybe_read_done(self, rd: _Read) -> None:
+        # in_flight membership doubles as the completion guard: inline
+        # (same-thread) replies can finish the read while the dispatch
+        # loop is still running, and the loop's final check must not
+        # complete it a second time
+        if rd.pending_shards or rd.tid not in self.in_flight_reads:
+            return
+        # errors? try remaining shards once
+        # (ref: ECBackend.cc:1628 get_remaining_shards retry)
+        needs_retry = []
+        for oid in rd.reads:
+            errs = rd.shard_errs.get(oid, {})
+            if not errs:
+                continue
+            got = set(rd.shard_bufs.get(oid, {}))
+            remaining = [s for s in self._avail_shards(oid)
+                         if s not in got and s not in errs]
+            if len(got) < self.k and remaining and not rd.retried:
+                needs_retry.extend(
+                    (oid, s) for s in
+                    remaining[:self.k - len(got)])
+        if needs_retry:
+            rd.retried = True
+            per_shard: dict[int, list] = {}
+            for oid, s in needs_retry:
+                per_shard.setdefault(s, []).append(oid)
+            rd.pending_shards |= set(per_shard)
+            for s, oids in per_shard.items():
+                self._dispatch_read(rd, s, self._sub_read_msg(rd, s, oids))
+            # an inline retry reply may have recursed and completed the
+            # read already — re-check both guards before falling through
+            if rd.pending_shards or rd.tid not in self.in_flight_reads:
+                return
+        self.in_flight_reads.pop(rd.tid, None)
+        self._complete_read(rd)
+
+    def _complete_read(self, rd: _Read) -> None:
+        results: dict[str, bytes] = {}
+        errors: dict[str, str] = {}
+        for oid, window in rd.reads.items():
+            bufs = {s: b for s, b in rd.shard_bufs.get(oid, {}).items()}
+            if len(bufs) < self.k:
+                errors[oid] = "EIO"
+                continue
+            base = rd.chunk_windows[oid][2]   # logical offset of bufs[0]
+            logical = ecutil.decode_concat(self.sinfo, self.ec, bufs)
+            size = self._oi_size(rd, oid)
+            # highest valid logical byte we can serve from this read
+            limit = base + len(logical) if size is None \
+                else min(size, base + len(logical))
+            if window is None:
+                off, length = base, max(limit - base, 0)
+            else:
+                off, length = window
+                if length == 0:
+                    length = max(limit - off, 0)
+            end = min(off + length, limit)
+            results[oid] = logical[max(off - base, 0):max(end - base, 0)]
+        rd.on_complete(results, errors)
+
+    def _oi_size(self, rd: _Read, oid: str) -> Optional[int]:
+        attrs = rd.shard_attrs.get(oid, {})
+        for a in attrs.values():
+            oi = a.get(OI_ATTR)
+            if oi:
+                return oi["size"]
+        # distinguish "size 0" from "unknown": only a missing oi attr
+        # means unknown (a falsy-0 fallback would pad empty objects
+        # with a stripe of zeros)
+        try:
+            return self.local_shard.store.getattr(
+                pg_cid(self.pgid),
+                ObjectId(oid, shard=self.local_shard.shard),
+                OI_ATTR)["size"]
+        except StoreError:
+            return None
+
+    # ==================================================================
+    # recovery (ref: ECBackend.cc:735 recover_object,
+    #           :567 continue_recovery_op)
+    # ==================================================================
+    def recover_object(self, oid: str, target_shards: Iterable[int],
+                       on_done: Callable) -> None:
+        """Reconstruct `oid`'s chunks on target shards and push them."""
+        targets = sorted(set(target_shards))
+        # read enough shards (+ attrs) to rebuild the logical object
+        self.objects_read_and_reconstruct(
+            {oid: None}, lambda r, e: self._recovery_reads_done(
+                oid, targets, r, e, on_done),
+            for_recovery=True, want_attrs=True)
+
+    def _recovery_reads_done(self, oid: str, targets, results, errors,
+                             on_done) -> None:
+        if errors.get(oid) or oid not in results:
+            on_done(False)
+            return
+        with self._lock:
+            logical = results[oid]
+            # re-encode the full object: every shard's chunk stream
+            width = self.sinfo.stripe_width
+            padded = logical + b"\0" * (-len(logical) % width)
+            shards = ecutil.encode(self.sinfo, self.ec, padded)
+            hinfo = HashInfo(self.k + self.m)
+            if shards:
+                hinfo.append(0, shards)
+            size = len(logical)
+            version = self._object_prior_version(oid)
+            cid = pg_cid(self.pgid)
+            # all targets pending up front: an inline (synchronous)
+            # reply mid-loop must not see an empty set and complete
+            # the whole recovery early
+            pending = set(targets)
+            state = {"ok": True, "done": False}
+
+            def reply_cb(s, committed):
+                pending.discard(s)
+                if committed:
+                    # only the acked shard's missing entry clears
+                    pm = self.peer_missing.get(s)
+                    if pm is not None:
+                        pm.rm(oid)
+                else:
+                    state["ok"] = False
+                if not pending and not state["done"]:
+                    state["done"] = True
+                    on_done(state["ok"])
+
+            self._recovery_cbs = getattr(self, "_recovery_cbs", {})
+            if not targets:
+                on_done(True)
+                return
+            for s in targets:
+                soid = ObjectId(oid, shard=s)
+                txn = (Transaction()
+                       .touch(cid, soid)
+                       .truncate(cid, soid, 0)
+                       .write(cid, soid, 0, shards.get(s, b""))
+                       .setattrs(cid, soid, {
+                           OI_ATTR: {"size": size,
+                                     "version": (version.epoch,
+                                                 version.version)},
+                           HINFO_ATTR: hinfo.to_dict()}))
+                tid = self._next_tid()
+                msg = ECSubWrite(pgid=self.pgid, tid=tid, shard=s,
+                                 txn=txn, log_entries=[])
+                if self.acting[s] == self.whoami:
+                    rep = self.local_shard.handle_sub_write(msg)
+                    reply_cb(s, rep.committed)
+                else:
+                    self._recovery_cbs[tid] = (s, reply_cb)
+                    if not self.send(s, msg):
+                        self._recovery_cbs.pop(tid, None)
+                        reply_cb(s, False)
+
+    def handle_recovery_write_reply(self, m: ECSubWriteReply) -> bool:
+        """Route recovery push acks (returns True if consumed)."""
+        with self._lock:
+            cbs = getattr(self, "_recovery_cbs", {})
+            entry = cbs.pop(m.tid, None)
+            if entry is None:
+                return False
+            s, cb = entry
+            cb(s, m.committed)
+            return True

@@ -273,6 +273,17 @@ class OSDMap:
         raw, _ = self._pg_to_raw_osds(pool, pg)
         return raw, self._pick_primary(raw)
 
+    def pg_to_raw_upmap(self, pg: PG) -> list[int]:
+        """Raw crush placement with pg_upmap/pg_upmap_items applied but
+        no up-filtering (OSDMap.cc:2434) — the balancer's view of what
+        the current overrides produce."""
+        pool = self.pools.get(pg.pool)
+        if pool is None:
+            return []
+        raw, _ = self._pg_to_raw_osds(pool, pg)
+        self._apply_upmap(pool, pg, raw)
+        return raw
+
     def pg_to_up_acting_osds(self, pg: PG, raw_pg_to_pg: bool = True) \
             -> tuple[list[int], int, list[int], int]:
         """OSDMap.cc:2462-2510 _pg_to_up_acting_osds; returns

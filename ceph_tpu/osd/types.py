@@ -117,9 +117,10 @@ def ceph_str_hash(hash_type: int, data: bytes) -> int:
     raise ValueError(f"unknown str hash {hash_type}")
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, order=True)
 class PG:
-    """pg_t: (pool id, placement seed) (osd_types.h struct pg_t)."""
+    """pg_t: (pool id, placement seed) (osd_types.h struct pg_t);
+    ordered like the reference's operator< (pool, then seed)."""
     pool: int
     ps: int
 

@@ -225,6 +225,40 @@ def test_map_pgs(m: OSDMap, pool_filter: int, pg_num: int,
           f"({rate:,.0f} pg/s)", file=sys.stderr)
 
 
+def do_upmap(m: OSDMap, out_path: str, deviation: int, max_changes: int,
+             pools: list[int]) -> bool:
+    """--upmap: run the balancer and write the resulting commands
+    (ref: src/tools/osdmaptool.cc:48 usage, :331-404 upmap block).
+    Applies the upmaps to the in-memory map (so a --test-map-pgs in the
+    same invocation sees the balanced layout) and returns True when
+    changes were prepared; the mapfile itself is only rewritten under
+    --upmap-save, like the reference tool."""
+    from ..osd.balancer import Balancer
+    b = Balancer(max_deviation=deviation, max_iterations=max_changes)
+    inc = b.optimize(m, pools=pools or None)
+    lines = []
+    for pg in sorted(inc.old_pg_upmap_items):
+        lines.append(f"ceph osd rm-pg-upmap-items {pg}")
+    for pg, items in sorted(inc.new_pg_upmap_items.items()):
+        pairs = " ".join(f"{frm} {to}" for frm, to in items)
+        lines.append(f"ceph osd pg-upmap-items {pg} {pairs}")
+    out = open(out_path, "w") if out_path != "-" else sys.stdout
+    try:
+        for ln in lines:
+            print(ln, file=out)
+    finally:
+        if out is not sys.stdout:
+            out.close()
+    n = len(lines)
+    print(f"osdmaptool: upmap, max-count {max_changes}, "
+          f"max deviation {deviation}", file=sys.stderr)
+    print(f"prepared {n}/{max_changes} changes", file=sys.stderr)
+    if n:
+        inc.epoch = m.epoch + 1
+        m.apply_incremental(inc)
+    return bool(n)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="osdmaptool")
     ap.add_argument("mapfile")
@@ -238,6 +272,17 @@ def main(argv=None) -> int:
                     metavar="OSD")
     ap.add_argument("--mark-out", type=int, action="append", default=[],
                     metavar="OSD")
+    ap.add_argument("--upmap", metavar="FILE",
+                    help="calculate pg upmap entries to balance pg layout"
+                         " and write the commands to FILE ('-' = stdout)")
+    ap.add_argument("--upmap-max", type=int, default=10,
+                    help="max upmap entries to calculate")
+    ap.add_argument("--upmap-deviation", type=int, default=5,
+                    help="max deviation from target pgs per osd")
+    ap.add_argument("--upmap-pool", type=int, action="append", default=[],
+                    metavar="POOL", help="restrict upmap balancing to pool")
+    ap.add_argument("--upmap-save", action="store_true",
+                    help="write the upmap results back to the mapfile")
     args = ap.parse_args(argv)
 
     if args.createsimple:
@@ -263,6 +308,10 @@ def main(argv=None) -> int:
     for osd in args.mark_out:
         m.osd_weight[osd] = 0
         changed = True
+    if args.upmap:
+        did = do_upmap(m, args.upmap, args.upmap_deviation,
+                       args.upmap_max, args.upmap_pool)
+        changed |= did and args.upmap_save
     if args.test_map_pgs or args.test_map_pgs_dump:
         test_map_pgs(m, args.pool, args.pg_num, args.test_map_pgs_dump)
     if changed:

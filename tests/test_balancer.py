@@ -1,0 +1,295 @@
+"""Upmap balancer: try_remap_rule validity + calc_pg_upmaps convergence
+(ref: src/osd/OSDMap.cc:4360, src/crush/CrushWrapper.cc:3987,
+src/test/cli/osdmaptool/upmap*.t behavior)."""
+import numpy as np
+import pytest
+
+from ceph_tpu.crush import remap
+from ceph_tpu.crush.types import CRUSH_ITEM_NONE
+from ceph_tpu.osd.balancer import Balancer, calc_pg_upmaps
+from ceph_tpu.osd.mapping import OSDMapMapping
+from ceph_tpu.osd.osdmap import Incremental, OSDMap
+from ceph_tpu.osd.types import PG, PGPool
+
+
+def build_map(n_osd=16, osds_per_host=4, pg_num=256, size=3):
+    m = OSDMap()
+    m.build_simple(n_osd, PGPool(pg_num=pg_num, pgp_num=pg_num, size=size),
+                   osds_per_host=osds_per_host)
+    return m
+
+
+def host_of(cmap, parent, osd):
+    return remap.get_parent_of_type(cmap, osd, 1, parent)
+
+
+# ------------------------------------------------------------- tree walk
+def test_parent_and_subtree():
+    m = build_map()
+    parent = remap.build_parent_map(m.crush)
+    # osds 0-3 under first host; host under the root (type 10)
+    h0 = host_of(m.crush, parent, 0)
+    assert h0 < 0 and h0 == host_of(m.crush, parent, 3)
+    assert h0 != host_of(m.crush, parent, 4)
+    root = remap.get_parent_of_type(m.crush, 0, 10, parent)
+    assert root < 0
+    assert remap.subtree_contains(m.crush, root, 7)
+    assert remap.subtree_contains(m.crush, h0, 2)
+    assert not remap.subtree_contains(m.crush, h0, 4)
+
+
+def test_rule_weight_osd_map_normalized():
+    m = build_map(n_osd=8)
+    w = remap.get_rule_weight_osd_map(m.crush, 0)
+    assert set(w) == set(range(8))
+    assert abs(sum(w.values()) - 1.0) < 1e-6
+    assert all(abs(v - 1 / 8) < 1e-6 for v in w.values())
+
+
+# --------------------------------------------------------- try_remap_rule
+def test_try_remap_swaps_overfull_for_underfull_other_host():
+    m = build_map()
+    orig = m.pg_to_raw_upmap(PG(0, 0))
+    assert len(orig) == 3
+    parent = remap.build_parent_map(m.crush)
+    hosts = {host_of(m.crush, parent, o) for o in orig}
+    victim = orig[1]
+    # pick an underfull osd on a host not used by orig
+    cand = next(o for o in range(16)
+                if host_of(m.crush, parent, o) not in hosts)
+    out = remap.try_remap_rule(m.crush, 0, 3, {victim}, [cand], orig)
+    assert out != orig
+    assert victim not in out and cand in out
+    # failure domains stay distinct
+    out_hosts = [host_of(m.crush, parent, o) for o in out]
+    assert len(set(out_hosts)) == 3
+
+
+def test_try_remap_keeps_placement_when_candidate_collides():
+    """An underfull osd whose host is already in the placement must not
+    be chosen (chooseleaf host constraint)."""
+    m = build_map()
+    orig = m.pg_to_raw_upmap(PG(0, 0))
+    parent = remap.build_parent_map(m.crush)
+    victim = orig[0]
+    other = orig[1]
+    # candidate sharing a host with `other` (and not in orig)
+    sib = next(o for o in range(16)
+               if o not in orig and
+               host_of(m.crush, parent, o) == host_of(m.crush, parent, other))
+    out = remap.try_remap_rule(m.crush, 0, 3, {victim}, [sib], orig)
+    # cannot swap victim -> sib (host collision): placement unchanged
+    assert out == orig
+
+
+def test_try_remap_no_overfull_is_identity():
+    m = build_map()
+    orig = m.pg_to_raw_upmap(PG(0, 0))
+    out = remap.try_remap_rule(m.crush, 0, 3, set(), [5], orig)
+    assert out == orig
+
+
+# --------------------------------------------------------- calc_pg_upmaps
+def max_deviation(m, pool_ids=None):
+    mapping = OSDMapMapping()
+    mapping.update(m)
+    counts = mapping.osd_pg_counts(m.max_osd, acting=False)
+    target = counts.sum() / m.max_osd
+    return np.abs(counts - target).max(), counts
+
+
+def apply_pending(m, inc):
+    inc.epoch = m.epoch + 1
+    m2 = m.clone()
+    m2.apply_incremental(inc)
+    return m2
+
+
+def test_calc_pg_upmaps_balances_and_respects_failure_domains():
+    m = build_map(n_osd=16, pg_num=256, size=3)
+    before_dev, before_counts = max_deviation(m)
+    inc = Incremental(epoch=m.epoch + 1)
+    n = calc_pg_upmaps(m, 0.001, 100, set(), inc)
+    assert n > 0
+    assert len(inc.new_pg_upmap_items) > 0
+    m2 = apply_pending(m, inc)
+    after_dev, after_counts = max_deviation(m2)
+    assert after_counts.sum() == before_counts.sum()  # no PGs lost
+    assert after_dev < before_dev
+    assert after_dev <= 2.0  # near-perfect on a uniform tree
+    # every resulting placement keeps 3 distinct hosts
+    parent = remap.build_parent_map(m2.crush)
+    mapping = OSDMapMapping()
+    mapping.update(m2)
+    up = mapping.pools[0].up
+    for row in up:
+        osds = [int(o) for o in row if o != CRUSH_ITEM_NONE]
+        assert len(osds) == 3
+        assert len({host_of(m2.crush, parent, o) for o in osds}) == 3
+
+
+def test_calc_pg_upmaps_already_balanced_is_noop():
+    m = build_map(n_osd=16, pg_num=256, size=3)
+    inc = Incremental(epoch=m.epoch + 1)
+    n = calc_pg_upmaps(m, 0.001, 100, set(), inc)
+    m2 = apply_pending(m, inc)
+    inc2 = Incremental(epoch=m2.epoch + 1)
+    n2 = calc_pg_upmaps(m2, 0.001, 100, set(), inc2)
+    # converged: second run finds little or nothing
+    assert n2 <= max(2, n // 10)
+
+
+def test_calc_pg_upmaps_only_pools_filter():
+    m = build_map(n_osd=16, pg_num=128, size=3)
+    m.pools[1] = PGPool(pg_num=128, pgp_num=128, size=3)
+    m.pool_names[1] = "two"
+    inc = Incremental(epoch=m.epoch + 1)
+    calc_pg_upmaps(m, 0.001, 50, {1}, inc)
+    assert all(pg.pool == 1 for pg in inc.new_pg_upmap_items)
+    assert all(pg.pool == 1 for pg in inc.old_pg_upmap_items)
+
+
+def test_calc_pg_upmaps_retracts_stale_items():
+    """Existing pg_upmap_items that pile PGs onto an overfull osd get
+    dropped (the un-remap path, OSDMap.cc:4565)."""
+    m = build_map(n_osd=16, pg_num=256, size=3)
+    # manufacture imbalance: remap many PGs onto osd 0
+    mapping = OSDMapMapping()
+    mapping.update(m)
+    up = mapping.pools[0].up
+    made = 0
+    for ps in range(256):
+        row = [int(o) for o in up[ps]]
+        if 0 in row:
+            continue
+        # replace first osd whose host differs from osd0's host
+        parent = remap.build_parent_map(m.crush)
+        h0 = host_of(m.crush, parent, 0)
+        for o in row:
+            if host_of(m.crush, parent, o) != h0 and \
+                    not any(host_of(m.crush, parent, x) == h0 for x in row):
+                m.pg_upmap_items[PG(0, ps)] = [(o, 0)]
+                made += 1
+                break
+        if made >= 30:
+            break
+    assert made >= 30
+    dev0, counts0 = max_deviation(m)
+    assert counts0[0] > counts0.mean() + 10
+    inc = Incremental(epoch=m.epoch + 1)
+    n = calc_pg_upmaps(m, 0.001, 200, set(), inc)
+    assert n > 0
+    assert len(inc.old_pg_upmap_items) > 0  # retractions happened
+    m2 = apply_pending(m, inc)
+    dev2, counts2 = max_deviation(m2)
+    assert counts2[0] <= counts0[0] - 10
+
+
+def test_calc_pg_upmaps_inc_collections_disjoint():
+    """A PG retracted and later re-upmapped in one run must appear in
+    only one of old/new pg_upmap_items (the reference erases from the
+    opposite pending collection), else apply_incremental drops it."""
+    m = build_map(n_osd=16, pg_num=256, size=3)
+    parent = remap.build_parent_map(m.crush)
+    h0 = host_of(m.crush, parent, 0)
+    mapping = OSDMapMapping()
+    mapping.update(m)
+    up = mapping.pools[0].up
+    made = 0
+    for ps in range(256):
+        row = [int(o) for o in up[ps]]
+        if 0 in row or any(host_of(m.crush, parent, x) == h0 for x in row):
+            continue
+        for o in row:
+            m.pg_upmap_items[PG(0, ps)] = [(o, 0)]
+            made += 1
+            break
+        if made >= 40:
+            break
+    inc = Incremental(epoch=m.epoch + 1)
+    calc_pg_upmaps(m, 0.001, 300, set(), inc)
+    overlap = set(inc.new_pg_upmap_items) & set(inc.old_pg_upmap_items)
+    assert not overlap
+    # applying must produce exactly the balancer's view
+    m2 = apply_pending(m, inc)
+    for pg in inc.new_pg_upmap_items:
+        assert m2.pg_upmap_items.get(pg) == inc.new_pg_upmap_items[pg]
+
+
+def test_calc_pg_upmaps_survives_weightless_upmap_target():
+    """Stale pg_upmap_items pointing at a marked-out osd must not crash
+    the run when retracted (the out osd has no crush-weight target)."""
+    m = build_map(n_osd=16, pg_num=256, size=3)
+    parent = remap.build_parent_map(m.crush)
+    h15 = host_of(m.crush, parent, 15)
+    mapping = OSDMapMapping()
+    mapping.update(m)
+    up = mapping.pools[0].up
+    made = 0
+    for ps in range(256):
+        row = [int(o) for o in up[ps]]
+        if 15 in row or any(host_of(m.crush, parent, x) == h15 for x in row):
+            continue
+        m.pg_upmap_items[PG(0, ps)] = [(row[0], 15)]
+        made += 1
+        if made >= 20:
+            break
+    m.osd_weight[15] = 0  # mark out: osd 15 now carries no target
+    inc = Incremental(epoch=m.epoch + 1)
+    n = calc_pg_upmaps(m, 0.001, 200, set(), inc)
+    assert n > 0  # ran to completion and made progress
+    m2 = apply_pending(m, inc)
+    mapping2 = OSDMapMapping()
+    mapping2.update(m2)
+    counts = mapping2.osd_pg_counts(m2.max_osd, acting=False)
+    assert counts[15] == 0 or counts[15] < 20
+
+
+def test_balancer_driver_multi_pool():
+    m = build_map(n_osd=16, pg_num=128, size=3)
+    m.pools[1] = PGPool(pg_num=64, pgp_num=64, size=2)
+    m.pool_names[1] = "two"
+    b = Balancer(max_deviation=1, max_iterations=500)
+    before = b.score(m)
+    inc = b.optimize(m)
+    m2 = apply_pending(m, inc)
+    after = b.score(m2)
+    assert after["stddev"] < before["stddev"]
+    assert after["max_deviation"] <= before["max_deviation"]
+
+
+def test_osdmaptool_upmap_cli(tmp_path, capsys):
+    """--upmap writes pg-upmap-items commands and rebalances the stored
+    map (ref: src/test/cli/osdmaptool/upmap.t)."""
+    from ceph_tpu.tools import osdmaptool
+    mapfile = str(tmp_path / "om.json")
+    outfile = str(tmp_path / "upmap.txt")
+    assert osdmaptool.main(
+        ["--createsimple", "16", mapfile, "--pg-num", "256"]) == 0
+    assert osdmaptool.main(
+        [mapfile, "--upmap", outfile, "--upmap-max", "100",
+         "--upmap-deviation", "1"]) == 0
+    cmds = open(outfile).read().strip().splitlines()
+    assert cmds and all(
+        c.startswith(("ceph osd pg-upmap-items ",
+                      "ceph osd rm-pg-upmap-items ")) for c in cmds)
+    # without --upmap-save the mapfile is untouched (dry-run planner)
+    m1 = osdmaptool.load_map(mapfile)
+    assert len(m1.pg_upmap_items) == 0
+    # with --upmap-save the rebalanced map is written back
+    assert osdmaptool.main(
+        [mapfile, "--upmap", outfile, "--upmap-max", "100",
+         "--upmap-deviation", "1", "--upmap-save"]) == 0
+    m2 = osdmaptool.load_map(mapfile)
+    assert len(m2.pg_upmap_items) > 0
+    dev, _ = max_deviation(m2)
+    assert dev <= 2.0
+
+
+def test_balancer_score_shape():
+    m = build_map(n_osd=8, osds_per_host=2, pg_num=64)
+    s = Balancer().score(m)
+    assert set(s) == {"stddev", "max_deviation", "osds"}
+    assert len(s["osds"]) == 8
+    total = sum(v["pgs"] for v in s["osds"].values())
+    assert total == 64 * 3

@@ -139,6 +139,9 @@ OPTIONS: dict[str, Option] = {opt.name: opt for opt in [
     _o("mon_osd_min_up_ratio", T.FLOAT, 0.3, L.ADVANCED,
        desc="refuse to mark OSDs down below this up fraction"),
     _o("mon_osd_report_timeout", T.SECS, 900.0),
+    _o("mon_osd_min_down_reporters", T.UINT, 2, L.ADVANCED,
+       desc="distinct failure reporters required to mark an OSD down",
+       runtime=True),
     _o("mon_min_osdmap_epochs", T.UINT, 500, L.DEV),
     # balancer (ref: OSDMap.cc calc_pg_upmaps knobs)
     _o("upmap_max_deviation", T.UINT, 5, L.BASIC, runtime=True,

@@ -173,17 +173,20 @@ class CrushWrapper:
 
     # -- convenience for tests/tools --------------------------------------
     @classmethod
-    def build_flat(cls, n_osds: int, weight: float = 1.0) -> "CrushWrapper":
-        """default root -> host-per-osd -> osd, like `osdmaptool
+    def build_flat(cls, n_osds: int, weight: float = 1.0,
+                   osds_per_host: int = 1) -> "CrushWrapper":
+        """default root -> hosts -> osds, like `osdmaptool
         --createsimple` / `crushtool --build` defaults."""
         cw = cls()
         cw.add_bucket("default", "root")
-        for i in range(n_osds):
-            cw.add_bucket(f"host{i}", "host")
-            cw.insert_item(i, weight, f"osd.{i}", f"host{i}")
+        for base in range(0, n_osds, osds_per_host):
+            host = f"host{base // osds_per_host}"
+            cw.add_bucket(host, "host")
+            for i in range(base, min(base + osds_per_host, n_osds)):
+                cw.insert_item(i, weight, f"osd.{i}", host)
             # attach host under root
             root = cw.crush.bucket(cw.get_item_id("default"))
-            hid = cw.get_item_id(f"host{i}")
+            hid = cw.get_item_id(host)
             hb = cw.crush.bucket(hid)
             root.items.append(hid)
             root.item_weights.append(hb.weight)

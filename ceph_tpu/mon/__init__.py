@@ -1,0 +1,15 @@
+"""Monitor: the cluster's map authority.
+
+Single-process mon-lite: a versioned store (MonitorStore), a
+degenerate-quorum Paxos commit pipeline (Paxos/PaxosService), the
+OSDMonitor command engine (pool/EC-profile/osd state/upmap commands),
+and the Monitor daemon speaking MMonCommand/MMonSubscribe/MOSDBoot/
+MOSDFailure over the messenger (ref: src/mon/).
+"""
+from .store import MonitorStore, StoreTransaction
+from .paxos import Paxos, PaxosService
+from .osd_monitor import OSDMonitor
+from .monitor import Monitor
+
+__all__ = ["MonitorStore", "StoreTransaction", "Paxos", "PaxosService",
+           "OSDMonitor", "Monitor"]

@@ -1,0 +1,242 @@
+"""Monitor daemon: the map service endpoint on the messenger.
+
+Command, subscription, boot, and failure-report handling over the wire
+(ref: src/mon/Monitor.cc dispatch_op; OSDMonitor.cc preprocess/
+prepare split; failure handling OSDMonitor.cc:2519 prepare_failure,
+down-out: OSDMonitor.cc tick :4965).  One instance is the map
+authority; OSDs and clients subscribe and receive MMap incrementals on
+every committed epoch — the propagation path the reference runs through
+the mon session subs (src/mon/Monitor.cc handle_subscribe).
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from ..common.log import dout
+from ..common.options import global_config
+from ..msg.messages import (MMap, MMonCommand, MMonCommandAck,
+                            MMonSubscribe, MOSDBoot, MOSDFailure)
+from ..msg.messenger import Dispatcher, LocalNetwork, Message, Messenger
+from ..osd.osdmap import CEPH_OSD_AUTOOUT, CEPH_OSD_IN, OSDMap
+from .osd_monitor import OSDMonitor
+from .paxos import Paxos
+from .store import MonitorStore
+
+
+def build_initial(n_osd: int, osds_per_host: int = 1
+                  ) -> tuple[OSDMap, "CrushWrapper"]:
+    """Named crush tree (default/host*/osd.*) + replicated_rule + all
+    OSDs up/in — the vstart-style bootstrap a fresh mon starts from
+    (ref: OSDMap.cc build_simple with names via CrushWrapper)."""
+    from ..crush.wrapper import CrushWrapper
+    from ..osd.osdmap import CEPH_OSD_EXISTS, CEPH_OSD_UP
+    w = CrushWrapper.build_flat(n_osd, osds_per_host=osds_per_host)
+    w.add_simple_rule("replicated_rule", "default", "host")
+    m = OSDMap()
+    m.set_max_osd(n_osd)
+    for osd in range(n_osd):
+        m.osd_state[osd] = CEPH_OSD_EXISTS | CEPH_OSD_UP
+        m.osd_weight[osd] = CEPH_OSD_IN
+    m.crush = w.crush
+    m.epoch = 1
+    return m, w
+
+
+class Monitor(Dispatcher):
+    """mon.<rank> (ref: src/mon/Monitor.h:201)."""
+
+    def __init__(self, network: LocalNetwork, rank: int = 0,
+                 initial_map: OSDMap | None = None,
+                 initial_wrapper=None, store: MonitorStore | None = None,
+                 threaded: bool = True):
+        self.name = f"mon.{rank}"
+        self.store = store or MonitorStore()
+        self.paxos = Paxos(self.store)
+        self.osdmon = OSDMonitor(self.paxos, initial_map, initial_wrapper)
+        self.ms = Messenger.create(network, self.name, threaded=threaded)
+        self.ms.add_dispatcher(self)
+        # osdmap subscribers: entity -> next epoch they need
+        self._subs: dict[str, int] = {}
+        # failure reports: target osd -> {reporter: stamp}
+        self._failure_reports: dict[int, dict[int, float]] = {}
+        self._down_stamp: dict[int, float] = {}
+        self._lock = threading.RLock()
+
+    # ------------------------------------------------------------ setup
+    def init(self) -> None:
+        self.osdmon.init()
+        self.ms.start()
+
+    def shutdown(self) -> None:
+        self.ms.shutdown()
+
+    @property
+    def osdmap(self) -> OSDMap:
+        return self.osdmon.osdmap
+
+    # -------------------------------------------------------- dispatch
+    def ms_dispatch(self, msg: Message) -> bool:
+        with self._lock:
+            if isinstance(msg, MMonCommand):
+                r, outs, outb = self.handle_command(msg.cmd)
+                self.ms.connect(msg.src).send_message(
+                    MMonCommandAck(tid=msg.tid, result=r, outs=outs,
+                                   outb=outb))
+                return True
+            if isinstance(msg, MMonSubscribe):
+                self._handle_subscribe(msg)
+                return True
+            if isinstance(msg, MOSDBoot):
+                self._handle_boot(msg)
+                return True
+            if isinstance(msg, MOSDFailure):
+                self._handle_failure(msg)
+                return True
+        return False
+
+    # -------------------------------------------------------- commands
+    def handle_command(self, cmdmap: dict) -> tuple[int, str, object]:
+        """Synchronous command path (also used by tests/CLI directly).
+        A failed prepare resets the pending delta so partially staged
+        state can never ride along with the next command."""
+        with self._lock:
+            try:
+                res = self.osdmon.preprocess_command(cmdmap)
+                if res is not None:
+                    return res
+                r, outs, outb = self.osdmon.prepare_command(cmdmap)
+            except (KeyError, ValueError, TypeError) as ex:
+                self.osdmon.create_pending()
+                return -22, f"invalid command arguments: {ex}", None
+            if r == 0:
+                self.osdmon.propose_pending()
+                self._publish()
+            else:
+                self.osdmon.create_pending()
+            return r, outs, outb
+
+    # ---------------------------------------------------- subscriptions
+    def _handle_subscribe(self, msg: MMonSubscribe) -> None:
+        if msg.what != "osdmap":
+            return
+        self._subs[msg.src] = msg.start or 1
+        self._send_maps(msg.src)
+
+    def _send_maps(self, entity: str) -> None:
+        """Send everything from the subscriber's next epoch to current
+        (ref: OSDMonitor.cc send_incremental)."""
+        start = self._subs.get(entity, 1)
+        cur = self.osdmap.epoch
+        if start > cur:
+            return
+        first = self.osdmon.get_first_committed() or 1
+        incs = []
+        if start > first:
+            for e in range(start, cur + 1):
+                inc = self.osdmon.get_incremental(e)
+                if inc is None:
+                    incs = None
+                    break
+                incs.append(inc)
+        else:
+            incs = None
+        if incs is not None and start > 1:
+            m = MMap(incrementals=incs, first=start, last=cur)
+        else:
+            m = MMap(full_map=self.osdmon.get_full_map(cur),
+                     first=cur, last=cur)
+        self.ms.connect(entity).send_message(m)
+        self._subs[entity] = cur + 1
+
+    def _publish(self) -> None:
+        """Push new epochs to all subscribers (post-commit)."""
+        for entity in list(self._subs):
+            self._send_maps(entity)
+
+    # ------------------------------------------------------------- boot
+    def _handle_boot(self, msg: MOSDBoot) -> None:
+        """(ref: OSDMonitor.cc:3270 prepare_boot — mark up; a brand-new
+        osd also gets EXISTS and full in-weight)."""
+        osd = msg.osd
+        m = self.osdmap
+        if osd < 0:
+            return
+        if osd >= m.max_osd:
+            self.osdmon.pending_inc.new_max_osd = osd + 1
+        if osd >= m.max_osd or not m.is_up(osd):
+            inc = self.osdmon.pending_inc
+            inc.new_up_osds.append(osd)
+            if osd >= m.max_osd or not m.exists(osd):
+                inc.new_weight[osd] = CEPH_OSD_IN
+            elif m.osd_state[osd] & CEPH_OSD_AUTOOUT and m.is_out(osd):
+                # an auto-out osd comes back in on boot
+                # (ref: mon_osd_auto_mark_auto_out_in)
+                inc.new_weight[osd] = CEPH_OSD_IN
+                inc.new_state[osd] = \
+                    inc.new_state.get(osd, 0) | CEPH_OSD_AUTOOUT
+            self.osdmon.propose_pending()
+            dout("mon", 1).write("%s: osd.%d boot -> e%d", self.name,
+                                 osd, self.osdmap.epoch)
+            self._publish()
+        self._failure_reports.pop(osd, None)
+        self._down_stamp.pop(osd, None)
+
+    # ---------------------------------------------------------- failure
+    def _handle_failure(self, msg: MOSDFailure) -> None:
+        """Quorum-of-reporters mark-down
+        (ref: OSDMonitor.cc:2519 prepare_failure / check_failure:
+        reporters must be distinct live peers, reports expire after the
+        grace window)."""
+        target = msg.target_osd
+        reporter = msg.reporter
+        m = self.osdmap
+        if not (0 <= target < m.max_osd) or m.is_down(target):
+            return
+        if reporter == target or not (0 <= reporter < m.max_osd) or \
+                m.is_down(reporter):
+            return
+        now = time.monotonic()
+        grace = global_config()["osd_heartbeat_grace"]
+        reports = self._failure_reports.setdefault(target, {})
+        reports[reporter] = now
+        for r, stamp in list(reports.items()):
+            if now - stamp > grace:
+                del reports[r]
+        need = global_config()["mon_osd_min_down_reporters"]
+        if len(reports) >= need:
+            self._mark_down(target)
+
+    def _mark_down(self, osd: int) -> None:
+        self.osdmon.pending_inc.new_down_osds.append(osd)
+        self.osdmon.propose_pending()
+        self._failure_reports.pop(osd, None)
+        self._down_stamp[osd] = time.monotonic()
+        dout("mon", 1).write("%s: marked osd.%d down -> e%d", self.name,
+                             osd, self.osdmap.epoch)
+        self._publish()
+
+    # -------------------------------------------------------------- tick
+    def tick(self, now: float | None = None) -> None:
+        """Periodic: auto-out OSDs down longer than
+        mon_osd_down_out_interval (ref: OSDMonitor.cc:4965 tick)."""
+        with self._lock:
+            now = time.monotonic() if now is None else now
+            interval = global_config()["mon_osd_down_out_interval"]
+            changed = False
+            for osd, stamp in list(self._down_stamp.items()):
+                m = self.osdmap
+                if m.is_up(osd):
+                    del self._down_stamp[osd]
+                    continue
+                if interval and now - stamp >= interval and m.is_in(osd):
+                    self.osdmon.pending_inc.new_weight[osd] = 0
+                    self.osdmon.pending_inc.new_state[osd] = \
+                        self.osdmon.pending_inc.new_state.get(osd, 0) | \
+                        CEPH_OSD_AUTOOUT
+                    changed = True
+                    dout("mon", 1).write("%s: auto-out osd.%d", self.name,
+                                         osd)
+            if changed:
+                self.osdmon.propose_pending()
+                self._publish()

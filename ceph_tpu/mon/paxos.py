@@ -1,0 +1,130 @@
+"""Paxos commit pipeline + PaxosService base.
+
+The reference mon serializes every state change through Paxos
+(ref: src/mon/Paxos.h:174 — begin/accept/commit over the quorum, each
+committed value a MonitorDBStore transaction at version n), and every
+map service is a PaxosService that accumulates a *pending* delta,
+encodes it into a proposal, and refreshes its in-memory state from the
+store after commit (ref: src/mon/PaxosService.h:30).
+
+Mon-lite runs a quorum of one: the proposal path keeps the exact
+begin -> commit -> refresh shape (values land in the store under the
+"paxos" prefix at monotonically increasing versions, first/last
+committed markers maintained) so a replicated accept phase can slot
+between begin and commit without touching the services.
+"""
+from __future__ import annotations
+
+from ..common.log import dout
+from .store import MonitorStore, StoreTransaction
+
+PAXOS_PREFIX = "paxos"
+
+
+class Paxos:
+    """Single-node commit log (ref: src/mon/Paxos.h:174)."""
+
+    def __init__(self, store: MonitorStore, keep_versions: int = 500):
+        self.store = store
+        self.keep_versions = keep_versions
+        self.first_committed = store.get_int(PAXOS_PREFIX,
+                                             "first_committed", 0)
+        self.last_committed = store.get_int(PAXOS_PREFIX,
+                                            "last_committed", 0)
+
+    def propose(self, tx: StoreTransaction) -> int:
+        """begin + commit in one step (quorum of one); returns the
+        committed version (ref: Paxos.cc begin/commit_start)."""
+        v = self.last_committed + 1
+        meta = StoreTransaction()
+        meta.put(PAXOS_PREFIX, v, tx.encode())   # the decided value
+        meta.put(PAXOS_PREFIX, "last_committed", v)
+        if self.first_committed == 0:
+            self.first_committed = 1
+            meta.put(PAXOS_PREFIX, "first_committed", 1)
+        # apply the value itself atomically with the commit record
+        meta.ops.extend(tx.ops)
+        self.store.apply_transaction(meta)
+        self.last_committed = v
+        self._maybe_trim()
+        return v
+
+    def _maybe_trim(self) -> None:
+        """(ref: Paxos.cc trim)."""
+        if self.last_committed - self.first_committed <= self.keep_versions:
+            return
+        new_first = self.last_committed - self.keep_versions
+        tx = StoreTransaction()
+        tx.erase_range(PAXOS_PREFIX, self.first_committed, new_first)
+        tx.put(PAXOS_PREFIX, "first_committed", new_first)
+        self.store.apply_transaction(tx)
+        self.first_committed = new_first
+
+
+class PaxosService:
+    """A map service over Paxos (ref: src/mon/PaxosService.h:30).
+
+    Subclasses implement create_initial / update_from_paxos /
+    create_pending / encode_pending and call propose_pending when a
+    prepare_* handler mutated the pending state.
+    """
+
+    def __init__(self, name: str, paxos: Paxos):
+        self.service_name = name
+        self.paxos = paxos
+        self.store = paxos.store
+        self.have_pending = False
+
+    # -- versioned store helpers (PaxosService.h:690 get/put_version) ----
+    def get_last_committed(self) -> int:
+        return self.store.get_int(self.service_name, "last_committed", 0)
+
+    def get_first_committed(self) -> int:
+        return self.store.get_int(self.service_name, "first_committed", 0)
+
+    def get_version(self, key: str | int):
+        return self.store.get(self.service_name, key)
+
+    def put_version(self, tx: StoreTransaction, key: str | int,
+                    value) -> None:
+        tx.put(self.service_name, key, value)
+
+    # -- subclass interface ---------------------------------------------
+    def create_initial(self) -> None:
+        raise NotImplementedError
+
+    def update_from_paxos(self) -> None:
+        raise NotImplementedError
+
+    def create_pending(self) -> None:
+        raise NotImplementedError
+
+    def encode_pending(self, tx: StoreTransaction) -> None:
+        raise NotImplementedError
+
+    # -- lifecycle -------------------------------------------------------
+    def init(self) -> None:
+        """Bootstrap or catch up, then open a pending period
+        (ref: PaxosService::_active)."""
+        if self.get_last_committed() == 0:
+            self.create_initial()
+            tx = StoreTransaction()
+            self.encode_pending(tx)
+            self.paxos.propose(tx)
+        self.update_from_paxos()
+        self.create_pending()
+        self.have_pending = True
+
+    def propose_pending(self) -> int:
+        """Commit the pending delta and refresh
+        (ref: PaxosService::propose_pending)."""
+        assert self.have_pending
+        tx = StoreTransaction()
+        self.encode_pending(tx)
+        if tx.empty:
+            return self.paxos.last_committed
+        v = self.paxos.propose(tx)
+        dout("mon", 10).write("%s proposed v%d", self.service_name, v)
+        self.update_from_paxos()
+        self.create_pending()
+        return v

@@ -1,0 +1,83 @@
+"""MonitorStore: the mon's versioned key/value backing store.
+
+Shape of src/mon/MonitorDBStore.h: values live under (prefix, key),
+mutations batch into transactions applied atomically, and services
+keep versioned entries ("%d" keys) plus first/last_committed markers.
+In-memory here (the reference sits on RocksDB); the transaction journal
+makes replay/replication possible later.
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Iterator
+
+
+@dataclass
+class StoreTransaction:
+    """Atomic batch of puts/erases
+    (ref: MonitorDBStore.h:51 Transaction)."""
+    ops: list[tuple[str, str, str, Any]] = field(default_factory=list)
+
+    def put(self, prefix: str, key: str | int, value: Any) -> None:
+        self.ops.append(("put", prefix, str(key), value))
+
+    def erase(self, prefix: str, key: str | int) -> None:
+        self.ops.append(("erase", prefix, str(key), None))
+
+    def erase_range(self, prefix: str, first: str | int,
+                    last: str | int) -> None:
+        """erase [first, last) like compact_prefix trimming."""
+        self.ops.append(("erase_range", prefix, str(first), str(last)))
+
+    @property
+    def empty(self) -> bool:
+        return not self.ops
+
+    def encode(self) -> bytes:
+        return pickle.dumps(self.ops)
+
+    @classmethod
+    def decode(cls, data: bytes) -> "StoreTransaction":
+        return cls(ops=pickle.loads(data))
+
+
+class MonitorStore:
+    """(prefix, key) -> value with atomic transactions
+    (ref: MonitorDBStore.h:161 apply_transaction)."""
+
+    def __init__(self) -> None:
+        self._data: dict[tuple[str, str], Any] = {}
+        self._lock = threading.Lock()
+
+    def apply_transaction(self, tx: StoreTransaction) -> None:
+        with self._lock:
+            for op, prefix, key, value in tx.ops:
+                if op == "put":
+                    self._data[(prefix, key)] = value
+                elif op == "erase":
+                    self._data.pop((prefix, key), None)
+                elif op == "erase_range":
+                    lo, hi = int(key), int(value)
+                    # versioned keys are decimal ints
+                    for k in [k for k in self._data
+                              if k[0] == prefix and k[1].isdigit()
+                              and lo <= int(k[1]) < hi]:
+                        del self._data[k]
+
+    def get(self, prefix: str, key: str | int, default: Any = None) -> Any:
+        with self._lock:
+            return self._data.get((prefix, str(key)), default)
+
+    def exists(self, prefix: str, key: str | int) -> bool:
+        with self._lock:
+            return (prefix, str(key)) in self._data
+
+    def get_int(self, prefix: str, key: str | int, default: int = 0) -> int:
+        v = self.get(prefix, key)
+        return default if v is None else int(v)
+
+    def keys(self, prefix: str) -> Iterator[str]:
+        with self._lock:
+            return iter(sorted(k[1] for k in self._data if k[0] == prefix))

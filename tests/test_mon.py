@@ -1,0 +1,384 @@
+"""Monitor / OSDMonitor: paxos commit pipeline, pool & EC-profile
+commands, boot/failure/subscription flow (ref: src/mon/OSDMonitor.cc,
+src/test/mon/osd-pool-create.sh behaviors)."""
+import time
+
+import pytest
+
+from ceph_tpu.mon import Monitor, MonitorStore, Paxos, StoreTransaction
+from ceph_tpu.mon.monitor import build_initial
+from ceph_tpu.msg.messages import (MMap, MMonCommand, MMonCommandAck,
+                                   MMonSubscribe, MOSDBoot, MOSDFailure)
+from ceph_tpu.msg.messenger import Dispatcher, LocalNetwork, Messenger
+from ceph_tpu.osd.osdmap import OSDMap
+from ceph_tpu.osd.types import PG, POOL_TYPE_ERASURE
+
+
+@pytest.fixture
+def mon():
+    net = LocalNetwork()
+    m, w = build_initial(8, osds_per_host=2)
+    mon = Monitor(net, initial_map=m, initial_wrapper=w, threaded=False)
+    mon.init()
+    yield mon
+    mon.shutdown()
+
+
+# ----------------------------------------------------------------- store
+def test_store_transactions():
+    s = MonitorStore()
+    tx = StoreTransaction()
+    tx.put("p", "a", 1)
+    tx.put("p", 5, "five")
+    s.apply_transaction(tx)
+    assert s.get("p", "a") == 1
+    assert s.get("p", "5") == "five"  # int keys stringified
+    tx2 = StoreTransaction()
+    tx2.erase("p", "a")
+    s.apply_transaction(tx2)
+    assert s.get("p", "a") is None
+
+
+def test_paxos_versions_and_trim():
+    s = MonitorStore()
+    p = Paxos(s, keep_versions=5)
+    for i in range(12):
+        tx = StoreTransaction()
+        tx.put("svc", "x", i)
+        assert p.propose(tx) == i + 1
+    assert s.get("svc", "x") == 11
+    assert p.last_committed == 12
+    assert p.first_committed == 12 - 5
+    # trimmed decided values are gone, recent ones remain
+    assert s.get("paxos", 1) is None
+    assert s.get("paxos", 12) is not None
+
+
+# ------------------------------------------------------------- bootstrap
+def test_monitor_bootstrap(mon):
+    assert mon.osdmap.epoch >= 1
+    assert mon.osdmap.max_osd == 8
+    r, outs, outb = mon.handle_command({"prefix": "osd stat"})
+    assert r == 0 and outb["num_up_osds"] == 8
+
+
+def test_osd_tree_names(mon):
+    r, outs, _ = mon.handle_command({"prefix": "osd tree"})
+    assert r == 0
+    assert "root default" in outs
+    assert "host host0" in outs
+
+
+# ------------------------------------------------------ pool create paths
+def test_pool_create_replicated(mon):
+    e0 = mon.osdmap.epoch
+    r, outs, _ = mon.handle_command(
+        {"prefix": "osd pool create", "pool": "data", "pg_num": 64})
+    assert r == 0, outs
+    assert mon.osdmap.epoch == e0 + 1
+    pid = [p for p, n in mon.osdmap.pool_names.items() if n == "data"][0]
+    pool = mon.osdmap.pools[pid]
+    assert pool.size == 3 and pool.pg_num == 64
+    # placements resolve through the named crush rule
+    up, up_p, _, _ = mon.osdmap.pg_to_up_acting_osds(PG(pid, 0))
+    assert len(up) == 3 and up_p in up
+    # duplicate create fails
+    r, outs, _ = mon.handle_command(
+        {"prefix": "osd pool create", "pool": "data", "pg_num": 64})
+    assert r == -17  # EEXIST
+
+
+def test_pool_create_erasure_default_profile(mon):
+    """EC pool via the implicit default profile: the mon drives the
+    plugin's create_rule exactly like OSDMonitor.cc:6458."""
+    r, outs, _ = mon.handle_command(
+        {"prefix": "osd pool create", "pool": "ecpool", "pg_num": 32,
+         "pool_type": "erasure"})
+    assert r == 0, outs
+    pid = [p for p, n in mon.osdmap.pool_names.items()
+           if n == "ecpool"][0]
+    pool = mon.osdmap.pools[pid]
+    assert pool.type == POOL_TYPE_ERASURE
+    assert pool.size == 3          # default profile k=2 m=1
+    assert pool.min_size == 2      # k + min(1, m-1)
+    assert pool.erasure_code_profile == "default"
+    # the plugin-made erasure rule maps with NONE-capable indep
+    up, _, _, _ = mon.osdmap.pg_to_up_acting_osds(PG(pid, 3))
+    assert len(up) == 3
+
+
+def test_pool_create_erasure_custom_profile(mon):
+    r, outs, _ = mon.handle_command(
+        {"prefix": "osd erasure-code-profile set", "name": "k3m2",
+         "profile": {"plugin": "tpu", "k": "3", "m": "2",
+                     "crush-failure-domain": "osd"}})
+    assert r == 0, outs
+    r, outs, outb = mon.handle_command(
+        {"prefix": "osd erasure-code-profile get", "name": "k3m2"})
+    assert r == 0 and outb["k"] == "3"
+    r, outs, _ = mon.handle_command(
+        {"prefix": "osd pool create", "pool": "ec32", "pg_num": 16,
+         "pool_type": "erasure", "erasure_code_profile": "k3m2"})
+    assert r == 0, outs
+    pid = [p for p, n in mon.osdmap.pool_names.items() if n == "ec32"][0]
+    pool = mon.osdmap.pools[pid]
+    assert pool.size == 5 and pool.min_size == 4
+    up, _, _, _ = mon.osdmap.pg_to_up_acting_osds(PG(pid, 1))
+    assert len(up) == 5
+    # profile now in use: rm refuses
+    r, outs, _ = mon.handle_command(
+        {"prefix": "osd erasure-code-profile rm", "name": "k3m2"})
+    assert r == -16 and "in use" in outs
+
+
+def test_profile_override_needs_force(mon):
+    mon.handle_command(
+        {"prefix": "osd erasure-code-profile set", "name": "p1",
+         "profile": {"plugin": "tpu", "k": "2", "m": "1"}})
+    r, outs, _ = mon.handle_command(
+        {"prefix": "osd erasure-code-profile set", "name": "p1",
+         "profile": {"plugin": "tpu", "k": "4", "m": "2"}})
+    assert r == -1 and "force" in outs
+    r, outs, _ = mon.handle_command(
+        {"prefix": "osd erasure-code-profile set", "name": "p1",
+         "profile": {"plugin": "tpu", "k": "4", "m": "2"},
+         "force": True})
+    assert r == 0
+
+
+def test_pool_set_and_delete(mon):
+    mon.handle_command({"prefix": "osd pool create", "pool": "p",
+                        "pg_num": 8, "size": 2})
+    r, outs, _ = mon.handle_command(
+        {"prefix": "osd pool set", "pool": "p", "var": "pg_num",
+         "val": "16"})
+    assert r == 0
+    pid = [p for p, n in mon.osdmap.pool_names.items() if n == "p"][0]
+    assert mon.osdmap.pools[pid].pg_num == 16
+    # pg_num shrink refused
+    r, outs, _ = mon.handle_command(
+        {"prefix": "osd pool set", "pool": "p", "var": "pg_num",
+         "val": "8"})
+    assert r == -1
+    # delete needs the guard
+    r, outs, _ = mon.handle_command(
+        {"prefix": "osd pool delete", "pool": "p"})
+    assert r == -1
+    r, outs, _ = mon.handle_command(
+        {"prefix": "osd pool delete", "pool": "p",
+         "yes_i_really_really_mean_it": True})
+    assert r == 0
+    assert pid not in mon.osdmap.pools
+
+
+# ------------------------------------------------------ osd state commands
+def test_osd_down_out_in(mon):
+    e0 = mon.osdmap.epoch
+    r, outs, _ = mon.handle_command({"prefix": "osd down", "ids": [3]})
+    assert r == 0 and mon.osdmap.is_down(3)
+    r, outs, _ = mon.handle_command({"prefix": "osd out", "ids": [3]})
+    assert r == 0 and mon.osdmap.is_out(3)
+    r, outs, _ = mon.handle_command({"prefix": "osd in", "ids": [3]})
+    assert r == 0 and mon.osdmap.is_in(3)
+    assert mon.osdmap.epoch == e0 + 3
+    # idempotent: no epoch bump for an already-in osd
+    r, outs, _ = mon.handle_command({"prefix": "osd in", "ids": [3]})
+    assert r == 0 and "already" in outs
+
+
+def test_reweight_and_upmap_commands(mon):
+    mon.handle_command({"prefix": "osd pool create", "pool": "d",
+                        "pg_num": 8})
+    pid = [p for p, n in mon.osdmap.pool_names.items() if n == "d"][0]
+    r, _, _ = mon.handle_command(
+        {"prefix": "osd reweight", "id": 2, "weight": 0.5})
+    assert r == 0
+    assert mon.osdmap.osd_weight[2] == 0x8000
+    up0, _, _, _ = mon.osdmap.pg_to_up_acting_osds(PG(pid, 0))
+    frm = up0[0]
+    to = next(o for o in range(8) if o not in up0)
+    r, outs, _ = mon.handle_command(
+        {"prefix": "osd pg-upmap-items", "pgid": f"{pid}.0",
+         "id_pairs": [(frm, to)]})
+    assert r == 0, outs
+    assert PG(pid, 0) in mon.osdmap.pg_upmap_items
+    r, _, _ = mon.handle_command(
+        {"prefix": "osd rm-pg-upmap-items", "pgid": f"{pid}.0"})
+    assert r == 0
+    assert PG(pid, 0) not in mon.osdmap.pg_upmap_items
+
+
+# ------------------------------------------------- wire: boot/failure/subs
+class Client(Dispatcher):
+    def __init__(self, net, name):
+        self.ms = Messenger.create(net, name, threaded=False)
+        self.ms.add_dispatcher(self)
+        self.ms.start()
+        self.maps = []
+        self.acks = []
+
+    def ms_dispatch(self, msg):
+        if isinstance(msg, MMap):
+            self.maps.append(msg)
+            return True
+        if isinstance(msg, MMonCommandAck):
+            self.acks.append(msg)
+            return True
+        return False
+
+
+def test_subscribe_and_publish():
+    net = LocalNetwork()
+    m, w = build_initial(4, osds_per_host=1)
+    mon = Monitor(net, initial_map=m, initial_wrapper=w, threaded=False)
+    mon.init()
+    cl = Client(net, "client.1")
+    cl.ms.connect("mon.0").send_message(MMonSubscribe(start=1))
+    mon.ms.poll()
+    cl.ms.poll()
+    assert len(cl.maps) == 1 and cl.maps[0].full_map is not None
+    e0 = cl.maps[0].full_map.epoch
+    # a committed change pushes incrementals to the subscriber
+    cl.ms.connect("mon.0").send_message(MMonCommand(
+        tid=7, cmd={"prefix": "osd pool create", "pool": "x",
+                    "pg_num": 8}))
+    mon.ms.poll()
+    cl.ms.poll()
+    assert cl.acks and cl.acks[0].result == 0 and cl.acks[0].tid == 7
+    assert len(cl.maps) == 2
+    m2 = cl.maps[1]
+    assert m2.incrementals and m2.first == e0 + 1
+    # client can replay the incremental onto its map
+    full = cl.maps[0].full_map
+    for inc in m2.incrementals:
+        full.apply_incremental(inc)
+    assert full.epoch == mon.osdmap.epoch
+    assert any(n == "x" for n in full.pool_names.values())
+    mon.shutdown()
+
+
+def test_boot_and_failure_flow():
+    net = LocalNetwork()
+    m, w = build_initial(4, osds_per_host=1)
+    mon = Monitor(net, initial_map=m, initial_wrapper=w, threaded=False)
+    mon.init()
+    osd_ms = Messenger.create(net, "osd.2", threaded=False)
+    osd_ms.start()
+    # two distinct reporters -> mark down
+    osd_ms.connect("mon.0").send_message(
+        MOSDFailure(target_osd=2, reporter=0))
+    mon.ms.poll()
+    assert mon.osdmap.is_up(2)        # one reporter is not enough
+    osd_ms.connect("mon.0").send_message(
+        MOSDFailure(target_osd=2, reporter=1))
+    mon.ms.poll()
+    assert mon.osdmap.is_down(2)
+    # auto-out after the down-out interval
+    mon._down_stamp[2] = time.monotonic() - 1e6
+    mon.tick()
+    assert mon.osdmap.is_out(2)
+    # boot brings it back up and (auto-out) back in
+    osd_ms.connect("mon.0").send_message(MOSDBoot(osd=2))
+    mon.ms.poll()
+    assert mon.osdmap.is_up(2) and mon.osdmap.is_in(2)
+    # boot of a brand-new osd extends the map
+    osd_ms.connect("mon.0").send_message(MOSDBoot(osd=9))
+    mon.ms.poll()
+    assert mon.osdmap.max_osd == 10 and mon.osdmap.is_up(9)
+    mon.shutdown()
+
+
+def test_failed_command_does_not_leak_pending_state(mon):
+    """A failed multi-id command must not leave earlier ids staged in
+    pending_inc for the next command to commit."""
+    r, outs, _ = mon.handle_command(
+        {"prefix": "osd down", "ids": [0, 999]})
+    assert r != 0
+    assert mon.osdmap.is_up(0)
+    r, _, _ = mon.handle_command({"prefix": "osd setmaxosd",
+                                  "newmax": 8})
+    assert r == 0
+    assert mon.osdmap.is_up(0)  # stray mark-down must not ride along
+
+
+def test_malformed_command_returns_einval(mon):
+    r, outs, _ = mon.handle_command({"prefix": "osd down",
+                                     "ids": ["abc"]})
+    assert r == -22
+    r, outs, _ = mon.handle_command({"prefix": "osd setmaxosd"})
+    assert r == -22
+    r, outs, _ = mon.handle_command({"prefix": "pg map",
+                                     "pgid": "garbage"})
+    assert r == -22
+    # mon still healthy afterwards
+    r, _, _ = mon.handle_command({"prefix": "osd stat"})
+    assert r == 0
+
+
+def test_failure_reports_validated_and_expire():
+    net = LocalNetwork()
+    m, w = build_initial(4, osds_per_host=1)
+    mon = Monitor(net, initial_map=m, initial_wrapper=w, threaded=False)
+    mon.init()
+    ms = Messenger.create(net, "osd.9", threaded=False)
+    ms.start()
+    # self-report and invalid reporter ignored
+    ms.connect("mon.0").send_message(MOSDFailure(target_osd=2, reporter=2))
+    ms.connect("mon.0").send_message(MOSDFailure(target_osd=2, reporter=-1))
+    ms.connect("mon.0").send_message(MOSDFailure(target_osd=2, reporter=77))
+    mon.ms.poll()
+    assert mon.osdmap.is_up(2)
+    # stale report expired before a fresh one arrives
+    ms.connect("mon.0").send_message(MOSDFailure(target_osd=2, reporter=0))
+    mon.ms.poll()
+    mon._failure_reports[2][0] -= 1e6  # age far past the grace window
+    ms.connect("mon.0").send_message(MOSDFailure(target_osd=2, reporter=1))
+    mon.ms.poll()
+    assert mon.osdmap.is_up(2)  # stale + fresh != quorum
+    # two fresh distinct reporters do mark it down
+    ms.connect("mon.0").send_message(MOSDFailure(target_osd=2, reporter=0))
+    mon.ms.poll()
+    assert mon.osdmap.is_down(2)
+    mon.shutdown()
+
+
+def test_map_history_trimmed():
+    net = LocalNetwork()
+    m, w = build_initial(2, osds_per_host=1)
+    from ceph_tpu.common.options import global_config
+    cfg = global_config()
+    old = cfg["mon_min_osdmap_epochs"]
+    cfg.set("mon_min_osdmap_epochs", 5)
+    try:
+        mon = Monitor(net, initial_map=m, initial_wrapper=w,
+                      threaded=False)
+        mon.init()
+        for i in range(12):
+            mon.handle_command({"prefix": "osd pool create",
+                                "pool": f"p{i}", "pg_num": 8})
+        e = mon.osdmap.epoch
+        assert mon.osdmon.get_version(f"full_{e}") is not None
+        assert mon.osdmon.get_first_committed() == e - 5
+        assert mon.osdmon.get_version(f"full_{e - 6}") is None
+        mon.shutdown()
+    finally:
+        cfg.set("mon_min_osdmap_epochs", old)
+
+
+def test_map_history_served(mon):
+    mon.handle_command({"prefix": "osd pool create", "pool": "a",
+                        "pg_num": 8})
+    mon.handle_command({"prefix": "osd pool create", "pool": "b",
+                        "pg_num": 8})
+    e = mon.osdmap.epoch
+    r, _, full = mon.handle_command({"prefix": "osd getmap",
+                                     "epoch": e - 1})
+    assert r == 0 and full.epoch == e - 1
+    # monitor restart from the same store recovers the map
+    mon2_store = mon.store
+    net2 = LocalNetwork()
+    mon2 = Monitor(net2, store=mon2_store, threaded=False)
+    mon2.init()
+    assert mon2.osdmap.epoch == e
+    assert set(mon2.osdmap.pool_names.values()) >= {"a", "b"}
+    mon2.shutdown()

@@ -78,14 +78,14 @@ class GFMatmul:
     """
 
     def __init__(self, mat: np.ndarray, use_pallas: bool | None = None):
-        mat = np.ascontiguousarray(mat, dtype=np.uint8)
-        self.r, self.k = mat.shape
+        self.mat = np.ascontiguousarray(mat, dtype=np.uint8)
+        self.r, self.k = self.mat.shape
         self.bitmat = jnp.asarray(
-            companion_bitmatrix(mat.tobytes(), self.r, self.k))
+            companion_bitmatrix(self.mat.tobytes(), self.r, self.k))
         if use_pallas is None:
-            # config-selected backend; pallas only makes sense on TPU.
-            # Measured: the XLA formulation beats the current Pallas
-            # kernel (PERF_NOTES.md), so the schema default is "xla".
+            # config-selected backend; pallas only lowers on TPU.
+            # Measured on v5e (PERF_NOTES.md): the fused planar kernel
+            # beats the XLA formulation ~1.5x, so it is the default.
             from ...common.options import global_config
             use_pallas = (global_config()["ec_tpu_backend"] == "pallas"
                           and jax.default_backend() == "tpu")
@@ -95,72 +95,176 @@ class GFMatmul:
         """data: (..., k, N) uint8 (device or host) -> (..., r, N) uint8."""
         data = jnp.asarray(data, dtype=jnp.uint8)
         if self.use_pallas:
-            return gf_matmul_pallas(self.bitmat, data)
+            return gf_matmul_pallas(self.mat, data)
         return gf_matmul_xla(self.bitmat, data)
 
 
 # ---------------------------------------------------------------------------
-# Pallas fused kernel
+# Grouped (block-diagonal) formulation: full MXU tiles
 # ---------------------------------------------------------------------------
+# A single (8m x 8k) companion matmul uses a sliver of the 128x128 MXU
+# tile (k=8,m=4: 32 of 128 rows, 64 of 128 contraction lanes).  Stacking
+# g stripes' bit-planes into one column vector and the weights into a
+# block-diagonal (8mg x 8kg) matrix turns g tiny matmuls into one dense-
+# tile matmul: for g=4, (128 x 256) @ (256 x N) — full rows, double-pass
+# contraction.  The reshape (S, k, N) -> (S/g, gk, N) is free (no data
+# movement); only the weight matrix grows (by g, with zeros the MXU
+# processes at full rate).
 
-def _gf_kernel(bitmat_ref, data_ref, out_ref):
-    """One N-tile: unpack -> MXU matmul -> mod 2 -> pack, all in VMEM."""
-    data = data_ref[...].astype(jnp.int32)    # (k, TN)
-    k, tn = data.shape
-    shifts = jax.lax.broadcasted_iota(jnp.int32, (1, 8, 1), 1)
-    bits = ((data[:, None, :] >> shifts) & 1).astype(jnp.int8)
-    bits = bits.reshape(8 * k, tn)
+@functools.lru_cache(maxsize=512)
+def grouped_bitmatrix(mat_bytes: bytes, r: int, k: int,
+                      group: int) -> np.ndarray:
+    """Block-diagonal stack of `group` copies of the companion matrix:
+    (8r*g, 8k*g) int8."""
+    b = companion_bitmatrix(mat_bytes, r, k)
+    g = group
+    out = np.zeros((8 * r * g, 8 * k * g), dtype=np.int8)
+    for i in range(g):
+        out[8 * r * i:8 * r * (i + 1), 8 * k * i:8 * k * (i + 1)] = b
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("group",))
+def gf_matmul_xla_grouped(bitmat_g: jax.Array, data: jax.Array,
+                          group: int) -> jax.Array:
+    """data (S, k, N) with S % group == 0; bitmat_g the grouped
+    block-diagonal companion -> (S, r, N)."""
+    s, k, n = data.shape
+    d = data.reshape(s // group, group * k, n)
+    bits = expand_bits(d)
+    acc = jnp.matmul(bitmat_g, bits, preferred_element_type=jnp.int32)
+    out = pack_bits(acc & 1)                    # (S/g, g*r, N)
+    return out.reshape(s, -1, n)
+
+
+# ---------------------------------------------------------------------------
+# Pallas fused kernel (plane-major, pack-by-matmul)
+# ---------------------------------------------------------------------------
+# Design notes (measured on v5e, see PERF_NOTES.md):
+# * The bit-plane expansion must never touch HBM: fused in VMEM per grid
+#   cell.
+# * Plane-major bit layout — all bit-0 planes, then all bit-1 planes —
+#   lowers to 8 flat shift/mask passes with no sublane interleave; the
+#   companion matrix's columns are permuted to match (free, host side).
+# * The byte re-pack is itself a (gr x 8gr) matmul against a weight
+#   matrix with P[i, 8i+t] = 1<<t: elementwise epilogues over the
+#   8x-inflated mod-2 accumulator dominated the kernel before this.
+# * Mosaic constraints: MXU accumulator must be int32; int8/int16
+#   shifts and uint8 iota don't lower (and the int8 compare-mask
+#   variant lowers but runs slower than int32 shifts).
+
+@functools.lru_cache(maxsize=512)
+def _planar_perm(gk: int) -> np.ndarray:
+    """Column permutation taking byte-major bit rows (bit c of byte j at
+    8j+c) to plane-major (at c*gk+j)."""
+    return np.array([8 * j + c for c in range(8) for j in range(gk)],
+                    dtype=np.int64)
+
+
+@functools.lru_cache(maxsize=512)
+def grouped_planar_bitmatrix(mat_bytes: bytes, r: int, k: int,
+                             group: int) -> np.ndarray:
+    """Block-diagonal companion stack with plane-major columns:
+    (8rg, 8kg) int8, ready for the fused kernel."""
+    bg = grouped_bitmatrix(mat_bytes, r, k, group)
+    return np.ascontiguousarray(bg[:, _planar_perm(group * k)])
+
+
+@functools.lru_cache(maxsize=64)
+def pack_matrix(rows: int) -> np.ndarray:
+    """(rows, 8*rows) int8 with P[i, 8i+t] = 1<<t — packs mod-2 bit rows
+    back into bytes as a matmul.  1<<7 wraps to -128 in int8; the int32
+    accumulation truncated to uint8 is still exact mod 256."""
+    p = np.zeros((rows, 8 * rows), dtype=np.int8)
+    for i in range(rows):
+        for t in range(8):
+            p[i, 8 * i + t] = np.int8(np.uint8(1 << t).view(np.int8))
+    return p
+
+
+def _gf_kernel_planar(bitmat_ref, pack_ref, data_ref, out_ref):
+    """One (stripe-group, N-tile) cell: plane-major unpack -> dense-tile
+    MXU matmul -> &1 -> MXU pack-matmul; bit-planes only in VMEM."""
+    data = data_ref[0].astype(jnp.int32)           # (gk, TN)
+    planes = [((data >> c) & 1) for c in range(8)]
+    bits = jnp.concatenate(planes, axis=0).astype(jnp.int8)  # (8gk, TN)
     acc = jax.lax.dot_general(
         bitmat_ref[...], bits, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.int32)     # (8r, TN)
-    acc = acc & 1
-    r8 = acc.shape[0]
-    weights = (jnp.int32(1) << jax.lax.broadcasted_iota(
-        jnp.int32, (1, 8, 1), 1))
-    planes = acc.reshape(r8 // 8, 8, tn) * weights
-    out_ref[...] = planes.sum(axis=1).astype(jnp.uint8)
+        preferred_element_type=jnp.int32)          # (8gr, TN)
+    acc1 = (acc & 1).astype(jnp.int8)
+    packed = jax.lax.dot_general(
+        pack_ref[...], acc1, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)          # (gr, TN)
+    out_ref[0] = packed.astype(jnp.uint8)
 
 
-@functools.partial(jax.jit, static_argnames=("tile_n",))
-def _gf_matmul_pallas_2d(bitmat: jax.Array, data: jax.Array,
-                         tile_n: int) -> jax.Array:
+@functools.partial(jax.jit,
+                   static_argnames=("group", "tile_n", "interpret"))
+def gf_matmul_pallas_grouped(bitmat_gp: jax.Array, data: jax.Array,
+                             group: int, tile_n: int,
+                             interpret: bool = False) -> jax.Array:
+    """Fused grouped kernel: grid (stripe-groups, N-tiles); the grid
+    walks the stripe axis directly (no batch flatten/transpose).
+
+    bitmat_gp: grouped_planar_bitmatrix; data (S, k, N) uint8 with
+    S % group == 0 and N % tile_n == 0."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    k8 = bitmat.shape[1]
-    r8 = bitmat.shape[0]
-    k = k8 // 8
-    r = r8 // 8
-    n = data.shape[1]
-    grid = (n // tile_n,)
-    return pl.pallas_call(
-        _gf_kernel,
-        out_shape=jax.ShapeDtypeStruct((r, n), jnp.uint8),
-        grid=grid,
+    s, k, n = data.shape
+    gr8, gk8 = bitmat_gp.shape
+    gk, gr = gk8 // 8, gr8 // 8
+    d = data.reshape(s // group, gk, n)
+    pmat = jnp.asarray(pack_matrix(gr))
+    out = pl.pallas_call(
+        _gf_kernel_planar,
+        out_shape=jax.ShapeDtypeStruct((s // group, gr, n), jnp.uint8),
+        grid=(s // group, n // tile_n),
         in_specs=[
-            pl.BlockSpec((r8, k8), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((k, tile_n), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((gr8, gk8), lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((gr, gr8), lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, gk, tile_n), lambda i, j: (i, 0, j),
+                         memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((r, tile_n), lambda i: (0, i),
+        out_specs=pl.BlockSpec((1, gr, tile_n), lambda i, j: (i, 0, j),
                                memory_space=pltpu.VMEM),
-    )(bitmat, data)
+        interpret=interpret,
+    )(bitmat_gp, pmat, d)
+    return out.reshape(s, -1, n)
 
 
-def gf_matmul_pallas(bitmat: jax.Array, data: jax.Array) -> jax.Array:
-    """Fused kernel entry; handles batching and ragged tails by splitting
-    into an aligned body (Pallas) and a remainder (XLA path)."""
-    *lead, k, n = data.shape
-    if lead:
-        flat = jnp.moveaxis(data, -2, 0).reshape(k, -1)  # (k, B*N) view
-        out = gf_matmul_pallas(bitmat, flat)
-        r = bitmat.shape[0] // 8
-        return jnp.moveaxis(out.reshape(r, *lead, n), 0, -2)
-    tile_n = 2048
-    if n < tile_n:
-        return gf_matmul_xla(bitmat, data)
-    body_n = (n // tile_n) * tile_n
-    body = _gf_matmul_pallas_2d(bitmat, data[:, :body_n], tile_n)
-    if body_n == n:
-        return body
-    tail = gf_matmul_xla(bitmat, data[:, body_n:])
-    return jnp.concatenate([body, tail], axis=1)
+PALLAS_MIN_TILE = 2048
+PALLAS_TILE = 8192
+
+
+def gf_matmul_pallas(mat: np.ndarray, data: jax.Array,
+                     interpret: bool = False) -> jax.Array:
+    """Fused-kernel entry on the BYTE matrix `mat` (r, k): picks the
+    stripe group (4/2/1 dividing the batch) and N tiling, sends ragged
+    tails through the XLA path.  data (..., k, N) -> (..., r, N)."""
+    mat = np.ascontiguousarray(mat, dtype=np.uint8)
+    r, k = mat.shape
+    *lead, k_, n = data.shape
+    s = int(np.prod(lead)) if lead else 1
+    d = data.reshape(s, k, n)
+    group = 4 if s % 4 == 0 else 2 if s % 2 == 0 else 1
+    tile = PALLAS_TILE if n % PALLAS_TILE == 0 else (
+        PALLAS_MIN_TILE if n % PALLAS_MIN_TILE == 0 else 0)
+    body_n = n if tile else (n // PALLAS_MIN_TILE) * PALLAS_MIN_TILE
+    if body_n == 0:
+        B = jnp.asarray(companion_bitmatrix(mat.tobytes(), r, k))
+        return gf_matmul_xla(B, data)
+    bgp = jnp.asarray(grouped_planar_bitmatrix(mat.tobytes(), r, k, group))
+    if tile:
+        out = gf_matmul_pallas_grouped(bgp, d, group=group, tile_n=tile,
+                                       interpret=interpret)
+    else:
+        body = gf_matmul_pallas_grouped(
+            bgp, d[:, :, :body_n], group=group, tile_n=PALLAS_MIN_TILE,
+            interpret=interpret)
+        B = jnp.asarray(companion_bitmatrix(mat.tobytes(), r, k))
+        tail = gf_matmul_xla(B, d[:, :, body_n:])
+        out = jnp.concatenate([body, tail], axis=2)
+    return out.reshape(*lead, r, n) if lead else out[0]

@@ -1,0 +1,74 @@
+/* Minimal ISA-L-style GF(2^8) erasure encode for the CPU baseline.
+ *
+ * The measured floor for bench.py's vs_baseline: the same split-nibble
+ * PSHUFB scheme ISA-L's ec_encode_data AVX2 assembly uses
+ * (ref: src/erasure-code/isa/ ec_encode_data -> gf_vect_mad_avx2: two
+ * 16-entry table lookups per 32-byte lane, xor-accumulated across k
+ * inputs).  Written from the public algorithm, not the ISA-L sources.
+ *
+ * Build: cc -O3 -mavx2 -shared -fPIC -o libgfavx2.so gf_avx2.c
+ */
+#include <immintrin.h>
+#include <stdint.h>
+#include <string.h>
+
+/* GF(2^8) multiply, AES polynomial 0x11d (same field as jerasure/ISA-L). */
+static uint8_t gf_mul_slow(uint8_t a, uint8_t b)
+{
+    uint16_t p = 0, aa = a;
+    while (b) {
+        if (b & 1)
+            p ^= aa;
+        aa <<= 1;
+        if (aa & 0x100)
+            aa ^= 0x11d;
+        b >>= 1;
+    }
+    return (uint8_t)p;
+}
+
+/* Per-coefficient nibble tables: lo[x] = c*x, hi[x] = c*(x<<4). */
+static void build_tables(uint8_t c, uint8_t lo[16], uint8_t hi[16])
+{
+    for (int x = 0; x < 16; x++) {
+        lo[x] = gf_mul_slow(c, (uint8_t)x);
+        hi[x] = gf_mul_slow(c, (uint8_t)(x << 4));
+    }
+}
+
+/* out[m][len] ^= mat[m][k] * data[k][len], 32 bytes per AVX2 step.
+ * mat is row-major (m x k); data/out are arrays of row pointers. */
+void gf_encode_avx2(int k, int m, long len, const uint8_t *mat,
+                    const uint8_t **data, uint8_t **out)
+{
+    const __m256i mask0f = _mm256_set1_epi8(0x0f);
+    for (int i = 0; i < m; i++)
+        memset(out[i], 0, (size_t)len);
+    for (int j = 0; j < k; j++) {
+        for (int i = 0; i < m; i++) {
+            uint8_t lo[16], hi[16];
+            build_tables(mat[i * k + j], lo, hi);
+            const __m256i tlo = _mm256_broadcastsi128_si256(
+                _mm_loadu_si128((const __m128i *)lo));
+            const __m256i thi = _mm256_broadcastsi128_si256(
+                _mm_loadu_si128((const __m128i *)hi));
+            const uint8_t *src = data[j];
+            uint8_t *dst = out[i];
+            long n = 0;
+            for (; n + 32 <= len; n += 32) {
+                __m256i v = _mm256_loadu_si256((const __m256i *)(src + n));
+                __m256i l = _mm256_and_si256(v, mask0f);
+                __m256i h = _mm256_and_si256(
+                    _mm256_srli_epi16(v, 4), mask0f);
+                __m256i prod = _mm256_xor_si256(
+                    _mm256_shuffle_epi8(tlo, l),
+                    _mm256_shuffle_epi8(thi, h));
+                __m256i acc = _mm256_loadu_si256((__m256i *)(dst + n));
+                _mm256_storeu_si256((__m256i *)(dst + n),
+                                    _mm256_xor_si256(acc, prod));
+            }
+            for (; n < len; n++)
+                dst[n] ^= gf_mul_slow(mat[i * k + j], src[n]);
+        }
+    }
+}

@@ -1,0 +1,66 @@
+"""Generate the golden EC chunk corpus (tests/fixtures/ec_corpus.json).
+
+Non-regression pinning in the spirit of
+src/test/erasure-code/ceph_erasure_code_non_regression.cc:113 — encode a
+fixed seeded object with every plugin/technique and archive the chunks.
+Run once and commit; the corpus test re-encodes and compares, so any
+change to field tables, matrix constructions, chunk layout, or padding
+is caught even if it stays self-consistent.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from ceph_tpu.ec import registry  # noqa: E402
+
+OBJECT_SIZE = 1536  # not chunk-aligned for every k: exercises padding
+CONFIGS = [
+    ("jerasure", {"k": "4", "m": "2", "technique": "reed_sol_van"}),
+    ("jerasure", {"k": "5", "m": "3", "technique": "reed_sol_van"}),
+    ("jerasure", {"k": "4", "m": "2", "technique": "reed_sol_r6_op"}),
+    ("jerasure", {"k": "4", "m": "2", "technique": "cauchy_orig"}),
+    ("jerasure", {"k": "4", "m": "2", "technique": "cauchy_good"}),
+    ("isa", {"k": "4", "m": "2", "technique": "reed_sol_van"}),
+    ("isa", {"k": "4", "m": "2", "technique": "cauchy"}),
+    ("tpu", {"k": "4", "m": "2", "technique": "reed_sol_van"}),
+    ("tpu", {"k": "4", "m": "2", "technique": "cauchy"}),
+    ("shec", {"k": "4", "m": "3", "c": "2"}),
+    ("shec", {"k": "6", "m": "4", "c": "2"}),
+    ("clay", {"k": "4", "m": "2"}),
+    ("clay", {"k": "6", "m": "3", "d": "8"}),
+    ("lrc", {"k": "4", "m": "2", "l": "3"}),
+    ("lrc", {"mapping": "__DD__DD", "layers": json.dumps(
+        [["_cDD_cDD", ""], ["cDDD____", ""], ["____cDDD", ""]])}),
+]
+
+
+def main() -> None:
+    rng = np.random.default_rng(0xCEF)
+    obj = rng.integers(0, 256, OBJECT_SIZE, dtype=np.uint8).tobytes()
+    out = {"object_sha": __import__("hashlib").sha256(obj).hexdigest(),
+           "object_hex": obj.hex(), "entries": []}
+    for plugin, profile in CONFIGS:
+        ec = registry.factory(plugin, dict(profile))
+        n = ec.get_chunk_count()
+        encoded = ec.encode(set(range(n)), obj)
+        out["entries"].append({
+            "plugin": plugin,
+            "profile": profile,
+            "chunk_count": n,
+            "data_chunk_count": ec.get_data_chunk_count(),
+            "chunk_size": ec.get_chunk_size(OBJECT_SIZE),
+            "chunks": {str(i): bytes(encoded[i]).hex() for i in encoded},
+        })
+    path = os.path.join(os.path.dirname(__file__), "..", "tests",
+                        "fixtures", "ec_corpus.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"wrote {len(out['entries'])} entries to {path}")
+
+
+if __name__ == "__main__":
+    main()

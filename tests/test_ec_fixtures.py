@@ -1,0 +1,237 @@
+"""Non-self-referential EC parity pins (VERDICT round-1 item 7).
+
+Three independent lines of defense against transcription bugs in
+ceph_tpu.ec.gf that would otherwise pass every round-trip test:
+
+1. An INDEPENDENT GF(2^8) implementation (bitwise carryless multiply
+   reduced mod 0x11d — no log/antilog tables, no shared code with
+   gf.py) cross-checked exhaustively against gf.py's tables, plus
+   hand-derived known-answer values.
+2. The coding-matrix constructions rebuilt from their published
+   formulas using only the independent arithmetic (ISA-L
+   gf_gen_rs_matrix / gf_gen_cauchy1_matrix structure, jerasure
+   RAID-6 and Cauchy constructions, Vandermonde systematization by
+   independent Gauss-Jordan).
+3. A committed golden chunk corpus (tests/fixtures/ec_corpus.json,
+   scripts/gen_ec_corpus.py) re-encoded and compared byte-for-byte for
+   every plugin/technique, plus exhaustive erasure-sweep decodes.
+"""
+import itertools
+import json
+import os
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import gf, registry
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+# ---------------------------------------------------------------------------
+# 1. Independent field arithmetic
+# ---------------------------------------------------------------------------
+
+def mul_slow(a: int, b: int) -> int:
+    """Carryless multiply reduced mod x^8+x^4+x^3+x^2+1 — shares nothing
+    with gf.py's log/antilog construction."""
+    p = 0
+    for bit in range(8):
+        if (b >> bit) & 1:
+            p ^= a << bit
+    for bit in range(15, 7, -1):
+        if (p >> bit) & 1:
+            p ^= 0x11D << (bit - 8)
+    return p
+
+
+def inv_slow(a: int) -> int:
+    if a == 0:
+        return 0
+    return next(x for x in range(1, 256) if mul_slow(a, x) == 1)
+
+
+def pow_slow(a: int, n: int) -> int:
+    r = 1
+    for _ in range(n):
+        r = mul_slow(r, a)
+    return r
+
+
+def test_mul_table_exhaustive_vs_independent():
+    MUL = gf.mul_table()
+    for a in range(256):
+        row = np.array([mul_slow(a, b) for b in range(256)],
+                       dtype=np.uint8)
+        assert np.array_equal(MUL[a], row), f"mul table row {a} wrong"
+
+
+def test_inv_table_vs_independent():
+    INV = gf.inv_table()
+    for a in range(256):
+        assert INV[a] == inv_slow(a), f"inv[{a}] wrong"
+
+
+def test_hand_derived_known_answers():
+    # 2*0x80: 0x100 ^ 0x11d = 0x1d
+    assert gf.gf_mul(2, 0x80) == 0x1D
+    # 2*0x8d: 0x11a ^ 0x11d = 0x07
+    assert gf.gf_mul(2, 0x8D) == 0x07
+    # 2*0x8e = 0x11c ^ 0x11d = 1, so inv(2) = 0x8e
+    assert gf.gf_inv(2) == 0x8E
+    # generator order: 2^255 = 1, and 2^8 = 0x1d by the reduction above
+    assert gf.gf_pow(2, 255) == 1
+    assert gf.gf_pow(2, 8) == 0x1D
+    # 3 generates too: 3 = x+1; (x+1)^2 = x^2+1 = 5
+    assert gf.gf_mul(3, 3) == 5
+
+
+# ---------------------------------------------------------------------------
+# 2. Matrix constructions rebuilt from published formulas
+# ---------------------------------------------------------------------------
+
+def invert_slow(mat):
+    """Independent Gauss-Jordan over GF(2^8) using only mul_slow."""
+    n = len(mat)
+    m = [list(row) for row in mat]
+    out = [[1 if i == j else 0 for j in range(n)] for i in range(n)]
+    for i in range(n):
+        if m[i][i] == 0:
+            j = next(r for r in range(i + 1, n) if m[r][i])
+            m[i], m[j] = m[j], m[i]
+            out[i], out[j] = out[j], out[i]
+        piv = inv_slow(m[i][i])
+        m[i] = [mul_slow(piv, x) for x in m[i]]
+        out[i] = [mul_slow(piv, x) for x in out[i]]
+        for r in range(n):
+            if r == i or m[r][i] == 0:
+                continue
+            f = m[r][i]
+            m[r] = [x ^ mul_slow(f, y) for x, y in zip(m[r], m[i])]
+            out[r] = [x ^ mul_slow(f, y) for x, y in zip(out[r], out[i])]
+    return out
+
+
+@pytest.mark.parametrize("k,m", [(2, 1), (4, 2), (8, 4), (5, 3)])
+def test_isa_rs_matrix_structure(k, m):
+    """ISA-L gf_gen_rs_matrix: coding row i = [gen^0..gen^(k-1)],
+    gen = 2^(i-k) (ref: isa-l erasure_code gf_gen_rs_matrix)."""
+    a = gf.isa_rs_matrix(k, m)
+    assert np.array_equal(a[:k], np.eye(k, dtype=np.uint8))
+    for i in range(m):
+        gen = pow_slow(2, i)
+        expect = [pow_slow(gen, j) for j in range(k)]
+        assert list(a[k + i]) == expect, f"rs coding row {i}"
+    assert (a[k] == 1).all()  # XOR row
+
+
+@pytest.mark.parametrize("k,m", [(4, 2), (8, 4)])
+def test_isa_cauchy_matrix_structure(k, m):
+    """gf_gen_cauchy1_matrix: coding row i col j = 1/(i ^ j), i >= k."""
+    a = gf.isa_cauchy_matrix(k, m)
+    for i in range(k, k + m):
+        for j in range(k):
+            assert a[i, j] == inv_slow(i ^ j)
+
+
+def test_jerasure_r6_structure():
+    """RAID-6: P row all ones, Q row = 2^j."""
+    mat = gf.jerasure_r6_coding_matrix(6)
+    assert (mat[0] == 1).all()
+    assert list(mat[1]) == [pow_slow(2, j) for j in range(6)]
+
+
+@pytest.mark.parametrize("k,m", [(4, 2), (5, 3)])
+def test_cauchy_original_structure(k, m):
+    """jerasure cauchy_original: row i col j = 1/(i ^ (m+j))."""
+    a = gf.cauchy_original_coding_matrix(k, m)
+    for i in range(m):
+        for j in range(k):
+            assert a[i, j] == inv_slow(i ^ (m + j))
+
+
+@pytest.mark.parametrize("k,m", [(4, 2), (6, 3)])
+def test_jerasure_vandermonde_independent_rebuild(k, m):
+    """reed_sol_van systematization rebuilt with the independent
+    arithmetic: W = V @ inv(V[:k]), V[i][j] = i^j."""
+    v = [[pow_slow(i, j) for j in range(k)] for i in range(k + m)]
+    top_inv = invert_slow([row[:] for row in v[:k]])
+    expect = [[0] * k for _ in range(m)]
+    for i in range(m):
+        for j in range(k):
+            acc = 0
+            for t in range(k):
+                acc ^= mul_slow(v[k + i][t], top_inv[t][j])
+            expect[i][j] = acc
+    got = gf.jerasure_vandermonde_coding_matrix(k, m)
+    assert [[int(x) for x in r] for r in got] == expect
+
+
+def test_cauchy_good_row0_all_ones_and_mds():
+    """cauchy_good column-normalizes row 0 to all ones and must stay
+    MDS (every k x k submatrix of [I; C] invertible)."""
+    k, m = 4, 2
+    c = gf.cauchy_good_coding_matrix(k, m)
+    assert (c[0] == 1).all()
+    full = np.vstack([np.eye(k, dtype=np.uint8), c])
+    for rows in itertools.combinations(range(k + m), k):
+        sub = full[list(rows)]
+        assert gf.gf_invert_matrix(sub) is not None, rows
+
+
+# ---------------------------------------------------------------------------
+# 3. Golden corpus + erasure sweeps
+# ---------------------------------------------------------------------------
+
+def _corpus():
+    with open(os.path.join(FIXTURES, "ec_corpus.json")) as f:
+        return json.load(f)
+
+
+def test_corpus_reencode_byte_exact():
+    corpus = _corpus()
+    obj = bytes.fromhex(corpus["object_hex"])
+    for entry in corpus["entries"]:
+        ec = registry.factory(entry["plugin"], dict(entry["profile"]))
+        assert ec.get_chunk_count() == entry["chunk_count"]
+        assert ec.get_chunk_size(len(obj)) == entry["chunk_size"]
+        encoded = ec.encode(set(range(entry["chunk_count"])), obj)
+        for i_str, hexdata in entry["chunks"].items():
+            got = bytes(encoded[int(i_str)])
+            assert got == bytes.fromhex(hexdata), \
+                f"{entry['plugin']} {entry['profile']} chunk {i_str}"
+
+
+def test_corpus_decode_sweep():
+    """All erasure patterns up to min(m, 3) of every corpus entry
+    decode back to the archived chunks.  Only shec/lrc may skip
+    patterns (their codes legitimately cannot recover every <=m-subset);
+    MDS plugins must decode every pattern — a raising
+    minimum_to_decode there is itself a regression."""
+    corpus = _corpus()
+    for entry in corpus["entries"]:
+        ec = registry.factory(entry["plugin"], dict(entry["profile"]))
+        n = entry["chunk_count"]
+        chunks = {int(i): np.frombuffer(bytes.fromhex(h), dtype=np.uint8)
+                  for i, h in entry["chunks"].items()}
+        want = set(range(n))
+        m = n - entry["data_chunk_count"]
+        may_skip = entry["plugin"] in ("shec", "lrc")
+        skipped = 0
+        for sz in range(1, min(m, 3) + 1):
+            for erasure in itertools.combinations(range(n), sz):
+                avail = {i: c for i, c in chunks.items()
+                         if i not in erasure}
+                try:
+                    ec.minimum_to_decode(want, set(avail))
+                except Exception:
+                    assert may_skip, \
+                        (entry["plugin"], entry["profile"], erasure)
+                    skipped += 1
+                    continue
+                decoded = ec.decode(want, avail)
+                for i in range(n):
+                    assert np.array_equal(decoded[i], chunks[i]), \
+                        (entry["plugin"], entry["profile"], erasure, i)
+        if not may_skip:
+            assert skipped == 0

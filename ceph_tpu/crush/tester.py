@@ -1,0 +1,143 @@
+"""CrushTester: placement distribution testing for crushtool --test.
+
+Port of src/crush/CrushTester.{h,cc} (test_with_fork -> test :477): map
+x = min_x..max_x through each rule for each num_rep in the rule mask
+range, bucket results by size, count per-device placements, and print
+the reference tool's exact output shapes (--show-utilization /
+--show-statistics / --show-mappings / --show-bad-mappings; golden
+format: src/test/cli/crushtool/arg-order-checks.t:204).
+
+TPU-first: all x values for one (rule, num_rep) go through the batched
+vmapped engine in one dispatch (scalar fallback when the map isn't
+batchable), where the reference forks workers to loop scalar crush.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .batch import BatchUnsupported, compile_map
+from .types import CRUSH_ITEM_NONE, CRUSH_RULE_TAKE
+from .wrapper import CrushWrapper
+from . import mapper as crush_mapper
+
+
+def _fmt_float(v: float) -> str:
+    """C++ default ostream float formatting (6 significant digits)."""
+    return f"{v:g}"
+
+
+class CrushTester:
+    def __init__(self, w: CrushWrapper, min_x: int = 0, max_x: int = 1023,
+                 min_rep: int = 0, max_rep: int = 0, rule: int = -1,
+                 weights: list[int] | None = None):
+        self.w = w
+        self.min_x = min_x
+        self.max_x = max_x
+        self.min_rep = min_rep
+        self.max_rep = max_rep
+        self.rule = rule
+        n = w.crush.max_devices
+        self.weights = list(weights) if weights is not None \
+            else [0x10000] * n
+
+    # ------------------------------------------------------------ engine
+    def _map_all(self, ruleno: int, numrep: int) -> list[list[int]]:
+        xs = np.arange(self.min_x, self.max_x + 1, dtype=np.int64)
+        try:
+            cc = compile_map(self.w.crush)
+            res, cnt = cc.map_batch(
+                xs, np.asarray(self.weights, dtype=np.int64),
+                ruleno=ruleno, result_max=numrep, return_counts=True)
+            res = np.asarray(res)
+            cnt = np.asarray(cnt)
+            return [[int(o) for o in res[i, :cnt[i]]]
+                    for i in range(len(xs))]
+        except BatchUnsupported:
+            return [crush_mapper.do_rule(self.w.crush, ruleno, int(x),
+                                         numrep, self.weights)
+                    for x in xs]
+
+    def _reachable_devices(self, ruleno: int) -> set[int]:
+        """Devices under the rule's TAKE roots
+        (get_maximum_affected_by_rule, CrushTester.cc:133)."""
+        out: set[int] = set()
+        rule = self.w.crush.rules[ruleno]
+        for step in rule.steps:
+            if step.op != CRUSH_RULE_TAKE:
+                continue
+            stack = [step.arg1]
+            while stack:
+                it = stack.pop()
+                if it >= 0:
+                    out.add(it)
+                else:
+                    b = self.w.crush.bucket(it)
+                    if b is not None:
+                        stack.extend(b.items)
+        return out
+
+    # ------------------------------------------------------------ output
+    def test(self, show_utilization: bool = False,
+             show_statistics: bool = False, show_mappings: bool = False,
+             show_bad_mappings: bool = False) -> str:
+        lines: list[str] = []
+        rules = [self.rule] if self.rule >= 0 else [
+            i for i, r in enumerate(self.w.crush.rules) if r is not None]
+        num_x = self.max_x - self.min_x + 1
+        for r in rules:
+            rule = self.w.crush.rules[r] \
+                if 0 <= r < len(self.w.crush.rules) else None
+            if rule is None:
+                lines.append(f"rule {r} dne")
+                continue
+            name = self.w.rule_name_map.get(r, f"rule{r}")
+            min_rep = self.min_rep or rule.mask.min_size
+            max_rep = self.max_rep or rule.mask.max_size
+            lines.append(f"rule {r} ({name}), x = {self.min_x}.."
+                         f"{self.max_x}, numrep = {min_rep}..{max_rep}")
+            reachable = self._reachable_devices(r)
+            total_weight = sum(self.weights[d] for d in reachable
+                               if d < len(self.weights))
+            for nr in range(min_rep, max_rep + 1):
+                results = self._map_all(r, nr)
+                per = np.zeros(self.w.crush.max_devices, dtype=np.int64)
+                sizes: dict[int, int] = {}
+                for x, out in zip(range(self.min_x, self.max_x + 1),
+                                  results):
+                    # size histogram keys on the raw result length,
+                    # NONE holes included (CrushTester.cc:648)
+                    sizes[len(out)] = sizes.get(len(out), 0) + 1
+                    for o in out:
+                        # non-device results (a rule emitting buckets)
+                        # must not wrap into the device counters
+                        if o != CRUSH_ITEM_NONE and 0 <= o < len(per):
+                            per[o] += 1
+                    fmt = "[" + ",".join(str(o) for o in out) + "]"
+                    if show_mappings:
+                        lines.append(f"CRUSH rule {r} x {x} {fmt}")
+                    if show_bad_mappings and (
+                            len(out) != nr or
+                            any(o == CRUSH_ITEM_NONE for o in out)):
+                        lines.append(f"bad mapping rule {r} x {x} "
+                                     f"num_rep {nr} result {fmt}")
+                if show_statistics or show_utilization:
+                    expected_objects = min(nr, len(reachable)) * num_x
+                    for size in sorted(sizes):
+                        lines.append(
+                            f"rule {r} ({name}) num_rep {nr} result "
+                            f"size == {size}:\t{sizes[size]}/{num_x}")
+                    if show_utilization:
+                        # devices with nothing stored (or no weight)
+                        # are omitted (CrushTester.cc:674)
+                        for dev in range(self.w.crush.max_devices):
+                            frac = (self.weights[dev] / total_weight
+                                    if total_weight and dev in reachable
+                                    else 0.0)
+                            expected = frac * expected_objects
+                            if per[dev] == 0 or expected == 0:
+                                continue
+                            lines.append(
+                                f"  device {dev}:\t\t stored : "
+                                f"{per[dev]}\t expected : "
+                                f"{_fmt_float(expected)}")
+        return "\n".join(lines) + ("\n" if lines else "")

@@ -21,6 +21,7 @@ import time
 
 import numpy as np
 
+from ..crush.codec import crush_from_json, crush_to_json
 from ..crush.types import CRUSH_ITEM_NONE
 from ..osd.mapping import OSDMapMapping
 from ..osd.osdmap import OSDMap
@@ -47,41 +48,15 @@ def save_map(m: OSDMap, path: str) -> None:
         "primary_temp": [[pg.pool, pg.ps, p]
                          for pg, p in m.primary_temp.items()],
         "erasure_code_profiles": m.erasure_code_profiles,
-        "choose_args": {
-            str(name): {
-                str(bid): {"ids": arg.ids, "weight_set": arg.weight_set}
-                for bid, arg in args.items()}
-            for name, args in m.crush.choose_args.items()},
-        "crush": {
-            "tunables": [m.crush.choose_local_tries,
-                         m.crush.choose_local_fallback_tries,
-                         m.crush.choose_total_tries,
-                         m.crush.chooseleaf_descend_once,
-                         m.crush.chooseleaf_vary_r,
-                         m.crush.chooseleaf_stable],
-            "straw_calc_version": m.crush.straw_calc_version,
-            "max_devices": m.crush.max_devices,
-            "buckets": [
-                None if b is None else {
-                    "id": b.id, "type": b.type, "alg": b.alg,
-                    "hash": b.hash, "weight": b.weight,
-                    "items": b.items, "item_weights": b.item_weights,
-                } for b in m.crush.buckets],
-            "rules": [
-                None if r is None else {
-                    "steps": [[s.op, s.arg1, s.arg2] for s in r.steps],
-                    "mask": [r.mask.ruleset, r.mask.type,
-                             r.mask.min_size, r.mask.max_size],
-                } for r in m.crush.rules],
-        },
+        # shared codec (ceph_tpu.crush.codec) — same crush encoding as
+        # crushtool map files, choose_args included
+        "crush": crush_to_json(m.crush),
     }
     with open(path, "w") as f:
         json.dump(data, f)
 
 
 def load_map(path: str) -> OSDMap:
-    from ..crush.types import (ChooseArg, CrushBucket, CrushMap, CrushRule,
-                               CrushRuleMask, CrushRuleStep)
     with open(path) as f:
         data = json.load(f)
     m = OSDMap()
@@ -106,37 +81,7 @@ def load_map(path: str) -> OSDMap:
     for pool, ps, p in data.get("primary_temp", []):
         m.primary_temp[PG(pool, ps)] = p
     m.erasure_code_profiles = data.get("erasure_code_profiles", {})
-    c = data["crush"]
-    cm = CrushMap()
-    (cm.choose_local_tries, cm.choose_local_fallback_tries,
-     cm.choose_total_tries, cm.chooseleaf_descend_once,
-     cm.chooseleaf_vary_r, cm.chooseleaf_stable) = c["tunables"]
-    cm.straw_calc_version = c["straw_calc_version"]
-    cm.max_devices = c["max_devices"]
-    for bd in c["buckets"]:
-        cm.buckets.append(None if bd is None else CrushBucket(
-            id=bd["id"], type=bd["type"], alg=bd["alg"], hash=bd["hash"],
-            weight=bd["weight"], items=bd["items"],
-            item_weights=bd["item_weights"]))
-    for rd in c["rules"]:
-        if rd is None:
-            cm.rules.append(None)
-        else:
-            cm.rules.append(CrushRule(
-                steps=[CrushRuleStep(*s) for s in rd["steps"]],
-                mask=CrushRuleMask(*rd["mask"])))
-    for name, args in data.get("choose_args", {}).items():
-        # JSON stringifies the keys; choose_args names are ints in
-        # practice (incl. the -1 DEFAULT_CHOOSE_ARGS set)
-        try:
-            key = int(name)
-        except ValueError:
-            key = name
-        cm.choose_args[key] = {
-            int(bid): ChooseArg(ids=arg.get("ids"),
-                                weight_set=arg.get("weight_set"))
-            for bid, arg in args.items()}
-    m.crush = cm
+    m.crush = crush_from_json(data["crush"])
     return m
 
 

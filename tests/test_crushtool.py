@@ -1,0 +1,257 @@
+"""crushtool-lite: text codec round-trips, tester stats, CLI golden
+shapes (ref: src/crush/CrushCompiler.cc, src/crush/CrushTester.cc:477,
+src/test/cli/crushtool/compile-decompile-recompile.t model)."""
+import numpy as np
+import pytest
+
+from ceph_tpu.crush import mapper as crush_mapper
+from ceph_tpu.crush.compiler import (CompileError, compile_crushmap,
+                                     decompile)
+from ceph_tpu.crush.tester import CrushTester
+from ceph_tpu.crush.wrapper import CrushWrapper
+from ceph_tpu.tools import crushtool
+
+MAP_TXT = """\
+# begin crush map
+tunable choose_local_tries 0
+tunable choose_local_fallback_tries 0
+tunable choose_total_tries 50
+tunable chooseleaf_descend_once 1
+tunable chooseleaf_vary_r 1
+tunable chooseleaf_stable 1
+tunable straw_calc_version 1
+
+# devices
+device 0 osd.0
+device 1 osd.1
+device 2 osd.2
+device 3 osd.3
+device 4 osd.4
+device 5 osd.5
+
+# types
+type 0 osd
+type 1 host
+type 10 root
+
+# buckets
+host host0 {
+\tid -2
+\talg straw2
+\thash 0
+\titem osd.0 weight 1.000
+\titem osd.1 weight 1.000
+}
+host host1 {
+\tid -3
+\talg straw2
+\thash 0
+\titem osd.2 weight 1.000
+\titem osd.3 weight 1.000
+}
+host host2 {
+\tid -4
+\talg straw2
+\thash 0
+\titem osd.4 weight 1.000
+\titem osd.5 weight 2.000
+}
+root default {
+\tid -1
+\talg straw2
+\thash 0
+\titem host0 weight 2.000
+\titem host1 weight 2.000
+\titem host2 weight 3.000
+}
+
+# rules
+rule replicated_rule {
+\tid 0
+\ttype replicated
+\tmin_size 1
+\tmax_size 10
+\tstep take default
+\tstep chooseleaf firstn 0 type host
+\tstep emit
+}
+rule ec_rule {
+\tid 1
+\ttype erasure
+\tmin_size 3
+\tmax_size 6
+\tstep set_chooseleaf_tries 5
+\tstep take default
+\tstep chooseleaf indep 0 type host
+\tstep emit
+}
+
+# end crush map
+"""
+
+
+def compiled():
+    return compile_crushmap(MAP_TXT)
+
+
+# ----------------------------------------------------------------- codec
+def test_compile_structure():
+    w = compiled()
+    assert w.crush.max_devices == 6
+    assert w.get_item_id("default") == -1
+    assert w.get_item_id("host2") == -4
+    assert w.get_type_id("root") == 10
+    b = w.crush.bucket(-4)
+    assert b.items == [4, 5]
+    assert b.item_weights == [0x10000, 0x20000]
+    assert w.crush.choose_total_tries == 50
+    assert w.crush.chooseleaf_stable == 1
+    assert w.get_rule_id("ec_rule") == 1
+    assert w.crush.rules[1].mask.type == 3
+    assert w.crush.rules[1].steps[0].arg1 == 5  # set_chooseleaf_tries
+
+
+def test_decompile_compile_fixed_point():
+    """decompile(compile(t)) is canonical: recompiling and decompiling
+    again is a fixed point (compile-decompile-recompile.t model)."""
+    w1 = compiled()
+    t1 = decompile(w1)
+    w2 = compile_crushmap(t1)
+    t2 = decompile(w2)
+    assert t1 == t2
+
+
+def test_roundtrip_preserves_placements():
+    w1 = compiled()
+    w2 = compile_crushmap(decompile(w1))
+    weights = [0x10000] * 6
+    for ruleno in (0, 1):
+        for x in range(200):
+            a = crush_mapper.do_rule(w1.crush, ruleno, x, 4, weights)
+            b = crush_mapper.do_rule(w2.crush, ruleno, x, 4, weights)
+            assert a == b, (ruleno, x)
+
+
+def test_compile_errors():
+    with pytest.raises(CompileError):
+        compile_crushmap("bogus line\n")
+    with pytest.raises(CompileError):
+        compile_crushmap("type 0 osd\nhost h { id -1\nitem osd.9 "
+                         "weight 1.0\n}\n")  # undefined item
+    with pytest.raises(CompileError):
+        compile_crushmap("device 0 osd.0\n")  # no types
+
+
+def test_decompile_matches_reference_shape():
+    """Spot-check the exact line grammar the reference golden files pin
+    (src/test/cli/crushtool/set-choose.crushmap.txt)."""
+    text = decompile(compiled())
+    assert text.startswith("# begin crush map\n")
+    assert text.endswith("# end crush map\n")
+    assert "tunable choose_total_tries 50" in text
+    assert "device 0 osd.0" in text
+    assert "\titem osd.5 weight 2.000" in text
+    assert "\tstep chooseleaf firstn 0 type host" in text
+    assert "\tstep set_chooseleaf_tries 5" in text
+    assert "rule replicated_rule {" in text
+
+
+# ---------------------------------------------------------------- tester
+def test_tester_counts_match_scalar_engine():
+    w = compiled()
+    t = CrushTester(w, min_x=0, max_x=255, rule=0, min_rep=3, max_rep=3)
+    out = t.test(show_utilization=True)
+    # recompute per-device counts with the scalar oracle
+    per = np.zeros(6, dtype=np.int64)
+    weights = [0x10000] * 6
+    for x in range(256):
+        for o in crush_mapper.do_rule(w.crush, 0, x, 3, weights):
+            per[o] += 1
+    assert "rule 0 (replicated_rule), x = 0..255, numrep = 3..3" in out
+    assert f"result size == 3:\t256/256" in out
+    # "expected" uses the tester's device weight vector (uniform by
+    # default), not crush bucket weights — matching the reference,
+    # whose proportional_weights come from the --weight vector
+    for dev in range(6):
+        assert f"  device {dev}:\t\t stored : {per[dev]}\t " \
+               f"expected : 128" in out
+    # bucket weight skew shows up in `stored`: osd.5 (weight 2) gets
+    # the most placements
+    assert per[5] == per.max()
+
+
+def test_tester_bad_mappings():
+    """Asking for more replicas than hosts yields bad-mapping lines for
+    firstn (short result) (bad-mappings.t model)."""
+    w = compiled()
+    t = CrushTester(w, min_x=0, max_x=63, rule=0, min_rep=5, max_rep=5)
+    out = t.test(show_bad_mappings=True)
+    assert "bad mapping rule 0 x" in out
+    assert "num_rep 5 result [" in out
+
+
+def test_tester_mappings_format():
+    w = compiled()
+    t = CrushTester(w, min_x=0, max_x=3, rule=1, min_rep=3, max_rep=3)
+    out = t.test(show_mappings=True)
+    lines = [ln for ln in out.splitlines()
+             if ln.startswith("CRUSH rule 1 x ")]
+    assert len(lines) == 4
+    assert lines[0].startswith("CRUSH rule 1 x 0 [")
+
+
+# ------------------------------------------------------------------- CLI
+def test_cli_compile_decompile_test(tmp_path, capsys):
+    src = tmp_path / "map.txt"
+    src.write_text(MAP_TXT)
+    mapfile = str(tmp_path / "map.json")
+    assert crushtool.main(["-c", str(src), "-o", mapfile]) == 0
+    assert crushtool.main(["-d", mapfile]) == 0
+    text = capsys.readouterr().out
+    assert "rule ec_rule {" in text
+    # recompile the decompiled text: placements identical
+    src2 = tmp_path / "map2.txt"
+    src2.write_text(text)
+    mapfile2 = str(tmp_path / "map2.json")
+    assert crushtool.main(["-c", str(src2), "-o", mapfile2]) == 0
+    assert crushtool.main(
+        ["-i", mapfile, "--test", "--show-statistics", "--max-x", "127",
+         "--rule", "0", "--num-rep", "3"]) == 0
+    out1 = capsys.readouterr().out
+    assert crushtool.main(
+        ["-i", mapfile2, "--test", "--show-statistics", "--max-x", "127",
+         "--rule", "0", "--num-rep", "3"]) == 0
+    out2 = capsys.readouterr().out
+    assert out1 == out2
+    assert "result size == 3:\t128/128" in out1
+
+
+def test_cli_tree(tmp_path, capsys):
+    src = tmp_path / "map.txt"
+    src.write_text(MAP_TXT)
+    mapfile = str(tmp_path / "map.json")
+    crushtool.main(["-c", str(src), "-o", mapfile])
+    capsys.readouterr()
+    assert crushtool.main(["-i", mapfile, "--tree"]) == 0
+    out = capsys.readouterr().out
+    assert "root default" in out and "host host2" in out
+    assert "osd.5" in out
+
+
+def test_cli_build(tmp_path, capsys):
+    mapfile = str(tmp_path / "built.json")
+    assert crushtool.main(
+        ["--build", "--num-osds", "8", "-o", mapfile,
+         "host", "straw2", "2", "root", "straw2", "0"]) == 0
+    w = crushtool.load(mapfile)
+    assert w.crush.max_devices == 8
+    hosts = [b for b in w.crush.buckets
+             if b is not None and w.type_map[b.type] == "host"]
+    assert len(hosts) == 4 and all(len(h.items) == 2 for h in hosts)
+    roots = [b for b in w.crush.buckets
+             if b is not None and w.type_map[b.type] == "root"]
+    assert len(roots) == 1 and len(roots[0].items) == 4
+    # the built tree decompiles and recompiles
+    text = decompile(w)
+    w2 = compile_crushmap(text)
+    assert decompile(w2) == text

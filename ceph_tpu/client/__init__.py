@@ -1,0 +1,7 @@
+"""Client access layer: Objecter (target calc + resend engine) and the
+librados-like Rados/IoCtx API (ref: src/osdc/Objecter.cc,
+src/librados/)."""
+from .objecter import Objecter, OpFuture
+from .rados import IoCtx, Rados, RadosError
+
+__all__ = ["Objecter", "OpFuture", "Rados", "IoCtx", "RadosError"]

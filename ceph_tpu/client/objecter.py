@@ -1,0 +1,273 @@
+"""Objecter: object op submission with target calculation and resend.
+
+The client-side engine (ref: src/osdc/Objecter.{h,cc}): each op's
+target PG and primary OSD are computed from the client's osdmap
+(_calc_target :1095), ops are tagged with tids and sent to the primary
+(_op_submit :2378, _send_op), and every map epoch or connection reset
+triggers a rescan — ops whose target changed (or that were parked
+homeless for lack of a primary) are resent (_scan_requests,
+handle_osd_map :1182).  The mon subscription keeps the map fresh.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Optional
+
+from ..common.log import dout
+from ..msg.messages import (MMap, MMonCommand, MMonCommandAck,
+                            MMonSubscribe, OSDOp, OSDOpReply)
+from ..msg.messenger import Dispatcher, LocalNetwork, Message, Messenger
+from ..osd.osdmap import OSDMap
+from ..osd.types import PG
+
+_client_ids = itertools.count(4100)
+
+
+class OpFuture:
+    """Completion handle for one op."""
+
+    def __init__(self):
+        self._ev = threading.Event()
+        self.result: int = 0
+        self.errno_name: str = ""
+        self.data: bytes = b""
+        self.attrs: dict = {}
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def wait(self, timeout: float = 30.0) -> "OpFuture":
+        if not self._ev.wait(timeout):
+            raise TimeoutError("op timed out")
+        return self
+
+    def _complete(self, reply: OSDOpReply) -> None:
+        self.result = reply.result
+        self.errno_name = reply.errno_name
+        self.data = reply.data
+        self.attrs = reply.attrs
+        self._ev.set()
+
+
+class _Op:
+    def __init__(self, tid: int, pool: int, oid: str, op: str,
+                 offset: int, length: int, data: bytes,
+                 future: OpFuture):
+        self.tid = tid
+        self.pool = pool
+        self.oid = oid
+        self.op = op
+        self.offset = offset
+        self.length = length
+        self.data = data
+        self.future = future
+        self.pg: Optional[PG] = None
+        self.target_osd = -1
+        self.attempts = 0
+
+
+class Objecter(Dispatcher):
+    """(ref: src/osdc/Objecter.h:1204)."""
+
+    def __init__(self, network: LocalNetwork, name: str | None = None,
+                 mon: str = "mon.0", threaded: bool = True):
+        self.name = name or f"client.{next(_client_ids)}"
+        self.mon = mon
+        self.osdmap = OSDMap()
+        self._map_ev = threading.Event()
+        self._lock = threading.RLock()
+        self._tid = itertools.count(1)
+        self.in_flight: dict[int, _Op] = {}
+        self.homeless: list[_Op] = []
+        self._rescan_timer = None
+        self._pending_cmds: dict = {}
+        self.ms = Messenger.create(network, self.name, threaded=threaded)
+        self.ms.add_dispatcher(self)
+
+    # ------------------------------------------------------------ setup
+    def start(self) -> None:
+        self.ms.start()
+        self.ms.connect(self.mon).send_message(
+            MMonSubscribe(what="osdmap", start=1))
+
+    def shutdown(self) -> None:
+        self.ms.shutdown()
+
+    def wait_for_map(self, epoch: int = 1, timeout: float = 30.0) -> None:
+        import time
+        end = time.monotonic() + timeout
+        while self.osdmap.epoch < epoch:
+            remaining = end - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"no osdmap >= e{epoch} (have e{self.osdmap.epoch})")
+            self._map_ev.clear()
+            if self.osdmap.epoch >= epoch:
+                break
+            self._map_ev.wait(min(remaining, 0.5))
+
+    # --------------------------------------------------------- dispatch
+    def ms_dispatch(self, msg: Message) -> bool:
+        if isinstance(msg, MMap):
+            self._handle_map(msg)
+            return True
+        if isinstance(msg, OSDOpReply):
+            self._handle_reply(msg)
+            return True
+        if isinstance(msg, MMonCommandAck):
+            return self._handle_command_ack(msg)
+        return False
+
+    def ms_handle_reset(self, peer: str) -> None:
+        """Retarget ops aimed at a gone peer (ref:
+        Objecter::ms_handle_reset :4487).  Never blindly resend to the
+        same peer — route() reports the reset synchronously, so a
+        resend to a dead endpoint would recurse; ops whose recalculated
+        target is unchanged park homeless until a newer map (or the
+        rescan timer) moves them."""
+        if not peer.startswith("osd."):
+            return
+        osd = int(peer[4:])
+        with self._lock:
+            for op in list(self.in_flight.values()):
+                if op.target_osd != osd:
+                    continue
+                self._calc_target(op)
+                if op.target_osd == osd or op.target_osd < 0:
+                    del self.in_flight[op.tid]
+                    self.homeless.append(op)
+                else:
+                    self._send_op(op)
+            if self.homeless:
+                self._schedule_rescan()
+
+    # --------------------------------------------------------- map flow
+    def _handle_map(self, msg: MMap) -> None:
+        with self._lock:
+            self.osdmap = self.osdmap.ingest(msg.full_map,
+                                             msg.incrementals)
+            dout("client", 10).write("%s: osdmap e%d", self.name,
+                                     self.osdmap.epoch)
+            self._scan_requests()
+        self._map_ev.set()
+
+    def _scan_requests(self) -> None:
+        """Recompute targets; resend what moved; adopt the homeless
+        (ref: Objecter.cc:1182 handle_osd_map -> _scan_requests)."""
+        for op in list(self.in_flight.values()):
+            old = op.target_osd
+            self._calc_target(op)
+            if op.target_osd != old:
+                if op.target_osd < 0:
+                    del self.in_flight[op.tid]
+                    self.homeless.append(op)
+                else:
+                    self._send_op(op)
+        still_homeless = []
+        for op in self.homeless:
+            self._calc_target(op)
+            if op.target_osd >= 0:
+                self.in_flight[op.tid] = op
+                self._send_op(op)
+            else:
+                still_homeless.append(op)
+        self.homeless = still_homeless
+
+    # ------------------------------------------------------ target calc
+    def _calc_target(self, op: _Op) -> None:
+        """(ref: Objecter.cc:1095 _calc_target)."""
+        try:
+            raw = self.osdmap.object_locator_to_pg(op.oid, op.pool)
+        except KeyError:
+            op.pg, op.target_osd = None, -1
+            return
+        pool = self.osdmap.pools[op.pool]
+        op.pg = pool.raw_pg_to_pg(raw)
+        _, _, _, acting_primary = self.osdmap.pg_to_up_acting_osds(raw)
+        op.target_osd = acting_primary if acting_primary >= 0 and \
+            self.osdmap.is_up(acting_primary) else -1
+
+    # -------------------------------------------------------- op submit
+    def submit(self, pool: int, oid: str, op: str, offset: int = 0,
+               length: int = 0, data: bytes = b"") -> OpFuture:
+        """(ref: Objecter.cc:2378 _op_submit)."""
+        fut = OpFuture()
+        o = _Op(next(self._tid), pool, oid, op, offset, length, data,
+                fut)
+        with self._lock:
+            self._calc_target(o)
+            if o.target_osd < 0:
+                self.homeless.append(o)
+            else:
+                self.in_flight[o.tid] = o
+                self._send_op(o)
+        return fut
+
+    def _send_op(self, op: _Op) -> None:
+        op.attempts += 1
+        self.ms.connect(f"osd.{op.target_osd}").send_message(OSDOp(
+            pgid=op.pg, oid=op.oid, op=op.op, tid=op.tid,
+            epoch=self.osdmap.epoch, offset=op.offset,
+            length=op.length, data=op.data))
+
+    def _handle_reply(self, msg: OSDOpReply) -> None:
+        with self._lock:
+            op = self.in_flight.get(msg.tid)
+            if op is None:
+                return
+            if msg.errno_name == "ESTALE":
+                # target wasn't primary (it may simply be behind on
+                # maps): park + schedule a rescan so the op retries
+                # even if no newer map reaches this client (ref: the
+                # RETRY path in Objecter::handle_osd_op_reply :3547)
+                del self.in_flight[op.tid]
+                self.homeless.append(op)
+                self._schedule_rescan()
+                return
+            del self.in_flight[op.tid]
+        op.future._complete(msg)
+
+    def _schedule_rescan(self, delay: float = 0.05) -> None:
+        """Periodic retry for parked ops (the reference's tick_event)."""
+        if getattr(self, "_rescan_timer", None) is not None:
+            return
+
+        def fire():
+            with self._lock:
+                self._rescan_timer = None
+                # adopts + resends any homeless op whose map target
+                # resolves (incl. the ESTALE case where the target is
+                # unchanged but the OSD was behind on maps)
+                self._scan_requests()
+                if self.homeless:
+                    self._schedule_rescan(min(delay * 2, 1.0))
+
+        self._rescan_timer = threading.Timer(delay, fire)
+        self._rescan_timer.daemon = True
+        self._rescan_timer.start()
+
+    # ---------------------------------------------------- mon commands
+    def mon_command(self, cmd: dict, timeout: float = 30.0
+                    ) -> tuple[int, str, object]:
+        """Synchronous mon command round-trip."""
+        tid = next(self._tid)
+        ev = threading.Event()
+        slot: dict = {}
+        with self._lock:
+            self._pending_cmds[tid] = (ev, slot)
+        self.ms.connect(self.mon).send_message(
+            MMonCommand(tid=tid, cmd=cmd))
+        if not ev.wait(timeout):
+            raise TimeoutError(f"mon command {cmd.get('prefix')} timed out")
+        return slot["r"], slot["outs"], slot["outb"]
+
+    def _handle_command_ack(self, msg: MMonCommandAck) -> bool:
+        entry = self._pending_cmds.pop(msg.tid, None)
+        if entry is None:
+            return False
+        ev, slot = entry
+        slot["r"], slot["outs"], slot["outb"] = \
+            msg.result, msg.outs, msg.outb
+        ev.set()
+        return True

@@ -60,6 +60,30 @@ class ECSubReadReply(Message):
     errors: dict = field(default_factory=dict)        # oid -> errno str
 
 
+@dataclass
+class RepOpWrite(Message):
+    """Replica write fan-out for replicated pools
+    (ref: src/messages/MOSDRepOp.h; ReplicatedBackend.cc
+    issue_op/sub_op_modify)."""
+    pgid: Any = None
+    tid: int = 0
+    oid: str = ""
+    offset: int = 0
+    data: bytes = b""
+    delete: bool = False
+    version: Any = None
+    log_entries: list = field(default_factory=list)
+
+
+@dataclass
+class RepOpReply(Message):
+    """(ref: src/messages/MOSDRepOpReply.h)."""
+    pgid: Any = None
+    tid: int = 0
+    from_osd: int = -1
+    committed: bool = True
+
+
 # ---------------------------------------------------------------- client
 
 

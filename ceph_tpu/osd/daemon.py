@@ -1,0 +1,293 @@
+"""OSD daemon: the per-OSD process wiring PGs to the wire.
+
+The messenger-facing shell around the PG backends (ref: src/osd/OSD.cc
+— init/boot :3054, ms_dispatch/dispatch_op_fast, _dispatch of client
+ops to PrimaryLogPG::do_request; map handling handle_osd_map :8010):
+boots to the mon, subscribes to osdmap epochs, instantiates shard
+services and primary backends for the PGs its map places on it, routes
+client MOSDOp traffic into the backends, and fans sub-ops between
+peers.
+
+TPU-first split kept intact: all coding math stays inside ECBackend's
+batched encode/decode dispatches; the daemon is host-side protocol
+glue.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..common.log import dout
+from ..ec import registry as ec_registry
+from ..msg.messages import (ECSubRead, ECSubReadReply, ECSubWrite,
+                            ECSubWriteReply, MMap, MOSDBoot,
+                            MMonSubscribe, OSDOp, OSDOpReply, RepOpReply,
+                            RepOpWrite)
+from ..msg.messenger import Dispatcher, LocalNetwork, Message, Messenger
+from ..store import MemStore, StoreError
+from .ec_backend import ECBackend, ECPGShard
+from .osdmap import OSDMap
+from .replicated_backend import ReplicatedBackend, ReplicatedPGShard
+from .types import PG, POOL_TYPE_ERASURE
+from ..crush.types import CRUSH_ITEM_NONE
+from ..mon.osd_monitor import DEFAULT_EC_PROFILE
+
+
+class _PGState:
+    """One PG's services on this OSD."""
+
+    def __init__(self):
+        self.shard = None          # ECPGShard | ReplicatedPGShard
+        self.backend = None        # primary-only
+        self.acting: list[int] = []
+        self.acting_primary = -1
+
+
+class OSDDaemon(Dispatcher):
+    """osd.<id> (ref: src/osd/OSD.h:1036)."""
+
+    def __init__(self, network: LocalNetwork, whoami: int,
+                 store: Optional[MemStore] = None, mon: str = "mon.0",
+                 threaded: bool = False):
+        self.whoami = whoami
+        self.name = f"osd.{whoami}"
+        self.mon = mon
+        self.store = store or MemStore()
+        if not self.store.mounted:
+            self.store.mkfs()
+            self.store.mount()
+        self.osdmap = OSDMap()
+        self.pgs: dict[PG, _PGState] = {}
+        self._ecs: dict[str, object] = {}     # profile name -> plugin
+        # shared across backend rebuilds: stale sub-replies must never
+        # alias a new op's tid
+        import itertools
+        self._tid_gen = itertools.count(1)
+        self._lock = threading.RLock()
+        self.ms = Messenger.create(network, self.name, threaded=threaded)
+        self.ms.add_dispatcher(self)
+
+    # ------------------------------------------------------------ setup
+    def init(self) -> None:
+        self.ms.start()
+        self.ms.connect(self.mon).send_message(MOSDBoot(osd=self.whoami))
+        self.ms.connect(self.mon).send_message(
+            MMonSubscribe(what="osdmap", start=1))
+
+    def shutdown(self) -> None:
+        self.ms.shutdown()
+
+    # ------------------------------------------------------- dispatch
+    def ms_dispatch(self, msg: Message) -> bool:
+        if isinstance(msg, MMap):
+            self._handle_map(msg)
+            return True
+        if isinstance(msg, OSDOp):
+            self._handle_client_op(msg)
+            return True
+        if isinstance(msg, ECSubWrite):
+            st = self.pgs.get(msg.pgid)
+            if st is not None and st.shard is not None:
+                reply = st.shard.handle_sub_write(msg)
+                self.ms.connect(msg.src).send_message(reply)
+            return True
+        if isinstance(msg, ECSubRead):
+            st = self.pgs.get(msg.pgid)
+            if st is not None and st.shard is not None:
+                reply = st.shard.handle_sub_read(msg)
+                self.ms.connect(msg.src).send_message(reply)
+            return True
+        if isinstance(msg, ECSubWriteReply):
+            st = self.pgs.get(msg.pgid)
+            if st is not None and st.backend is not None:
+                if not st.backend.handle_recovery_write_reply(msg):
+                    st.backend.handle_sub_write_reply(msg)
+            return True
+        if isinstance(msg, ECSubReadReply):
+            st = self.pgs.get(msg.pgid)
+            if st is not None and st.backend is not None:
+                st.backend.handle_sub_read_reply(msg)
+            return True
+        if isinstance(msg, RepOpWrite):
+            st = self.pgs.get(msg.pgid)
+            if st is not None and st.shard is not None:
+                reply = st.shard.handle_rep_write(msg, self.whoami)
+                self.ms.connect(msg.src).send_message(reply)
+            return True
+        if isinstance(msg, RepOpReply):
+            st = self.pgs.get(msg.pgid)
+            if st is not None and st.backend is not None:
+                st.backend.handle_rep_reply(msg)
+            return True
+        return False
+
+    # ----------------------------------------------------------- maps
+    def _handle_map(self, msg: MMap) -> None:
+        with self._lock:
+            self.osdmap = self.osdmap.ingest(msg.full_map,
+                                             msg.incrementals)
+            dout("osd", 10).write("%s: now at map e%d", self.name,
+                                  self.osdmap.epoch)
+            self._update_pgs()
+
+    def _ec_plugin(self, profile_name: str):
+        ec = self._ecs.get(profile_name)
+        if ec is None:
+            profile = self.osdmap.erasure_code_profiles.get(
+                profile_name) or (dict(DEFAULT_EC_PROFILE)
+                                  if profile_name == "default" else None)
+            if profile is None:
+                raise KeyError(f"no ec profile {profile_name}")
+            ec = ec_registry.factory(profile["plugin"], dict(profile))
+            self._ecs[profile_name] = ec
+        return ec
+
+    def _update_pgs(self) -> None:
+        """Instantiate/refresh services for PGs mapped onto this OSD
+        (ref: OSD.cc consume_map -> split/instantiate PGs)."""
+        m = self.osdmap
+        seen: set[PG] = set()
+        for pool_id, pool in m.pools.items():
+            for ps in range(pool.pg_num):
+                pg = PG(pool_id, ps)
+                up, up_p, acting, acting_p = m.pg_to_up_acting_osds(pg)
+                acting = [-1 if o == CRUSH_ITEM_NONE else o
+                          for o in acting]
+                if self.whoami not in acting:
+                    continue
+                seen.add(pg)
+                st = self.pgs.get(pg)
+                if st is not None and st.acting == acting and \
+                        st.acting_primary == acting_p and \
+                        (st.backend is None) == (acting_p != self.whoami):
+                    if st.backend is not None:
+                        st.backend.epoch = m.epoch
+                    continue
+                old = self.pgs.get(pg)
+                if old is not None and old.backend is not None:
+                    # acting change: abort queued ops so clients see
+                    # failures and retry, instead of hanging
+                    old.backend.fail_in_flight()
+                st = _PGState()
+                st.acting = acting
+                st.acting_primary = acting_p
+                if pool.type == POOL_TYPE_ERASURE:
+                    ec = self._ec_plugin(pool.erasure_code_profile
+                                         or "default")
+                    shard_idx = acting.index(self.whoami)
+                    st.shard = ECPGShard(
+                        pg, shard_idx, self.store,
+                        ec.get_data_chunk_count(),
+                        ec.get_coding_chunk_count())
+                    if acting_p == self.whoami:
+                        st.backend = ECBackend(
+                            pg, ec, whoami=self.whoami, acting=acting,
+                            local_shard=st.shard,
+                            send=self._make_send(pg),
+                            epoch=m.epoch, tid_gen=self._tid_gen)
+                else:
+                    st.shard = ReplicatedPGShard(pg, self.store)
+                    if acting_p == self.whoami:
+                        st.backend = ReplicatedBackend(
+                            pg, self.whoami, acting, st.shard,
+                            send=self._make_send(pg), epoch=m.epoch,
+                            tid_gen=self._tid_gen)
+                self.pgs[pg] = st
+        for pg in list(self.pgs):
+            if pg not in seen:
+                del self.pgs[pg]
+
+    def _make_send(self, pg: PG):
+        def send(shard_idx: int, payload) -> bool:
+            st = self.pgs.get(pg)
+            if st is None or not (0 <= shard_idx < len(st.acting)):
+                return False
+            osd = st.acting[shard_idx]
+            if osd < 0:
+                return False
+            return self.ms.connect(f"osd.{osd}").send_message(payload)
+        return send
+
+    # ---------------------------------------------------- client ops
+    def _reply(self, msg: OSDOp, result: int, errno_name: str = "",
+               data: bytes = b"", attrs: dict | None = None) -> None:
+        self.ms.connect(msg.src).send_message(OSDOpReply(
+            tid=msg.tid, result=result, errno_name=errno_name,
+            data=data, attrs=attrs or {}, epoch=self.osdmap.epoch))
+
+    def _handle_client_op(self, msg: OSDOp) -> None:
+        st = self.pgs.get(msg.pgid)
+        if st is None or st.backend is None or \
+                st.acting_primary != self.whoami:
+            # not the primary for this pg (stale client map)
+            self._reply(msg, -1, "ESTALE")
+            return
+        b = st.backend
+        try:
+            if msg.op == "write":
+                b.submit_transaction(
+                    msg.oid, msg.offset, msg.data,
+                    lambda ok, m=msg: self._reply(
+                        m, 0 if ok else -5, "" if ok else "EIO"))
+            elif msg.op == "write_full":
+                # delete-then-write through the ordered pipeline so a
+                # longer prior object leaves no tail
+                def after_delete(_ok, m=msg):
+                    b.submit_transaction(
+                        m.oid, 0, m.data,
+                        lambda ok2, m2=m: self._reply(
+                            m2, 0 if ok2 else -5, "" if ok2 else "EIO"))
+                if self._object_exists(st, msg.oid):
+                    b.submit_transaction(msg.oid, 0, b"", after_delete,
+                                         delete=True)
+                else:
+                    after_delete(True)
+            elif msg.op == "delete":
+                if b.object_size(msg.oid) == 0 and not \
+                        self._object_exists(st, msg.oid):
+                    self._reply(msg, -2, "ENOENT")
+                    return
+                b.submit_transaction(
+                    msg.oid, 0, b"",
+                    lambda ok, m=msg: self._reply(
+                        m, 0 if ok else -5, "" if ok else "EIO"),
+                    delete=True)
+            elif msg.op == "read":
+                self._do_read(st, msg)
+            elif msg.op == "stat":
+                if not self._object_exists(st, msg.oid):
+                    self._reply(msg, -2, "ENOENT")
+                    return
+                self._reply(msg, 0,
+                            attrs={"size": b.object_size(msg.oid)})
+            else:
+                self._reply(msg, -22, "EINVAL")
+        except StoreError as err:
+            self._reply(msg, -5, err.errno_name)
+
+    def _object_exists(self, st: _PGState, oid: str) -> bool:
+        return st.shard.exists(oid)
+
+    def _do_read(self, st: _PGState, msg: OSDOp) -> None:
+        b = st.backend
+        if isinstance(b, ReplicatedBackend):
+            try:
+                data = b.read(msg.oid, msg.offset, msg.length)
+                self._reply(msg, 0, data=data)
+            except StoreError as err:
+                self._reply(msg, -2 if err.errno_name == "ENOENT"
+                            else -5, err.errno_name)
+            return
+        if not self._object_exists(st, msg.oid):
+            self._reply(msg, -2, "ENOENT")
+            return
+        window = None if (msg.offset == 0 and msg.length == 0) \
+            else (msg.offset, msg.length)
+
+        def on_complete(results, errors, m=msg):
+            if m.oid in errors:
+                self._reply(m, -5, errors[m.oid])
+            else:
+                self._reply(m, 0, data=bytes(results.get(m.oid, b"")))
+
+        b.objects_read_and_reconstruct({msg.oid: window}, on_complete)

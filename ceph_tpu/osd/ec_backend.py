@@ -132,6 +132,10 @@ class ECPGShard:
         return sorted({o.name for o in self.store.collection_list(self.cid)
                        if o.name != "pgmeta"})
 
+    def exists(self, oid: str) -> bool:
+        return self.store.exists(self.cid,
+                                 ObjectId(oid, shard=self.shard))
+
 
 # ------------------------------------------------------------------ primary
 
@@ -186,7 +190,7 @@ class ECBackend:
                  acting: list[int],
                  local_shard: ECPGShard,
                  send: Callable[[int, object], bool],
-                 epoch: int = 1):
+                 epoch: int = 1, tid_gen=None):
         self.pgid = pgid
         self.ec = ec
         self.k = ec.get_data_chunk_count()
@@ -204,6 +208,10 @@ class ECBackend:
         self.peer_missing: dict[int, PGMissing] = {
             s: PGMissing() for s in range(len(acting))}
         self._tid = 0
+        # optional shared generator: a daemon rebuilding backends after
+        # a map change must not restart tids or a stale sub-reply could
+        # alias a new op
+        self._tid_gen = tid_gen
         self._lock = threading.RLock()
         # the three-queue pipeline (ref: ECBackend.h waiting_state/
         # waiting_reads/waiting_commit)
@@ -217,8 +225,28 @@ class ECBackend:
 
     # -- utilities ------------------------------------------------------
     def _next_tid(self) -> int:
+        if self._tid_gen is not None:
+            return next(self._tid_gen)
         self._tid += 1
         return self._tid
+
+    def fail_in_flight(self) -> None:
+        """Abort every queued/pending op with failure callbacks — used
+        when the daemon tears a backend down on an acting-set change so
+        no client op is silently dropped (the reference requeues
+        through peering; see PG::on_change)."""
+        with self._lock:
+            writes = list(self.tid_to_op.values())
+            reads = list(self.in_flight_reads.values())
+            self.tid_to_op.clear()
+            self.in_flight_reads.clear()
+            self.waiting_state.clear()
+            self.waiting_reads.clear()
+            self.waiting_commit.clear()
+        for op in writes:
+            op.on_all_commit(False)
+        for rd in reads:
+            rd.on_complete({}, {oid: "ESTALE" for oid in rd.reads})
 
     def _next_version(self) -> EVersion:
         self.last_version = EVersion(self.epoch,

@@ -364,6 +364,21 @@ class OSDMap:
     def clone(self) -> "OSDMap":
         return copy.deepcopy(self)
 
+    def ingest(self, full_map: "OSDMap | None",
+               incrementals: list) -> "OSDMap":
+        """Apply a map publish (full and/or incrementals) and return
+        the resulting map — newer full maps replace, stale ones are
+        ignored, incs apply in epoch order.  Shared by the OSD daemon
+        and the Objecter (ref: OSD.cc handle_osd_map :8010,
+        Objecter.cc handle_osd_map :1182)."""
+        m = self
+        if full_map is not None and full_map.epoch > m.epoch:
+            m = full_map
+        for inc in incrementals:
+            if inc.epoch == m.epoch + 1:
+                m.apply_incremental(inc)
+        return m
+
     # ------------------------------------------------------------------
     # convenience builders (vstart-style, for tests/tools)
     def build_simple(self, n_osd: int, pg_pool: PGPool | None = None,

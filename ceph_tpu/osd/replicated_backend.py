@@ -1,0 +1,191 @@
+"""ReplicatedBackend: the replicated-pool PG data plane.
+
+The primary applies each write locally and fans whole-object segments
+out to the replicas, acking the client once every acting shard
+committed (ref: src/osd/ReplicatedBackend.{h,cc}: submit_transaction
+:1069 -> issue_op :999, replica side sub_op_modify/do_repop :1148;
+reads are served from the primary's full local copy, unlike the EC
+reconstruct path).
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable
+
+from ..common.log import dout
+from ..msg.messages import RepOpReply, RepOpWrite
+from ..store import ObjectId, StoreError, Transaction
+from .ec_backend import OI_ATTR, pg_cid
+from .pg_log import PGLog
+from .pg_types import DELETE, EVersion, MODIFY, PGLogEntry, ZERO_VERSION
+
+
+class ReplicatedPGShard:
+    """Per-OSD service for one replicated PG (primary or replica)."""
+
+    def __init__(self, pgid, store):
+        self.pgid = pgid
+        self.store = store
+        self.cid = pg_cid(pgid)
+        self.pg_log = PGLog()
+        if not store.collection_exists(self.cid):
+            store.queue_transaction(
+                Transaction().create_collection(self.cid))
+
+    # -- local apply (both roles; ref: ReplicatedBackend.cc:1148) ------
+    def apply_write(self, oid: str, offset: int, data: bytes,
+                    delete: bool, version, log_entries) -> bool:
+        soid = ObjectId(oid)
+        txn = Transaction()
+        try:
+            if delete:
+                if self.store.exists(self.cid, soid):
+                    txn.remove(self.cid, soid)
+            else:
+                txn.write(self.cid, soid, offset, data)
+                old = self.object_size(oid)
+                txn.setattr(self.cid, soid, OI_ATTR,
+                            {"size": max(old, offset + len(data)),
+                             "version": version})
+            if not txn.empty():
+                self.store.queue_transaction(txn)
+            for e in log_entries:
+                if e.version > self.pg_log.log.head:
+                    self.pg_log.append(e)
+            return True
+        except StoreError as err:
+            dout("osd", 0).write("%s replicated apply failed: %s",
+                                 self.pgid, err)
+            return False
+
+    def handle_rep_write(self, m: RepOpWrite, whoami: int) -> RepOpReply:
+        ok = self.apply_write(m.oid, m.offset, m.data, m.delete,
+                              m.version, m.log_entries)
+        return RepOpReply(pgid=m.pgid, tid=m.tid, from_osd=whoami,
+                          committed=ok)
+
+    def read(self, oid: str, offset: int = 0, length: int = 0) -> bytes:
+        size = self.object_size(oid)
+        if not self.store.exists(self.cid, ObjectId(oid)):
+            raise StoreError("ENOENT", f"{oid} does not exist")
+        buf = self.store.read(self.cid, ObjectId(oid), offset,
+                              length or max(0, size - offset))
+        return bytes(buf)
+
+    def object_size(self, oid: str) -> int:
+        try:
+            return self.store.getattr(self.cid, ObjectId(oid),
+                                      OI_ATTR)["size"]
+        except StoreError:
+            return 0
+
+    def objects(self) -> list[str]:
+        return sorted({o.name for o in self.store.collection_list(self.cid)
+                       if o.name != "pgmeta"})
+
+    def exists(self, oid: str) -> bool:
+        return self.store.exists(self.cid, ObjectId(oid))
+
+
+@dataclass
+class _RepWrite:
+    tid: int
+    on_all_commit: Callable
+    pending: set = field(default_factory=set)
+    failed: set = field(default_factory=set)
+
+
+class ReplicatedBackend:
+    """Primary-side engine for one replicated PG."""
+
+    def __init__(self, pgid, whoami: int, acting: list[int],
+                 local_shard: ReplicatedPGShard,
+                 send: Callable[[int, object], bool], epoch: int = 1,
+                 tid_gen=None):
+        self.pgid = pgid
+        self.whoami = whoami
+        self.acting = list(acting)
+        self.local_shard = local_shard
+        self.send = send
+        self.epoch = epoch
+        self.last_version = ZERO_VERSION
+        self._tid = 0
+        self._tid_gen = tid_gen    # see ECBackend: no tid reuse across
+        self._lock = threading.RLock()      # backend rebuilds
+        self.in_flight: dict[int, _RepWrite] = {}
+
+    def _next_tid(self) -> int:
+        if self._tid_gen is not None:
+            return next(self._tid_gen)
+        self._tid += 1
+        return self._tid
+
+    def fail_in_flight(self) -> None:
+        with self._lock:
+            ops = list(self.in_flight.values())
+            self.in_flight.clear()
+        for op in ops:
+            op.on_all_commit(False)
+
+    def _next_version(self) -> EVersion:
+        self.last_version = EVersion(self.epoch,
+                                     self.last_version.version + 1)
+        return self.last_version
+
+    # -- writes (ref: ReplicatedBackend.cc:1069 submit_transaction) ----
+    def submit_transaction(self, oid: str, offset: int, data: bytes,
+                           on_all_commit: Callable,
+                           delete: bool = False) -> int:
+        with self._lock:
+            tid = self._next_tid()
+            version = self._next_version()
+            entry = PGLogEntry(DELETE if delete else MODIFY, oid,
+                               version)
+            ok = self.local_shard.apply_write(oid, offset, data, delete,
+                                              version, [entry])
+            if not ok:
+                on_all_commit(False)
+                return tid
+            replicas = [i for i, o in enumerate(self.acting)
+                        if o >= 0 and o != self.whoami]
+            if not replicas:
+                on_all_commit(True)
+                return tid
+            op = _RepWrite(tid=tid, on_all_commit=on_all_commit,
+                           pending=set(replicas))
+            self.in_flight[tid] = op
+            msg = RepOpWrite(pgid=self.pgid, tid=tid, oid=oid,
+                             offset=offset, data=data, delete=delete,
+                             version=version, log_entries=[entry])
+            for s in replicas:
+                if not self.send(s, msg):
+                    op.failed.add(s)
+                    op.pending.discard(s)
+            self._maybe_done(op)
+            return tid
+
+    def handle_rep_reply(self, m: RepOpReply) -> None:
+        with self._lock:
+            op = self.in_flight.get(m.tid)
+            if op is None:
+                return
+            for idx, osd in enumerate(self.acting):
+                if osd == m.from_osd and idx in op.pending:
+                    op.pending.discard(idx)
+                    if not m.committed:
+                        op.failed.add(idx)
+            self._maybe_done(op)
+
+    def _maybe_done(self, op: _RepWrite) -> None:
+        if op.pending:
+            return
+        self.in_flight.pop(op.tid, None)
+        op.on_all_commit(not op.failed)
+
+    # -- reads: primary local copy (ref: ReplicatedBackend::objects_read_sync)
+    def read(self, oid: str, offset: int = 0, length: int = 0) -> bytes:
+        return self.local_shard.read(oid, offset, length)
+
+    def object_size(self, oid: str) -> int:
+        return self.local_shard.object_size(oid)

@@ -1,0 +1,4 @@
+"""Test/QA harnesses (the qa/ tier analogues)."""
+from .cluster import MiniCluster
+
+__all__ = ["MiniCluster"]

@@ -1,0 +1,98 @@
+"""MiniCluster: vstart-style single-process cluster harness.
+
+One mon + N OSD daemons + client handles over a LocalNetwork — the
+tier-2 cluster fixture the reference builds with vstart.sh /
+qa/tasks/ceph.py: spin a cluster up, create pools, do IO through
+librados, kill/revive daemons, and let the mon's failure handling and
+the client's resend engine react.
+"""
+from __future__ import annotations
+
+import time
+
+from ..client.rados import Rados
+from ..mon.monitor import Monitor, build_initial
+from ..msg.messenger import LocalNetwork
+from ..osd.daemon import OSDDaemon
+
+
+class MiniCluster:
+    def __init__(self, n_osd: int = 6, osds_per_host: int = 1,
+                 threaded: bool = True):
+        self.network = LocalNetwork()
+        self.threaded = threaded
+        m, w = build_initial(n_osd, osds_per_host=osds_per_host)
+        self.mon = Monitor(self.network, initial_map=m,
+                           initial_wrapper=w, threaded=threaded)
+        self.mon.init()
+        self.osds: dict[int, OSDDaemon] = {}
+        self._stores: dict[int, object] = {}
+        for osd in range(n_osd):
+            self.start_osd(osd)
+        self.clients: list[Rados] = []
+
+    # ------------------------------------------------------------ osds
+    def start_osd(self, osd: int) -> OSDDaemon:
+        store = self._stores.get(osd)
+        d = OSDDaemon(self.network, osd, store=store,
+                      threaded=self.threaded)
+        self._stores[osd] = d.store
+        d.init()
+        self.osds[osd] = d
+        return d
+
+    def kill_osd(self, osd: int) -> None:
+        """Hard-kill: the daemon vanishes from the wire; its store
+        survives for a later restart (qa thrasher kill_osd model)."""
+        d = self.osds.pop(osd, None)
+        if d is not None:
+            d.shutdown()
+
+    def revive_osd(self, osd: int) -> OSDDaemon:
+        return self.start_osd(osd)
+
+    # ---------------------------------------------------------- client
+    def rados(self, timeout: float = 30.0) -> Rados:
+        r = Rados(self.network, op_timeout=timeout,
+                  threaded=self.threaded)
+        self.clients.append(r)   # before connect: pump() must see it
+        if self.threaded:
+            r.connect(timeout)
+        else:
+            r.objecter.start()
+            self.pump()
+            if r.objecter.osdmap.epoch < 1:
+                raise TimeoutError("no osdmap after pump")
+            r._connected = True
+        return r
+
+    # ------------------------------------------------------------ sync
+    def pump(self, rounds: int = 30) -> None:
+        """Non-threaded mode: pump every endpoint until quiescent."""
+        for _ in range(rounds):
+            moved = self.mon.ms.poll()
+            for d in self.osds.values():
+                moved += d.ms.poll()
+            for c in self.clients:
+                moved += c.objecter.ms.poll()
+            if not moved:
+                break
+
+    def wait_all_up(self, timeout: float = 30.0) -> None:
+        end = time.monotonic() + timeout
+        want = set(self.osds)
+        while time.monotonic() < end:
+            if not self.threaded:
+                self.pump()
+            m = self.mon.osdmap
+            if all(o < m.max_osd and m.is_up(o) for o in want):
+                return
+            time.sleep(0.01)
+        raise TimeoutError("osds never came up")
+
+    def shutdown(self) -> None:
+        for c in self.clients:
+            c.shutdown()
+        for d in list(self.osds.values()):
+            d.shutdown()
+        self.mon.shutdown()

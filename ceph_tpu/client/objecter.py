@@ -82,6 +82,9 @@ class Objecter(Dispatcher):
         self.homeless: list[_Op] = []
         self._rescan_timer = None
         self._pending_cmds: dict = {}
+        #: non-threaded harnesses set this to a network pump callable;
+        #: synchronous waits then drive the cluster instead of blocking
+        self.pump_hook = None
         self.ms = Messenger.create(network, self.name, threaded=threaded)
         self.ms.add_dispatcher(self)
 
@@ -94,18 +97,31 @@ class Objecter(Dispatcher):
     def shutdown(self) -> None:
         self.ms.shutdown()
 
-    def wait_for_map(self, epoch: int = 1, timeout: float = 30.0) -> None:
+    def wait_sync(self, done, timeout: float, ev=None) -> bool:
+        """Wait for `done()` — blocking on `ev` (default: the map
+        event) in threaded mode, pumping the harness network
+        otherwise.  Call sites need no threaded-vs-pump branching."""
         import time
+        ev = ev or self._map_ev
         end = time.monotonic() + timeout
-        while self.osdmap.epoch < epoch:
-            remaining = end - time.monotonic()
-            if remaining <= 0:
-                raise TimeoutError(
-                    f"no osdmap >= e{epoch} (have e{self.osdmap.epoch})")
-            self._map_ev.clear()
-            if self.osdmap.epoch >= epoch:
-                break
-            self._map_ev.wait(min(remaining, 0.5))
+        while time.monotonic() < end:
+            if done():
+                return True
+            if self.pump_hook is not None:
+                self.pump_hook()
+                if not done():
+                    time.sleep(0.001)   # idle round: don't spin hot
+            else:
+                ev.wait(min(0.5, max(0.0, end - time.monotonic())))
+                if ev is self._map_ev:
+                    ev.clear()
+        return done()
+
+    def wait_for_map(self, epoch: int = 1, timeout: float = 30.0) -> None:
+        if not self.wait_sync(lambda: self.osdmap.epoch >= epoch,
+                              timeout):
+            raise TimeoutError(
+                f"no osdmap >= e{epoch} (have e{self.osdmap.epoch})")
 
     # --------------------------------------------------------- dispatch
     def ms_dispatch(self, msg: Message) -> bool:
@@ -258,7 +274,7 @@ class Objecter(Dispatcher):
             self._pending_cmds[tid] = (ev, slot)
         self.ms.connect(self.mon).send_message(
             MMonCommand(tid=tid, cmd=cmd))
-        if not ev.wait(timeout):
+        if not self.wait_sync(ev.is_set, timeout, ev=ev):
             raise TimeoutError(f"mon command {cmd.get('prefix')} timed out")
         return slot["r"], slot["outs"], slot["outb"]
 

@@ -114,7 +114,10 @@ class IoCtx:
 
     # -- sync ----------------------------------------------------------
     def _wait(self, fut: OpFuture) -> OpFuture:
-        fut.wait(self.rados.op_timeout)
+        ob = self.rados.objecter
+        if not ob.wait_sync(fut.done, self.rados.op_timeout,
+                            ev=fut._ev):
+            raise TimeoutError("op timed out")
         if fut.result < 0:
             raise RadosError(fut.errno_name or "EIO")
         return fut

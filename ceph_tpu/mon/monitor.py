@@ -49,8 +49,11 @@ class Monitor(Dispatcher):
     def __init__(self, network: LocalNetwork, rank: int = 0,
                  initial_map: OSDMap | None = None,
                  initial_wrapper=None, store: MonitorStore | None = None,
-                 threaded: bool = True):
+                 threaded: bool = True, clock=time.monotonic):
         self.name = f"mon.{rank}"
+        #: injectable clock so harnesses can run the failure/auto-out
+        #: machinery on simulated time consistently with OSD ticks
+        self.clock = clock
         self.store = store or MonitorStore()
         self.paxos = Paxos(self.store)
         self.osdmon = OSDMonitor(self.paxos, initial_map, initial_wrapper)
@@ -196,7 +199,7 @@ class Monitor(Dispatcher):
         if reporter == target or not (0 <= reporter < m.max_osd) or \
                 m.is_down(reporter):
             return
-        now = time.monotonic()
+        now = self.clock()
         grace = global_config()["osd_heartbeat_grace"]
         reports = self._failure_reports.setdefault(target, {})
         reports[reporter] = now
@@ -211,7 +214,7 @@ class Monitor(Dispatcher):
         self.osdmon.pending_inc.new_down_osds.append(osd)
         self.osdmon.propose_pending()
         self._failure_reports.pop(osd, None)
-        self._down_stamp[osd] = time.monotonic()
+        self._down_stamp[osd] = self.clock()
         dout("mon", 1).write("%s: marked osd.%d down -> e%d", self.name,
                              osd, self.osdmap.epoch)
         self._publish()
@@ -221,7 +224,7 @@ class Monitor(Dispatcher):
         """Periodic: auto-out OSDs down longer than
         mon_osd_down_out_interval (ref: OSDMonitor.cc:4965 tick)."""
         with self._lock:
-            now = time.monotonic() if now is None else now
+            now = self.clock() if now is None else now
             interval = global_config()["mon_osd_down_out_interval"]
             changed = False
             for osd, stamp in list(self._down_stamp.items()):

@@ -17,11 +17,14 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
+from ..common.heartbeat_map import HeartbeatMap
 from ..common.log import dout
+from ..common.options import global_config
 from ..ec import registry as ec_registry
 from ..msg.messages import (ECSubRead, ECSubReadReply, ECSubWrite,
                             ECSubWriteReply, MMap, MOSDBoot,
-                            MMonSubscribe, OSDOp, OSDOpReply, RepOpReply,
+                            MMonSubscribe, MOSDFailure, OSDOp,
+                            OSDOpReply, Ping, PingReply, RepOpReply,
                             RepOpWrite)
 from ..msg.messenger import Dispatcher, LocalNetwork, Message, Messenger
 from ..store import MemStore, StoreError
@@ -64,6 +67,19 @@ class OSDDaemon(Dispatcher):
         import itertools
         self._tid_gen = itertools.count(1)
         self._lock = threading.RLock()
+        # heartbeat state (ref: OSD.cc heartbeat_* family)
+        self._hb_last: dict[int, float] = {}   # peer -> last reply time
+        self._hb_first: dict[int, float] = {}  # peer -> first ping time
+        self._hb_reported: set[int] = set()
+        self._hb_now: float | None = None      # our last tick stamp
+        #: test/fault hook: when True the daemon ignores incoming pings
+        #: (a "hung" osd — the heartbeat_inject_failure analogue,
+        #: ref: src/common/options.cc:774)
+        self.inject_heartbeat_mute = False
+        self.hbmap = HeartbeatMap()
+        self._hb_handle = self.hbmap.add_worker(
+            f"{self.name}.tick",
+            grace=4 * global_config()["osd_heartbeat_interval"])
         self.ms = Messenger.create(network, self.name, threaded=threaded)
         self.ms.add_dispatcher(self)
 
@@ -119,15 +135,36 @@ class OSDDaemon(Dispatcher):
             if st is not None and st.backend is not None:
                 st.backend.handle_rep_reply(msg)
             return True
+        if isinstance(msg, Ping):
+            if not self.inject_heartbeat_mute:
+                self.ms.connect(msg.src).send_message(
+                    PingReply(epoch=self.osdmap.epoch, stamp=msg.stamp))
+            return True
+        if isinstance(msg, PingReply):
+            if msg.src.startswith("osd."):
+                peer = int(msg.src[4:])
+                self._hb_last[peer] = max(
+                    self._hb_last.get(peer, 0.0), msg.stamp)
+            return True
         return False
 
     # ----------------------------------------------------------- maps
     def _handle_map(self, msg: MMap) -> None:
         with self._lock:
+            old_up = {o for o in range(self.osdmap.max_osd)
+                      if self.osdmap.is_up(o)}
             self.osdmap = self.osdmap.ingest(msg.full_map,
                                              msg.incrementals)
             dout("osd", 10).write("%s: now at map e%d", self.name,
                                   self.osdmap.epoch)
+            # a peer that came (back) up starts with a clean heartbeat
+            # slate — its pre-down silence must not trigger an instant
+            # re-report (ref: OSD.cc note_up resetting hb peers)
+            for o in range(self.osdmap.max_osd):
+                if self.osdmap.is_up(o) and o not in old_up:
+                    self._hb_first.pop(o, None)
+                    self._hb_last.pop(o, None)
+                    self._hb_reported.discard(o)
             self._update_pgs()
 
     def _ec_plugin(self, profile_name: str):
@@ -207,6 +244,69 @@ class OSDDaemon(Dispatcher):
                 return False
             return self.ms.connect(f"osd.{osd}").send_message(payload)
         return send
+
+    # ------------------------------------------------------ heartbeats
+    def heartbeat_peers(self) -> set[int]:
+        """OSDs sharing PGs with this one (ref: OSD.cc
+        maybe_update_heartbeat_peers — PG peers, not the whole
+        cluster)."""
+        peers: set[int] = set()
+        with self._lock:
+            for st in self.pgs.values():
+                peers.update(o for o in st.acting if o >= 0)
+        peers.discard(self.whoami)
+        return peers
+
+    def heartbeat_tick(self, now: float | None = None) -> None:
+        """Ping peers; report silent ones to the mon after the grace
+        window (ref: OSD.cc heartbeat() + heartbeat_check :4583).
+        `now` may be simulated time for deterministic tests; stamps
+        echo through PingReply so the clocks stay consistent."""
+        import time as _time
+        now = _time.monotonic() if now is None else now
+        self.hbmap.reset_timeout(self._hb_handle)
+        grace = global_config()["osd_heartbeat_grace"]
+        # clock-domain sanity: if our own ticks stopped for more than a
+        # grace (or time went backwards — e.g. a test switching between
+        # real and simulated clocks), everyone gets a fresh window; a
+        # daemon that missed its own ticks cannot blame its peers
+        # (ref: the osd_heartbeat_min_healthy_ratio self-check idea)
+        last_tick = self._hb_now
+        if last_tick is not None and (now < last_tick or
+                                      now - last_tick > grace):
+            self._hb_first.clear()
+            self._hb_last.clear()
+            self._hb_reported.clear()
+        self._hb_now = now
+        peers = self.heartbeat_peers()
+        # prune state for ex-peers (any of the three maps may hold the
+        # only record of a peer that never replied)
+        for p in (set(self._hb_last) | set(self._hb_first) |
+                  self._hb_reported):
+            if p not in peers:
+                self._hb_last.pop(p, None)
+                self._hb_first.pop(p, None)
+                self._hb_reported.discard(p)
+        for p in peers:
+            self._hb_first.setdefault(p, now)
+            self.ms.connect(f"osd.{p}").send_message(
+                Ping(epoch=self.osdmap.epoch, stamp=now))
+        for p in peers:
+            if not self.osdmap.is_up(p):
+                self._hb_reported.discard(p)
+                continue
+            last = self._hb_last.get(p, self._hb_first[p])
+            if now - last > grace:
+                if p not in self._hb_reported:
+                    dout("osd", 1).write(
+                        "%s: no reply from osd.%d in %.1fs, reporting",
+                        self.name, p, now - last)
+                self._hb_reported.add(p)
+                self.ms.connect(self.mon).send_message(MOSDFailure(
+                    target_osd=p, reporter=self.whoami,
+                    failed_for=now - last, epoch=self.osdmap.epoch))
+            else:
+                self._hb_reported.discard(p)
 
     # ---------------------------------------------------- client ops
     def _reply(self, msg: OSDOp, result: int, errno_name: str = "",

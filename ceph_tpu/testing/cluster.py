@@ -21,9 +21,11 @@ class MiniCluster:
                  threaded: bool = True):
         self.network = LocalNetwork()
         self.threaded = threaded
+        self._sim_now: float | None = None
         m, w = build_initial(n_osd, osds_per_host=osds_per_host)
         self.mon = Monitor(self.network, initial_map=m,
-                           initial_wrapper=w, threaded=threaded)
+                           initial_wrapper=w, threaded=threaded,
+                           clock=self._clock)
         self.mon.init()
         self.osds: dict[int, OSDDaemon] = {}
         self._stores: dict[int, object] = {}
@@ -59,6 +61,7 @@ class MiniCluster:
         if self.threaded:
             r.connect(timeout)
         else:
+            r.objecter.pump_hook = self.pump
             r.objecter.start()
             self.pump()
             if r.objecter.osdmap.epoch < 1:
@@ -77,6 +80,26 @@ class MiniCluster:
                 moved += c.objecter.ms.poll()
             if not moved:
                 break
+
+    def _clock(self) -> float:
+        """Mon clock: simulated when ticks carry `now`, else real —
+        keeps the mon's failure/auto-out timers in the same time domain
+        as the OSD heartbeats."""
+        return self._sim_now if self._sim_now is not None \
+            else time.monotonic()
+
+    def tick(self, now: float | None = None) -> None:
+        """One heartbeat round on every live OSD + a mon tick; pumps
+        in non-threaded mode so the exchange completes."""
+        if now is not None:
+            self._sim_now = now
+        for d in self.osds.values():
+            d.heartbeat_tick(now)
+        if not self.threaded:
+            self.pump()
+        self.mon.tick(now)
+        if not self.threaded:
+            self.pump()
 
     def wait_all_up(self, timeout: float = 30.0) -> None:
         end = time.monotonic() + timeout

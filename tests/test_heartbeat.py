@@ -1,0 +1,144 @@
+"""Failure detection: peer heartbeats -> mon mark-down -> auto-out ->
+reboot-in, plus the HeartbeatMap liveness watchdog
+(ref: src/osd/OSD.cc heartbeat_check :4583, src/common/HeartbeatMap.cc,
+OSDMonitor failure handling)."""
+import time
+
+import pytest
+
+from ceph_tpu.common.heartbeat_map import (HeartbeatMap, SuicideTimeout)
+from ceph_tpu.common.options import global_config
+from ceph_tpu.testing import MiniCluster
+
+
+# ------------------------------------------------------------ HeartbeatMap
+def test_heartbeat_map_basics():
+    t = [0.0]
+    hm = HeartbeatMap(clock=lambda: t[0])
+    h = hm.add_worker("tp_osd_tp", grace=5.0)
+    assert hm.is_healthy()
+    t[0] = 4.0
+    assert hm.is_healthy()
+    t[0] = 6.0
+    assert hm.get_unhealthy_workers() == ["tp_osd_tp"]
+    hm.reset_timeout(h)
+    assert hm.is_healthy()
+    hm.clear_timeout(h)
+    t[0] = 100.0
+    assert hm.is_healthy()  # cleared = not armed
+
+
+def test_heartbeat_map_suicide():
+    t = [0.0]
+    hm = HeartbeatMap(clock=lambda: t[0])
+    hm.add_worker("stuck", grace=1.0, suicide_grace=10.0)
+    t[0] = 5.0
+    assert not hm.is_healthy()   # grace blown, still alive
+    t[0] = 11.0
+    with pytest.raises(SuicideTimeout):
+        hm.is_healthy()
+
+
+# --------------------------------------------------------- cluster flow
+def make_cluster(n=4):
+    c = MiniCluster(n_osd=n, threaded=False)
+    # non-threaded: pump until boots/subscriptions settle
+    c.pump()
+    c.wait_all_up()
+    r = c.rados()
+    r.pool_create("p", pg_num=16)
+    c.pump()
+    return c, r
+
+
+def test_mute_osd_reported_and_marked_down():
+    c, r = make_cluster()
+    grace = global_config()["osd_heartbeat_grace"]
+    victim = 2
+    c.osds[victim].inject_heartbeat_mute = True
+    now = 1000.0
+    # tick at sub-grace intervals like the real 6s-interval/20s-grace
+    # cadence: healthy peers keep refreshing, the mute one goes silent
+    c.tick(now)
+    c.tick(now + grace / 2)
+    assert c.mon.osdmap.is_up(victim)
+    c.tick(now + grace + 1)          # victim's silence exceeds grace
+    # >=2 distinct reporters (everyone shares PGs in a small cluster)
+    assert c.mon.osdmap.is_down(victim)
+    # healthy peers were never marked down
+    assert all(c.mon.osdmap.is_up(o) for o in range(4) if o != victim)
+    # reports were by real peers, not the victim itself
+    assert victim not in c.mon._failure_reports
+    c.shutdown()
+
+
+def test_healthy_cluster_never_reports():
+    c, r = make_cluster()
+    for i in range(3):
+        c.tick(2000.0 + i * 5)
+    assert all(c.mon.osdmap.is_up(o) for o in range(4))
+    assert not c.mon._failure_reports
+    c.shutdown()
+
+
+def test_down_then_autoout_then_boot_in():
+    c, r = make_cluster()
+    cfg = global_config()
+    victim = 1
+    c.osds[victim].inject_heartbeat_mute = True
+    grace = cfg["osd_heartbeat_grace"]
+    c.tick(3000.0)
+    c.tick(3000.0 + grace / 2)
+    c.tick(3000.0 + grace + 1)
+    assert c.mon.osdmap.is_down(victim)
+    assert all(c.mon.osdmap.is_up(o) for o in range(4) if o != victim)
+    # auto-out after the down-out interval
+    c.mon._down_stamp[victim] -= cfg["mon_osd_down_out_interval"] + 1
+    c.mon.tick()
+    c.pump()
+    assert c.mon.osdmap.is_out(victim)
+    # revive: boot brings it up and (auto-out) back in
+    c.osds[victim].inject_heartbeat_mute = False
+    from ceph_tpu.msg.messages import MOSDBoot
+    c.osds[victim].ms.connect("mon.0").send_message(
+        MOSDBoot(osd=victim))
+    c.pump()
+    assert c.mon.osdmap.is_up(victim) and c.mon.osdmap.is_in(victim)
+    # heartbeats resume cleanly: the revived peer's pre-down silence
+    # must not trigger an instant re-report (hb state was reset on the
+    # up transition), and sub-grace ticks stay quiet
+    c.tick(3000.0 + grace + 2)
+    c.tick(3000.0 + grace + 2 + grace / 2)
+    assert not c.mon._failure_reports
+    assert c.mon.osdmap.is_up(victim)
+    c.shutdown()
+
+
+def test_killed_osd_detected_and_io_continues():
+    """End-to-end: hard-kill an OSD, peers detect + report, mon remaps,
+    client IO keeps working (test-erasure-code.sh / thrasher model)."""
+    c, r = make_cluster(n=5)
+    io = r.open_ioctx("p")
+    io.aio_write_full("obj", b"x" * 300)
+    c.pump()
+    grace = global_config()["osd_heartbeat_grace"]
+    victim = 0
+    c.kill_osd(victim)
+    c.tick(5000.0)
+    c.tick(5000.0 + grace / 2)
+    c.tick(5000.0 + grace + 1)
+    assert c.mon.osdmap.is_down(victim)
+    assert all(c.mon.osdmap.is_up(o) for o in range(1, 5))
+    # client reads still complete after the remap
+    fut = io.aio_read("obj")
+    c.pump()
+    assert fut.done() and fut.data == b"x" * 300
+    c.shutdown()
+
+
+def test_map_epochs_propagate_to_osds():
+    c, r = make_cluster()
+    e = c.mon.osdmap.epoch
+    for d in c.osds.values():
+        assert d.osdmap.epoch == e
+    c.shutdown()

@@ -84,6 +84,42 @@ class RepOpReply(Message):
     committed: bool = True
 
 
+@dataclass
+class PGScan(Message):
+    """Primary asks a peer for its object inventory after an acting
+    change (the peering/backfill scan,
+    ref: src/messages/MOSDPGScan.h / PG::scan_range)."""
+    pgid: Any = None
+
+
+@dataclass
+class PGScanReply(Message):
+    pgid: Any = None
+    from_osd: int = -1
+    #: oid -> ((epoch, version), whiteout) — the recovery inventory
+    objects: dict = field(default_factory=dict)
+
+
+@dataclass
+class PGPull(Message):
+    """Primary requests objects it lacks from a holder
+    (ref: src/messages/MOSDPGPull.h)."""
+    pgid: Any = None
+    oids: list = field(default_factory=list)
+
+
+@dataclass
+class PGPush(Message):
+    """Full-object push (recovery/backfill payload,
+    ref: src/messages/MOSDPGPush.h)."""
+    pgid: Any = None
+    oid: str = ""
+    data: bytes = b""
+    size: int = 0
+    version: Any = None
+    whiteout: bool = False     # delete tombstone push
+
+
 # ---------------------------------------------------------------- client
 
 

@@ -24,12 +24,14 @@ from ..ec import registry as ec_registry
 from ..msg.messages import (ECSubRead, ECSubReadReply, ECSubWrite,
                             ECSubWriteReply, MMap, MOSDBoot,
                             MMonSubscribe, MOSDFailure, OSDOp,
-                            OSDOpReply, Ping, PingReply, RepOpReply,
+                            OSDOpReply, PGPull, PGPush, PGScan,
+                            PGScanReply, Ping, PingReply, RepOpReply,
                             RepOpWrite)
 from ..msg.messenger import Dispatcher, LocalNetwork, Message, Messenger
 from ..store import MemStore, StoreError
 from .ec_backend import ECBackend, ECPGShard
 from .osdmap import OSDMap
+from .pg_types import EVersion
 from .replicated_backend import ReplicatedBackend, ReplicatedPGShard
 from .types import PG, POOL_TYPE_ERASURE
 from ..crush.types import CRUSH_ITEM_NONE
@@ -44,6 +46,12 @@ class _PGState:
         self.backend = None        # primary-only
         self.acting: list[int] = []
         self.acting_primary = -1
+        # replicated recovery state (primary only; ref: PG peering ->
+        # recovery/backfill, simplified to scan/pull/push)
+        self.recovering = False
+        self.scan_pending: set[int] = set()
+        self.peer_objects: dict[int, dict] = {}   # osd -> {oid: size}
+        self.pull_pending: set[str] = set()
 
 
 class OSDDaemon(Dispatcher):
@@ -135,6 +143,31 @@ class OSDDaemon(Dispatcher):
             if st is not None and st.backend is not None:
                 st.backend.handle_rep_reply(msg)
             return True
+        if isinstance(msg, PGScan):
+            # answer from the store even if our map (and PG state) lags
+            # the scanner's — an unanswered scan would wedge its
+            # recovery; the store view is the authority anyway
+            shard = self._replicated_view(msg.pgid)
+            self.ms.connect(msg.src).send_message(PGScanReply(
+                pgid=msg.pgid, from_osd=self.whoami,
+                objects=shard.inventory()))
+            return True
+        if isinstance(msg, PGScanReply):
+            self._handle_scan_reply(msg)
+            return True
+        if isinstance(msg, PGPull):
+            shard = self._replicated_view(msg.pgid)
+            for oid in msg.oids:
+                if not shard.exists(oid):
+                    continue
+                data = shard.read(oid)
+                self.ms.connect(msg.src).send_message(PGPush(
+                    pgid=msg.pgid, oid=oid, data=data, size=len(data),
+                    version=shard.object_version(oid)))
+            return True
+        if isinstance(msg, PGPush):
+            self._handle_push(msg)
+            return True
         if isinstance(msg, Ping):
             if not self.inject_heartbeat_mute:
                 self.ms.connect(msg.src).send_message(
@@ -199,6 +232,11 @@ class OSDDaemon(Dispatcher):
                         (st.backend is None) == (acting_p != self.whoami):
                     if st.backend is not None:
                         st.backend.epoch = m.epoch
+                        if st.recovering:
+                            # a scanned/pulled-from peer may have died:
+                            # restart the (idempotent) recovery against
+                            # the live acting set so it can't wedge
+                            self._start_recovery(pg, st)
                     continue
                 old = self.pgs.get(pg)
                 if old is not None and old.backend is not None:
@@ -230,9 +268,138 @@ class OSDDaemon(Dispatcher):
                             send=self._make_send(pg), epoch=m.epoch,
                             tid_gen=self._tid_gen)
                 self.pgs[pg] = st
+                if st.backend is not None:
+                    # new primary or acting change: re-peer (empty
+                    # peers answer instantly, so initial pool creation
+                    # converges in one scan round-trip)
+                    self._start_recovery(pg, st)
         for pg in list(self.pgs):
             if pg not in seen:
                 del self.pgs[pg]
+
+    # -------------------------------------------------------- recovery
+    # Simplified replicated peering: on an acting change the primary
+    # scans peers' inventories, pulls objects it lacks, then pushes
+    # what each peer lacks (ref: PG peering -> PrimaryLogPG recovery/
+    # backfill, collapsed to scan/pull/push; client ops get ESTALE and
+    # retry while this runs).
+    def _start_recovery(self, pg: PG, st: _PGState) -> None:
+        if not isinstance(st.backend, ReplicatedBackend):
+            return
+        peers = [o for o in st.acting if o >= 0 and o != self.whoami]
+        st.peer_objects = {}
+        st.pull_pending = set()
+        st.scan_pending = set(peers)
+        if not peers:
+            st.recovering = False
+            return
+        st.recovering = True
+        for p in peers:
+            self.ms.connect(f"osd.{p}").send_message(PGScan(pgid=pg))
+
+    def _handle_scan_reply(self, msg: PGScanReply) -> None:
+        st = self.pgs.get(msg.pgid)
+        if st is None or not st.recovering:
+            return
+        if msg.from_osd not in st.scan_pending:
+            return   # stale reply from a previous recovery round
+        st.scan_pending.discard(msg.from_osd)
+        st.peer_objects[msg.from_osd] = dict(msg.objects)
+        if st.scan_pending:
+            return
+        # version-aware want list: the newest (version, whiteout) per
+        # object wins — existence alone is not enough (a stale replica
+        # surviving a remap must not win, and a versioned whiteout
+        # means a delete outranks older data; the reference derives
+        # this from authoritative-log comparison in peering)
+        want: dict[str, tuple] = {}     # oid -> (ver, whiteout, holder)
+        for osd, objs in st.peer_objects.items():
+            for oid, (ver, whiteout) in objs.items():
+                ver = tuple(ver)
+                cur = want.get(oid)
+                if cur is None or ver > cur[0]:
+                    want[oid] = (ver, whiteout, osd)
+        mine = st.shard.inventory()
+        pulls: dict[str, int] = {}
+        for oid, (ver, whiteout, osd) in want.items():
+            my_ver = mine.get(oid, ((0, 0), False))[0]
+            if ver <= my_ver:
+                continue
+            if whiteout:
+                # a newer delete needs no data transfer: tombstone it
+                st.shard.apply_write(oid, 0, b"", True,
+                                     EVersion(*ver), [])
+            else:
+                pulls[oid] = osd
+        st.pull_pending = set(pulls)
+        by_holder: dict[int, list] = {}
+        for oid, osd in pulls.items():
+            by_holder.setdefault(osd, []).append(oid)
+        for osd, oids in by_holder.items():
+            self.ms.connect(f"osd.{osd}").send_message(
+                PGPull(pgid=msg.pgid, oids=oids))
+        if not st.pull_pending:
+            self._finish_recovery(msg.pgid, st)
+
+    def _replicated_view(self, pg) -> ReplicatedPGShard:
+        """Current PG shard, or a transient read-only store view when
+        our PG state lags the sender's map (the view never creates the
+        collection)."""
+        st = self.pgs.get(pg)
+        if st is not None and isinstance(st.shard, ReplicatedPGShard):
+            return st.shard
+        return ReplicatedPGShard(pg, self.store, create=False)
+
+    def _apply_push(self, shard: ReplicatedPGShard, oid: str,
+                    data: bytes, version, whiteout: bool) -> None:
+        """Full-object overwrite, but never let an older version clobber
+        newer local data (pushes can race regular writes)."""
+        ver = tuple(version) if version else (0, 0)
+        inv = shard.inventory().get(oid)
+        if inv is not None and inv[0] >= ver:
+            return
+        if whiteout:
+            shard.apply_write(oid, 0, b"", True, EVersion(*ver), [])
+            return
+        if inv is not None:
+            shard.apply_write(oid, 0, b"", True, None, [])
+        shard.apply_write(oid, 0, data, False, EVersion(*ver), [])
+
+    def _handle_push(self, msg: PGPush) -> None:
+        st = self.pgs.get(msg.pgid)
+        if st is None or not isinstance(st.shard, ReplicatedPGShard):
+            # a delayed push for a PG we no longer own must not write
+            # into the store (it would be reported by a later scan)
+            return
+        self._apply_push(st.shard, msg.oid, msg.data, msg.version,
+                         msg.whiteout)
+        if st.recovering and msg.oid in st.pull_pending:
+            st.pull_pending.discard(msg.oid)
+            if not st.pull_pending and not st.scan_pending:
+                self._finish_recovery(msg.pgid, st)
+
+    def _finish_recovery(self, pg: PG, st: _PGState) -> None:
+        mine = st.shard.inventory()
+        # (osd, oid) pairs that lag, grouped by oid so each object's
+        # data is read once
+        stale: dict[str, list[int]] = {}
+        for osd, objs in st.peer_objects.items():
+            for oid, (my_ver, _w) in mine.items():
+                theirs = tuple(objs[oid][0]) if oid in objs else (0, 0)
+                if theirs < my_ver:
+                    stale.setdefault(oid, []).append(osd)
+        for oid, osds in stale.items():
+            my_ver, whiteout = mine[oid]
+            data = b"" if whiteout else st.shard.read(oid)
+            for osd in osds:
+                self.ms.connect(f"osd.{osd}").send_message(PGPush(
+                    pgid=pg, oid=oid, data=data, size=len(data),
+                    version=my_ver, whiteout=whiteout))
+        st.recovering = False
+        dout("osd", 10).write("%s: pg %s recovered", self.name, pg)
+
+    def pgs_recovering(self) -> int:
+        return sum(1 for st in self.pgs.values() if st.recovering)
 
     def _make_send(self, pg: PG):
         def send(shard_idx: int, payload) -> bool:
@@ -322,13 +489,24 @@ class OSDDaemon(Dispatcher):
             # not the primary for this pg (stale client map)
             self._reply(msg, -1, "ESTALE")
             return
+        if st.recovering:
+            # ops wait out recovery via the client's retry machinery
+            # (the reference queues them on the PG; ESTALE re-parks the
+            # op until the rescan timer retries)
+            self._reply(msg, -1, "ESTALE")
+            return
         b = st.backend
         try:
+            # failed writes answer ESTALE, not EIO: a fan-out that lost
+            # a shard mid-map-change may be partially applied, and the
+            # client's retry against the re-peered acting set is the
+            # converging behavior (the reference requeues such ops on
+            # the PG through peering instead)
             if msg.op == "write":
                 b.submit_transaction(
                     msg.oid, msg.offset, msg.data,
                     lambda ok, m=msg: self._reply(
-                        m, 0 if ok else -5, "" if ok else "EIO"))
+                        m, 0 if ok else -116, "" if ok else "ESTALE"))
             elif msg.op == "write_full":
                 # delete-then-write through the ordered pipeline so a
                 # longer prior object leaves no tail
@@ -336,7 +514,8 @@ class OSDDaemon(Dispatcher):
                     b.submit_transaction(
                         m.oid, 0, m.data,
                         lambda ok2, m2=m: self._reply(
-                            m2, 0 if ok2 else -5, "" if ok2 else "EIO"))
+                            m2, 0 if ok2 else -116,
+                            "" if ok2 else "ESTALE"))
                 if self._object_exists(st, msg.oid):
                     b.submit_transaction(msg.oid, 0, b"", after_delete,
                                          delete=True)
@@ -350,7 +529,7 @@ class OSDDaemon(Dispatcher):
                 b.submit_transaction(
                     msg.oid, 0, b"",
                     lambda ok, m=msg: self._reply(
-                        m, 0 if ok else -5, "" if ok else "EIO"),
+                        m, 0 if ok else -116, "" if ok else "ESTALE"),
                     delete=True)
             elif msg.op == "read":
                 self._do_read(st, msg)

@@ -24,16 +24,20 @@ from .pg_types import DELETE, EVersion, MODIFY, PGLogEntry, ZERO_VERSION
 class ReplicatedPGShard:
     """Per-OSD service for one replicated PG (primary or replica)."""
 
-    def __init__(self, pgid, store):
+    def __init__(self, pgid, store, create: bool = True):
         self.pgid = pgid
         self.store = store
         self.cid = pg_cid(pgid)
         self.pg_log = PGLog()
-        if not store.collection_exists(self.cid):
+        if create and not store.collection_exists(self.cid):
             store.queue_transaction(
                 Transaction().create_collection(self.cid))
 
     # -- local apply (both roles; ref: ReplicatedBackend.cc:1148) ------
+    # Deletes leave a zero-length *whiteout* carrying the delete's
+    # version (ref: the cache-tier whiteout concept, object_info flag
+    # FLAG_WHITEOUT): recovery compares versions, so a delete must be
+    # a versioned event or a stale replica would resurrect the object.
     def apply_write(self, oid: str, offset: int, data: bytes,
                     delete: bool, version, log_entries) -> bool:
         soid = ObjectId(oid)
@@ -42,9 +46,18 @@ class ReplicatedPGShard:
             if delete:
                 if self.store.exists(self.cid, soid):
                     txn.remove(self.cid, soid)
+                txn.touch(self.cid, soid)
+                txn.setattr(self.cid, soid, OI_ATTR,
+                            {"size": 0, "version": version,
+                             "whiteout": True})
             else:
+                if self._is_whiteout(soid):
+                    txn.remove(self.cid, soid)
+                    txn.touch(self.cid, soid)
+                    old = 0
+                else:
+                    old = self.object_size(oid)
                 txn.write(self.cid, soid, offset, data)
-                old = self.object_size(oid)
                 txn.setattr(self.cid, soid, OI_ATTR,
                             {"size": max(old, offset + len(data)),
                              "version": version})
@@ -59,6 +72,13 @@ class ReplicatedPGShard:
                                  self.pgid, err)
             return False
 
+    def _is_whiteout(self, soid: ObjectId) -> bool:
+        try:
+            return bool(self.store.getattr(self.cid, soid,
+                                           OI_ATTR).get("whiteout"))
+        except StoreError:
+            return False
+
     def handle_rep_write(self, m: RepOpWrite, whoami: int) -> RepOpReply:
         ok = self.apply_write(m.oid, m.offset, m.data, m.delete,
                               m.version, m.log_entries)
@@ -67,7 +87,7 @@ class ReplicatedPGShard:
 
     def read(self, oid: str, offset: int = 0, length: int = 0) -> bytes:
         size = self.object_size(oid)
-        if not self.store.exists(self.cid, ObjectId(oid)):
+        if not self.exists(oid):
             raise StoreError("ENOENT", f"{oid} does not exist")
         buf = self.store.read(self.cid, ObjectId(oid), offset,
                               length or max(0, size - offset))
@@ -80,12 +100,44 @@ class ReplicatedPGShard:
         except StoreError:
             return 0
 
+    def object_version(self, oid: str) -> tuple[int, int]:
+        """(epoch, version) from the oi xattr; (0,0) when unknown —
+        the recovery inventory's ordering key."""
+        try:
+            v = self.store.getattr(self.cid, ObjectId(oid),
+                                   OI_ATTR).get("version")
+        except StoreError:
+            return (0, 0)
+        if isinstance(v, EVersion):
+            return (v.epoch, v.version)
+        return tuple(v) if v else (0, 0)
+
     def objects(self) -> list[str]:
+        """Client-visible objects (whiteouts excluded)."""
+        if not self.store.collection_exists(self.cid):
+            return []
         return sorted({o.name for o in self.store.collection_list(self.cid)
-                       if o.name != "pgmeta"})
+                       if o.name != "pgmeta"
+                       and not self._is_whiteout(o)})
+
+    def inventory(self) -> dict[str, tuple]:
+        """Recovery inventory incl. whiteouts:
+        oid -> ((epoch, version), whiteout)."""
+        if not self.store.collection_exists(self.cid):
+            return {}
+        out = {}
+        for o in self.store.collection_list(self.cid):
+            if o.name == "pgmeta":
+                continue
+            out[o.name] = (self.object_version(o.name),
+                           self._is_whiteout(o))
+        return out
 
     def exists(self, oid: str) -> bool:
-        return self.store.exists(self.cid, ObjectId(oid))
+        soid = ObjectId(oid)
+        return self.store.collection_exists(self.cid) and \
+            self.store.exists(self.cid, soid) and \
+            not self._is_whiteout(soid)
 
 
 @dataclass

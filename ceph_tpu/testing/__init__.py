@@ -1,4 +1,5 @@
 """Test/QA harnesses (the qa/ tier analogues)."""
 from .cluster import MiniCluster
+from .thrasher import OSDThrasher
 
-__all__ = ["MiniCluster"]
+__all__ = ["MiniCluster", "OSDThrasher"]

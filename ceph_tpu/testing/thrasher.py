@@ -1,0 +1,128 @@
+"""OSDThrasher: randomized fault injection against a MiniCluster.
+
+Port of the qa thrasher loop (ref: qa/tasks/ceph_manager.py:98
+OSDThrasher: choose_action kill/revive/out/in with min-in guards,
+interleaved with client IO, then heal and verify).  Deterministic: a
+seeded RNG picks actions, the harness pumps the network and drives
+heartbeat/mon ticks on simulated time.
+"""
+from __future__ import annotations
+
+import random
+
+from ..common.options import global_config
+from .cluster import MiniCluster
+
+
+class OSDThrasher:
+    def __init__(self, cluster: MiniCluster, seed: int = 0,
+                 min_in: int = 3, min_live: int = 3):
+        self.c = cluster
+        self.rng = random.Random(seed)
+        self.min_in = min_in
+        self.min_live = min_live
+        self.all_osds = sorted(cluster.osds)
+        self.dead: set[int] = set()
+        self.out: set[int] = set()
+        self.now = 10_000.0
+        self.log: list[str] = []
+
+    # ------------------------------------------------------------ state
+    def _live(self) -> list[int]:
+        return [o for o in self.all_osds if o not in self.dead]
+
+    def _in(self) -> list[int]:
+        return [o for o in self.all_osds if o not in self.out]
+
+    def _tick_rounds(self, n: int = 3) -> None:
+        """Advance simulated time in sub-grace steps so failure
+        detection works the way production cadence does."""
+        grace = global_config()["osd_heartbeat_grace"]
+        for _ in range(n):
+            self.now += grace / 2 + 1
+            self.c.tick(self.now)
+
+    # ---------------------------------------------------------- actions
+    def kill_osd(self, osd: int | None = None) -> None:
+        live = [o for o in self._live()]
+        if len(live) <= self.min_live:
+            return
+        osd = osd if osd is not None else self.rng.choice(live)
+        if osd in self.dead:
+            return
+        self.log.append(f"kill osd.{osd}")
+        self.c.kill_osd(osd)
+        self.dead.add(osd)
+        self._tick_rounds()      # peers detect + mon marks down
+
+    def revive_osd(self, osd: int | None = None) -> None:
+        if not self.dead:
+            return
+        osd = osd if osd is not None else self.rng.choice(
+            sorted(self.dead))
+        self.log.append(f"revive osd.{osd}")
+        self.c.revive_osd(osd)
+        self.dead.discard(osd)
+        if not self.c.threaded:
+            self.c.pump()
+        self._tick_rounds(1)
+
+    def out_osd(self, osd: int | None = None) -> None:
+        candidates = [o for o in self._in()]
+        if len(candidates) <= self.min_in:
+            return
+        osd = osd if osd is not None else self.rng.choice(candidates)
+        self.log.append(f"out osd.{osd}")
+        self.c.mon.handle_command({"prefix": "osd out", "ids": [osd]})
+        self.out.add(osd)
+        if not self.c.threaded:
+            self.c.pump()
+
+    def in_osd(self, osd: int | None = None) -> None:
+        candidates = sorted(o for o in self.out if o not in self.dead)
+        if not candidates:
+            return
+        osd = osd if osd is not None else self.rng.choice(candidates)
+        self.log.append(f"in osd.{osd}")
+        self.c.mon.handle_command({"prefix": "osd in", "ids": [osd]})
+        self.out.discard(osd)
+        if not self.c.threaded:
+            self.c.pump()
+
+    ACTIONS = ("kill_osd", "revive_osd", "out_osd", "in_osd")
+
+    def choose_action(self) -> str:
+        """(ref: ceph_manager.py choose_action weights)."""
+        weights = {"kill_osd": 3, "revive_osd": 3,
+                   "out_osd": 2, "in_osd": 2}
+        names = list(weights)
+        return self.rng.choices(names,
+                                weights=[weights[n] for n in names])[0]
+
+    def do_thrash(self, rounds: int, between=None) -> None:
+        """`between(i)` runs client IO between actions."""
+        for i in range(rounds):
+            getattr(self, self.choose_action())()
+            if between is not None:
+                between(i)
+
+    # ------------------------------------------------------------- heal
+    def heal(self, timeout_rounds: int = 50) -> None:
+        """Revive + mark in everything, wait until no PG is
+        recovering (ref: thrasher's final do_join/wait_for_clean)."""
+        for osd in sorted(self.dead):
+            self.revive_osd(osd)
+        for osd in sorted(self.out):
+            self.in_osd(osd)
+        import time
+        for _ in range(timeout_rounds):
+            if self.c.threaded:
+                time.sleep(0.02)   # let messenger threads drain
+            else:
+                self.c.pump()
+            if all(d.pgs_recovering() == 0
+                   for d in self.c.osds.values()):
+                return
+            self._tick_rounds(1)   # unwedge map-waiting recoveries
+        raise TimeoutError(
+            f"cluster never went clean; log: {self.log}")

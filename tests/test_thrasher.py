@@ -1,0 +1,167 @@
+"""QA tier 2/3: recovery on remap + the randomized thrasher loop
+(ref: qa/tasks/ceph_manager.py:98 OSDThrasher,
+qa/standalone/erasure-code/test-erasure-code.sh shapes)."""
+import random
+
+import pytest
+
+from ceph_tpu.common.options import global_config
+from ceph_tpu.testing import MiniCluster, OSDThrasher
+
+
+def make_cluster(n=6):
+    c = MiniCluster(n_osd=n, threaded=False)
+    c.pump()
+    c.wait_all_up()
+    r = c.rados()
+    r.pool_create("p", pg_num=16)
+    c.pump()
+    return c, r
+
+
+# --------------------------------------------------------------- recovery
+def test_out_remap_recovers_data():
+    """Mark an OSD out: PGs remap, new members get the objects via
+    scan/pull/push recovery, reads keep working."""
+    c, r = make_cluster()
+    io = r.open_ioctx("p")
+    objs = {f"o{i}": bytes([i]) * (100 + i) for i in range(24)}
+    for oid, data in objs.items():
+        io.write_full(oid, data)
+    c.pump()
+    r.mon_command({"prefix": "osd out", "ids": [0]})
+    c.pump()   # maps propagate, recovery scan/pull/push runs
+    c.pump()
+    assert all(d.pgs_recovering() == 0 for d in c.osds.values())
+    for oid, data in objs.items():
+        assert io.read(oid) == data
+    # every PG's new acting set holds every object
+    pid = r.pool_lookup("p")
+    m = c.mon.osdmap
+    from ceph_tpu.osd.types import PG
+    for ps in range(16):
+        pg = PG(pid, ps)
+        _, _, acting, _ = m.pg_to_up_acting_osds(pg)
+        assert 0 not in acting
+        for osd in acting:
+            shard = c.osds[osd].pgs[pg].shard
+            for oid, data in objs.items():
+                if pg == m.pools[pid].raw_pg_to_pg(
+                        m.object_locator_to_pg(oid, pid)):
+                    assert shard.read(oid) == data, (ps, osd, oid)
+    c.shutdown()
+
+
+def test_new_primary_pulls_before_serving():
+    """A remapped-in primary with an empty store must pull objects
+    before serving (no phantom ENOENT)."""
+    c, r = make_cluster()
+    io = r.open_ioctx("p")
+    io.write_full("key", b"payload" * 50)
+    c.pump()
+    # out two osds to force substantial remapping
+    r.mon_command({"prefix": "osd out", "ids": [0, 1]})
+    c.pump()
+    c.pump()
+    assert io.read("key") == b"payload" * 50
+    r.mon_command({"prefix": "osd in", "ids": [0, 1]})
+    c.pump()
+    c.pump()
+    assert io.read("key") == b"payload" * 50
+    c.shutdown()
+
+
+# --------------------------------------------------------------- thrasher
+def test_thrasher_replicated_io_survives():
+    """The full loop: random kill/revive/out/in with async IO
+    interleaved (a PG whose whole acting set is dead rightly BLOCKS its
+    ops until revival, so mid-thrash IO can't be synchronous — same as
+    the qa thrasher's radosbench-join-at-end model), heal, wait for
+    every op to complete, then verify every object byte-for-byte."""
+    import time
+    c, r = make_cluster(n=7)
+    io = r.open_ioctx("p")
+    rng = random.Random(42)
+    expected: dict[str, bytes] = {}
+    futures: dict[str, object] = {}   # oid -> latest write future
+
+    def do_io(i):
+        for _ in range(3):
+            oid = f"obj{rng.randrange(30)}"
+            data = bytes([rng.randrange(256)]) * rng.randrange(1, 800)
+            futures[oid] = io.aio_write_full(oid, data)
+            expected[oid] = data
+        c.pump()
+
+    t = OSDThrasher(c, seed=7, min_in=4, min_live=4)
+    do_io(-1)
+    t.do_thrash(12, between=do_io)
+    t.heal()
+    # drain: parked ops resend via the rescan timer (real-time), so
+    # pump until every write future completes
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        c.pump()
+        if all(f.done() for f in futures.values()):
+            break
+        time.sleep(0.02)
+    undone = [o for o, f in futures.items() if not f.done()]
+    assert not undone, (undone, t.log)
+    failed = {o: f.errno_name for o, f in futures.items()
+              if f.result < 0}
+    assert not failed, (failed, t.log)
+    # post-heal: all objects intact
+    for oid, data in sorted(expected.items()):
+        assert io.read(oid) == data, (oid, t.log)
+    # cluster fully up/in again
+    assert all(c.mon.osdmap.is_up(o) and c.mon.osdmap.is_in(o)
+               for o in range(7)), t.log
+    c.shutdown()
+
+
+def test_deleted_object_not_resurrected_by_stale_replica():
+    """Delete while a replica is down: when it returns, the versioned
+    whiteout must outrank the stale copy — no resurrection."""
+    c, r = make_cluster()
+    io = r.open_ioctx("p")
+    io.write_full("ghost", b"boo" * 100)
+    c.pump()
+    from ceph_tpu.osd.types import PG
+    pid = r.pool_lookup("p")
+    m = c.mon.osdmap
+    raw = m.object_locator_to_pg("ghost", pid)
+    pg = m.pools[pid].raw_pg_to_pg(raw)
+    _, _, acting, primary = m.pg_to_up_acting_osds(raw)
+    stale = next(o for o in acting if o != primary)
+    c.kill_osd(stale)
+    # mark it down so the delete proceeds on the remaining members
+    c.mon.handle_command({"prefix": "osd down", "ids": [stale]})
+    c.pump()
+    io.remove("ghost")
+    c.pump()
+    # stale replica returns with its old copy; recovery must spread the
+    # whiteout, not the data
+    c.revive_osd(stale)
+    c.pump()
+    c.pump()
+    assert all(d.pgs_recovering() == 0 for d in c.osds.values())
+    import pytest as _pytest
+    from ceph_tpu.client import RadosError
+    with _pytest.raises(RadosError) as ei:
+        io.read("ghost")
+    assert ei.value.errno_name == "ENOENT"
+    # and the stale holder's store view agrees it is deleted
+    shard = c.osds[stale].pgs[pg].shard
+    assert not shard.exists("ghost")
+    c.shutdown()
+
+
+def test_thrasher_respects_min_guards():
+    c, _ = make_cluster(n=4)
+    t = OSDThrasher(c, seed=1, min_in=3, min_live=3)
+    for _ in range(10):
+        t.kill_osd()
+        t.out_osd()
+    assert len(t._live()) >= 3
+    assert len(t._in()) >= 3
+    c.shutdown()

@@ -80,23 +80,52 @@ def make_decode_matrix(encode_matrix: np.ndarray, k: int,
 
 
 class MatrixErasureCode(ErasureCode):
-    """Systematic MDS matrix code over GF(2^8) with pluggable matmul."""
+    """Systematic MDS matrix code with pluggable matmul.
+
+    Default field is GF(2^8) (the byte fast path in ceph_tpu.ec.gf);
+    setting `self.field` to a ceph_tpu.ec.gfw.GF2w switches the matmul
+    and decode-matrix construction to that wide-word field (jerasure's
+    w=16/32 matrix techniques)."""
 
     def __init__(self) -> None:
         super().__init__()
         self.k = 0
         self.m = 0
         self.encode_matrix: np.ndarray | None = None  # (k+m) x k, identity top
+        self.field = None                             # None = GF(2^8)
         self.table_cache = DecodeTableCache()
 
     # subclasses set self.k/self.m and call _prepare with the full matrix
     def _prepare(self, encode_matrix: np.ndarray) -> None:
         assert encode_matrix.shape == (self.k + self.m, self.k)
-        self.encode_matrix = np.ascontiguousarray(encode_matrix, dtype=np.uint8)
+        dtype = np.uint8 if self.field is None else np.int64
+        self.encode_matrix = np.ascontiguousarray(encode_matrix,
+                                                  dtype=dtype)
 
-    # the byte matmul backend; TPU plugin overrides
+    # the matmul backend; TPU plugin overrides
     def matmul(self, mat: np.ndarray, data: np.ndarray) -> np.ndarray:
+        if self.field is not None:
+            return self.field.matmul_bytes(mat, data)
         return gf.gf_matmul_bytes(mat, data)
+
+    def _make_decode_matrix(self, decode_index: list[int],
+                            erasures: list[int]) -> np.ndarray:
+        if self.field is None:
+            return make_decode_matrix(self.encode_matrix, self.k,
+                                      decode_index, erasures)
+        f = self.field
+        b = [list(self.encode_matrix[i]) for i in decode_index]
+        inv_b = f.invert_matrix(b)
+        if inv_b is None:
+            raise ErasureCodeError("EIO: singular survivor matrix")
+        rows = []
+        for e in erasures:
+            if e < self.k:
+                rows.append(inv_b[e])
+            else:
+                rows.append(f.matmul_small(
+                    [list(self.encode_matrix[e])], inv_b)[0])
+        return np.array(rows, dtype=np.int64)
 
     def get_chunk_count(self) -> int:
         return self.k + self.m
@@ -128,7 +157,7 @@ class MatrixErasureCode(ErasureCode):
         sig = erasure_signature(decode_index, erasures)
         dmat = self.table_cache.get(sig)
         if dmat is None:
-            dmat = make_decode_matrix(self.encode_matrix, k, decode_index, erasures)
+            dmat = self._make_decode_matrix(decode_index, erasures)
             self.table_cache.put(sig, dmat)
         survivors = np.stack([decoded[i] for i in decode_index])
         out = self.matmul(dmat, survivors)

@@ -5,9 +5,14 @@ Matches the technique set and chunk-size semantics of the jerasure plugin
 
 * techniques: reed_sol_van (Vandermonde systematized), reed_sol_r6_op
   (RAID-6 P+Q), cauchy_orig, cauchy_good (improved Cauchy);
-* w=8 matrix codes (the Ceph default; prime-w bitmatrix techniques
-  liberation/blaum_roth/liber8tion are bit-scheduled variants of different
-  constructions and are not yet implemented);
+* matrix codes at w=8 (the Ceph default, byte fast path) and w=16/32
+  (wide-word fields over gf-complete's standard polynomials, via
+  ceph_tpu.ec.gfw).  The prime-w bitmatrix techniques liberation/
+  blaum_roth/liber8tion use minimal-density bitmatrix constructions
+  from Plank's papers whose exact matrices cannot be regenerated
+  bit-faithfully here (the jerasure sources are not vendored in the
+  reference checkout); they raise ENOENT like an absent plugin rather
+  than ship a lookalike code under the same name;
 * chunk size: object padded to a multiple of k*w*sizeof(int) (w*16-aligned
   per-chunk when jerasure-per-chunk-alignment=true); cauchy variants align
   to k*w*packetsize*sizeof(int) with packetsize default 2048
@@ -58,12 +63,28 @@ class ErasureCodeJerasure(MatrixErasureCode):
             self.chunk_mapping = []
             raise ErasureCodeError("bad mapping size")
         sanity_check_k_m(self.k, self.m)
-        if self.w != 8:
-            # w=16/32 matrix codes exist in jerasure; the TPU framework is a
-            # byte (w=8) field end-to-end, which is also the Ceph default.
-            raise ErasureCodeError(f"w={self.w} not supported (only w=8)")
+        if self.w not in (8, 16, 32):
+            raise ErasureCodeError(
+                f"w={self.w} not supported (matrix codes take 8/16/32)")
         self.per_chunk_alignment = to_bool(
             "jerasure-per-chunk-alignment", profile, "false")
+
+    def _field(self):
+        """GF(2^w) field for wide w; None selects the byte fast path."""
+        if self.w == 8:
+            return None
+        from .. import gfw
+        return gfw.field(self.w)
+
+    def _prepare_coding(self, byte_builder, wide_builder) -> None:
+        """Shared field dispatch for every matrix technique: pick the
+        byte-path or wide-field coding-matrix builder and prepend the
+        identity."""
+        self.field = self._field()
+        coding = byte_builder() if self.field is None \
+            else wide_builder(self.field)
+        self._prepare(np.vstack([np.eye(self.k, dtype=coding.dtype),
+                                 coding]))
 
     def get_alignment(self) -> int:
         # ref: ErasureCodeJerasure.cc:174-184
@@ -96,8 +117,9 @@ class ReedSolomonVandermonde(ErasureCodeJerasure):
     technique = "reed_sol_van"
 
     def prepare(self) -> None:
-        coding = gf.jerasure_vandermonde_coding_matrix(self.k, self.m)
-        self._prepare(np.vstack([np.eye(self.k, dtype=np.uint8), coding]))
+        self._prepare_coding(
+            lambda: gf.jerasure_vandermonde_coding_matrix(self.k, self.m),
+            lambda f: f.vandermonde_coding_matrix(self.k, self.m))
 
 
 class ReedSolomonRAID6(ErasureCodeJerasure):
@@ -109,8 +131,9 @@ class ReedSolomonRAID6(ErasureCodeJerasure):
         self.m = 2
 
     def prepare(self) -> None:
-        coding = gf.jerasure_r6_coding_matrix(self.k)
-        self._prepare(np.vstack([np.eye(self.k, dtype=np.uint8), coding]))
+        self._prepare_coding(
+            lambda: gf.jerasure_r6_coding_matrix(self.k),
+            lambda f: f.r6_coding_matrix(self.k))
 
 
 class Cauchy(ErasureCodeJerasure):
@@ -144,16 +167,18 @@ class CauchyOrig(Cauchy):
     technique = "cauchy_orig"
 
     def prepare(self) -> None:
-        coding = gf.cauchy_original_coding_matrix(self.k, self.m)
-        self._prepare(np.vstack([np.eye(self.k, dtype=np.uint8), coding]))
+        self._prepare_coding(
+            lambda: gf.cauchy_original_coding_matrix(self.k, self.m),
+            lambda f: f.cauchy_original_coding_matrix(self.k, self.m))
 
 
 class CauchyGood(Cauchy):
     technique = "cauchy_good"
 
     def prepare(self) -> None:
-        coding = gf.cauchy_good_coding_matrix(self.k, self.m)
-        self._prepare(np.vstack([np.eye(self.k, dtype=np.uint8), coding]))
+        self._prepare_coding(
+            lambda: gf.cauchy_good_coding_matrix(self.k, self.m),
+            lambda f: f.cauchy_good_coding_matrix(self.k, self.m))
 
 
 TECHNIQUES = {
@@ -162,6 +187,12 @@ TECHNIQUES = {
     "cauchy_orig": CauchyOrig,
     "cauchy_good": CauchyGood,
 }
+
+# bitmatrix techniques whose published minimal-density constructions
+# cannot be regenerated bit-faithfully without the jerasure sources
+# (empty submodule in the reference checkout); shipping a lookalike
+# under the same name would silently break cross-implementation parity
+UNSUPPORTED_BITMATRIX = ("liberation", "blaum_roth", "liber8tion")
 
 
 class _JerasureFactory:
@@ -182,6 +213,12 @@ class _TechniqueDispatch(ErasureCodeJerasure):
         technique = profile.setdefault("technique", "reed_sol_van")
         impl_cls = TECHNIQUES.get(technique)
         if impl_cls is None:
+            if technique in UNSUPPORTED_BITMATRIX:
+                raise ErasureCodeError(
+                    f"ENOENT: technique={technique!r} (minimal-density "
+                    "bitmatrix) is not implemented — its construction "
+                    "cannot be reproduced bit-faithfully here; use "
+                    "reed_sol_van or a cauchy technique")
             raise ErasureCodeError(
                 f"ENOENT: technique={technique!r} is not supported")
         self.__class__ = impl_cls

@@ -53,7 +53,7 @@ class OpFuture:
 class _Op:
     def __init__(self, tid: int, pool: int, oid: str, op: str,
                  offset: int, length: int, data: bytes,
-                 future: OpFuture):
+                 future: OpFuture, pg_ps: Optional[int] = None):
         self.tid = tid
         self.pool = pool
         self.oid = oid
@@ -62,6 +62,7 @@ class _Op:
         self.length = length
         self.data = data
         self.future = future
+        self.pg_ps = pg_ps        # PG-addressed op (pgls)
         self.pg: Optional[PG] = None
         self.target_osd = -1
         self.attempts = 0
@@ -194,7 +195,12 @@ class Objecter(Dispatcher):
     def _calc_target(self, op: _Op) -> None:
         """(ref: Objecter.cc:1095 _calc_target)."""
         try:
-            raw = self.osdmap.object_locator_to_pg(op.oid, op.pool)
+            if op.pg_ps is not None:
+                raw = PG(op.pool, op.pg_ps)
+                if op.pool not in self.osdmap.pools:
+                    raise KeyError(op.pool)
+            else:
+                raw = self.osdmap.object_locator_to_pg(op.oid, op.pool)
         except KeyError:
             op.pg, op.target_osd = None, -1
             return
@@ -206,11 +212,12 @@ class Objecter(Dispatcher):
 
     # -------------------------------------------------------- op submit
     def submit(self, pool: int, oid: str, op: str, offset: int = 0,
-               length: int = 0, data: bytes = b"") -> OpFuture:
+               length: int = 0, data: bytes = b"",
+               pg_ps: Optional[int] = None) -> OpFuture:
         """(ref: Objecter.cc:2378 _op_submit)."""
         fut = OpFuture()
         o = _Op(next(self._tid), pool, oid, op, offset, length, data,
-                fut)
+                fut, pg_ps=pg_ps)
         with self._lock:
             self._calc_target(o)
             if o.target_osd < 0:

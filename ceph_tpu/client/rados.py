@@ -137,3 +137,17 @@ class IoCtx:
     def stat(self, oid: str) -> dict:
         fut = self.rados.objecter.submit(self.pool_id, oid, "stat")
         return self._wait(fut).attrs
+
+    def list_objects(self) -> list[str]:
+        """Pool object listing: one pgls per PG
+        (ref: librados NObjectIterator -> Objecter pg_read)."""
+        pool = self.rados.objecter.osdmap.pools.get(self.pool_id)
+        if pool is None:
+            raise RadosError("ENOENT", f"pool {self.pool_id} gone")
+        futs = [self.rados.objecter.submit(self.pool_id, "", "pgls",
+                                           pg_ps=ps)
+                for ps in range(pool.pg_num)]
+        names: set[str] = set()
+        for fut in futs:
+            names.update(self._wait(fut).attrs.get("objects", []))
+        return sorted(names)

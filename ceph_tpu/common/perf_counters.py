@@ -45,7 +45,10 @@ class PerfCounters:
 
     # -- builder surface (ref: perf_counters.h PerfCountersBuilder) --
     def add_u64_counter(self, key: str, desc: str = "") -> None:
-        self._c[key] = _Counter(U64, desc)
+        # idempotent: re-registration (e.g. a restarted daemon reusing
+        # its name) must not zero live counts
+        if key not in self._c:
+            self._c[key] = _Counter(U64, desc)
 
     def add_u64(self, key: str, desc: str = "") -> None:
         self._c[key] = _Counter(GAUGE, desc)

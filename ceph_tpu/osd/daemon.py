@@ -59,7 +59,7 @@ class OSDDaemon(Dispatcher):
 
     def __init__(self, network: LocalNetwork, whoami: int,
                  store: Optional[MemStore] = None, mon: str = "mon.0",
-                 threaded: bool = False):
+                 threaded: bool = False, perf_collection=None):
         self.whoami = whoami
         self.name = f"osd.{whoami}"
         self.mon = mon
@@ -88,6 +88,17 @@ class OSDDaemon(Dispatcher):
         self._hb_handle = self.hbmap.add_worker(
             f"{self.name}.tick",
             grace=4 * global_config()["osd_heartbeat_interval"])
+        # op counters (ref: src/osd/osd_perf_counters.cc l_osd_op*);
+        # multi-cluster harnesses pass their own collection so two
+        # same-named daemons never commingle counts
+        from ..common.perf_counters import global_perf
+        coll = perf_collection if perf_collection is not None \
+            else global_perf()
+        self.perf = coll.create(self.name)
+        for key in ("op", "op_r", "op_w", "op_r_bytes", "op_w_bytes",
+                    "subop_w", "recovery_push", "recovery_pull",
+                    "map_epochs"):
+            self.perf.add_u64_counter(key)
         self.ms = Messenger.create(network, self.name, threaded=threaded)
         self.ms.add_dispatcher(self)
 
@@ -112,6 +123,7 @@ class OSDDaemon(Dispatcher):
         if isinstance(msg, ECSubWrite):
             st = self.pgs.get(msg.pgid)
             if st is not None and st.shard is not None:
+                self.perf.inc("subop_w")
                 reply = st.shard.handle_sub_write(msg)
                 self.ms.connect(msg.src).send_message(reply)
             return True
@@ -135,6 +147,7 @@ class OSDDaemon(Dispatcher):
         if isinstance(msg, RepOpWrite):
             st = self.pgs.get(msg.pgid)
             if st is not None and st.shard is not None:
+                self.perf.inc("subop_w")
                 reply = st.shard.handle_rep_write(msg, self.whoami)
                 self.ms.connect(msg.src).send_message(reply)
             return True
@@ -186,8 +199,11 @@ class OSDDaemon(Dispatcher):
         with self._lock:
             old_up = {o for o in range(self.osdmap.max_osd)
                       if self.osdmap.is_up(o)}
+            old_epoch = self.osdmap.epoch
             self.osdmap = self.osdmap.ingest(msg.full_map,
                                              msg.incrementals)
+            self.perf.inc("map_epochs",
+                          max(0, self.osdmap.epoch - old_epoch))
             dout("osd", 10).write("%s: now at map e%d", self.name,
                                   self.osdmap.epoch)
             # a peer that came (back) up starts with a clean heartbeat
@@ -336,6 +352,7 @@ class OSDDaemon(Dispatcher):
         for oid, osd in pulls.items():
             by_holder.setdefault(osd, []).append(oid)
         for osd, oids in by_holder.items():
+            self.perf.inc("recovery_pull", len(oids))
             self.ms.connect(f"osd.{osd}").send_message(
                 PGPull(pgid=msg.pgid, oids=oids))
         if not st.pull_pending:
@@ -392,6 +409,7 @@ class OSDDaemon(Dispatcher):
             my_ver, whiteout = mine[oid]
             data = b"" if whiteout else st.shard.read(oid)
             for osd in osds:
+                self.perf.inc("recovery_push")
                 self.ms.connect(f"osd.{osd}").send_message(PGPush(
                     pgid=pg, oid=oid, data=data, size=len(data),
                     version=my_ver, whiteout=whiteout))
@@ -495,6 +513,12 @@ class OSDDaemon(Dispatcher):
             # op until the rescan timer retries)
             self._reply(msg, -1, "ESTALE")
             return
+        self.perf.inc("op")
+        if msg.op in ("write", "write_full"):
+            self.perf.inc("op_w")
+            self.perf.inc("op_w_bytes", len(msg.data))
+        elif msg.op == "read":
+            self.perf.inc("op_r")
         b = st.backend
         try:
             # failed writes answer ESTALE, not EIO: a fan-out that lost
@@ -539,6 +563,11 @@ class OSDDaemon(Dispatcher):
                     return
                 self._reply(msg, 0,
                             attrs={"size": b.object_size(msg.oid)})
+            elif msg.op == "pgls":
+                # PG object listing (ref: MOSDOp CEPH_OSD_OP_PGLS /
+                # PrimaryLogPG::do_pg_op)
+                self._reply(msg, 0,
+                            attrs={"objects": st.shard.objects()})
             else:
                 self._reply(msg, -22, "EINVAL")
         except StoreError as err:
@@ -552,6 +581,7 @@ class OSDDaemon(Dispatcher):
         if isinstance(b, ReplicatedBackend):
             try:
                 data = b.read(msg.oid, msg.offset, msg.length)
+                self.perf.inc("op_r_bytes", len(data))
                 self._reply(msg, 0, data=data)
             except StoreError as err:
                 self._reply(msg, -2 if err.errno_name == "ENOENT"
@@ -567,6 +597,8 @@ class OSDDaemon(Dispatcher):
             if m.oid in errors:
                 self._reply(m, -5, errors[m.oid])
             else:
-                self._reply(m, 0, data=bytes(results.get(m.oid, b"")))
+                data = bytes(results.get(m.oid, b""))
+                self.perf.inc("op_r_bytes", len(data))
+                self._reply(m, 0, data=data)
 
         b.objects_read_and_reconstruct({msg.oid: window}, on_complete)

@@ -1,0 +1,4 @@
+"""Client-side object compute: striping (ref: src/osdc/)."""
+from .striper import ObjectExtent, StripeLayout, Striper
+
+__all__ = ["Striper", "StripeLayout", "ObjectExtent"]

@@ -1,0 +1,4 @@
+"""RBD: block images striped over RADOS objects (ref: src/librbd/)."""
+from .image import RBD, Image, RBDError
+
+__all__ = ["RBD", "Image", "RBDError"]

@@ -1,0 +1,201 @@
+"""RBD-lite: block-device images striped over RADOS objects.
+
+The librbd data-path model (ref: src/librbd/: image metadata in a
+header object, data in `rbd_data.<id>.<objectno>` objects of size
+2^order, io/ImageRequest.cc mapping block extents through the Striper;
+naming scheme util::data_object_name): an image is a sparse array of
+equal-size objects — absent objects read as zeros, partial writes touch
+only the covered objects.
+
+API mirrors librbd's Python binding surface: RBD().create/remove/list,
+Image open -> read/write/discard/resize/stat/close.
+"""
+from __future__ import annotations
+
+import json
+
+from ..client.rados import IoCtx, RadosError
+from ..osdc import StripeLayout, Striper
+
+RBD_DEFAULT_ORDER = 22          # 4 MiB objects (rbd_default_order)
+
+
+class RBDError(OSError):
+    pass
+
+
+def header_name(name: str) -> str:
+    return f"rbd_header.{name}"
+
+
+def data_name(name: str, objectno: int) -> str:
+    """(ref: librbd util::data_object_name '%s.%016llx')."""
+    return f"rbd_data.{name}.{objectno:016x}"
+
+
+class RBD:
+    """Pool-level image operations (ref: librbd::RBD)."""
+
+    def create(self, ioctx: IoCtx, name: str, size: int,
+               order: int = RBD_DEFAULT_ORDER, stripe_unit: int = 0,
+               stripe_count: int = 1) -> None:
+        if self._exists(ioctx, name):
+            raise RBDError(17, f"image {name!r} exists")
+        obj_size = 1 << order
+        su = stripe_unit or obj_size
+        layout = StripeLayout(stripe_unit=su, stripe_count=stripe_count,
+                              object_size=obj_size)
+        layout.validate()
+        meta = {"size": size, "order": order, "stripe_unit": su,
+                "stripe_count": stripe_count}
+        ioctx.write_full(header_name(name), json.dumps(meta).encode())
+
+    def remove(self, ioctx: IoCtx, name: str) -> None:
+        img = Image(ioctx, name)
+        try:
+            for objno in range(img._object_span()):
+                try:
+                    ioctx.remove(data_name(name, objno))
+                except RadosError:
+                    pass
+        finally:
+            img.close()
+        ioctx.remove(header_name(name))
+
+    def list(self, ioctx: IoCtx) -> list[str]:
+        """(ref: librbd::RBD::list — header-object scan)."""
+        return sorted(oid[len("rbd_header."):]
+                      for oid in ioctx.list_objects()
+                      if oid.startswith("rbd_header."))
+
+    @staticmethod
+    def _exists(ioctx: IoCtx, name: str) -> bool:
+        try:
+            ioctx.stat(header_name(name))
+            return True
+        except RadosError:
+            return False
+
+
+class Image:
+    """(ref: librbd::Image / ImageCtx)."""
+
+    def __init__(self, ioctx: IoCtx, name: str):
+        self.ioctx = ioctx
+        self.name = name
+        try:
+            raw = ioctx.read(header_name(name))
+        except RadosError as ex:
+            raise RBDError(2, f"image {name!r} does not exist") from ex
+        meta = json.loads(raw.decode())
+        self.size = int(meta["size"])
+        self.order = int(meta["order"])
+        self.layout = StripeLayout(
+            stripe_unit=int(meta["stripe_unit"]),
+            stripe_count=int(meta["stripe_count"]),
+            object_size=1 << self.order)
+        self._open = True
+
+    # -- metadata ------------------------------------------------------
+    def stat(self) -> dict:
+        """(ref: librbd image_info_t)."""
+        return {"size": self.size, "order": self.order,
+                "obj_size": 1 << self.order,
+                "num_objs": self._object_span(),
+                "stripe_unit": self.layout.stripe_unit,
+                "stripe_count": self.layout.stripe_count}
+
+    def _object_span(self) -> int:
+        if self.size == 0:
+            return 0
+        last = Striper.file_to_extents(self.layout, self.size - 1, 1)
+        return max(e.objectno for e in last) + 1
+
+    def resize(self, size: int) -> None:
+        """Grow or shrink; shrink removes whole objects past the end
+        (ref: librbd Operations::resize / object trimming)."""
+        self._check_open()
+        old_span = self._object_span()
+        self.size = size
+        new_span = self._object_span()
+        for objno in range(new_span, old_span):
+            try:
+                self.ioctx.remove(data_name(self.name, objno))
+            except RadosError:
+                pass
+        self._save_meta()
+
+    def _save_meta(self) -> None:
+        meta = {"size": self.size, "order": self.order,
+                "stripe_unit": self.layout.stripe_unit,
+                "stripe_count": self.layout.stripe_count}
+        self.ioctx.write_full(header_name(self.name),
+                              json.dumps(meta).encode())
+
+    # -- IO ------------------------------------------------------------
+    def _check_open(self) -> None:
+        if not self._open:
+            raise RBDError(9, "image is closed")
+
+    def _clip(self, offset: int, length: int) -> int:
+        if offset > self.size:
+            raise RBDError(22, "offset beyond end of image")
+        return min(length, self.size - offset)
+
+    def write(self, offset: int, data: bytes) -> int:
+        """(ref: librbd io/ImageRequest.cc write path: extents through
+        the striper, one object op per extent)."""
+        self._check_open()
+        length = self._clip(offset, len(data))
+        futs = []
+        for ext in Striper.file_to_extents(self.layout, offset, length):
+            buf = data[ext.logical_offset - offset:
+                       ext.logical_offset - offset + ext.length]
+            futs.append(self.ioctx.aio_write(
+                data_name(self.name, ext.objectno), buf,
+                offset=ext.offset))
+        for f in futs:
+            self.ioctx._wait(f)
+        return length
+
+    def read(self, offset: int, length: int) -> bytes:
+        """Sparse-aware: missing objects/ranges read as zeros."""
+        self._check_open()
+        length = self._clip(offset, length)
+        out = bytearray(length)
+        pend = []
+        for ext in Striper.file_to_extents(self.layout, offset, length):
+            fut = self.ioctx.aio_read(
+                data_name(self.name, ext.objectno),
+                length=ext.length, offset=ext.offset)
+            pend.append((ext, fut))
+        for ext, fut in pend:
+            try:
+                buf = self.ioctx._wait(fut).data
+            except RadosError as ex:
+                if ex.errno_name != "ENOENT":
+                    raise
+                buf = b""
+            base = ext.logical_offset - offset
+            out[base:base + len(buf)] = buf
+        return bytes(out)
+
+    def discard(self, offset: int, length: int) -> None:
+        """Zero a range (whole-object removes when covered,
+        ref: io/ImageRequest.cc discard)."""
+        self._check_open()
+        length = self._clip(offset, length)
+        obj_size = 1 << self.order
+        for ext in Striper.file_to_extents(self.layout, offset, length):
+            oid = data_name(self.name, ext.objectno)
+            if ext.offset == 0 and ext.length == obj_size:
+                try:
+                    self.ioctx.remove(oid)
+                except RadosError:
+                    pass
+            else:
+                self.ioctx.write(oid, b"\0" * ext.length,
+                                 offset=ext.offset)
+
+    def close(self) -> None:
+        self._open = False

@@ -22,6 +22,8 @@ class MiniCluster:
         self.network = LocalNetwork()
         self.threaded = threaded
         self._sim_now: float | None = None
+        from ..common.perf_counters import PerfCountersCollection
+        self.perf_collection = PerfCountersCollection()
         m, w = build_initial(n_osd, osds_per_host=osds_per_host)
         self.mon = Monitor(self.network, initial_map=m,
                            initial_wrapper=w, threaded=threaded,
@@ -37,7 +39,8 @@ class MiniCluster:
     def start_osd(self, osd: int) -> OSDDaemon:
         store = self._stores.get(osd)
         d = OSDDaemon(self.network, osd, store=store,
-                      threaded=self.threaded)
+                      threaded=self.threaded,
+                      perf_collection=self.perf_collection)
         self._stores[osd] = d.store
         d.init()
         self.osds[osd] = d

@@ -1,0 +1,182 @@
+"""Striper math + RBD-lite image IO + perf-counter wiring
+(ref: src/osdc/Striper.cc, src/librbd/, src/osd/osd_perf_counters.cc)."""
+import numpy as np
+import pytest
+
+from ceph_tpu.common.perf_counters import global_perf
+from ceph_tpu.osdc import ObjectExtent, StripeLayout, Striper
+from ceph_tpu.rbd import RBD, Image, RBDError
+from ceph_tpu.testing import MiniCluster
+
+
+# ---------------------------------------------------------------- striper
+def test_striper_trivial_layout():
+    lo = StripeLayout(stripe_unit=1 << 20, stripe_count=1,
+                      object_size=1 << 20)
+    exts = Striper.file_to_extents(lo, 0, 3 << 20)
+    assert [(e.objectno, e.offset, e.length) for e in exts] == \
+        [(0, 0, 1 << 20), (1, 0, 1 << 20), (2, 0, 1 << 20)]
+
+
+def test_striper_round_robin():
+    """su=4k, sc=3, os=8k: blocks round-robin over 3 objects, two
+    stripes per object."""
+    lo = StripeLayout(stripe_unit=4096, stripe_count=3,
+                      object_size=8192)
+    exts = Striper.file_to_extents(lo, 0, 6 * 4096)
+    assert [(e.objectno, e.offset) for e in exts] == [
+        (0, 0), (1, 0), (2, 0),      # stripe 0
+        (0, 4096), (1, 4096), (2, 4096)]  # stripe 1
+    # next object set starts at objectno 3
+    exts2 = Striper.file_to_extents(lo, 6 * 4096, 4096)
+    assert (exts2[0].objectno, exts2[0].offset) == (3, 0)
+
+
+def test_striper_unaligned_window():
+    lo = StripeLayout(stripe_unit=4096, stripe_count=2,
+                      object_size=8192)
+    exts = Striper.file_to_extents(lo, 1000, 5000)
+    assert sum(e.length for e in exts) == 5000
+    assert exts[0] == ObjectExtent(0, 1000, 3096, 1000)
+    assert exts[1].objectno == 1 and exts[1].offset == 0
+    # logical offsets cover [1000, 6000) without gaps
+    covered = sorted((e.logical_offset, e.logical_offset + e.length)
+                     for e in exts)
+    pos = 1000
+    for lo_, hi in covered:
+        assert lo_ == pos
+        pos = hi
+    assert pos == 6000
+
+
+def test_striper_roundtrip_inverse():
+    lo = StripeLayout(stripe_unit=4096, stripe_count=3,
+                      object_size=16384)
+    rng = np.random.default_rng(0)
+    for _ in range(50):
+        off = int(rng.integers(0, 200000))
+        ln = int(rng.integers(1, 30000))
+        for e in Striper.file_to_extents(lo, off, ln):
+            back = Striper.extent_to_file(lo, e.objectno, e.offset,
+                                          e.length)
+            assert back[0][0] == e.logical_offset
+            assert sum(n for _, n in back) == e.length
+
+
+def test_striper_validation():
+    with pytest.raises(ValueError):
+        StripeLayout(stripe_unit=3000, stripe_count=1,
+                     object_size=8192).validate()
+    with pytest.raises(ValueError):
+        StripeLayout(stripe_unit=0).validate()
+
+
+# ------------------------------------------------------------------- rbd
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(n_osd=5, threaded=True)
+    c.wait_all_up()
+    r = c.rados()
+    r.pool_create("rbd", pg_num=16)
+    yield c, r
+    c.shutdown()
+
+
+def test_rbd_create_open_stat_list(cluster):
+    c, r = cluster
+    io = r.open_ioctx("rbd")
+    rbd = RBD()
+    rbd.create(io, "img", size=1 << 20, order=16)  # 64 KiB objects
+    assert "img" in rbd.list(io)
+    img = Image(io, "img")
+    st = img.stat()
+    assert st["size"] == 1 << 20 and st["obj_size"] == 1 << 16
+    assert st["num_objs"] == 16
+    with pytest.raises(RBDError):
+        rbd.create(io, "img", size=1)  # duplicate
+    img.close()
+    with pytest.raises(RBDError):
+        img.read(0, 1)  # closed
+
+
+def test_rbd_write_read_spanning_objects(cluster):
+    c, r = cluster
+    io = r.open_ioctx("rbd")
+    RBD().create(io, "span", size=1 << 20, order=16)
+    img = Image(io, "span")
+    rng = np.random.default_rng(3)
+    data = rng.integers(0, 256, 200_000, dtype=np.uint8).tobytes()
+    off = 60_000  # crosses object 0 -> 3+ boundaries
+    assert img.write(off, data) == len(data)
+    assert img.read(off, len(data)) == data
+    # sparse before/after
+    assert img.read(0, 100) == b"\0" * 100
+    # unwritten tail reads as zeros
+    assert img.read(off + len(data), 50) == b"\0" * 50
+    # overwrite inside
+    img.write(off + 1000, b"X" * 70000)
+    expect = bytearray(data)
+    expect[1000:71000] = b"X" * 70000
+    assert img.read(off, len(data)) == bytes(expect)
+
+
+def test_rbd_striped_layout(cluster):
+    c, r = cluster
+    io = r.open_ioctx("rbd")
+    RBD().create(io, "striped", size=1 << 20, order=16,
+                 stripe_unit=4096, stripe_count=4)
+    img = Image(io, "striped")
+    data = bytes(range(256)) * 64  # 16 KiB: 4 stripe units
+    img.write(0, data)
+    assert img.read(0, len(data)) == data
+    # units landed on four distinct objects
+    objs = {e.objectno for e in Striper.file_to_extents(
+        img.layout, 0, len(data))}
+    assert objs == {0, 1, 2, 3}
+
+
+def test_rbd_resize_and_clip(cluster):
+    c, r = cluster
+    io = r.open_ioctx("rbd")
+    RBD().create(io, "rsz", size=1 << 18, order=16)
+    img = Image(io, "rsz")
+    img.write((1 << 18) - 100, b"y" * 500)   # clipped at image end
+    assert img.read((1 << 18) - 100, 100) == b"y" * 100
+    img.resize(1 << 19)
+    assert Image(io, "rsz").size == 1 << 19
+    img.resize(1 << 16)
+    img2 = Image(io, "rsz")
+    assert img2.size == 1 << 16
+    with pytest.raises(RBDError):
+        img2.read(1 << 17, 10)  # beyond end
+
+
+def test_rbd_discard_and_remove(cluster):
+    c, r = cluster
+    io = r.open_ioctx("rbd")
+    RBD().create(io, "disc", size=1 << 18, order=16)
+    img = Image(io, "disc")
+    img.write(0, b"z" * (1 << 17))
+    img.discard(0, 1 << 16)          # whole first object dropped
+    assert img.read(0, 1 << 16) == b"\0" * (1 << 16)
+    assert img.read(1 << 16, 1 << 16) == b"z" * (1 << 16)
+    RBD().remove(io, "disc")
+    assert "disc" not in RBD().list(io)
+    with pytest.raises(RBDError):
+        Image(io, "disc")
+
+
+# ----------------------------------------------------------- perf dump
+def test_osd_perf_counters_wired(cluster):
+    c, r = cluster
+    io = r.open_ioctx("rbd")
+    io.write_full("pobj", b"q" * 4096)
+    io.read("pobj")
+    dump = c.perf_collection.perf_dump()
+    osd_dumps = [v for k, v in dump.items() if k.startswith("osd.")]
+    assert osd_dumps
+    assert sum(d["op"] for d in osd_dumps) > 0
+    assert sum(d["op_w_bytes"] for d in osd_dumps) >= 4096
+    assert sum(d["op_r_bytes"] for d in osd_dumps) >= 4096
+    assert sum(d["subop_w"] for d in osd_dumps) > 0
+    assert sum(d["map_epochs"] for d in osd_dumps) > 0

@@ -1,0 +1,11 @@
+"""Distributed device-mesh compute: EC coding as ICI collectives.
+
+The TPU re-design of the reference's inter-OSD data fan-out
+(ref: src/osd/ECBackend.cc:2037-2070 per-shard message fan-out over the
+messenger; src/msg/Messenger.h): when chunk shards are device-resident
+on a `jax.sharding.Mesh`, the k+m shard traffic becomes XLA collectives
+riding ICI instead of host messages.
+"""
+from .mesh_ec import MeshECCoder, make_mesh
+
+__all__ = ["MeshECCoder", "make_mesh"]

@@ -1,0 +1,119 @@
+"""MgrDaemon: the balancer loop as a wire citizen.
+
+The mgr shape (ref: src/mgr/Mgr.cc + the balancer module's serve loop,
+src/pybind/mgr/balancer/module.py:340 serve -> optimize -> execute):
+subscribe to osdmaps, periodically run the upmap optimizer against the
+current map, and submit the resulting pg-upmap-items commands to the
+mon, which commits them and publishes the new epoch back.
+
+The optimizer itself is ceph_tpu.osd.balancer (calc_pg_upmaps over the
+batched vmapped mapping tables) — the mgr is the scheduling/command
+glue around it.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+
+from ..common.log import dout
+from ..common.options import global_config
+from ..msg.messages import (MMap, MMonCommand, MMonCommandAck,
+                            MMonSubscribe)
+from ..msg.messenger import Dispatcher, LocalNetwork, Message, Messenger
+from ..osd.balancer import Balancer
+from ..osd.osdmap import OSDMap
+
+
+class MgrDaemon(Dispatcher):
+    def __init__(self, network: LocalNetwork, rank: int = 0,
+                 mon: str = "mon.0", threaded: bool = False,
+                 max_deviation: int = 1, max_iterations: int = 100):
+        self.name = f"mgr.{rank}"
+        self.mon = mon
+        self.osdmap = OSDMap()
+        self.active = True
+        self.balancer = Balancer(max_deviation=max_deviation,
+                                 max_iterations=max_iterations)
+        self.last_optimize: dict = {}
+        self._tid = itertools.count(1)
+        self._pending: set[int] = set()       # unacked command tids
+        self.failed_commands = 0
+        self._lock = threading.RLock()
+        self.ms = Messenger.create(network, self.name, threaded=threaded)
+        self.ms.add_dispatcher(self)
+
+    # ------------------------------------------------------------ setup
+    def init(self) -> None:
+        self.ms.start()
+        self.ms.connect(self.mon).send_message(
+            MMonSubscribe(what="osdmap", start=1))
+
+    def shutdown(self) -> None:
+        self.ms.shutdown()
+
+    # -------------------------------------------------------- dispatch
+    def ms_dispatch(self, msg: Message) -> bool:
+        if isinstance(msg, MMap):
+            with self._lock:
+                self.osdmap = self.osdmap.ingest(msg.full_map,
+                                                 msg.incrementals)
+            return True
+        if isinstance(msg, MMonCommandAck):
+            with self._lock:
+                self._pending.discard(msg.tid)
+                if msg.result != 0:
+                    self.failed_commands += 1
+                    dout("mgr", 0).write(
+                        "%s: mon command failed (%d): %s", self.name,
+                        msg.result, msg.outs)
+            return True
+        return False
+
+    # ------------------------------------------------------- balancing
+    def tick(self) -> int:
+        """One balancer round: optimize the current map and submit the
+        upmap commands (ref: balancer module.py execute :1450 —
+        pg-upmap-items mon commands per plan item).  Returns the number
+        of commands submitted."""
+        with self._lock:
+            if not self.active or self.osdmap.epoch == 0 or \
+                    not self.osdmap.pools:
+                return 0
+            inc = self.balancer.optimize(self.osdmap)
+            rm = [str(pg) for pg in sorted(inc.old_pg_upmap_items)]
+            set_ = [(str(pg), items) for pg, items in
+                    sorted(inc.new_pg_upmap_items.items())]
+            sent = len(rm) + len(set_)
+            if sent:
+                # one batched command = one map epoch for the whole
+                # plan (an epoch per item would fan N incrementals to
+                # every subscriber)
+                self._command({"prefix": "osd upmap-batch",
+                               "rm": rm, "set": set_})
+            self.last_optimize = {
+                "epoch": self.osdmap.epoch,
+                "commands": sent,
+            }
+            if sent:
+                dout("mgr", 1).write("%s: submitted %d upmap changes "
+                                     "at e%d", self.name, sent,
+                                     self.osdmap.epoch)
+            return sent
+
+    def _command(self, cmd: dict) -> None:
+        tid = next(self._tid)
+        self._pending.add(tid)
+        self.ms.connect(self.mon).send_message(
+            MMonCommand(tid=tid, cmd=cmd))
+
+    def status(self) -> dict:
+        """(ref: `ceph balancer status`)."""
+        with self._lock:
+            score = self.balancer.score(self.osdmap) \
+                if self.osdmap.pools else {}
+            return {"active": self.active,
+                    "mode": "upmap",
+                    "epoch": self.osdmap.epoch,
+                    "last_optimize": dict(self.last_optimize),
+                    "score": {k: score.get(k)
+                              for k in ("stddev", "max_deviation")}}

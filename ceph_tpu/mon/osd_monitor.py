@@ -475,6 +475,25 @@ class OSDMonitor(PaxosService):
             self.pending_inc.new_primary_affinity[osd] = \
                 int(w * 0x10000)
             return 0, f"set osd.{osd} primary-affinity to {w}", None
+        if prefix == "osd upmap-batch":
+            # one proposal for a whole balancer plan (the reference
+            # batches via paxos round coalescing; an epoch per item
+            # would flood every subscriber with incrementals)
+            n = 0
+            for pgid in cmdmap.get("rm", []):
+                r, outs, _ = self.prepare_command(
+                    {"prefix": "osd rm-pg-upmap-items", "pgid": pgid})
+                if r != 0:
+                    return r, f"rm {pgid}: {outs}", None
+                n += 1
+            for pgid, pairs in cmdmap.get("set", []):
+                r, outs, _ = self.prepare_command(
+                    {"prefix": "osd pg-upmap-items", "pgid": pgid,
+                     "id_pairs": pairs})
+                if r != 0:
+                    return r, f"set {pgid}: {outs}", None
+                n += 1
+            return 0, f"staged {n} upmap changes", None
         if prefix in ("osd pg-upmap-items", "osd rm-pg-upmap-items"):
             pgid = str(cmdmap["pgid"])
             pool_s, _, ps_s = pgid.partition(".")

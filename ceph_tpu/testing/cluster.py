@@ -31,6 +31,7 @@ class MiniCluster:
         self.mon.init()
         self.osds: dict[int, OSDDaemon] = {}
         self._stores: dict[int, object] = {}
+        self.mgr = None
         for osd in range(n_osd):
             self.start_osd(osd)
         self.clients: list[Rados] = []
@@ -55,6 +56,17 @@ class MiniCluster:
 
     def revive_osd(self, osd: int) -> OSDDaemon:
         return self.start_osd(osd)
+
+    # ------------------------------------------------------------- mgr
+    def start_mgr(self, **kw):
+        from ..mgr import MgrDaemon
+        if self.mgr is not None:
+            self.mgr.shutdown()
+        self.mgr = MgrDaemon(self.network, threaded=self.threaded, **kw)
+        self.mgr.init()
+        if not self.threaded:
+            self.pump()
+        return self.mgr
 
     # ---------------------------------------------------------- client
     def rados(self, timeout: float = 30.0) -> Rados:
@@ -81,6 +93,8 @@ class MiniCluster:
                 moved += d.ms.poll()
             for c in self.clients:
                 moved += c.objecter.ms.poll()
+            if self.mgr is not None:
+                moved += self.mgr.ms.poll()
             if not moved:
                 break
 
@@ -119,6 +133,8 @@ class MiniCluster:
     def shutdown(self) -> None:
         for c in self.clients:
             c.shutdown()
+        if self.mgr is not None:
+            self.mgr.shutdown()
         for d in list(self.osds.values()):
             d.shutdown()
         self.mon.shutdown()

@@ -86,6 +86,21 @@ class Rados:
     def open_ioctx(self, pool_name: str) -> "IoCtx":
         return IoCtx(self, self.pool_lookup(pool_name))
 
+    def pg_scrub(self, pool_id: int, ps: int,
+                 repair: bool = False) -> dict:
+        """Deep-scrub one PG at its primary; returns
+        {inconsistent, repaired, unrepairable}
+        (ref: `ceph pg deep-scrub` / `ceph pg repair`)."""
+        fut = self.objecter.submit(
+            pool_id, "", "scrub-repair" if repair else "scrub",
+            pg_ps=ps)
+        if not self.objecter.wait_sync(fut.done, self.op_timeout,
+                                       ev=fut._ev):
+            raise TimeoutError("scrub timed out")
+        if fut.result < 0:
+            raise RadosError(fut.errno_name or "EIO")
+        return fut.attrs
+
 
 class IoCtx:
     """Pool IO context (ref: librados::IoCtx)."""

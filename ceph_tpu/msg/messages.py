@@ -109,6 +109,28 @@ class PGPull(Message):
 
 
 @dataclass
+class ScrubMapRequest(Message):
+    """Primary asks a peer for its scrub map
+    (ref: src/messages/MOSDRepScrub.h; PG::replica_scrub)."""
+    pgid: Any = None
+    deep: bool = True
+
+
+@dataclass
+class ScrubMapReply(Message):
+    """(ref: ScrubMap in src/osd/scrubber_common.h — per-object
+    version/size/digest)."""
+    pgid: Any = None
+    from_osd: int = -1
+    #: oid -> {"version": (e, v), "size": int, "crc": int | None,
+    #:          "ok": bool}  (crc None on shallow scrub)
+    objects: dict = field(default_factory=dict)
+    #: the peer has no state for this PG yet (map lag) — the scrub
+    #: must retry rather than treat every object as missing there
+    absent: bool = False
+
+
+@dataclass
 class PGPush(Message):
     """Full-object push (recovery/backfill payload,
     ref: src/messages/MOSDPGPush.h)."""
@@ -118,6 +140,7 @@ class PGPush(Message):
     size: int = 0
     version: Any = None
     whiteout: bool = False     # delete tombstone push
+    force: bool = False        # scrub repair: overwrite same-version
 
 
 # ---------------------------------------------------------------- client

@@ -26,7 +26,7 @@ from ..msg.messages import (ECSubRead, ECSubReadReply, ECSubWrite,
                             MMonSubscribe, MOSDFailure, OSDOp,
                             OSDOpReply, PGPull, PGPush, PGScan,
                             PGScanReply, Ping, PingReply, RepOpReply,
-                            RepOpWrite)
+                            RepOpWrite, ScrubMapReply, ScrubMapRequest)
 from ..msg.messenger import Dispatcher, LocalNetwork, Message, Messenger
 from ..store import MemStore, StoreError
 from .ec_backend import ECBackend, ECPGShard
@@ -52,6 +52,24 @@ class _PGState:
         self.scan_pending: set[int] = set()
         self.peer_objects: dict[int, dict] = {}   # osd -> {oid: size}
         self.pull_pending: set[str] = set()
+        self.scrub = None          # active _ScrubState (primary only)
+
+
+class _ScrubState:
+    """One in-flight scrub round (ref: src/osd/scrubber/pg_scrubber)."""
+
+    def __init__(self, reply_msg, repair: bool):
+        self.reply_msg = reply_msg
+        self.repair = repair
+        self.pending: set[int] = set()        # osds awaited
+        self.maps: dict[int, dict] = {}       # osd -> scrub map
+        self.repairs_pending = 0
+        self.comparing = False                # reply gate (see
+        self.inconsistent: list[str] = []     # _finish_scrub)
+        #: objects whose repair was dispatched (pushes are
+        #: fire-and-forget; the verifying re-scrub is the proof)
+        self.repaired = 0
+        self.unrepairable: list[str] = []
 
 
 class OSDDaemon(Dispatcher):
@@ -180,6 +198,21 @@ class OSDDaemon(Dispatcher):
             return True
         if isinstance(msg, PGPush):
             self._handle_push(msg)
+            return True
+        if isinstance(msg, ScrubMapRequest):
+            st = self.pgs.get(msg.pgid)
+            if st is None or st.shard is None:
+                # map lag: no PG state yet — tell the primary to retry
+                # instead of reading "no objects anywhere"
+                self.ms.connect(msg.src).send_message(ScrubMapReply(
+                    pgid=msg.pgid, from_osd=self.whoami, absent=True))
+            else:
+                self.ms.connect(msg.src).send_message(ScrubMapReply(
+                    pgid=msg.pgid, from_osd=self.whoami,
+                    objects=st.shard.scrub_map(msg.deep)))
+            return True
+        if isinstance(msg, ScrubMapReply):
+            self._handle_scrub_reply(msg)
             return True
         if isinstance(msg, Ping):
             if not self.inject_heartbeat_mute:
@@ -368,12 +401,16 @@ class OSDDaemon(Dispatcher):
         return ReplicatedPGShard(pg, self.store, create=False)
 
     def _apply_push(self, shard: ReplicatedPGShard, oid: str,
-                    data: bytes, version, whiteout: bool) -> None:
+                    data: bytes, version, whiteout: bool,
+                    force: bool = False) -> None:
         """Full-object overwrite, but never let an older version clobber
-        newer local data (pushes can race regular writes)."""
+        newer local data (pushes can race regular writes).  `force`
+        (scrub repair) overwrites a same-version corrupted copy."""
         ver = tuple(version) if version else (0, 0)
         inv = shard.inventory().get(oid)
-        if inv is not None and inv[0] >= ver:
+        if inv is not None and not force and inv[0] >= ver:
+            return
+        if inv is not None and force and inv[0] > ver:
             return
         if whiteout:
             shard.apply_write(oid, 0, b"", True, EVersion(*ver), [])
@@ -389,7 +426,7 @@ class OSDDaemon(Dispatcher):
             # into the store (it would be reported by a later scan)
             return
         self._apply_push(st.shard, msg.oid, msg.data, msg.version,
-                         msg.whiteout)
+                         msg.whiteout, force=msg.force)
         if st.recovering and msg.oid in st.pull_pending:
             st.pull_pending.discard(msg.oid)
             if not st.pull_pending and not st.scan_pending:
@@ -418,6 +455,162 @@ class OSDDaemon(Dispatcher):
 
     def pgs_recovering(self) -> int:
         return sum(1 for st in self.pgs.values() if st.recovering)
+
+    # ------------------------------------------------------------ scrub
+    # Primary-driven deep scrub (ref: src/osd/scrubber/pg_scrubber.cc:
+    # collect replica scrub maps, compare against the authoritative
+    # copy, optionally repair): replicated PGs compare
+    # version/size/crc per copy; EC PGs aggregate each shard's local
+    # HashInfo-crc verification and rebuild bad shards through the
+    # recovery path.
+    def _start_scrub(self, pg: PG, st: _PGState, msg: OSDOp,
+                     repair: bool) -> None:
+        if st.scrub is not None:
+            self._reply(msg, -16, "EBUSY")
+            return
+        sc = _ScrubState(msg, repair)
+        st.scrub = sc
+        sc.maps[self.whoami] = st.shard.scrub_map(deep=True)
+        peers = {o for o in st.acting if o >= 0 and o != self.whoami}
+        sc.pending = set(peers)
+        for p in peers:
+            if not self.ms.connect(f"osd.{p}").send_message(
+                    ScrubMapRequest(pgid=pg, deep=True)):
+                # unreachable peer: abort rather than wedge in
+                # scrubbing state (retry after the remap settles)
+                st.scrub = None
+                self._reply(msg, -11, "EAGAIN")
+                return
+        if not sc.pending:
+            self._finish_scrub(pg, st)
+
+    def _handle_scrub_reply(self, msg: ScrubMapReply) -> None:
+        st = self.pgs.get(msg.pgid)
+        if st is None or st.scrub is None or \
+                msg.from_osd not in st.scrub.pending:
+            return
+        if msg.absent:
+            sc = st.scrub
+            st.scrub = None
+            self._reply(sc.reply_msg, -11, "EAGAIN")
+            return
+        st.scrub.pending.discard(msg.from_osd)
+        st.scrub.maps[msg.from_osd] = dict(msg.objects)
+        if not st.scrub.pending:
+            self._finish_scrub(msg.pgid, st)
+
+    def _finish_scrub(self, pg: PG, st: _PGState) -> None:
+        # guard against synchronous repair completions firing the
+        # client reply while the compare loop is still running
+        st.scrub.comparing = True
+        try:
+            if isinstance(st.shard, ReplicatedPGShard):
+                self._scrub_compare_replicated(pg, st)
+            else:
+                self._scrub_compare_ec(pg, st)
+        finally:
+            if st.scrub is not None:
+                st.scrub.comparing = False
+        self._maybe_scrub_done(pg, st)
+
+    @staticmethod
+    def _copies_match(a: dict, b: dict) -> bool:
+        return (a["version"] == b["version"] and a["size"] == b["size"]
+                and a["crc"] == b["crc"]
+                and a["whiteout"] == b["whiteout"] and b["ok"])
+
+    def _scrub_compare_replicated(self, pg: PG, st: _PGState) -> None:
+        sc = st.scrub
+        all_oids = sorted({o for m in sc.maps.values() for o in m})
+        for oid in all_oids:
+            copies = {osd: m[oid] for osd, m in sc.maps.items()
+                      if oid in m}
+            # authoritative selection: highest version among healthy
+            # copies (ref: PrimaryLogPG::be_select_auth_object)
+            healthy = {o: c for o, c in copies.items() if c["ok"]}
+            if not healthy:
+                sc.inconsistent.append(oid)
+                sc.unrepairable.append(oid)
+                continue
+            auth_osd = max(healthy,
+                           key=lambda o: (tuple(healthy[o]["version"]),
+                                          o == self.whoami))
+            auth = healthy[auth_osd]
+            bad = [osd for osd in sc.maps
+                   if osd not in copies or
+                   not self._copies_match(auth, copies[osd])]
+            if not bad:
+                continue
+            sc.inconsistent.append(oid)
+            if not sc.repair:
+                continue
+            if auth_osd != self.whoami:
+                # repairing from a remote authority needs a pull the
+                # scrub path doesn't do yet
+                sc.unrepairable.append(oid)
+                continue
+            ver = tuple(auth["version"])
+            if auth["whiteout"]:
+                data = b""
+            else:
+                data = st.shard.read(oid)
+            for osd in bad:
+                self.ms.connect(f"osd.{osd}").send_message(PGPush(
+                    pgid=pg, oid=oid, data=data, size=len(data),
+                    version=ver, whiteout=auth["whiteout"],
+                    force=True))
+            sc.repaired += 1    # per object, matching the EC path
+
+    def _scrub_compare_ec(self, pg: PG, st: _PGState) -> None:
+        sc = st.scrub
+        osd_to_shard = {osd: idx for idx, osd in enumerate(st.acting)
+                        if osd >= 0}
+        all_oids = sorted({o for m in sc.maps.values() for o in m})
+        for oid in all_oids:
+            bad_shards = []
+            for osd, m in sc.maps.items():
+                entry = m.get(oid)
+                if entry is None or not entry["ok"]:
+                    bad_shards.append(osd_to_shard[osd])
+            if not bad_shards:
+                continue
+            sc.inconsistent.append(oid)
+            if not sc.repair or st.backend is None:
+                continue
+            if len(bad_shards) > self._ec_m(st):
+                sc.unrepairable.append(oid)
+                continue
+            for s in bad_shards:
+                st.backend.peer_missing[s].add(oid, EVersion(1, 1))
+            sc.repairs_pending += 1
+
+            def on_done(ok, oid=oid, pg=pg, st=st):
+                sc2 = st.scrub
+                if sc2 is None:
+                    return
+                sc2.repairs_pending -= 1
+                if ok:
+                    sc2.repaired += 1
+                else:
+                    sc2.unrepairable.append(oid)
+                self._maybe_scrub_done(pg, st)
+
+            st.backend.recover_object(oid, bad_shards, on_done)
+
+    def _ec_m(self, st: _PGState) -> int:
+        return st.backend.m if st.backend is not None else 0
+
+    def _maybe_scrub_done(self, pg: PG, st: _PGState) -> None:
+        sc = st.scrub
+        if sc is None or sc.pending or sc.repairs_pending or \
+                sc.comparing:
+            return
+        st.scrub = None
+        self._reply(sc.reply_msg, 0, attrs={
+            "inconsistent": sorted(set(sc.inconsistent)),
+            "repaired": sc.repaired,
+            "unrepairable": sorted(set(sc.unrepairable)),
+        })
 
     def _make_send(self, pg: PG):
         def send(shard_idx: int, payload) -> bool:
@@ -568,6 +761,9 @@ class OSDDaemon(Dispatcher):
                 # PrimaryLogPG::do_pg_op)
                 self._reply(msg, 0,
                             attrs={"objects": st.shard.objects()})
+            elif msg.op in ("scrub", "scrub-repair"):
+                self._start_scrub(msg.pgid, st, msg,
+                                  repair=msg.op == "scrub-repair")
             else:
                 self._reply(msg, -22, "EINVAL")
         except StoreError as err:

@@ -136,6 +136,33 @@ class ECPGShard:
         return self.store.exists(self.cid,
                                  ObjectId(oid, shard=self.shard))
 
+    def scrub_map(self, deep: bool = True) -> dict:
+        """Per-object shard integrity for scrub: the stored chunk
+        stream re-hashed against the HashInfo cumulative crc
+        (ref: ECBackend.cc be_deep_scrub :2424)."""
+        from ..common.crc32c import crc32c
+        out: dict[str, dict] = {}
+        for oid in self.objects():
+            soid = ObjectId(oid, shard=self.shard)
+            try:
+                buf = self.store.read(self.cid, soid, 0, 0)
+            except StoreError:
+                out[oid] = {"size": -1, "crc": None, "ok": False}
+                continue
+            entry = {"size": len(buf), "crc": None, "ok": True}
+            if deep:
+                crc = int(crc32c(0xFFFFFFFF, buf))
+                entry["crc"] = crc
+                hd = self._hinfo(soid)
+                if hd is not None and hd.has_chunk_hash():
+                    # a truncated/extended stream is itself an
+                    # inconsistency, not a reason to skip the check
+                    entry["ok"] = (
+                        hd.get_total_chunk_size() == len(buf) and
+                        crc == hd.get_chunk_hash(self.shard))
+            out[oid] = entry
+        return out
+
 
 # ------------------------------------------------------------------ primary
 

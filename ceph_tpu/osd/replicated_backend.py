@@ -139,6 +139,29 @@ class ReplicatedPGShard:
             self.store.exists(self.cid, soid) and \
             not self._is_whiteout(soid)
 
+    def scrub_map(self, deep: bool = True) -> dict:
+        """Per-object (version, size, digest) inventory for scrub
+        (ref: src/osd/scrubber_common.h ScrubMap;
+        PrimaryLogPG::build_scrub_map_chunk)."""
+        from ..common.crc32c import crc32c
+        out: dict[str, dict] = {}
+        for oid, (ver, whiteout) in self.inventory().items():
+            if whiteout:
+                out[oid] = {"version": ver, "size": 0, "crc": None,
+                            "whiteout": True, "ok": True}
+                continue
+            try:
+                data = self.read(oid)
+            except StoreError:
+                out[oid] = {"version": ver, "size": -1, "crc": None,
+                            "whiteout": False, "ok": False}
+                continue
+            out[oid] = {"version": ver, "size": len(data),
+                        "crc": int(crc32c(0xFFFFFFFF, data))
+                        if deep else None,
+                        "whiteout": False, "ok": True}
+        return out
+
 
 @dataclass
 class _RepWrite:

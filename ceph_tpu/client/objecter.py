@@ -183,6 +183,11 @@ class Objecter(Dispatcher):
                     self._send_op(op)
         still_homeless = []
         for op in self.homeless:
+            if op.pool not in self.osdmap.pools:
+                # pool deleted while the op was parked
+                op.future._complete(OSDOpReply(
+                    tid=op.tid, result=-2, errno_name="ENOENT"))
+                continue
             self._calc_target(op)
             if op.target_osd >= 0:
                 self.in_flight[op.tid] = op
@@ -219,6 +224,13 @@ class Objecter(Dispatcher):
         o = _Op(next(self._tid), pool, oid, op, offset, length, data,
                 fut, pg_ps=pg_ps)
         with self._lock:
+            if self.osdmap.epoch > 0 and pool not in self.osdmap.pools:
+                # pool does not exist in the current map: fail fast
+                # instead of parking forever (ref: Objecter
+                # _check_op_pool_dne)
+                fut._complete(OSDOpReply(tid=o.tid, result=-2,
+                                         errno_name="ENOENT"))
+                return fut
             self._calc_target(o)
             if o.target_osd < 0:
                 self.homeless.append(o)

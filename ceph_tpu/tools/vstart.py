@@ -1,0 +1,235 @@
+"""vstart: boot a cluster in-process and drive it with ceph-style
+commands.
+
+The vstart.sh + `ceph` CLI analogue for this framework (ref:
+src/vstart.sh, src/ceph.in): one process hosts mon + mgr + N OSDs over
+the local transport; stdin (or -c arguments) takes a ceph-flavored
+command language:
+
+    osd stat | osd dump | osd tree | osd down/out/in <id>
+    osd pool create <name> <pg_num> [erasure [<profile>]]
+    osd erasure-code-profile set <name> k=K m=M [plugin=tpu] [...]
+    osd erasure-code-profile ls | get <name>
+    pg map <pgid> | pg scrub <pgid> | pg repair <pgid>
+    put <pool> <obj> <file|-> | get <pool> <obj> [file]
+    rm <pool> <obj> | ls <pool> | stat <pool> <obj>
+    balance | balancer status
+    kill-osd <id> | revive-osd <id> | tick
+    perf dump | status | quit
+
+Example:
+    echo "osd stat" | python -m ceph_tpu.tools.vstart --osds 4
+    python -m ceph_tpu.tools.vstart --osds 6 -c "osd pool create p 32" \\
+        -c "put p hello /etc/hostname" -c "get p hello -" -c status
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import shlex
+import sys
+
+from ..testing.cluster import MiniCluster
+
+
+class VstartShell:
+    def __init__(self, n_osd: int = 4, osds_per_host: int = 1,
+                 out=sys.stdout):
+        self.out = out
+        self.cluster = MiniCluster(n_osd=n_osd,
+                                   osds_per_host=osds_per_host,
+                                   threaded=True)
+        self.cluster.wait_all_up()
+        self.rados = self.cluster.rados()
+        self.mgr = self.cluster.start_mgr()
+        self._now = 10_000.0
+        #: set while commands stream from stdin (put ... - is invalid)
+        self.stdin_is_script = False
+
+    def close(self) -> None:
+        self.cluster.shutdown()
+
+    def _print(self, *args) -> None:
+        print(*args, file=self.out)
+
+    # ----------------------------------------------------------- exec
+    def run_line(self, line: str) -> bool:
+        """Execute one command; returns False on quit."""
+        toks = shlex.split(line.strip())
+        if not toks or toks[0].startswith("#"):
+            return True
+        try:
+            return self._dispatch(toks)
+        except Exception as ex:                     # CLI surface: report
+            self._print(f"Error: {type(ex).__name__}: {ex}")
+            return True
+
+    def _dispatch(self, toks: list[str]) -> bool:
+        cmd = toks[0]
+        if cmd in ("quit", "exit"):
+            return False
+        if cmd == "status":
+            r, outs, outb = self.rados.mon_command(
+                {"prefix": "osd stat"})
+            st = self.mgr.status()
+            pools = ", ".join(self.rados.list_pools()) or "-"
+            self._print(f"  cluster: {outs}")
+            self._print(f"  pools:   {pools}")
+            self._print(f"  balancer: active={st['active']} "
+                        f"score={st['score']}")
+            return True
+        if cmd == "osd":
+            return self._osd(toks[1:])
+        if cmd == "pg":
+            return self._pg(toks[1:])
+        if cmd == "put":
+            pool, obj, src = toks[1], toks[2], toks[3]
+            if src == "-":
+                if self.stdin_is_script:
+                    raise ValueError(
+                        "put ... - cannot read stdin while commands "
+                        "come from stdin; use a file path")
+                data = sys.stdin.buffer.read()
+            else:
+                data = open(src, "rb").read()
+            self.rados.open_ioctx(pool).write_full(obj, data)
+            self._print(f"wrote {len(data)} bytes to {pool}/{obj}")
+            return True
+        if cmd == "get":
+            pool, obj = toks[1], toks[2]
+            dst = toks[3] if len(toks) > 3 else "-"
+            data = self.rados.open_ioctx(pool).read(obj)
+            if dst == "-":
+                self.out.write(data.decode(errors="replace"))
+                self.out.flush()
+            else:
+                open(dst, "wb").write(data)
+                self._print(f"read {len(data)} bytes to {dst}")
+            return True
+        if cmd == "rm":
+            self.rados.open_ioctx(toks[1]).remove(toks[2])
+            self._print("removed")
+            return True
+        if cmd == "ls":
+            for oid in self.rados.open_ioctx(toks[1]).list_objects():
+                self._print(oid)
+            return True
+        if cmd == "stat":
+            st = self.rados.open_ioctx(toks[1]).stat(toks[2])
+            self._print(json.dumps(st))
+            return True
+        if cmd == "balance":
+            n = self.mgr.tick()
+            self._print(f"submitted {n} upmap changes; "
+                        f"score {self.mgr.status()['score']}")
+            return True
+        if cmd == "balancer" and toks[1:] == ["status"]:
+            self._print(json.dumps(self.mgr.status(), indent=1))
+            return True
+        if cmd == "kill-osd":
+            self.cluster.kill_osd(int(toks[1]))
+            self._print(f"osd.{toks[1]} killed")
+            return True
+        if cmd == "revive-osd":
+            self.cluster.revive_osd(int(toks[1]))
+            self._print(f"osd.{toks[1]} revived")
+            return True
+        if cmd == "tick":
+            import time
+            from ..common.options import global_config
+            grace = global_config()["osd_heartbeat_grace"]
+            for _ in range(3):
+                self._now += grace / 2 + 1
+                self.cluster.tick(self._now)
+                # threaded cluster: let ping replies land before the
+                # next round's grace check, else live peers race past
+                # the window and get falsely reported
+                time.sleep(0.1)
+            self._print(f"ticked; {self.rados.mon_command({'prefix': 'osd stat'})[1]}")
+            return True
+        if cmd == "perf" and toks[1:] == ["dump"]:
+            self._print(json.dumps(
+                self.cluster.perf_collection.perf_dump(), indent=1,
+                sort_keys=True))
+            return True
+        raise ValueError(f"unknown command {' '.join(toks)!r} "
+                         "(see module docstring)")
+
+    def _osd(self, toks: list[str]) -> bool:
+        if toks[0] == "pool" and toks[1] == "create":
+            name, pg_num = toks[2], int(toks[3])
+            ptype = toks[4] if len(toks) > 4 else "replicated"
+            profile = toks[5] if len(toks) > 5 else ""
+            self.rados.pool_create(name, pg_num=pg_num, pool_type=ptype,
+                                   erasure_code_profile=profile)
+            self._print(f"pool '{name}' created")
+            return True
+        if toks[0] == "erasure-code-profile" and toks[1] == "set":
+            name = toks[2]
+            profile = dict(kv.split("=", 1) for kv in toks[3:])
+            r, outs, _ = self.rados.mon_command(
+                {"prefix": "osd erasure-code-profile set", "name": name,
+                 "profile": profile, "force": True})
+            self._print(outs or f"profile '{name}' set")
+            return True
+        if toks[0] in ("down", "out", "in"):
+            r, outs, _ = self.rados.mon_command(
+                {"prefix": f"osd {toks[0]}",
+                 "ids": [int(t) for t in toks[1:]]})
+            self._print(outs)
+            return True
+        # passthrough read commands: stat/dump/tree/ls/erasure-code-
+        # profile ls|get/pool ls|get
+        cmd = {"prefix": "osd " + " ".join(
+            t for t in toks if "=" not in t)}
+        if toks[0] == "erasure-code-profile" and len(toks) > 2:
+            cmd = {"prefix": f"osd erasure-code-profile {toks[1]}",
+                   "name": toks[2]}
+        elif toks[0] == "pool" and toks[1] == "get":
+            cmd = {"prefix": "osd pool get", "pool": toks[2],
+                   "var": toks[3]}
+        r, outs, outb = self.rados.mon_command(cmd)
+        self._print(outs if outs else json.dumps(outb, default=str))
+        return True
+
+    def _pg(self, toks: list[str]) -> bool:
+        verb, pgid = toks[0], toks[1]
+        pool_s, _, ps_s = pgid.partition(".")
+        if verb == "map":
+            r, outs, _ = self.rados.mon_command(
+                {"prefix": "pg map", "pgid": pgid})
+            self._print(outs)
+            return True
+        if verb in ("scrub", "deep-scrub", "repair"):
+            res = self.rados.pg_scrub(int(pool_s), int(ps_s, 16),
+                                      repair=verb == "repair")
+            self._print(json.dumps(res))
+            return True
+        raise ValueError(f"unknown pg command {verb!r}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="vstart", description="in-process cluster + ceph-style CLI")
+    ap.add_argument("--osds", type=int, default=4)
+    ap.add_argument("--osds-per-host", type=int, default=1)
+    ap.add_argument("-c", "--command", action="append", default=[],
+                    help="run command and continue (repeatable)")
+    args = ap.parse_args(argv)
+    sh = VstartShell(args.osds, args.osds_per_host)
+    try:
+        for cmd in args.command:
+            if not sh.run_line(cmd):
+                return 0
+        if not args.command or not sys.stdin.isatty():
+            sh.stdin_is_script = True
+            for line in sys.stdin:
+                if not sh.run_line(line):
+                    break
+    finally:
+        sh.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

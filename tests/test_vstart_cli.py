@@ -1,0 +1,43 @@
+"""vstart CLI shell: the ceph-command tour as a smoke test
+(ref: src/vstart.sh + src/ceph.in usage model)."""
+import io
+
+from ceph_tpu.tools.vstart import VstartShell
+
+
+def test_vstart_shell_tour(tmp_path):
+    src = tmp_path / "payload"
+    src.write_bytes(b"cli payload " * 10)
+    out = io.StringIO()
+    sh = VstartShell(n_osd=4, osds_per_host=1, out=out)
+    try:
+        for line in [
+            "osd stat",
+            "osd pool create p 8",
+            f"put p obj {src}",
+            f"get p obj {tmp_path / 'back'}",
+            "ls p",
+            "stat p obj",
+            "pg map 0.1",
+            "pg scrub 0.1",
+            "balance",
+            "osd down 1",
+            "osd in 1",
+            "status",
+            "perf dump",
+        ]:
+            assert sh.run_line(line)
+        assert not sh.run_line("quit")
+        text = out.getvalue()
+        assert "4 osds: 4 up" in text
+        assert "pool 'p' created" in text
+        assert (tmp_path / "back").read_bytes() == src.read_bytes()
+        assert "obj" in text
+        assert '"inconsistent": []' in text
+        assert "marked down osd.1" in text
+        assert '"op"' in text            # perf dump
+        # errors report, not raise, and the shell keeps running
+        assert sh.run_line("bogus command here")
+        assert "Error:" in out.getvalue()
+    finally:
+        sh.close()

@@ -90,6 +90,7 @@ class PGScan(Message):
     change (the peering/backfill scan,
     ref: src/messages/MOSDPGScan.h / PG::scan_range)."""
     pgid: Any = None
+    ec: bool = False       # scanner's pool type: build only that view
 
 
 @dataclass
@@ -98,6 +99,8 @@ class PGScanReply(Message):
     from_osd: int = -1
     #: oid -> ((epoch, version), whiteout) — the recovery inventory
     objects: dict = field(default_factory=dict)
+    #: EC pools: oid -> [shard indexes present in the peer's store]
+    ec_shards: dict = field(default_factory=dict)
 
 
 @dataclass

@@ -52,6 +52,9 @@ class _PGState:
         self.scan_pending: set[int] = set()
         self.peer_objects: dict[int, dict] = {}   # osd -> {oid: size}
         self.pull_pending: set[str] = set()
+        self.ec_jobs_pending = 0   # in-flight EC recover_object jobs
+        self.ec_jobs_failed = False
+        self.recovery_gen = 0      # invalidates stale job callbacks
         self.scrub = None          # active _ScrubState (primary only)
 
 
@@ -143,13 +146,26 @@ class OSDDaemon(Dispatcher):
             if st is not None and st.shard is not None:
                 self.perf.inc("subop_w")
                 reply = st.shard.handle_sub_write(msg)
-                self.ms.connect(msg.src).send_message(reply)
+            else:
+                # map lag: nack so the sender's op/recovery fails fast
+                # instead of waiting on an ack that never comes
+                reply = ECSubWriteReply(pgid=msg.pgid, tid=msg.tid,
+                                        shard=msg.shard,
+                                        committed=False)
+            self.ms.connect(msg.src).send_message(reply)
             return True
         if isinstance(msg, ECSubRead):
             st = self.pgs.get(msg.pgid)
             if st is not None and st.shard is not None:
                 reply = st.shard.handle_sub_read(msg)
-                self.ms.connect(msg.src).send_message(reply)
+            else:
+                # map lag: error every requested object so the reading
+                # primary fails fast instead of waiting forever
+                reply = ECSubReadReply(
+                    pgid=msg.pgid, tid=msg.tid, shard=msg.shard,
+                    errors={oid: "ESTALE"
+                            for oid, _off, _len in msg.to_read})
+            self.ms.connect(msg.src).send_message(reply)
             return True
         if isinstance(msg, ECSubWriteReply):
             st = self.pgs.get(msg.pgid)
@@ -177,11 +193,20 @@ class OSDDaemon(Dispatcher):
         if isinstance(msg, PGScan):
             # answer from the store even if our map (and PG state) lags
             # the scanner's — an unanswered scan would wedge its
-            # recovery; the store view is the authority anyway
-            shard = self._replicated_view(msg.pgid)
-            self.ms.connect(msg.src).send_message(PGScanReply(
-                pgid=msg.pgid, from_osd=self.whoami,
-                objects=shard.inventory()))
+            # recovery; the store view is the authority anyway.  The
+            # scanner tags its pool type so only that view is built
+            # (both walks would double the peering scan cost).
+            if msg.ec:
+                from .ec_backend import ec_store_inventory, pg_cid
+                reply = PGScanReply(
+                    pgid=msg.pgid, from_osd=self.whoami,
+                    ec_shards=ec_store_inventory(self.store,
+                                                 pg_cid(msg.pgid)))
+            else:
+                reply = PGScanReply(
+                    pgid=msg.pgid, from_osd=self.whoami,
+                    objects=self._replicated_view(msg.pgid).inventory())
+            self.ms.connect(msg.src).send_message(reply)
             return True
         if isinstance(msg, PGScanReply):
             self._handle_scan_reply(msg)
@@ -333,18 +358,20 @@ class OSDDaemon(Dispatcher):
     # backfill, collapsed to scan/pull/push; client ops get ESTALE and
     # retry while this runs).
     def _start_recovery(self, pg: PG, st: _PGState) -> None:
-        if not isinstance(st.backend, ReplicatedBackend):
-            return
         peers = [o for o in st.acting if o >= 0 and o != self.whoami]
         st.peer_objects = {}
         st.pull_pending = set()
         st.scan_pending = set(peers)
+        st.recovery_gen += 1       # cancels stale in-flight job cbs
+        st.ec_jobs_pending = 0
         if not peers:
             st.recovering = False
             return
         st.recovering = True
+        is_ec = isinstance(st.shard, ECPGShard)
         for p in peers:
-            self.ms.connect(f"osd.{p}").send_message(PGScan(pgid=pg))
+            self.ms.connect(f"osd.{p}").send_message(
+                PGScan(pgid=pg, ec=is_ec))
 
     def _handle_scan_reply(self, msg: PGScanReply) -> None:
         st = self.pgs.get(msg.pgid)
@@ -353,6 +380,11 @@ class OSDDaemon(Dispatcher):
         if msg.from_osd not in st.scan_pending:
             return   # stale reply from a previous recovery round
         st.scan_pending.discard(msg.from_osd)
+        if isinstance(st.shard, ECPGShard):
+            st.peer_objects[msg.from_osd] = dict(msg.ec_shards)
+            if not st.scan_pending:
+                self._ec_recover(msg.pgid, st)
+            return
         st.peer_objects[msg.from_osd] = dict(msg.objects)
         if st.scan_pending:
             return
@@ -390,6 +422,124 @@ class OSDDaemon(Dispatcher):
                 PGPull(pgid=msg.pgid, oids=oids))
         if not st.pull_pending:
             self._finish_recovery(msg.pgid, st)
+
+    def _ec_recover(self, pg: PG, st: _PGState) -> None:
+        """EC peering completion: bring every acting (object, shard
+        index) to the authoritative version.  Version-aware like the
+        replicated path: a remapped/returning OSD may hold chunks for
+        stale indexes or stale versions — mere presence is not enough
+        (ref: EC backfill; ECBackend recover_object).  A newest-version
+        whiteout means the delete wins: tombstones are pushed and no
+        data is reconstructed."""
+        b = st.backend
+        if b is None:
+            st.recovering = False
+            return
+        inv: dict[int, dict] = {self.whoami:
+                                st.shard.shard_inventory()}
+        inv.update(st.peer_objects)
+        all_oids = sorted({o for m in inv.values() for o in m})
+        jobs: list[tuple[str, list[int], tuple]] = []
+        tombstones: list[tuple[str, tuple, list[int]]] = []
+        failed_any = False
+        for oid in all_oids:
+            # authoritative (version, whiteout): newest version wins
+            auth = max((entry for m in inv.values()
+                        for entry in m.get(oid, {}).values()),
+                       default=((0, 0), False))
+            auth_ver, auth_whiteout = auth
+            targets = []
+            for s, osd in enumerate(st.acting):
+                if osd < 0:
+                    continue
+                entry = inv.get(osd, {}).get(oid, {}).get(s)
+                if entry is None or tuple(entry[0]) < tuple(auth_ver) \
+                        or bool(entry[1]) != auth_whiteout:
+                    targets.append(s)
+            if not targets:
+                continue
+            if auth_whiteout:
+                tombstones.append((oid, tuple(auth_ver), targets))
+                continue
+            # sources must hold the authoritative version; shards that
+            # are current get any stale marks from earlier rounds
+            # cleared (marks only otherwise clear on recovery-push ack)
+            for s, osd in enumerate(st.acting):
+                if osd < 0:
+                    continue
+                entry = inv.get(osd, {}).get(oid, {}).get(s)
+                stale = entry is None or \
+                    tuple(entry[0]) < tuple(auth_ver) or bool(entry[1])
+                if stale:
+                    b.peer_missing[s].add(oid, EVersion(*auth_ver))
+                else:
+                    b.peer_missing[s].rm(oid)
+            valid = sum(1 for s, osd in enumerate(st.acting)
+                        if osd >= 0 and
+                        not b.peer_missing[s].is_missing(oid))
+            if valid < b.k:
+                # gate writes on the phantom object but don't wedge
+                # the whole PG on it (ref: the missing-object guard in
+                # submit_transaction)
+                failed_any = True
+                dout("osd", 0).write(
+                    "%s: pg %s object %s unrecoverable (%d < k=%d "
+                    "valid shards)", self.name, pg, oid, valid, b.k)
+                continue
+            jobs.append((oid, targets, tuple(auth_ver)))
+        for oid, ver, targets in tombstones:
+            self._push_ec_tombstones(pg, st, oid, ver, targets)
+        if not jobs:
+            st.recovering = False
+            if failed_any:
+                dout("osd", 0).write(
+                    "%s: pg %s recovery finished with unrecoverable "
+                    "objects", self.name, pg)
+            return
+        st.ec_jobs_pending = len(jobs)
+        st.ec_jobs_failed = failed_any
+        gen = st.recovery_gen
+
+        def on_done(ok, pg=pg, st=st, gen=gen):
+            if st.recovery_gen != gen:
+                return             # a restarted recovery superseded us
+            if not ok:
+                st.ec_jobs_failed = True
+            st.ec_jobs_pending -= 1
+            if st.ec_jobs_pending == 0 and st.recovering:
+                st.recovering = False
+                if st.ec_jobs_failed:
+                    # honest failure: missing marks persist (gating
+                    # writes to those objects) until a map change
+                    # restarts recovery
+                    dout("osd", 0).write(
+                        "%s: pg %s ec-recovery INCOMPLETE", self.name,
+                        pg)
+                else:
+                    dout("osd", 10).write("%s: pg %s ec-recovered",
+                                          self.name, pg)
+
+        for oid, targets, ver in jobs:
+            # stamp rebuilt shards with the authoritative version (the
+            # rebuilt primary's pg_log cannot supply it)
+            b.recover_object(oid, targets, on_done,
+                             version=EVersion(*ver))
+
+    def _push_ec_tombstones(self, pg: PG, st: _PGState, oid: str,
+                            ver: tuple, targets: list[int]) -> None:
+        """Spread a delete to shards that missed it (the EC analogue of
+        pushing a replicated whiteout)."""
+        from .ec_backend import ec_tombstone_txn, pg_cid
+        b = st.backend
+        cid = pg_cid(pg)
+        for s in targets:
+            txn = ec_tombstone_txn(cid, oid, s, ver, b.k + b.m)
+            msg = ECSubWrite(pgid=pg, tid=0, shard=s, txn=txn,
+                             log_entries=[])
+            if st.acting[s] == self.whoami:
+                st.shard.handle_sub_write(msg)
+            else:
+                self.ms.connect(f"osd.{st.acting[s]}").send_message(msg)
 
     def _replicated_view(self, pg) -> ReplicatedPGShard:
         """Current PG shard, or a transient read-only store view when
@@ -567,21 +717,39 @@ class OSDDaemon(Dispatcher):
                         if osd >= 0}
         all_oids = sorted({o for m in sc.maps.values() for o in m})
         for oid in all_oids:
+            # authoritative (version, whiteout) among healthy entries
+            entries = {osd: m[oid] for osd, m in sc.maps.items()
+                       if oid in m}
+            healthy = [e for e in entries.values() if e["ok"]]
+            auth_ver = max((tuple(e.get("version", (0, 0)))
+                            for e in healthy), default=(0, 0))
+            auth_whiteout = any(
+                e.get("whiteout") for e in healthy
+                if tuple(e.get("version", (0, 0))) == auth_ver)
             bad_shards = []
             for osd, m in sc.maps.items():
-                entry = m.get(oid)
-                if entry is None or not entry["ok"]:
+                e = m.get(oid)
+                if e is None or not e["ok"] or \
+                        tuple(e.get("version", (0, 0))) < auth_ver or \
+                        bool(e.get("whiteout")) != auth_whiteout:
                     bad_shards.append(osd_to_shard[osd])
             if not bad_shards:
                 continue
             sc.inconsistent.append(oid)
             if not sc.repair or st.backend is None:
                 continue
+            if auth_whiteout:
+                # the delete is authoritative: spread tombstones, no
+                # data reconstruction
+                self._push_ec_tombstones(pg, st, oid, auth_ver,
+                                         bad_shards)
+                sc.repaired += 1
+                continue
             if len(bad_shards) > self._ec_m(st):
                 sc.unrepairable.append(oid)
                 continue
             for s in bad_shards:
-                st.backend.peer_missing[s].add(oid, EVersion(1, 1))
+                st.backend.peer_missing[s].add(oid, EVersion(*auth_ver))
             sc.repairs_pending += 1
 
             def on_done(ok, oid=oid, pg=pg, st=st):
@@ -595,7 +763,8 @@ class OSDDaemon(Dispatcher):
                     sc2.unrepairable.append(oid)
                 self._maybe_scrub_done(pg, st)
 
-            st.backend.recover_object(oid, bad_shards, on_done)
+            st.backend.recover_object(oid, bad_shards, on_done,
+                                      version=EVersion(*auth_ver))
 
     def _ec_m(self, st: _PGState) -> int:
         return st.backend.m if st.backend is not None else 0

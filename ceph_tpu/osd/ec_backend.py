@@ -47,6 +47,49 @@ def pg_cid(pgid) -> str:
     return f"pg_{pgid}"
 
 
+def ec_tombstone_txn(cid: str, oid: str, shard: int, ver: tuple,
+                     n_chunks: int) -> Transaction:
+    """The versioned-whiteout delete for one shard: data trimmed,
+    delete version recorded, hinfo reset.  Single source of truth for
+    the tombstone layout (delete commit, recovery spread, scrub repair
+    all write this shape)."""
+    soid = ObjectId(oid, shard=shard)
+    return (Transaction()
+            .touch(cid, soid)
+            .truncate(cid, soid, 0)
+            .setattrs(cid, soid, {
+                OI_ATTR: {"size": 0, "version": tuple(ver),
+                          "whiteout": True},
+                HINFO_ATTR: HashInfo(n_chunks).to_dict()}))
+
+
+def ec_store_inventory(store, cid: str) -> dict:
+    """oid -> {shard_index: ((epoch, ver), whiteout)} straight from a
+    PG collection, independent of any live ECPGShard (a peer whose map
+    lags can still answer a peering scan from its store; after a remap
+    an OSD may hold chunks for indexes it no longer serves).  Version-
+    carrying so stale chunks lose to newer writes/tombstones
+    (ref: EC backfill presence/version decisions)."""
+    out: dict[str, dict] = {}
+    if not store.collection_exists(cid):
+        return out
+    for o in store.collection_list(cid):
+        if o.name == "pgmeta":
+            continue
+        try:
+            oi = store.getattr(cid, o, OI_ATTR)
+        except StoreError:
+            oi = {}
+        v = oi.get("version", (0, 0))
+        # replicated collections store EVersion objects; EC stores
+        # (epoch, version) tuples — normalize either
+        ver = (v.epoch, v.version) if hasattr(v, "epoch") else \
+            tuple(v) if v else (0, 0)
+        out.setdefault(o.name, {})[o.shard] = (
+            ver, bool(oi.get("whiteout")))
+    return out
+
+
 # --------------------------------------------------------------------- shard
 
 
@@ -88,6 +131,9 @@ class ECPGShard:
         for oid, off, length in m.to_read:
             soid = ObjectId(oid, shard=self.shard)
             try:
+                if self._is_whiteout(soid):
+                    raise StoreError("ENOENT",
+                                     f"{oid} deleted (whiteout)")
                 buf = self.store.read(self.cid, soid, off, length)
                 # integrity gate: full-stream reads verify the
                 # cumulative shard crc (ref: ECBackend.cc:1059-1075)
@@ -130,26 +176,50 @@ class ECPGShard:
 
     def objects(self) -> list[str]:
         return sorted({o.name for o in self.store.collection_list(self.cid)
-                       if o.name != "pgmeta"})
+                       if o.name != "pgmeta"
+                       and not self._is_whiteout(o)})
+
+    def _is_whiteout(self, soid: ObjectId) -> bool:
+        try:
+            return bool(self.store.getattr(self.cid, soid,
+                                           OI_ATTR).get("whiteout"))
+        except StoreError:
+            return False
+
+    def shard_inventory(self) -> dict:
+        return ec_store_inventory(self.store, self.cid)
 
     def exists(self, oid: str) -> bool:
-        return self.store.exists(self.cid,
-                                 ObjectId(oid, shard=self.shard))
+        soid = ObjectId(oid, shard=self.shard)
+        return self.store.exists(self.cid, soid) and \
+            not self._is_whiteout(soid)
 
     def scrub_map(self, deep: bool = True) -> dict:
         """Per-object shard integrity for scrub: the stored chunk
         stream re-hashed against the HashInfo cumulative crc
-        (ref: ECBackend.cc be_deep_scrub :2424)."""
+        (ref: ECBackend.cc be_deep_scrub :2424).  Whiteout tombstones
+        are reported (with their delete version) so a shard that missed
+        a delete is flagged rather than 'repaired' by resurrection."""
         from ..common.crc32c import crc32c
         out: dict[str, dict] = {}
-        for oid in self.objects():
+        for oid, shards in self.shard_inventory().items():
+            entry_iv = shards.get(self.shard)
+            if entry_iv is None:
+                continue
+            ver, whiteout = tuple(entry_iv[0]), bool(entry_iv[1])
+            if whiteout:
+                out[oid] = {"size": 0, "crc": None, "ok": True,
+                            "version": ver, "whiteout": True}
+                continue
             soid = ObjectId(oid, shard=self.shard)
             try:
                 buf = self.store.read(self.cid, soid, 0, 0)
             except StoreError:
-                out[oid] = {"size": -1, "crc": None, "ok": False}
+                out[oid] = {"size": -1, "crc": None, "ok": False,
+                            "version": ver, "whiteout": False}
                 continue
-            entry = {"size": len(buf), "crc": None, "ok": True}
+            entry = {"size": len(buf), "crc": None, "ok": True,
+                     "version": ver, "whiteout": False}
             if deep:
                 crc = int(crc32c(0xFFFFFFFF, buf))
                 entry["crc"] = crc
@@ -438,9 +508,15 @@ class ECBackend:
         op.phase = "commit"
         self.waiting_commit.append(op)
         if op.delete:
+            # versioned whiteout tombstone per shard (like the
+            # replicated path): a stale shard returning after the
+            # delete loses to the tombstone in recovery instead of
+            # resurrecting the object
+            cid = pg_cid(self.pgid)
+            ver = (op.version.epoch, op.version.version)
             shard_txns = {
-                s: Transaction().remove(
-                    pg_cid(self.pgid), ObjectId(op.oid, shard=s))
+                s: ec_tombstone_txn(cid, op.oid, s, ver,
+                                    self.k + self.m)
                 for s in self._alive_shards()}
             new_size = 0
             shards = {}
@@ -729,17 +805,22 @@ class ECBackend:
     #           :567 continue_recovery_op)
     # ==================================================================
     def recover_object(self, oid: str, target_shards: Iterable[int],
-                       on_done: Callable) -> None:
-        """Reconstruct `oid`'s chunks on target shards and push them."""
+                       on_done: Callable, version=None) -> None:
+        """Reconstruct `oid`'s chunks on target shards and push them.
+
+        `version`: the authoritative object version to stamp on the
+        rebuilt shards.  Callers whose pg_log was rebuilt (daemon
+        peering/scrub) MUST pass it — the local prior-version fallback
+        is only correct while the primary's log is intact."""
         targets = sorted(set(target_shards))
         # read enough shards (+ attrs) to rebuild the logical object
         self.objects_read_and_reconstruct(
             {oid: None}, lambda r, e: self._recovery_reads_done(
-                oid, targets, r, e, on_done),
+                oid, targets, r, e, on_done, version),
             for_recovery=True, want_attrs=True)
 
     def _recovery_reads_done(self, oid: str, targets, results, errors,
-                             on_done) -> None:
+                             on_done, version=None) -> None:
         if errors.get(oid) or oid not in results:
             on_done(False)
             return
@@ -753,7 +834,8 @@ class ECBackend:
             if shards:
                 hinfo.append(0, shards)
             size = len(logical)
-            version = self._object_prior_version(oid)
+            if version is None:
+                version = self._object_prior_version(oid)
             cid = pg_cid(self.pgid)
             # all targets pending up front: an inline (synchronous)
             # reply mid-loop must not see an empty set and complete

@@ -251,15 +251,29 @@ def test_kill_and_recover_shard(cl):
         assert cl.read(oid) == data
 
 
-def test_delete_removes_all_shards(cl):
+def test_delete_leaves_versioned_tombstones(cl):
+    """Delete trims data and leaves a whiteout carrying the delete's
+    version on every shard (so a shard that missed the delete loses in
+    recovery instead of resurrecting the object)."""
     data = _payload(1024, 20)
     assert cl.write("obj", 0, data)
     assert cl.delete("obj")
     for s in range(K + M):
-        assert not cl.stores[s].exists(
-            pg_cid(PGID), ObjectId("obj", shard=s))
+        soid = ObjectId("obj", shard=s)
+        # physically present as a zero-length whiteout...
+        assert cl.stores[s].exists(pg_cid(PGID), soid)
+        oi = cl.stores[s].getattr(pg_cid(PGID), soid, "_")
+        assert oi["whiteout"] and oi["size"] == 0
+        assert tuple(oi["version"]) > (0, 0)
+        # ...but logically gone
+        assert not cl.shards[s].exists("obj")
+        assert "obj" not in cl.shards[s].objects()
     with pytest.raises(IOError):
         cl.read("obj")
+    # write-after-delete resurrects cleanly with fresh hinfo state
+    data2 = _payload(512, 21)
+    assert cl.write("obj", 0, data2)
+    assert cl.read("obj") == data2
 
 
 def test_per_object_write_ordering(cl):

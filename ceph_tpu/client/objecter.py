@@ -72,9 +72,11 @@ class Objecter(Dispatcher):
     """(ref: src/osdc/Objecter.h:1204)."""
 
     def __init__(self, network: LocalNetwork, name: str | None = None,
-                 mon: str = "mon.0", threaded: bool = True):
+                 mon="mon.0", threaded: bool = True):
         self.name = name or f"client.{next(_client_ids)}"
-        self.mon = mon
+        self.mons = [mon] if isinstance(mon, str) else list(mon)
+        self._mon_i = 0
+        self._mon_hunting = False
         self.osdmap = OSDMap()
         self._map_ev = threading.Event()
         self._lock = threading.RLock()
@@ -136,13 +138,34 @@ class Objecter(Dispatcher):
             return self._handle_command_ack(msg)
         return False
 
+    @property
+    def mon(self) -> str:
+        return self.mons[self._mon_i]
+
     def ms_handle_reset(self, peer: str) -> None:
         """Retarget ops aimed at a gone peer (ref:
         Objecter::ms_handle_reset :4487).  Never blindly resend to the
         same peer — route() reports the reset synchronously, so a
         resend to a dead endpoint would recurse; ops whose recalculated
         target is unchanged park homeless until a newer map (or the
-        rescan timer) moves them."""
+        rescan timer) moves them.  A gone mon triggers a hunt to the
+        next in the list (ref: MonClient reopen_session)."""
+        if peer == self.mon and len(self.mons) > 1:
+            if self._mon_hunting:
+                return   # a failed hunt send reports its reset inline
+            self._mon_hunting = True
+            try:
+                for _ in range(len(self.mons) - 1):
+                    self._mon_i = (self._mon_i + 1) % len(self.mons)
+                    dout("client", 1).write("%s: mon hunt -> %s",
+                                            self.name, self.mon)
+                    if self.ms.connect(self.mon).send_message(
+                            MMonSubscribe(what="osdmap",
+                                          start=self.osdmap.epoch + 1)):
+                        break
+            finally:
+                self._mon_hunting = False
+            return
         if not peer.startswith("osd."):
             return
         osd = int(peer[4:])

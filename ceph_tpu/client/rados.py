@@ -26,7 +26,7 @@ class Rados:
     """Cluster handle (ref: librados::Rados)."""
 
     def __init__(self, network: LocalNetwork, name: str | None = None,
-                 mon: str = "mon.0", op_timeout: float = 30.0,
+                 mon="mon.0", op_timeout: float = 30.0,
                  threaded: bool = True):
         self.objecter = Objecter(network, name=name, mon=mon,
                                  threaded=threaded)

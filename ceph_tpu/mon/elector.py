@@ -1,0 +1,138 @@
+"""Elector: rank-based monitor leader election.
+
+Simplified port of src/mon/Elector.{h,cc}: the mon with the lowest
+rank among responsive peers wins.  A mon starts (or restarts) an
+election by bumping the election epoch and proposing itself; every mon
+acks the lowest-ranked proposer it has seen in the current epoch; a
+proposer holding acks from a majority (counting itself) declares
+victory and broadcasts the quorum.  Re-election triggers when the
+leader's lease goes stale (Monitor.tick) or a peer proposes with a
+newer epoch.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+from ..common.log import dout
+from ..msg.messages import MMonElection
+
+
+class Elector:
+    def __init__(self, rank: int, ranks: list[int],
+                 send: Callable[[int, object], None],
+                 on_win: Callable[[int, list[int]], None],
+                 on_lose: Callable[[int, int, list[int]], None]):
+        self.rank = rank
+        self.ranks = sorted(ranks)         # all mon ranks incl. self
+        self.send = send                   # (peer_rank, msg)
+        self.on_win = on_win               # (epoch, quorum)
+        self.on_lose = on_lose             # (epoch, leader, quorum)
+        self.epoch = 0
+        self.electing = False
+        self.acked_me: set[int] = set()
+        self.leader: int | None = None
+        self.quorum: list[int] = []
+
+    @property
+    def majority(self) -> int:
+        return len(self.ranks) // 2 + 1
+
+    # ------------------------------------------------------------ start
+    def start(self) -> None:
+        """Propose ourselves (ref: Elector::start)."""
+        self.epoch += 1
+        self.electing = True
+        self.leader = None
+        self.acked_me = {self.rank}
+        dout("mon", 5).write("elector %d: starting election e%d",
+                             self.rank, self.epoch)
+        for r in self.ranks:
+            if r != self.rank:
+                self.send(r, MMonElection(op="propose",
+                                          epoch=self.epoch,
+                                          rank=self.rank))
+        self._check_win()
+
+    # ---------------------------------------------------------- handlers
+    def handle(self, msg: MMonElection) -> None:
+        if msg.op == "propose":
+            self._handle_propose(msg)
+        elif msg.op == "ack":
+            self._handle_ack(msg)
+        elif msg.op == "victory":
+            self._handle_victory(msg)
+
+    def _handle_propose(self, msg: MMonElection) -> None:
+        """(ref: Elector::handle_propose — defer to lower rank,
+        counter-propose otherwise).  Async delivery can let two
+        proposers each collect a majority in one epoch (a winner's
+        victory racing a late ack); conflicts are resolved by epoch
+        bumps — a standing leader outranked by a proposal abdicates
+        into a fresh epoch, whose single victory supersedes both."""
+        if msg.epoch > self.epoch:
+            self.epoch = msg.epoch
+            self.electing = True
+            self.leader = None
+            self.acked_me = {self.rank}
+        elif msg.epoch < self.epoch:
+            # stale proposer: provoke it to catch up
+            self.send(msg.rank, MMonElection(op="propose",
+                                             epoch=self.epoch,
+                                             rank=self.rank))
+            return
+        if msg.rank < self.rank:
+            if not self.electing and self.leader == self.rank:
+                # we won this epoch but a lower rank is proposing:
+                # abdicate — restart in a higher epoch and let the
+                # lower rank win it cleanly
+                self.start()
+                return
+            # defer
+            self.send(msg.rank, MMonElection(op="ack", epoch=self.epoch,
+                                             rank=self.rank))
+        else:
+            # we outrank the proposer: push our own candidacy
+            self.send(msg.rank, MMonElection(op="propose",
+                                             epoch=self.epoch,
+                                             rank=self.rank))
+
+    def _handle_ack(self, msg: MMonElection) -> None:
+        if msg.epoch != self.epoch or not self.electing:
+            return
+        self.acked_me.add(msg.rank)
+        self._check_win()
+
+    def _check_win(self) -> None:
+        if self.electing and len(self.acked_me) >= self.majority:
+            self.electing = False
+            self.leader = self.rank
+            self.quorum = sorted(self.acked_me)
+            dout("mon", 1).write("elector %d: WON e%d quorum %s",
+                                 self.rank, self.epoch, self.quorum)
+            # victory goes to EVERY rank, not just the quorum: a
+            # conflicting same-epoch winner must learn of us so the
+            # epoch-bump conflict resolution can run
+            for r in self.ranks:
+                if r != self.rank:
+                    self.send(r, MMonElection(op="victory",
+                                              epoch=self.epoch,
+                                              rank=self.rank,
+                                              quorum=self.quorum))
+            self.on_win(self.epoch, self.quorum)
+
+    def _handle_victory(self, msg: MMonElection) -> None:
+        if msg.epoch < self.epoch:
+            return
+        if msg.epoch == self.epoch and not self.electing and \
+                self.leader == self.rank and msg.rank > self.rank:
+            # double win in one epoch (their late acks): we outrank
+            # them — force a fresh epoch to supersede both victories
+            self.start()
+            return
+        self.epoch = msg.epoch
+        self.electing = False
+        self.leader = msg.rank
+        self.quorum = list(msg.quorum)
+        dout("mon", 1).write("elector %d: leader is %d (e%d)",
+                             self.rank, msg.rank, self.epoch)
+        self.on_lose(self.epoch, msg.rank, self.quorum)

@@ -3,25 +3,45 @@
 Command, subscription, boot, and failure-report handling over the wire
 (ref: src/mon/Monitor.cc dispatch_op; OSDMonitor.cc preprocess/
 prepare split; failure handling OSDMonitor.cc:2519 prepare_failure,
-down-out: OSDMonitor.cc tick :4965).  One instance is the map
-authority; OSDs and clients subscribe and receive MMap incrementals on
-every committed epoch — the propagation path the reference runs through
-the mon session subs (src/mon/Monitor.cc handle_subscribe).
+down-out: OSDMonitor.cc tick :4965).  Maps propagate to subscribers as
+MMap incrementals on every committed epoch (src/mon/Monitor.cc
+handle_subscribe).
+
+Quorum (multi-mon): leadership comes from the rank-based Elector
+(ceph_tpu.mon.elector); the leader drives every map mutation through
+the replicated Paxos pipeline (majority accept before commit,
+ceph_tpu.mon.paxos) and peons forward write traffic to it
+(ref: src/mon/Monitor.cc forward_request_leader, MForward).  Reads
+(preprocess commands, subscriptions) are served by any mon from its
+committed store.  Leases keep peons convinced the leader lives; a
+stale lease (or a reset from the leader's endpoint) triggers
+re-election, and lagging mons catch up by replaying committed paxos
+values (MPaxosSyncReq).  Mutations are serialized through a change
+queue: one staged prepare -> one proposal -> commit -> ack, matching
+the reference's paxos plug.
 """
 from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 
 from ..common.log import dout
 from ..common.options import global_config
 from ..msg.messages import (MMap, MMonCommand, MMonCommandAck,
-                            MMonSubscribe, MOSDBoot, MOSDFailure)
+                            MMonElection, MMonForward, MMonLease,
+                            MMonSubscribe, MOSDBoot, MOSDFailure,
+                            MPaxosAccept, MPaxosBegin, MPaxosCommit,
+                            MPaxosStoreSync, MPaxosSyncReq)
 from ..msg.messenger import Dispatcher, LocalNetwork, Message, Messenger
 from ..osd.osdmap import CEPH_OSD_AUTOOUT, CEPH_OSD_IN, OSDMap
+from .elector import Elector
 from .osd_monitor import OSDMonitor
 from .paxos import Paxos
 from .store import MonitorStore
+
+LEASE_INTERVAL = 5.0          # leader lease period (mon_lease)
+LEASE_TIMEOUT = 15.0          # peon re-elects after silence (mon_lease_ack)
 
 
 def build_initial(n_osd: int, osds_per_host: int = 1
@@ -49,8 +69,10 @@ class Monitor(Dispatcher):
     def __init__(self, network: LocalNetwork, rank: int = 0,
                  initial_map: OSDMap | None = None,
                  initial_wrapper=None, store: MonitorStore | None = None,
-                 threaded: bool = True, clock=time.monotonic):
+                 threaded: bool = True, clock=time.monotonic,
+                 mon_ranks: list[int] | None = None):
         self.name = f"mon.{rank}"
+        self.rank = rank
         #: injectable clock so harnesses can run the failure/auto-out
         #: machinery on simulated time consistently with OSD ticks
         self.clock = clock
@@ -65,11 +87,31 @@ class Monitor(Dispatcher):
         self._failure_reports: dict[int, dict[int, float]] = {}
         self._down_stamp: dict[int, float] = {}
         self._lock = threading.RLock()
+        # ---- quorum state ------------------------------------------
+        self.mon_ranks = sorted(mon_ranks) if mon_ranks else [rank]
+        self.standalone = len(self.mon_ranks) == 1
+        self.is_leader = self.standalone
+        self.leader_rank: int | None = rank if self.standalone else None
+        self.elector = Elector(rank, self.mon_ranks,
+                               send=self._send_rank,
+                               on_win=self._on_win,
+                               on_lose=self._on_lose)
+        self.elector.epoch = self.store.get_int("elector", "epoch", 0)
+        self.paxos.rank = rank
+        self.paxos.on_peon_commit = self._on_peon_commit
+        self._lease_stamp = self.clock()
+        self._last_lease_sent = 0.0
+        # serialized map mutations: (stage_fn, reply_cb)
+        self._chg_queue: deque = deque()
+        self._chg_busy = False
 
     # ------------------------------------------------------------ setup
     def init(self) -> None:
         self.osdmon.init()
         self.ms.start()
+        if not self.standalone:
+            self.elector.start()
+            self._persist_elector()
 
     def shutdown(self) -> None:
         self.ms.shutdown()
@@ -78,46 +120,267 @@ class Monitor(Dispatcher):
     def osdmap(self) -> OSDMap:
         return self.osdmon.osdmap
 
+    # --------------------------------------------------------- election
+    def _send_rank(self, r: int, msg: Message) -> None:
+        self.ms.connect(f"mon.{r}").send_message(msg)
+
+    def _persist_elector(self) -> None:
+        from .store import StoreTransaction
+        tx = StoreTransaction()
+        tx.put("elector", "epoch", self.elector.epoch)
+        self.store.apply_transaction(tx)
+
+    def _on_win(self, epoch: int, quorum: list[int]) -> None:
+        self.is_leader = True
+        self.leader_rank = self.rank
+        self.paxos.quorum = quorum
+        self.paxos.all_ranks = list(self.mon_ranks)
+        self.paxos.epoch = epoch
+        self.paxos.send = self._send_rank
+        self.paxos.abort_inflight()
+        self._fail_queued("EAGAIN")
+        # fresh reign: re-stage on top of the committed state
+        self.osdmon.update_from_paxos()
+        self.osdmon.create_pending()
+        self._persist_elector()
+        self._broadcast_lease()
+        self._publish()
+
+    def _on_lose(self, epoch: int, leader: int,
+                 quorum: list[int]) -> None:
+        self.is_leader = False
+        self.leader_rank = leader
+        self.paxos.quorum = quorum
+        self.paxos.all_ranks = list(self.mon_ranks)
+        self.paxos.epoch = epoch
+        self.paxos.send = self._send_rank
+        self.paxos.abort_inflight()
+        self._fail_queued("EAGAIN")
+        self._lease_stamp = self.clock()
+        self._persist_elector()
+        # catch up on anything we missed while electing
+        self._send_rank(leader, MPaxosSyncReq(
+            version=self.paxos.last_committed, rank=self.rank))
+
+    def _fail_queued(self, errno_name: str) -> None:
+        while self._chg_queue:
+            _stage, reply_cb = self._chg_queue.popleft()
+            if reply_cb is not None:
+                reply_cb(-11, errno_name, None)
+        self._chg_busy = False
+
+    def _broadcast_lease(self) -> None:
+        self._last_lease_sent = self.clock()
+        for r in self.mon_ranks:
+            if r != self.rank:
+                self._send_rank(r, MMonLease(
+                    epoch=self.elector.epoch,
+                    stamp=self._last_lease_sent,
+                    last_committed=self.paxos.last_committed))
+
+    def _on_peon_commit(self) -> None:
+        """A replicated value landed on this peon: refresh the service
+        and serve our subscribers."""
+        self.osdmon.update_from_paxos()
+        self._publish()
+
     # -------------------------------------------------------- dispatch
     def ms_dispatch(self, msg: Message) -> bool:
         with self._lock:
             if isinstance(msg, MMonCommand):
-                r, outs, outb = self.handle_command(msg.cmd)
-                self.ms.connect(msg.src).send_message(
-                    MMonCommandAck(tid=msg.tid, result=r, outs=outs,
-                                   outb=outb))
+                self._handle_wire_command(msg.cmd, msg.src, msg.tid)
                 return True
             if isinstance(msg, MMonSubscribe):
                 self._handle_subscribe(msg)
                 return True
             if isinstance(msg, MOSDBoot):
+                if self._relay_if_peon(msg):
+                    return True
                 self._handle_boot(msg)
                 return True
             if isinstance(msg, MOSDFailure):
+                if self._relay_if_peon(msg):
+                    return True
                 self._handle_failure(msg)
+                return True
+            if isinstance(msg, MMonElection):
+                self.elector.handle(msg)
+                self._persist_elector()
+                return True
+            if isinstance(msg, MPaxosBegin):
+                if not self.is_leader:
+                    self.paxos.handle_begin(
+                        msg, int(msg.src.split(".")[1]))
+                return True
+            if isinstance(msg, MPaxosAccept):
+                if self.is_leader:
+                    self.paxos.handle_accept(msg)
+                return True
+            if isinstance(msg, MPaxosCommit):
+                self.paxos.handle_commit(msg)
+                return True
+            if isinstance(msg, MPaxosSyncReq):
+                if self.is_leader:
+                    for m in self.paxos.sync_reply(msg.version):
+                        self._send_rank(msg.rank, m)
+                return True
+            if isinstance(msg, MMonLease):
+                sender = int(msg.src.split(".")[1])
+                if msg.epoch < self.elector.epoch:
+                    return True     # stale reign
+                if sender != self.leader_rank:
+                    # a lease is a quorum-backed leadership claim:
+                    # adopt it (heals diverged views after a
+                    # double-win epoch)
+                    self.elector.epoch = msg.epoch
+                    self.elector.electing = False
+                    self.elector.leader = sender
+                    self.is_leader = False
+                    self.leader_rank = sender
+                    self.paxos.epoch = msg.epoch
+                    self._persist_elector()
+                self._lease_stamp = self.clock()
+                if msg.last_committed > self.paxos.last_committed:
+                    self._send_rank(sender, MPaxosSyncReq(
+                        version=self.paxos.last_committed,
+                        rank=self.rank))
+                return True
+            if isinstance(msg, MMonForward):
+                if self.is_leader:
+                    self._handle_wire_command(msg.cmd, msg.client,
+                                              msg.tid)
+                else:
+                    # leadership raced away mid-forward: fast EAGAIN
+                    # beats the client's 30s timeout
+                    self.ms.connect(msg.client).send_message(
+                        MMonCommandAck(tid=msg.tid, result=-11,
+                                       outs="EAGAIN: not the leader"))
+                return True
+            if isinstance(msg, MPaxosStoreSync):
+                if not self.is_leader:
+                    self.paxos.apply_store_sync(msg)
                 return True
         return False
 
+    def ms_handle_reset(self, peer: str) -> None:
+        if not self.standalone and peer.startswith("mon.") and \
+                self.leader_rank is not None and \
+                peer == f"mon.{self.leader_rank}" and \
+                not self.is_leader and not self.elector.electing:
+            # (electing guard: proposing to the dead leader reports a
+            # reset synchronously — without it this would recurse)
+            dout("mon", 1).write("%s: leader %s gone, re-electing",
+                                 self.name, peer)
+            self.elector.start()
+            self._persist_elector()
+
+    def _relay_if_peon(self, msg: Message) -> bool:
+        """Peons relay map-mutating daemon traffic to the leader
+        (payloads carry identities, so re-sending is safe)."""
+        if self.is_leader:
+            return False
+        if self.leader_rank is not None:
+            self._send_rank(self.leader_rank, msg)
+        return True
+
     # -------------------------------------------------------- commands
+    def _handle_wire_command(self, cmdmap: dict, client: str,
+                             tid: int) -> None:
+        def reply(r, outs, outb):
+            self.ms.connect(client).send_message(MMonCommandAck(
+                tid=tid, result=r, outs=outs, outb=outb))
+
+        self._dispatch_command(cmdmap, reply, client=client, tid=tid)
+
+    def _dispatch_command(self, cmdmap: dict, reply_cb,
+                          client: str = "", tid: int = 0) -> None:
+        """preprocess locally; stage writes through the change queue
+        (leader) or forward them to it (peon,
+        ref: Monitor::forward_request_leader)."""
+        try:
+            res = self.osdmon.preprocess_command(cmdmap)
+        except (KeyError, ValueError, TypeError) as ex:
+            reply_cb(-22, f"invalid command arguments: {ex}", None)
+            return
+        if res is not None:
+            reply_cb(*res)
+            return
+        if not self.is_leader:
+            if self.leader_rank is None or not client:
+                reply_cb(-11, "EAGAIN: not the quorum leader", None)
+                return
+            # forward; the leader acks the client directly
+            self._send_rank(self.leader_rank, MMonForward(
+                tid=tid, client=client, cmd=cmdmap))
+            return
+        self._submit_change(
+            lambda: self.osdmon.prepare_command(cmdmap), reply_cb)
+
     def handle_command(self, cmdmap: dict) -> tuple[int, str, object]:
-        """Synchronous command path (also used by tests/CLI directly).
-        A failed prepare resets the pending delta so partially staged
-        state can never ride along with the next command."""
+        """Synchronous command path (tests/CLI).  Completes inline on a
+        standalone mon; in a quorum a write's commit needs peon acks,
+        so this API refuses it BEFORE staging anything — use the wire
+        path there (reads work everywhere)."""
+        slot: dict = {}
         with self._lock:
-            try:
-                res = self.osdmon.preprocess_command(cmdmap)
+            if not self.standalone:
+                try:
+                    res = self.osdmon.preprocess_command(cmdmap)
+                except (KeyError, ValueError, TypeError) as ex:
+                    return -22, f"invalid command arguments: {ex}", None
                 if res is not None:
                     return res
-                r, outs, outb = self.osdmon.prepare_command(cmdmap)
-            except (KeyError, ValueError, TypeError) as ex:
-                self.osdmon.create_pending()
-                return -22, f"invalid command arguments: {ex}", None
-            if r == 0:
-                self.osdmon.propose_pending()
-                self._publish()
-            else:
-                self.osdmon.create_pending()
-            return r, outs, outb
+                raise RuntimeError(
+                    "write command needs a quorum commit; use the "
+                    "wire path")
+            self._dispatch_command(
+                cmdmap, lambda r, outs, outb: slot.update(
+                    r=r, outs=outs, outb=outb))
+        if "r" not in slot:
+            raise RuntimeError(
+                "command awaits quorum commit; use the wire path")
+        return slot["r"], slot["outs"], slot["outb"]
+
+    # ---------------------------------------------- serialized changes
+    def _submit_change(self, stage, reply_cb=None) -> None:
+        """stage() runs prepare handlers against pending_inc and
+        returns (r, outs, outb) or None; the proposal commits before
+        the next change stages (the reference's paxos plug)."""
+        self._chg_queue.append((stage, reply_cb))
+        self._pump_changes()
+
+    def _pump_changes(self) -> None:
+        if self._chg_busy or not self._chg_queue:
+            return
+        if not self.is_leader:
+            self._fail_queued("EAGAIN")
+            return
+        stage, reply_cb = self._chg_queue.popleft()
+        try:
+            res = stage()
+        except (KeyError, ValueError, TypeError) as ex:
+            self.osdmon.create_pending()
+            if reply_cb is not None:
+                reply_cb(-22, f"invalid command arguments: {ex}", None)
+            self._pump_changes()
+            return
+        r, outs, outb = res if res is not None else (0, "", None)
+        if r != 0 or self.osdmon._is_pending_empty():
+            self.osdmon.create_pending()
+            if reply_cb is not None:
+                reply_cb(r, outs, outb)
+            self._pump_changes()
+            return
+        self._chg_busy = True
+
+        def committed():
+            self._chg_busy = False
+            self._publish()
+            if reply_cb is not None:
+                reply_cb(r, outs, outb)
+            self._pump_changes()
+
+        self.osdmon.propose_pending(on_done=committed)
 
     # ---------------------------------------------------- subscriptions
     def _handle_subscribe(self, msg: MMonSubscribe) -> None:
@@ -162,13 +425,18 @@ class Monitor(Dispatcher):
         """(ref: OSDMonitor.cc:3270 prepare_boot — mark up; a brand-new
         osd also gets EXISTS and full in-weight)."""
         osd = msg.osd
-        m = self.osdmap
         if osd < 0:
             return
-        if osd >= m.max_osd:
-            self.osdmon.pending_inc.new_max_osd = osd + 1
-        if osd >= m.max_osd or not m.is_up(osd):
+        self._failure_reports.pop(osd, None)
+        self._down_stamp.pop(osd, None)
+
+        def stage():
+            m = self.osdmap
+            if osd < m.max_osd and m.is_up(osd):
+                return (1, "", None)      # nothing to do, no proposal
             inc = self.osdmon.pending_inc
+            if osd >= m.max_osd:
+                inc.new_max_osd = osd + 1
             inc.new_up_osds.append(osd)
             if osd >= m.max_osd or not m.exists(osd):
                 inc.new_weight[osd] = CEPH_OSD_IN
@@ -178,12 +446,10 @@ class Monitor(Dispatcher):
                 inc.new_weight[osd] = CEPH_OSD_IN
                 inc.new_state[osd] = \
                     inc.new_state.get(osd, 0) | CEPH_OSD_AUTOOUT
-            self.osdmon.propose_pending()
-            dout("mon", 1).write("%s: osd.%d boot -> e%d", self.name,
-                                 osd, self.osdmap.epoch)
-            self._publish()
-        self._failure_reports.pop(osd, None)
-        self._down_stamp.pop(osd, None)
+            dout("mon", 1).write("%s: osd.%d boot", self.name, osd)
+            return (0, "", None)
+
+        self._submit_change(stage)
 
     # ---------------------------------------------------------- failure
     def _handle_failure(self, msg: MOSDFailure) -> None:
@@ -211,35 +477,62 @@ class Monitor(Dispatcher):
             self._mark_down(target)
 
     def _mark_down(self, osd: int) -> None:
-        self.osdmon.pending_inc.new_down_osds.append(osd)
-        self.osdmon.propose_pending()
         self._failure_reports.pop(osd, None)
         self._down_stamp[osd] = self.clock()
-        dout("mon", 1).write("%s: marked osd.%d down -> e%d", self.name,
-                             osd, self.osdmap.epoch)
-        self._publish()
+
+        def stage():
+            if self.osdmap.is_down(osd):
+                return (1, "", None)
+            self.osdmon.pending_inc.new_down_osds.append(osd)
+            dout("mon", 1).write("%s: marking osd.%d down", self.name,
+                                 osd)
+            return (0, "", None)
+
+        self._submit_change(stage)
 
     # -------------------------------------------------------------- tick
     def tick(self, now: float | None = None) -> None:
-        """Periodic: auto-out OSDs down longer than
-        mon_osd_down_out_interval (ref: OSDMonitor.cc:4965 tick)."""
+        """Periodic: auto-out down OSDs; leases/re-election in a
+        quorum (ref: OSDMonitor.cc:4965 tick; Monitor.cc tick)."""
         with self._lock:
             now = self.clock() if now is None else now
+            if not self.standalone:
+                if self.is_leader:
+                    if now - self._last_lease_sent >= LEASE_INTERVAL:
+                        self._broadcast_lease()
+                elif self.leader_rank is None or \
+                        now - self._lease_stamp > LEASE_TIMEOUT:
+                    dout("mon", 1).write(
+                        "%s: lease stale, re-electing", self.name)
+                    self.elector.start()
+                    self._persist_elector()
+            if not self.is_leader:
+                return
             interval = global_config()["mon_osd_down_out_interval"]
-            changed = False
+            to_out = []
             for osd, stamp in list(self._down_stamp.items()):
                 m = self.osdmap
                 if m.is_up(osd):
                     del self._down_stamp[osd]
                     continue
                 if interval and now - stamp >= interval and m.is_in(osd):
+                    to_out.append(osd)
+            if not to_out:
+                return
+
+            def stage():
+                changed = False
+                for osd in to_out:
+                    m = self.osdmap
+                    if m.is_up(osd) or m.is_out(osd):
+                        continue
                     self.osdmon.pending_inc.new_weight[osd] = 0
                     self.osdmon.pending_inc.new_state[osd] = \
-                        self.osdmon.pending_inc.new_state.get(osd, 0) | \
-                        CEPH_OSD_AUTOOUT
+                        self.osdmon.pending_inc.new_state.get(osd, 0) \
+                        | CEPH_OSD_AUTOOUT
                     changed = True
-                    dout("mon", 1).write("%s: auto-out osd.%d", self.name,
-                                         osd)
-            if changed:
-                self.osdmon.propose_pending()
-                self._publish()
+                    dout("mon", 1).write("%s: auto-out osd.%d",
+                                         self.name, osd)
+                return (0, "", None) if changed else (1, "", None)
+
+            self._submit_change(stage)

@@ -22,7 +22,19 @@ PAXOS_PREFIX = "paxos"
 
 
 class Paxos:
-    """Single-node commit log (ref: src/mon/Paxos.h:174)."""
+    """Commit log with optional quorum replication
+    (ref: src/mon/Paxos.h:174).
+
+    Standalone (quorum of one): `propose` commits synchronously, as
+    round-1.  In a quorum, the LEADER drives
+    begin -> majority accept -> commit: `propose_async` queues the
+    value, MPaxosBegin fans to peons, peon accepts count toward the
+    majority, the leader commits + broadcasts MPaxosCommit, and the
+    completion callback fires after local commit.  One proposal is in
+    flight at a time (the reference's is_updating plug).  Values accept
+    only after commit reaches a peon, so an unacked client command can
+    be lost on leader death but an acked one never is.
+    """
 
     def __init__(self, store: MonitorStore, keep_versions: int = 500):
         self.store = store
@@ -31,13 +43,24 @@ class Paxos:
                                              "first_committed", 0)
         self.last_committed = store.get_int(PAXOS_PREFIX,
                                             "last_committed", 0)
+        # quorum wiring (set by the Monitor after election)
+        self.rank = 0
+        self.epoch = 0                    # election epoch guard
+        self.quorum: list[int] = [0]      # voting members
+        self.all_ranks: list[int] = [0]   # commit audience (everyone)
+        self.send = None          # (peer_rank, msg) -> None
+        self.on_peon_commit = None   # peon hook: refresh services
+        self._pending: list = []     # [(tx_bytes, on_commit)]
+        self._inflight = None        # [version, tx_bytes, acks:set, cb]
 
-    def propose(self, tx: StoreTransaction) -> int:
-        """begin + commit in one step (quorum of one); returns the
-        committed version (ref: Paxos.cc begin/commit_start)."""
-        v = self.last_committed + 1
+    @property
+    def _is_solo(self) -> bool:
+        return len(self.quorum) <= 1 or self.send is None
+
+    def _commit_value(self, v: int, tx_bytes: bytes) -> None:
+        tx = StoreTransaction.decode(tx_bytes)
         meta = StoreTransaction()
-        meta.put(PAXOS_PREFIX, v, tx.encode())   # the decided value
+        meta.put(PAXOS_PREFIX, v, tx_bytes)      # the decided value
         meta.put(PAXOS_PREFIX, "last_committed", v)
         if self.first_committed == 0:
             self.first_committed = 1
@@ -47,7 +70,127 @@ class Paxos:
         self.store.apply_transaction(meta)
         self.last_committed = v
         self._maybe_trim()
+
+    def propose(self, tx: StoreTransaction) -> int:
+        """Synchronous commit — standalone mode only
+        (ref: Paxos.cc begin/commit_start collapsed)."""
+        assert self._is_solo, "sync propose needs a quorum of one"
+        v = self.last_committed + 1
+        self._commit_value(v, tx.encode())
         return v
+
+    # ----------------------------------------------------- leader side
+    def propose_async(self, tx: StoreTransaction, on_commit) -> None:
+        """Queue a value; on_commit(version) fires after local commit
+        (immediately in standalone mode)."""
+        self._pending.append((tx.encode(), on_commit))
+        self._maybe_begin()
+
+    def _maybe_begin(self) -> None:
+        if self._inflight is not None or not self._pending:
+            return
+        tx_bytes, cb = self._pending.pop(0)
+        v = self.last_committed + 1
+        if self._is_solo:
+            self._commit_value(v, tx_bytes)
+            cb(v)
+            self._maybe_begin()
+            return
+        from ..msg.messages import MPaxosBegin
+        self._inflight = [v, tx_bytes, {self.rank}, cb]
+        dout("mon", 10).write("paxos %d: begin v%d -> %s", self.rank,
+                              v, self.quorum)
+        for r in self.quorum:
+            if r != self.rank:
+                self.send(r, MPaxosBegin(version=v, tx=tx_bytes,
+                                         epoch=self.epoch))
+
+    def handle_accept(self, msg) -> None:
+        """(leader) count a peon accept (ref: Paxos.cc handle_accept).
+        Epoch-guarded: accepts from a previous reign never count toward
+        this one's majority."""
+        fl = self._inflight
+        if fl is None or msg.version != fl[0] or \
+                msg.epoch != self.epoch:
+            return
+        fl[2].add(msg.rank)
+        if len(fl[2]) < len(self.quorum) // 2 + 1:
+            return
+        from ..msg.messages import MPaxosCommit
+        v, tx_bytes, _acks, cb = fl
+        self._inflight = None
+        self._commit_value(v, tx_bytes)
+        # commits go to EVERY mon (late quorum ackers included); only
+        # the accept votes are quorum-scoped
+        for r in self.all_ranks:
+            if r != self.rank:
+                self.send(r, MPaxosCommit(version=v, tx=tx_bytes,
+                                          epoch=self.epoch))
+        cb(v)
+        self._maybe_begin()
+
+    def abort_inflight(self) -> None:
+        """Election/quorum change: drop queued + in-flight proposals
+        (their commands never acked; clients retry)."""
+        self._inflight = None
+        self._pending = []
+
+    # ------------------------------------------------------- peon side
+    def handle_begin(self, msg, from_rank: int) -> None:
+        """(peon) accept the value (ref: Paxos.cc handle_begin).
+        Values are durable only at commit in this simplified pipeline;
+        a deposed leader's begins (stale epoch) are never acked, so it
+        cannot assemble a majority after the election.  (The residual
+        window — accepts already in flight when the election fires —
+        is closed in the reference by the full collect/lease phases.)"""
+        from ..msg.messages import MPaxosAccept
+        if msg.epoch != self.epoch:
+            return
+        self.send(from_rank, MPaxosAccept(version=msg.version,
+                                          rank=self.rank,
+                                          epoch=self.epoch))
+
+    def handle_commit(self, msg) -> None:
+        """(peon) apply a committed value in order
+        (ref: Paxos.cc handle_commit)."""
+        if msg.epoch < self.epoch:
+            return               # deposed leader's commit
+        if msg.version != self.last_committed + 1:
+            if msg.version <= self.last_committed:
+                return           # duplicate
+            # gap: the sync path refills us
+            return
+        self._commit_value(msg.version, msg.tx)
+        if self.on_peon_commit is not None:
+            self.on_peon_commit()
+
+    # ------------------------------------------------------- catch-up
+    def sync_reply(self, from_version: int) -> list:
+        """Leader: committed values a lagging peer needs — or a full
+        store snapshot when the gap predates the trim window
+        (ref: Paxos.cc share_state; Monitor.cc full sync)."""
+        from ..msg.messages import MPaxosCommit, MPaxosStoreSync
+        if from_version + 1 < self.first_committed:
+            return [MPaxosStoreSync(
+                data=self.store.export_data(),
+                first_committed=self.first_committed,
+                last_committed=self.last_committed)]
+        out = []
+        for v in range(max(from_version + 1, self.first_committed),
+                       self.last_committed + 1):
+            blob = self.store.get(PAXOS_PREFIX, v)
+            if blob is not None:
+                out.append(MPaxosCommit(version=v, tx=blob,
+                                        epoch=self.epoch))
+        return out
+
+    def apply_store_sync(self, msg) -> None:
+        """Peon: adopt a full store snapshot."""
+        self.store.import_data(msg.data)
+        self.first_committed = msg.first_committed
+        self.last_committed = msg.last_committed
+        if self.on_peon_commit is not None:
+            self.on_peon_commit()
 
     def _maybe_trim(self) -> None:
         """(ref: Paxos.cc trim)."""
@@ -115,16 +258,24 @@ class PaxosService:
         self.create_pending()
         self.have_pending = True
 
-    def propose_pending(self) -> int:
-        """Commit the pending delta and refresh
+    def propose_pending(self, on_done=None) -> None:
+        """Commit the pending delta and refresh; `on_done()` fires
+        after the commit lands (synchronously in standalone mode)
         (ref: PaxosService::propose_pending)."""
         assert self.have_pending
         tx = StoreTransaction()
         self.encode_pending(tx)
         if tx.empty:
-            return self.paxos.last_committed
-        v = self.paxos.propose(tx)
-        dout("mon", 10).write("%s proposed v%d", self.service_name, v)
-        self.update_from_paxos()
-        self.create_pending()
-        return v
+            if on_done is not None:
+                on_done()
+            return
+
+        def committed(v):
+            dout("mon", 10).write("%s committed v%d",
+                                  self.service_name, v)
+            self.update_from_paxos()
+            self.create_pending()
+            if on_done is not None:
+                on_done()
+
+        self.paxos.propose_async(tx, committed)

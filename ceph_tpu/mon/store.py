@@ -81,3 +81,12 @@ class MonitorStore:
     def keys(self, prefix: str) -> Iterator[str]:
         with self._lock:
             return iter(sorted(k[1] for k in self._data if k[0] == prefix))
+
+    def export_data(self) -> bytes:
+        """Full snapshot for mon full-sync (ref: Monitor.cc sync_*)."""
+        with self._lock:
+            return pickle.dumps(self._data)
+
+    def import_data(self, blob: bytes) -> None:
+        with self._lock:
+            self._data = pickle.loads(blob)

@@ -226,6 +226,80 @@ class MOSDFailure(Message):
     epoch: int = 0
 
 
+# ------------------------------------------------------------ mon quorum
+
+
+@dataclass
+class MMonElection(Message):
+    """Leader election (ref: src/messages/MMonElection.h;
+    Elector propose/ack/victory ops)."""
+    op: str = "propose"            # propose | ack | victory
+    epoch: int = 0
+    rank: int = -1                 # sender's rank
+    quorum: list = field(default_factory=list)   # victory: member ranks
+
+
+@dataclass
+class MPaxosBegin(Message):
+    """Leader -> peon: accept value at version
+    (ref: src/messages/MMonPaxos.h OP_BEGIN; epoch guards a deposed
+    leader's traffic)."""
+    version: int = 0
+    tx: bytes = b""
+    epoch: int = 0
+
+
+@dataclass
+class MPaxosAccept(Message):
+    """(ref: MMonPaxos.h OP_ACCEPT)."""
+    version: int = 0
+    rank: int = -1
+    epoch: int = 0
+
+
+@dataclass
+class MPaxosCommit(Message):
+    """(ref: MMonPaxos.h OP_COMMIT)."""
+    version: int = 0
+    tx: bytes = b""
+    epoch: int = 0
+
+
+@dataclass
+class MPaxosStoreSync(Message):
+    """Full-store sync for a mon lagging past the trim window
+    (ref: src/mon/Monitor.cc sync_* full-store sync)."""
+    data: bytes = b""            # pickled store contents
+    first_committed: int = 0
+    last_committed: int = 0
+
+
+@dataclass
+class MMonLease(Message):
+    """Leader liveness lease to peons
+    (ref: MMonPaxos.h OP_LEASE)."""
+    epoch: int = 0
+    stamp: float = 0.0
+    last_committed: int = 0    # peons behind this request a sync
+
+
+@dataclass
+class MPaxosSyncReq(Message):
+    """Lagging peon asks the leader for missed commits
+    (ref: Paxos share_state/store sync)."""
+    version: int = 0           # requester's last_committed
+    rank: int = -1
+
+
+@dataclass
+class MMonForward(Message):
+    """Peon forwards a client command to the leader, which replies to
+    the client directly (ref: src/messages/MForward.h)."""
+    tid: int = 0
+    client: str = ""
+    cmd: dict = field(default_factory=dict)
+
+
 # ---------------------------------------------------------------- pings
 
 

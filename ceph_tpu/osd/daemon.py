@@ -79,11 +79,13 @@ class OSDDaemon(Dispatcher):
     """osd.<id> (ref: src/osd/OSD.h:1036)."""
 
     def __init__(self, network: LocalNetwork, whoami: int,
-                 store: Optional[MemStore] = None, mon: str = "mon.0",
+                 store: Optional[MemStore] = None, mon="mon.0",
                  threaded: bool = False, perf_collection=None):
         self.whoami = whoami
         self.name = f"osd.{whoami}"
-        self.mon = mon
+        # mon may be a single name or a failover list
+        self.mons = [mon] if isinstance(mon, str) else list(mon)
+        self._mon_i = 0
         self.store = store or MemStore()
         if not self.store.mounted:
             self.store.mkfs()
@@ -124,6 +126,10 @@ class OSDDaemon(Dispatcher):
         self.ms.add_dispatcher(self)
 
     # ------------------------------------------------------------ setup
+    @property
+    def mon(self) -> str:
+        return self.mons[self._mon_i]
+
     def init(self) -> None:
         self.ms.start()
         self.ms.connect(self.mon).send_message(MOSDBoot(osd=self.whoami))
@@ -132,6 +138,30 @@ class OSDDaemon(Dispatcher):
 
     def shutdown(self) -> None:
         self.ms.shutdown()
+
+    def ms_handle_reset(self, peer: str) -> None:
+        """Our mon went away: hunt to the next one
+        (ref: MonClient reopen_session mon hunting).  A hunt send to
+        another dead mon reports its reset synchronously, so the guard
+        keeps the walk iterative instead of recursive."""
+        if peer == self.mon and len(self.mons) > 1:
+            if getattr(self, "_mon_hunting", False):
+                return
+            self._mon_hunting = True
+            try:
+                for _ in range(len(self.mons) - 1):
+                    self._mon_i = (self._mon_i + 1) % len(self.mons)
+                    dout("osd", 1).write("%s: mon hunt -> %s",
+                                         self.name, self.mon)
+                    ok = self.ms.connect(self.mon).send_message(
+                        MOSDBoot(osd=self.whoami))
+                    if ok:
+                        self.ms.connect(self.mon).send_message(
+                            MMonSubscribe(what="osdmap",
+                                          start=self.osdmap.epoch + 1))
+                        break
+            finally:
+                self._mon_hunting = False
 
     # ------------------------------------------------------- dispatch
     def ms_dispatch(self, msg: Message) -> bool:
@@ -825,6 +855,12 @@ class OSDDaemon(Dispatcher):
             self._hb_last.clear()
             self._hb_reported.clear()
         self._hb_now = now
+        # mon keepalive: a dead mon only becomes visible when we send
+        # to it — the failed send triggers the hunt to the next mon
+        # (ref: MonClient tick/keepalive)
+        if len(self.mons) > 1:
+            self.ms.connect(self.mon).send_message(MMonSubscribe(
+                what="osdmap", start=self.osdmap.epoch + 1))
         peers = self.heartbeat_peers()
         # prune state for ex-peers (any of the three maps may hold the
         # only record of a peer that never replied)

@@ -18,30 +18,72 @@ from ..osd.daemon import OSDDaemon
 
 class MiniCluster:
     def __init__(self, n_osd: int = 6, osds_per_host: int = 1,
-                 threaded: bool = True):
+                 threaded: bool = True, n_mon: int = 1):
+        import copy
         self.network = LocalNetwork()
         self.threaded = threaded
         self._sim_now: float | None = None
         from ..common.perf_counters import PerfCountersCollection
         self.perf_collection = PerfCountersCollection()
-        m, w = build_initial(n_osd, osds_per_host=osds_per_host)
-        self.mon = Monitor(self.network, initial_map=m,
-                           initial_wrapper=w, threaded=threaded,
-                           clock=self._clock)
-        self.mon.init()
+        ranks = list(range(n_mon))
+        self.mon_names = [f"mon.{r}" for r in ranks]
         self.osds: dict[int, OSDDaemon] = {}
         self._stores: dict[int, object] = {}
         self.mgr = None
+        self.clients: list[Rados] = []
+        m, w = build_initial(n_osd, osds_per_host=osds_per_host)
+        self.mons: dict[int, Monitor] = {}
+        for r in ranks:
+            self.mons[r] = Monitor(
+                self.network, rank=r,
+                initial_map=copy.deepcopy(m),
+                initial_wrapper=copy.deepcopy(w),
+                threaded=threaded, clock=self._clock,
+                mon_ranks=ranks if n_mon > 1 else None)
+            self.mons[r].init()
+        self.mon = self.mons[0]      # rank 0 wins elections when alive
+        if not threaded and n_mon > 1:
+            self.pump()              # settle the election
         for osd in range(n_osd):
             self.start_osd(osd)
-        self.clients: list[Rados] = []
+
+    # ------------------------------------------------------------ mons
+    def leader(self) -> Monitor | None:
+        for mn in self.mons.values():
+            if mn.is_leader:
+                return mn
+        return None
+
+    def kill_mon(self, rank: int) -> None:
+        mn = self.mons.pop(rank, None)
+        if mn is not None:
+            if not hasattr(self, "_mon_stores"):
+                self._mon_stores = {}
+            self._mon_stores[rank] = mn.store
+            mn.shutdown()
+        if self.mon is mn and self.mons:
+            self.mon = self.mons[min(self.mons)]
+
+    def revive_mon(self, rank: int) -> Monitor:
+        """Restart a killed mon from its surviving store."""
+        store = getattr(self, "_mon_stores", {}).get(rank)
+        mn = Monitor(self.network, rank=rank, store=store,
+                     threaded=self.threaded, clock=self._clock,
+                     mon_ranks=[int(n.split(".")[1])
+                                for n in self.mon_names])
+        mn.init()
+        self.mons[rank] = mn
+        if not self.threaded:
+            self.pump()
+        return mn
 
     # ------------------------------------------------------------ osds
     def start_osd(self, osd: int) -> OSDDaemon:
         store = self._stores.get(osd)
         d = OSDDaemon(self.network, osd, store=store,
                       threaded=self.threaded,
-                      perf_collection=self.perf_collection)
+                      perf_collection=self.perf_collection,
+                      mon=self.mon_names)
         self._stores[osd] = d.store
         d.init()
         self.osds[osd] = d
@@ -71,7 +113,7 @@ class MiniCluster:
     # ---------------------------------------------------------- client
     def rados(self, timeout: float = 30.0) -> Rados:
         r = Rados(self.network, op_timeout=timeout,
-                  threaded=self.threaded)
+                  threaded=self.threaded, mon=self.mon_names)
         self.clients.append(r)   # before connect: pump() must see it
         if self.threaded:
             r.connect(timeout)
@@ -88,7 +130,7 @@ class MiniCluster:
     def pump(self, rounds: int = 30) -> None:
         """Non-threaded mode: pump every endpoint until quiescent."""
         for _ in range(rounds):
-            moved = self.mon.ms.poll()
+            moved = sum(mn.ms.poll() for mn in self.mons.values())
             for d in self.osds.values():
                 moved += d.ms.poll()
             for c in self.clients:
@@ -114,7 +156,8 @@ class MiniCluster:
             d.heartbeat_tick(now)
         if not self.threaded:
             self.pump()
-        self.mon.tick(now)
+        for mn in self.mons.values():
+            mn.tick(now)
         if not self.threaded:
             self.pump()
 
@@ -137,4 +180,5 @@ class MiniCluster:
             self.mgr.shutdown()
         for d in list(self.osds.values()):
             d.shutdown()
-        self.mon.shutdown()
+        for mn in self.mons.values():
+            mn.shutdown()

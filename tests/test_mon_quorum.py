@@ -1,0 +1,184 @@
+"""Multi-mon quorum: election, replicated paxos commits, peon command
+forwarding, leader failover, catch-up (ref: src/mon/Elector.cc,
+src/mon/Paxos.cc begin/accept/commit, Monitor::forward_request_leader)."""
+import pytest
+
+from ceph_tpu.msg.messages import MMonCommand, MMonCommandAck
+from ceph_tpu.msg.messenger import Dispatcher, Messenger
+from ceph_tpu.testing import MiniCluster
+
+
+def make_cluster(n_mon=3, n_osd=4):
+    c = MiniCluster(n_osd=n_osd, threaded=False, n_mon=n_mon)
+    c.pump()
+    c.wait_all_up()
+    return c
+
+
+def stores_converged(c):
+    versions = {r: mn.paxos.last_committed for r, mn in c.mons.items()}
+    epochs = {r: mn.osdmap.epoch for r, mn in c.mons.items()}
+    assert len(set(versions.values())) == 1, versions
+    assert len(set(epochs.values())) == 1, epochs
+
+
+class CmdClient(Dispatcher):
+    def __init__(self, net, name, mon):
+        self.ms = Messenger.create(net, name, threaded=False)
+        self.ms.add_dispatcher(self)
+        self.ms.start()
+        self.mon = mon
+        self.acks = []
+
+    def ms_dispatch(self, msg):
+        if isinstance(msg, MMonCommandAck):
+            self.acks.append(msg)
+            return True
+        return False
+
+    def send(self, tid, cmd):
+        self.ms.connect(self.mon).send_message(
+            MMonCommand(tid=tid, cmd=cmd))
+
+    def pump_with(self, c, rounds=10):
+        for _ in range(rounds):
+            c.pump()
+            if not self.ms.poll():
+                break
+
+
+def test_election_lowest_rank_wins():
+    c = make_cluster()
+    leaders = [r for r, mn in c.mons.items() if mn.is_leader]
+    assert leaders == [0]
+    for r, mn in c.mons.items():
+        assert mn.leader_rank == 0
+    # the winning quorum is a majority that contains the leader (late
+    # ackers need not be in it)
+    q = c.mons[0].elector.quorum
+    assert 0 in q and len(q) >= 2
+    c.shutdown()
+
+
+def test_commit_replicates_to_all_mons():
+    c = make_cluster()
+    r = c.rados()
+    r.pool_create("p", pg_num=8)
+    c.pump()
+    stores_converged(c)
+    for mn in c.mons.values():
+        assert "p" in mn.osdmap.pool_names.values()
+    c.shutdown()
+
+
+def test_peon_forwards_write_commands():
+    c = make_cluster()
+    cl = CmdClient(c.network, "client.77", "mon.2")   # a peon
+    cl.send(5, {"prefix": "osd pool create", "pool": "via-peon",
+                "pg_num": 8})
+    cl.pump_with(c)
+    assert cl.acks and cl.acks[0].tid == 5 and cl.acks[0].result == 0
+    stores_converged(c)
+    assert "via-peon" in c.mons[0].osdmap.pool_names.values()
+    # reads answered by the peon locally
+    cl.send(6, {"prefix": "osd stat"})
+    cl.pump_with(c)
+    assert cl.acks[1].result == 0
+    c.shutdown()
+
+
+def test_leader_failover_and_continuity():
+    c = make_cluster()
+    r = c.rados()
+    r.pool_create("before", pg_num=8)
+    c.pump()
+    # kill the leader; peons re-elect after the lease goes stale
+    c.kill_mon(0)
+    now = 50_000.0
+    c.tick(now)
+    c.tick(now + 20.0)          # > LEASE_TIMEOUT
+    c.pump()
+    leaders = [rk for rk, mn in c.mons.items() if mn.is_leader]
+    assert leaders == [1]
+    assert c.mons[2].leader_rank == 1
+    # cluster still mutable through the new leader (client hunts mons)
+    io_client = c.rados()
+    io_client.pool_create("after", pg_num=8)
+    c.pump()
+    for mn in c.mons.values():
+        assert "after" in mn.osdmap.pool_names.values()
+        assert "before" in mn.osdmap.pool_names.values()
+    # IO still flows
+    io = io_client.open_ioctx("after")
+    io.write_full("obj", b"post-failover")
+    assert io.read("obj") == b"post-failover"
+    c.shutdown()
+
+
+def test_peon_death_keeps_majority_working():
+    c = make_cluster()
+    r = c.rados()
+    c.kill_mon(2)
+    r.pool_create("still-works", pg_num=8)
+    c.pump()
+    assert "still-works" in c.mons[0].osdmap.pool_names.values()
+    assert "still-works" in c.mons[1].osdmap.pool_names.values()
+    c.shutdown()
+
+
+def test_revived_mon_catches_up():
+    c = make_cluster()
+    r = c.rados()
+    c.kill_mon(2)
+    r.pool_create("while-away", pg_num=8)
+    c.pump()
+    mn2 = c.revive_mon(2)
+    c.pump()
+    # leases carry last_committed; the revived peon syncs
+    now = 90_000.0
+    c.tick(now)
+    c.tick(now + 6.0)
+    c.pump()
+    assert mn2.paxos.last_committed == \
+        c.mons[0].paxos.last_committed
+    assert "while-away" in mn2.osdmap.pool_names.values()
+    stores_converged(c)
+    c.shutdown()
+
+
+def test_full_store_sync_beyond_trim_window():
+    """A mon lagging past the paxos trim window gets a full store
+    snapshot instead of an unfillable gap."""
+    c = make_cluster()
+    r = c.rados()
+    c.kill_mon(2)
+    r.pool_create("a", pg_num=8)
+    r.pool_create("b", pg_num=8)
+    r.pool_create("c", pg_num=8)
+    c.pump()
+    lead = c.mons[0]
+    lead.paxos.keep_versions = 1
+    lead.paxos._maybe_trim()
+    # the revived mon's last_committed is 1 (bootstrap): a gap it
+    # cannot fill incrementally
+    assert lead.paxos.first_committed > 2
+    mn2 = c.revive_mon(2)
+    c.pump()
+    now = 120_000.0
+    c.tick(now)
+    c.pump()
+    assert mn2.paxos.last_committed == lead.paxos.last_committed
+    assert "a" in mn2.osdmap.pool_names.values()
+    assert "b" in mn2.osdmap.pool_names.values()
+    c.shutdown()
+
+
+def test_sync_handle_command_raises_in_quorum():
+    c = make_cluster()
+    with pytest.raises(RuntimeError):
+        c.mons[0].handle_command({"prefix": "osd pool create",
+                                  "pool": "x", "pg_num": 8})
+    # reads still fine synchronously anywhere
+    r, outs, outb = c.mons[2].handle_command({"prefix": "osd stat"})
+    assert r == 0
+    c.shutdown()

@@ -83,9 +83,16 @@ class Elector:
         if msg.rank < self.rank:
             if not self.electing and self.leader == self.rank:
                 # we won this epoch but a lower rank is proposing:
-                # abdicate — restart in a higher epoch and let the
-                # lower rank win it cleanly
-                self.start()
+                # abdicate by DEFERRING in a fresh epoch (re-proposing
+                # ourselves here livelocks: our broadcast reaches the
+                # other voters first and we just win again)
+                self.epoch += 1
+                self.electing = True
+                self.leader = None
+                self.acked_me = set()
+                self.send(msg.rank, MMonElection(op="ack",
+                                                 epoch=self.epoch,
+                                                 rank=self.rank))
                 return
             # defer
             self.send(msg.rank, MMonElection(op="ack", epoch=self.epoch,
@@ -97,7 +104,14 @@ class Elector:
                                              rank=self.rank))
 
     def _handle_ack(self, msg: MMonElection) -> None:
-        if msg.epoch != self.epoch or not self.electing:
+        if msg.epoch > self.epoch:
+            # an abdicating leader deferred to us in a fresh epoch:
+            # adopt it and keep collecting there
+            self.epoch = msg.epoch
+            self.electing = True
+            self.leader = None
+            self.acked_me = {self.rank}
+        elif msg.epoch < self.epoch or not self.electing:
             return
         self.acked_me.add(msg.rank)
         self._check_win()

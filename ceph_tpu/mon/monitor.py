@@ -30,9 +30,10 @@ from ..common.log import dout
 from ..common.options import global_config
 from ..msg.messages import (MMap, MMonCommand, MMonCommandAck,
                             MMonElection, MMonForward, MMonLease,
-                            MMonSubscribe, MOSDBoot, MOSDFailure,
-                            MPaxosAccept, MPaxosBegin, MPaxosCommit,
-                            MPaxosStoreSync, MPaxosSyncReq)
+                            MMonLeaseAck, MMonSubscribe, MOSDBoot,
+                            MOSDFailure, MPaxosAccept, MPaxosBegin,
+                            MPaxosCommit, MPaxosStoreSync,
+                            MPaxosSyncReq)
 from ..msg.messenger import Dispatcher, LocalNetwork, Message, Messenger
 from ..osd.osdmap import CEPH_OSD_AUTOOUT, CEPH_OSD_IN, OSDMap
 from .elector import Elector
@@ -104,6 +105,10 @@ class Monitor(Dispatcher):
         # serialized map mutations: (stage_fn, reply_cb)
         self._chg_queue: deque = deque()
         self._chg_busy = False
+        self._chg_inflight_reply = None
+        # freshly-won leaders freeze proposals until enough lease acks
+        # confirm no peon holds history we lack (collect-phase analogue)
+        self._catchup_pending: set[int] = set()
 
     # ------------------------------------------------------------ setup
     def init(self) -> None:
@@ -139,6 +144,11 @@ class Monitor(Dispatcher):
         self.paxos.send = self._send_rank
         self.paxos.abort_inflight()
         self._fail_queued("EAGAIN")
+        # collect-phase analogue: don't propose anything until lease
+        # acks show whether a peon holds commits we missed (a revived
+        # stale low-rank winner must not fork history at old versions)
+        self._catchup_pending = {r for r in self.mon_ranks
+                                 if r != self.rank}
         # fresh reign: re-stage on top of the committed state
         self.osdmon.update_from_paxos()
         self.osdmon.create_pending()
@@ -163,6 +173,12 @@ class Monitor(Dispatcher):
             version=self.paxos.last_committed, rank=self.rank))
 
     def _fail_queued(self, errno_name: str) -> None:
+        # the in-flight proposal's client must get a fast EAGAIN too —
+        # paxos.abort_inflight drops its commit callback silently
+        if self._chg_inflight_reply is not None:
+            cb = self._chg_inflight_reply
+            self._chg_inflight_reply = None
+            cb(-11, errno_name, None)
         while self._chg_queue:
             _stage, reply_cb = self._chg_queue.popleft()
             if reply_cb is not None:
@@ -238,12 +254,36 @@ class Monitor(Dispatcher):
                     self.is_leader = False
                     self.leader_rank = sender
                     self.paxos.epoch = msg.epoch
+                    self.paxos.send = self._send_rank
+                    self.paxos.all_ranks = list(self.mon_ranks)
                     self._persist_elector()
                 self._lease_stamp = self.clock()
                 if msg.last_committed > self.paxos.last_committed:
                     self._send_rank(sender, MPaxosSyncReq(
                         version=self.paxos.last_committed,
                         rank=self.rank))
+                elif msg.last_committed < self.paxos.last_committed:
+                    # the (stale, freshly elected) leader is BEHIND us:
+                    # push the commits it missed before it proposes
+                    # conflicting versions
+                    for m in self.paxos.sync_reply(msg.last_committed):
+                        self._send_rank(sender, m)
+                # lease ack completes the leader's collect phase
+                self._send_rank(sender, MMonLeaseAck(
+                    epoch=msg.epoch, rank=self.rank,
+                    last_committed=self.paxos.last_committed))
+                return True
+            if isinstance(msg, MMonLeaseAck):
+                if self.is_leader and msg.epoch == self.elector.epoch:
+                    self._catchup_pending.discard(msg.rank)
+                    # unfreeze on a majority (incl. self): a member
+                    # that died right after the election must not
+                    # freeze the reign forever
+                    have = len(self.mon_ranks) - \
+                        len(self._catchup_pending)
+                    if have >= len(self.mon_ranks) // 2 + 1:
+                        self._catchup_pending = set()
+                        self._pump_changes()
                 return True
             if isinstance(msg, MMonForward):
                 if self.is_leader:
@@ -263,16 +303,17 @@ class Monitor(Dispatcher):
         return False
 
     def ms_handle_reset(self, peer: str) -> None:
-        if not self.standalone and peer.startswith("mon.") and \
-                self.leader_rank is not None and \
-                peer == f"mon.{self.leader_rank}" and \
-                not self.is_leader and not self.elector.electing:
-            # (electing guard: proposing to the dead leader reports a
-            # reset synchronously — without it this would recurse)
-            dout("mon", 1).write("%s: leader %s gone, re-electing",
-                                 self.name, peer)
-            self.elector.start()
-            self._persist_elector()
+        with self._lock:
+            if not self.standalone and peer.startswith("mon.") and \
+                    self.leader_rank is not None and \
+                    peer == f"mon.{self.leader_rank}" and \
+                    not self.is_leader and not self.elector.electing:
+                # (electing guard: proposing to the dead leader reports
+                # a reset synchronously — without it this would recurse)
+                dout("mon", 1).write("%s: leader %s gone, re-electing",
+                                     self.name, peer)
+                self.elector.start()
+                self._persist_elector()
 
     def _relay_if_peon(self, msg: Message) -> bool:
         """Peons relay map-mutating daemon traffic to the leader
@@ -355,6 +396,8 @@ class Monitor(Dispatcher):
         if not self.is_leader:
             self._fail_queued("EAGAIN")
             return
+        if self._catchup_pending:
+            return   # collect phase: lease acks will pump us
         stage, reply_cb = self._chg_queue.popleft()
         try:
             res = stage()
@@ -372,9 +415,11 @@ class Monitor(Dispatcher):
             self._pump_changes()
             return
         self._chg_busy = True
+        self._chg_inflight_reply = reply_cb
 
         def committed():
             self._chg_busy = False
+            self._chg_inflight_reply = None
             self._publish()
             if reply_cb is not None:
                 reply_cb(r, outs, outb)
@@ -498,7 +543,10 @@ class Monitor(Dispatcher):
             now = self.clock() if now is None else now
             if not self.standalone:
                 if self.is_leader:
-                    if now - self._last_lease_sent >= LEASE_INTERVAL:
+                    if self._catchup_pending and \
+                            now - self._last_lease_sent >= 1.0:
+                        self._broadcast_lease()   # re-ask for acks
+                    elif now - self._last_lease_sent >= LEASE_INTERVAL:
                         self._broadcast_lease()
                 elif self.leader_rank is None or \
                         now - self._lease_stamp > LEASE_TIMEOUT:

@@ -114,7 +114,10 @@ class Paxos:
                 msg.epoch != self.epoch:
             return
         fl[2].add(msg.rank)
-        if len(fl[2]) < len(self.quorum) // 2 + 1:
+        # majority of ALL mons, not just the (possibly sub-full)
+        # election quorum: an acked commit must survive any later
+        # majority (the reference waits for the full quorum)
+        if len(fl[2]) < len(self.all_ranks) // 2 + 1:
             return
         from ..msg.messages import MPaxosCommit
         v, tx_bytes, _acks, cb = fl

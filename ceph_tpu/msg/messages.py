@@ -284,6 +284,16 @@ class MMonLease(Message):
 
 
 @dataclass
+class MMonLeaseAck(Message):
+    """Peon lease acknowledgement (ref: MMonPaxos.h OP_LEASE_ACK);
+    carries the peon's paxos state so a freshly elected stale leader
+    learns what it missed before proposing anything."""
+    epoch: int = 0
+    rank: int = -1
+    last_committed: int = 0
+
+
+@dataclass
 class MPaxosSyncReq(Message):
     """Lagging peon asks the leader for missed commits
     (ref: Paxos share_state/store sync)."""

@@ -80,9 +80,14 @@ class Messenger:
 
     # -- factory (ref: Messenger.cc:21 Messenger::create) ---------------
     @staticmethod
-    def create(network: "LocalNetwork", name: EntityName,
+    def create(network, name: EntityName,
                ms_type: str | None = None,
-               threaded: bool = True) -> "Messenger":
+               threaded: bool = True):
+        # a TcpNet (monmap) network selects the socket backend: same
+        # dispatcher surface, one OS process per daemon
+        from .tcp import TcpMessenger, TcpNet
+        if isinstance(network, TcpNet):
+            return TcpMessenger(network.addr_map, name)
         if ms_type is None:
             ms_type = global_config()["ms_type"]
         if ms_type in ("local", "ici"):

@@ -1,0 +1,226 @@
+"""TCP messenger backend: the framework over real sockets.
+
+The AsyncMessenger/posix analogue (ref: src/msg/async/AsyncMessenger.cc,
+PosixStack — event-driven sockets with per-peer Connections;
+ProtocolV2's framing reduced to length-prefixed pickle since peers are
+trusted same-version Python here).  Same dispatcher surface as the
+in-process transport (ceph_tpu.msg.messenger), so every daemon — mon,
+OSD, mgr, client — runs unmodified over localhost or a LAN, one process
+per daemon (the reference's deployment model).
+
+Addressing: a static name -> (host, port) map (the monmap analogue,
+ref: src/mon/MonMap.h + per-daemon bind addrs from the config).
+
+Delivery semantics match LocalNetwork: per-peer FIFO, best-effort;
+a failed/refused connection reports ms_handle_reset to the sender.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+
+from ..common.log import dout
+from .messenger import Connection, Dispatcher, Message
+
+_HDR = struct.Struct("!I")
+MAX_FRAME = 1 << 30
+
+
+def send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(_HDR.pack(len(payload)) + payload)
+
+
+def recv_frame(sock: socket.socket) -> bytes | None:
+    hdr = _recv_exact(sock, _HDR.size)
+    if hdr is None:
+        return None
+    (n,) = _HDR.unpack(hdr)
+    if n > MAX_FRAME:
+        raise ValueError(f"frame too large: {n}")
+    return _recv_exact(sock, n)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return bytes(buf)
+
+
+class TcpNet:
+    """The monmap analogue: name -> (host, port) for every entity.
+    Passing one of these as the `network` to Messenger.create selects
+    the TCP backend (ref: MonMap + per-daemon bind addrs)."""
+
+    def __init__(self, addr_map: dict[str, tuple[str, int]]):
+        self.addr_map = dict(addr_map)
+
+
+class TcpMessenger:
+    """One endpoint bound to addr_map[name]
+    (ref: Messenger::bind + AsyncMessenger accept loop)."""
+
+    def __init__(self, addr_map: dict[str, tuple[str, int]], name: str):
+        self.name = name
+        self.addr_map = dict(addr_map)
+        self.dispatchers: list[Dispatcher] = []
+        self._lock = threading.Lock()
+        self._out: dict[str, socket.socket] = {}   # peer -> conn
+        self._running = False
+        self._listener: socket.socket | None = None
+        self._threads: list[threading.Thread] = []
+        self._seq = 0
+
+    # -- messenger surface ----------------------------------------------
+    def add_dispatcher(self, d: Dispatcher) -> None:
+        self.dispatchers.append(d)
+
+    def connect(self, peer: str) -> Connection:
+        return Connection(self, peer)
+
+    def start(self) -> None:
+        host, port = self.addr_map[self.name]
+        self._listener = socket.socket(socket.AF_INET,
+                                       socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET,
+                                  socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(64)
+        self._running = True
+        t = threading.Thread(target=self._accept_loop,
+                             name=f"tcp-accept-{self.name}", daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def poll(self, max_msgs: int = 0) -> int:
+        """Socket reads deliver on their own threads; nothing to pump
+        (API compat with the in-process transport)."""
+        return 0
+
+    def shutdown(self) -> None:
+        self._running = False
+        with self._lock:
+            socks = list(self._out.values())
+            self._out.clear()
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+
+    # -- send ------------------------------------------------------------
+    def _send(self, peer: str, msg: Message) -> bool:
+        import dataclasses
+        with self._lock:
+            self._seq += 1
+            msg = dataclasses.replace(msg, src=self.name, seq=self._seq)
+            try:
+                payload = pickle.dumps(msg)
+            except Exception as ex:
+                dout("ms", 0).write("%s: unpicklable %s: %s", self.name,
+                                    msg.type_name, ex)
+                return False
+            sock = self._out.get(peer)
+            if sock is None:
+                sock = self._connect_peer(peer)
+                if sock is None:
+                    self.handle_reset(peer)
+                    return False
+                self._out[peer] = sock
+            try:
+                send_frame(sock, payload)
+                return True
+            except OSError:
+                self._out.pop(peer, None)
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+        self.handle_reset(peer)
+        return False
+
+    def _connect_peer(self, peer: str) -> socket.socket | None:
+        addr = self.addr_map.get(peer)
+        if addr is None:
+            return None
+        try:
+            s = socket.create_connection(tuple(addr), timeout=5.0)
+            s.settimeout(None)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            return s
+        except OSError:
+            return None
+
+    # -- receive ---------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                conn, _addr = self._listener.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(target=self._read_loop, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _read_loop(self, conn: socket.socket) -> None:
+        peer = None
+        try:
+            while self._running:
+                frame = recv_frame(conn)
+                if frame is None:
+                    break
+                msg = pickle.loads(frame)
+                peer = msg.src
+                self._deliver(msg)
+        except (OSError, ValueError, pickle.UnpicklingError) as ex:
+            dout("ms", 1).write("%s: read error from %s: %s", self.name,
+                                peer, ex)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            if peer is not None and self._running:
+                self.handle_reset(peer)
+
+    def _deliver(self, msg: Message) -> None:
+        for d in self.dispatchers:
+            try:
+                if d.ms_dispatch(msg):
+                    return
+            except Exception:
+                import traceback
+                dout("ms", 0).write("dispatch error on %s: %s",
+                                    self.name, traceback.format_exc())
+                return
+        dout("ms", 1).write("%s: unhandled message %s from %s",
+                            self.name, msg.type_name, msg.src)
+
+    def handle_reset(self, peer: str) -> None:
+        for d in self.dispatchers:
+            d.ms_handle_reset(peer)
+
+
+def pick_free_ports(n: int, host: str = "127.0.0.1") -> list[int]:
+    """Ephemeral ports for a test/launcher monmap."""
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind((host, 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    return ports

@@ -1,0 +1,97 @@
+"""Daemon entrypoint: run a mon or OSD as its own OS process over TCP.
+
+The ceph-mon/ceph-osd analogue (ref: src/ceph_mon.cc, src/ceph_osd.cc
+global_init + daemon loop): a monmap JSON file carries every entity's
+bind address plus the cluster bootstrap parameters; each process binds
+its own socket and joins.
+
+monmap JSON:
+    {"addrs": {"mon.0": ["127.0.0.1", 6789], "osd.0": [...], ...},
+     "mon_ranks": [0], "n_osd": 3, "osds_per_host": 1}
+
+Usage:
+    python -m ceph_tpu.tools.daemon_main mon --rank 0 --monmap m.json
+    python -m ceph_tpu.tools.daemon_main osd --id 2 --monmap m.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import time
+
+
+def load_monmap(path: str) -> dict:
+    with open(path) as f:
+        mm = json.load(f)
+    mm["addrs"] = {k: tuple(v) for k, v in mm["addrs"].items()}
+    return mm
+
+
+def run_mon(args) -> int:
+    from ..mon.monitor import Monitor, build_initial
+    from ..msg.tcp import TcpNet
+    mm = load_monmap(args.monmap)
+    net = TcpNet(mm["addrs"])
+    m, w = build_initial(mm.get("n_osd", 0),
+                         osds_per_host=mm.get("osds_per_host", 1))
+    ranks = mm.get("mon_ranks", [0])
+    mon = Monitor(net, rank=args.rank, initial_map=m, initial_wrapper=w,
+                  mon_ranks=ranks if len(ranks) > 1 else None)
+    mon.init()
+    print(f"mon.{args.rank}: serving on "
+          f"{mm['addrs'][f'mon.{args.rank}']}", flush=True)
+    _serve(lambda: mon.tick(), interval=1.0)
+    mon.shutdown()
+    return 0
+
+
+def run_osd(args) -> int:
+    from ..common.options import global_config
+    from ..msg.tcp import TcpNet
+    from ..osd.daemon import OSDDaemon
+    mm = load_monmap(args.monmap)
+    net = TcpNet(mm["addrs"])
+    mons = [f"mon.{r}" for r in mm.get("mon_ranks", [0])]
+    d = OSDDaemon(net, args.id, mon=mons)
+    d.init()
+    print(f"osd.{args.id}: serving on "
+          f"{mm['addrs'][f'osd.{args.id}']}", flush=True)
+    interval = global_config()["osd_heartbeat_interval"]
+    _serve(lambda: d.heartbeat_tick(), interval=interval)
+    d.shutdown()
+    return 0
+
+
+def _serve(tick, interval: float) -> None:
+    stop = {"flag": False}
+
+    def on_sig(_sig, _frm):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, on_sig)
+    signal.signal(signal.SIGINT, on_sig)
+    while not stop["flag"]:
+        time.sleep(interval)
+        try:
+            tick()
+        except Exception as ex:           # daemon loop must survive
+            print(f"tick error: {ex}", file=sys.stderr, flush=True)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="ceph-tpu-daemon")
+    sub = ap.add_subparsers(dest="role", required=True)
+    pm = sub.add_parser("mon")
+    pm.add_argument("--rank", type=int, default=0)
+    pm.add_argument("--monmap", required=True)
+    po = sub.add_parser("osd")
+    po.add_argument("--id", type=int, required=True)
+    po.add_argument("--monmap", required=True)
+    args = ap.parse_args(argv)
+    return run_mon(args) if args.role == "mon" else run_osd(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -173,6 +173,42 @@ def test_full_store_sync_beyond_trim_window():
     c.shutdown()
 
 
+def test_revived_stale_leader_does_not_fork_history():
+    """mon.0 (lowest rank) revives behind the others and wins the
+    election; the collect phase (lease acks + peer pushes) must bring
+    it up to date BEFORE it proposes, so no version is forked."""
+    c = make_cluster()
+    r = c.rados()
+    c.kill_mon(0)
+    now = 200_000.0
+    c.tick(now)
+    c.tick(now + 20.0)
+    c.pump()
+    assert [rk for rk, mn in c.mons.items() if mn.is_leader] == [1]
+    r2 = c.rados()
+    r2.pool_create("while-0-dead", pg_num=8)
+    c.pump()
+    v_ahead = c.mons[1].paxos.last_committed
+    # revive the stale rank-0: it wins the next election
+    mn0 = c.revive_mon(0)
+    c.pump()
+    c.tick(now + 40.0)
+    c.pump()
+    assert mn0.is_leader
+    # collect phase must have caught it up, not forked
+    assert mn0.paxos.last_committed >= v_ahead
+    assert "while-0-dead" in mn0.osdmap.pool_names.values()
+    # new commits extend everyone identically
+    r3 = c.rados()
+    r3.pool_create("after-revive", pg_num=8)
+    c.pump()
+    stores_converged(c)
+    for mn in c.mons.values():
+        assert "while-0-dead" in mn.osdmap.pool_names.values()
+        assert "after-revive" in mn.osdmap.pool_names.values()
+    c.shutdown()
+
+
 def test_sync_handle_command_raises_in_quorum():
     c = make_cluster()
     with pytest.raises(RuntimeError):

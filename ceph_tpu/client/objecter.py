@@ -17,6 +17,7 @@ from typing import Optional
 from ..common.log import dout
 from ..msg.messages import (MMap, MMonCommand, MMonCommandAck,
                             MMonSubscribe, OSDOp, OSDOpReply)
+from ..msg.mon_client import MonHunter
 from ..msg.messenger import Dispatcher, LocalNetwork, Message, Messenger
 from ..osd.osdmap import OSDMap
 from ..osd.types import PG
@@ -68,15 +69,13 @@ class _Op:
         self.attempts = 0
 
 
-class Objecter(Dispatcher):
+class Objecter(Dispatcher, MonHunter):
     """(ref: src/osdc/Objecter.h:1204)."""
 
     def __init__(self, network: LocalNetwork, name: str | None = None,
                  mon="mon.0", threaded: bool = True):
         self.name = name or f"client.{next(_client_ids)}"
-        self.mons = [mon] if isinstance(mon, str) else list(mon)
-        self._mon_i = 0
-        self._mon_hunting = False
+        self._init_mons(mon)
         self.osdmap = OSDMap()
         self._map_ev = threading.Event()
         self._lock = threading.RLock()
@@ -138,9 +137,9 @@ class Objecter(Dispatcher):
             return self._handle_command_ack(msg)
         return False
 
-    @property
-    def mon(self) -> str:
-        return self.mons[self._mon_i]
+    def _hunt_greeting(self) -> list:
+        return [MMonSubscribe(what="osdmap",
+                              start=self.osdmap.epoch + 1)]
 
     def ms_handle_reset(self, peer: str) -> None:
         """Retarget ops aimed at a gone peer (ref:
@@ -148,23 +147,9 @@ class Objecter(Dispatcher):
         same peer — route() reports the reset synchronously, so a
         resend to a dead endpoint would recurse; ops whose recalculated
         target is unchanged park homeless until a newer map (or the
-        rescan timer) moves them.  A gone mon triggers a hunt to the
-        next in the list (ref: MonClient reopen_session)."""
-        if peer == self.mon and len(self.mons) > 1:
-            if self._mon_hunting:
-                return   # a failed hunt send reports its reset inline
-            self._mon_hunting = True
-            try:
-                for _ in range(len(self.mons) - 1):
-                    self._mon_i = (self._mon_i + 1) % len(self.mons)
-                    dout("client", 1).write("%s: mon hunt -> %s",
-                                            self.name, self.mon)
-                    if self.ms.connect(self.mon).send_message(
-                            MMonSubscribe(what="osdmap",
-                                          start=self.osdmap.epoch + 1)):
-                        break
-            finally:
-                self._mon_hunting = False
+        rescan timer) moves them.  A gone mon triggers the shared
+        MonHunter walk."""
+        if self._maybe_hunt(peer):
             return
         if not peer.startswith("osd."):
             return

@@ -19,17 +19,18 @@ from ..common.log import dout
 from ..common.options import global_config
 from ..msg.messages import (MMap, MMonCommand, MMonCommandAck,
                             MMonSubscribe)
+from ..msg.mon_client import MonHunter
 from ..msg.messenger import Dispatcher, LocalNetwork, Message, Messenger
 from ..osd.balancer import Balancer
 from ..osd.osdmap import OSDMap
 
 
-class MgrDaemon(Dispatcher):
+class MgrDaemon(Dispatcher, MonHunter):
     def __init__(self, network: LocalNetwork, rank: int = 0,
-                 mon: str = "mon.0", threaded: bool = False,
+                 mon="mon.0", threaded: bool = False,
                  max_deviation: int = 1, max_iterations: int = 100):
         self.name = f"mgr.{rank}"
-        self.mon = mon
+        self._init_mons(mon)
         self.osdmap = OSDMap()
         self.active = True
         self.balancer = Balancer(max_deviation=max_deviation,
@@ -41,6 +42,13 @@ class MgrDaemon(Dispatcher):
         self._lock = threading.RLock()
         self.ms = Messenger.create(network, self.name, threaded=threaded)
         self.ms.add_dispatcher(self)
+
+    def _hunt_greeting(self) -> list:
+        return [MMonSubscribe(what="osdmap",
+                              start=self.osdmap.epoch + 1)]
+
+    def ms_handle_reset(self, peer: str) -> None:
+        self._maybe_hunt(peer)
 
     # ------------------------------------------------------------ setup
     def init(self) -> None:

@@ -1,0 +1,54 @@
+"""MonHunter: shared mon-session failover for daemons/clients.
+
+The MonClient hunting behavior (ref: src/mon/MonClient.cc
+reopen_session / _reopen_session rank rotation): an entity holds a mon
+list, talks to one, and on a connection reset rotates to the next,
+re-sending its session greeting (subscription/boot).  The walk is
+iterative — a hunt send to another dead mon reports its reset
+synchronously and must not recurse.
+"""
+from __future__ import annotations
+
+from ..common.log import dout
+
+
+class MonHunter:
+    """Mixin; the host class must expose `self.ms` and override
+    `_hunt_greeting()` with the session (re)establishment messages."""
+
+    def _init_mons(self, mon) -> None:
+        self.mons = [mon] if isinstance(mon, str) else list(mon)
+        self._mon_i = 0
+        self._mon_hunting = False
+
+    @property
+    def mon(self) -> str:
+        return self.mons[self._mon_i]
+
+    def _hunt_greeting(self) -> list:
+        """Messages that re-establish the session at a new mon."""
+        return []
+
+    def _maybe_hunt(self, peer: str) -> bool:
+        """Handle a reset of our current mon; True when it was ours
+        (hunted or nothing else to do)."""
+        if peer != self.mon:
+            return False
+        if len(self.mons) <= 1 or self._mon_hunting:
+            return True
+        self._mon_hunting = True
+        try:
+            for _ in range(len(self.mons) - 1):
+                self._mon_i = (self._mon_i + 1) % len(self.mons)
+                dout("ms", 1).write("%s: mon hunt -> %s",
+                                    getattr(self, "name", "?"), self.mon)
+                msgs = self._hunt_greeting()
+                if not msgs:
+                    break
+                if self.ms.connect(self.mon).send_message(msgs[0]):
+                    for m in msgs[1:]:
+                        self.ms.connect(self.mon).send_message(m)
+                    break
+        finally:
+            self._mon_hunting = False
+        return True

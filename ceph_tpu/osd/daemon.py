@@ -27,6 +27,7 @@ from ..msg.messages import (ECSubRead, ECSubReadReply, ECSubWrite,
                             OSDOpReply, PGPull, PGPush, PGScan,
                             PGScanReply, Ping, PingReply, RepOpReply,
                             RepOpWrite, ScrubMapReply, ScrubMapRequest)
+from ..msg.mon_client import MonHunter
 from ..msg.messenger import Dispatcher, LocalNetwork, Message, Messenger
 from ..store import MemStore, StoreError
 from .ec_backend import ECBackend, ECPGShard
@@ -75,7 +76,7 @@ class _ScrubState:
         self.unrepairable: list[str] = []
 
 
-class OSDDaemon(Dispatcher):
+class OSDDaemon(Dispatcher, MonHunter):
     """osd.<id> (ref: src/osd/OSD.h:1036)."""
 
     def __init__(self, network: LocalNetwork, whoami: int,
@@ -84,8 +85,7 @@ class OSDDaemon(Dispatcher):
         self.whoami = whoami
         self.name = f"osd.{whoami}"
         # mon may be a single name or a failover list
-        self.mons = [mon] if isinstance(mon, str) else list(mon)
-        self._mon_i = 0
+        self._init_mons(mon)
         self.store = store or MemStore()
         if not self.store.mounted:
             self.store.mkfs()
@@ -126,10 +126,6 @@ class OSDDaemon(Dispatcher):
         self.ms.add_dispatcher(self)
 
     # ------------------------------------------------------------ setup
-    @property
-    def mon(self) -> str:
-        return self.mons[self._mon_i]
-
     def init(self) -> None:
         self.ms.start()
         self.ms.connect(self.mon).send_message(MOSDBoot(osd=self.whoami))
@@ -139,29 +135,15 @@ class OSDDaemon(Dispatcher):
     def shutdown(self) -> None:
         self.ms.shutdown()
 
+    def _hunt_greeting(self) -> list:
+        return [MOSDBoot(osd=self.whoami),
+                MMonSubscribe(what="osdmap",
+                              start=self.osdmap.epoch + 1)]
+
     def ms_handle_reset(self, peer: str) -> None:
-        """Our mon went away: hunt to the next one
-        (ref: MonClient reopen_session mon hunting).  A hunt send to
-        another dead mon reports its reset synchronously, so the guard
-        keeps the walk iterative instead of recursive."""
-        if peer == self.mon and len(self.mons) > 1:
-            if getattr(self, "_mon_hunting", False):
-                return
-            self._mon_hunting = True
-            try:
-                for _ in range(len(self.mons) - 1):
-                    self._mon_i = (self._mon_i + 1) % len(self.mons)
-                    dout("osd", 1).write("%s: mon hunt -> %s",
-                                         self.name, self.mon)
-                    ok = self.ms.connect(self.mon).send_message(
-                        MOSDBoot(osd=self.whoami))
-                    if ok:
-                        self.ms.connect(self.mon).send_message(
-                            MMonSubscribe(what="osdmap",
-                                          start=self.osdmap.epoch + 1))
-                        break
-            finally:
-                self._mon_hunting = False
+        """Our mon went away: hunt to the next one (shared MonHunter
+        walk; iterative, never recursive)."""
+        self._maybe_hunt(peer)
 
     # ------------------------------------------------------- dispatch
     def ms_dispatch(self, msg: Message) -> bool:

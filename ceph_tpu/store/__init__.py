@@ -2,10 +2,13 @@
 
 `ObjectStore` is the abstract transactional API (ObjectStore.h:66);
 `MemStore` is the in-memory implementation used by the OSD shards and
-tests (model: src/os/memstore/MemStore.cc).
+tests (model: src/os/memstore/MemStore.cc); `JournaledStore` adds an
+on-disk write-ahead journal + snapshot (FileStore/FileJournal shape)
+for durable one-process-per-daemon deployments.
 """
 from .objectstore import ObjectStore, Transaction, ObjectId, StoreError
 from .memstore import MemStore
+from .journaled import JournaledStore
 
 __all__ = ["ObjectStore", "Transaction", "ObjectId", "StoreError",
-           "MemStore"]
+           "MemStore", "JournaledStore"]

@@ -104,7 +104,8 @@ class MiniCluster:
         from ..mgr import MgrDaemon
         if self.mgr is not None:
             self.mgr.shutdown()
-        self.mgr = MgrDaemon(self.network, threaded=self.threaded, **kw)
+        self.mgr = MgrDaemon(self.network, threaded=self.threaded,
+                             mon=self.mon_names, **kw)
         self.mgr.init()
         if not self.threaded:
             self.pump()

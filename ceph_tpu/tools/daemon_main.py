@@ -54,13 +54,20 @@ def run_osd(args) -> int:
     mm = load_monmap(args.monmap)
     net = TcpNet(mm["addrs"])
     mons = [f"mon.{r}" for r in mm.get("mon_ranks", [0])]
-    d = OSDDaemon(net, args.id, mon=mons)
+    store = None
+    if args.data_dir:
+        from ..store import JournaledStore
+        store = JournaledStore(args.data_dir)
+        store.mount()
+    d = OSDDaemon(net, args.id, mon=mons, store=store)
     d.init()
     print(f"osd.{args.id}: serving on "
           f"{mm['addrs'][f'osd.{args.id}']}", flush=True)
     interval = global_config()["osd_heartbeat_interval"]
     _serve(lambda: d.heartbeat_tick(), interval=interval)
     d.shutdown()
+    if store is not None:
+        store.umount()
     return 0
 
 
@@ -89,6 +96,9 @@ def main(argv=None) -> int:
     po = sub.add_parser("osd")
     po.add_argument("--id", type=int, required=True)
     po.add_argument("--monmap", required=True)
+    po.add_argument("--data-dir", default="",
+                    help="durable store directory (JournaledStore); "
+                         "in-memory when omitted")
     args = ap.parse_args(argv)
     return run_mon(args) if args.role == "mon" else run_osd(args)
 

@@ -82,6 +82,12 @@ class Objecter(Dispatcher, MonHunter):
         self._tid = itertools.count(1)
         self.in_flight: dict[int, _Op] = {}
         self.homeless: list[_Op] = []
+        # per-object op ordering (librados semantics: one client's ops
+        # on one object complete in submission order — without this a
+        # parked-then-retried older write can land AFTER a newer acked
+        # write and silently win)
+        self._obj_active: dict[tuple, int] = {}   # (pool, oid) -> tid
+        self._obj_wait: dict[tuple, list] = {}
         self._rescan_timer = None
         self._pending_cmds: dict = {}
         #: non-threaded harnesses set this to a network pump callable;
@@ -193,7 +199,7 @@ class Objecter(Dispatcher, MonHunter):
         for op in self.homeless:
             if op.pool not in self.osdmap.pools:
                 # pool deleted while the op was parked
-                op.future._complete(OSDOpReply(
+                self._complete_op(op, OSDOpReply(
                     tid=op.tid, result=-2, errno_name="ENOENT"))
                 continue
             self._calc_target(op)
@@ -239,13 +245,52 @@ class Objecter(Dispatcher, MonHunter):
                 fut._complete(OSDOpReply(tid=o.tid, result=-2,
                                          errno_name="ENOENT"))
                 return fut
-            self._calc_target(o)
-            if o.target_osd < 0:
-                self.homeless.append(o)
-            else:
-                self.in_flight[o.tid] = o
-                self._send_op(o)
+            key = self._obj_key(o)
+            if key is not None and key in self._obj_active:
+                # an earlier op on this object is still outstanding:
+                # hold ours back so completions stay in order
+                self._obj_wait.setdefault(key, []).append(o)
+                return fut
+            if key is not None:
+                self._obj_active[key] = o.tid
+            self._launch(o)
         return fut
+
+    @staticmethod
+    def _obj_key(op: _Op):
+        return (op.pool, op.oid) if op.oid else None
+
+    def _launch(self, o: _Op) -> None:
+        self._calc_target(o)
+        if o.target_osd < 0:
+            self.homeless.append(o)
+        else:
+            self.in_flight[o.tid] = o
+            self._send_op(o)
+
+    def _complete_op(self, op: _Op, reply: OSDOpReply) -> None:
+        """Complete + release the object's next queued op (lock held).
+        Drains with a loop: a recursive single step strands waiters
+        behind an op that completes without ever becoming active
+        (e.g. ENOENT on a deleted pool)."""
+        op.future._complete(reply)
+        key = self._obj_key(op)
+        if key is None or self._obj_active.get(key) != op.tid:
+            return
+        del self._obj_active[key]
+        q = self._obj_wait.get(key, [])
+        while q:
+            nxt = q.pop(0)
+            if self.osdmap.epoch > 0 and \
+                    nxt.pool not in self.osdmap.pools:
+                nxt.future._complete(OSDOpReply(
+                    tid=nxt.tid, result=-2, errno_name="ENOENT"))
+                continue
+            self._obj_active[key] = nxt.tid
+            self._launch(nxt)
+            break
+        if not q:
+            self._obj_wait.pop(key, None)
 
     def _send_op(self, op: _Op) -> None:
         op.attempts += 1
@@ -269,7 +314,7 @@ class Objecter(Dispatcher, MonHunter):
                 self._schedule_rescan()
                 return
             del self.in_flight[op.tid]
-        op.future._complete(msg)
+            self._complete_op(op, msg)
 
     def _schedule_rescan(self, delay: float = 0.05) -> None:
         """Periodic retry for parked ops (the reference's tick_event)."""

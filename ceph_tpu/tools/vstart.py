@@ -34,11 +34,11 @@ from ..testing.cluster import MiniCluster
 
 class VstartShell:
     def __init__(self, n_osd: int = 4, osds_per_host: int = 1,
-                 out=sys.stdout):
+                 out=sys.stdout, n_mon: int = 1):
         self.out = out
         self.cluster = MiniCluster(n_osd=n_osd,
                                    osds_per_host=osds_per_host,
-                                   threaded=True)
+                                   threaded=True, n_mon=n_mon)
         self.cluster.wait_all_up()
         self.rados = self.cluster.rados()
         self.mgr = self.cluster.start_mgr()
@@ -213,10 +213,12 @@ def main(argv=None) -> int:
         prog="vstart", description="in-process cluster + ceph-style CLI")
     ap.add_argument("--osds", type=int, default=4)
     ap.add_argument("--osds-per-host", type=int, default=1)
+    ap.add_argument("--mons", type=int, default=1,
+                    help="monitor quorum size")
     ap.add_argument("-c", "--command", action="append", default=[],
                     help="run command and continue (repeatable)")
     args = ap.parse_args(argv)
-    sh = VstartShell(args.osds, args.osds_per_host)
+    sh = VstartShell(args.osds, args.osds_per_host, n_mon=args.mons)
     try:
         for cmd in args.command:
             if not sh.run_line(cmd):

@@ -149,6 +149,52 @@ def test_resend_on_primary_change(cluster):
     r.objecter.wait_for_map(r.objecter.osdmap.epoch)
 
 
+def test_per_object_write_ordering_across_retries():
+    """librados semantics: a parked-then-retried older write must not
+    land after (and silently beat) a newer acked write to the same
+    object — ops on one object complete in submission order."""
+    c = MiniCluster(n_osd=4, threaded=False)
+    try:
+        c.pump()
+        c.wait_all_up()
+        r = c.rados()
+        r.pool_create("p", pg_num=8)
+        c.pump()
+        io = r.open_ioctx("p")
+        io.write_full("ord", b"v0")
+        c.pump()
+        # take the primary down at the mon but freeze the client's map
+        # so write A targets the dead primary and parks
+        pid = r.pool_lookup("p")
+        m = r.objecter.osdmap
+        raw = m.object_locator_to_pg("ord", pid)
+        _, _, _, primary = m.pg_to_up_acting_osds(raw)
+        from ceph_tpu.msg.messages import MMap, MMonSubscribe
+        c.network.filter = lambda src, dst, msg: not (
+            dst == r.objecter.name and isinstance(msg, MMap))
+        c.kill_osd(primary)
+        fa = io.aio_write_full("ord", b"A" * 100)   # parks (dead target)
+        fb = io.aio_write_full("ord", b"B" * 100)   # must wait behind A
+        c.pump()
+        assert not fa.done() and not fb.done()
+        c.mon.handle_command({"prefix": "osd down", "ids": [primary]})
+        c.network.filter = None
+        r.objecter.ms.connect(r.objecter.mon).send_message(
+            MMonSubscribe(start=1))
+        import time
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and not (
+                fa.done() and fb.done()):
+            c.pump()
+            time.sleep(0.02)
+        assert fa.done() and fb.done()
+        assert fa.result == 0 and fb.result == 0
+        # B (submitted last) is the surviving content
+        assert io.read("ord") == b"B" * 100
+    finally:
+        c.shutdown()
+
+
 def test_killed_target_no_recursion_and_recovers():
     """Sending to a hard-killed OSD triggers ms_handle_reset inside the
     send; the op must park (no recursive resends) and complete once the

@@ -54,7 +54,8 @@ class OpFuture:
 class _Op:
     def __init__(self, tid: int, pool: int, oid: str, op: str,
                  offset: int, length: int, data: bytes,
-                 future: OpFuture, pg_ps: Optional[int] = None):
+                 future: OpFuture, pg_ps: Optional[int] = None,
+                 args: Optional[dict] = None):
         self.tid = tid
         self.pool = pool
         self.oid = oid
@@ -62,6 +63,7 @@ class _Op:
         self.offset = offset
         self.length = length
         self.data = data
+        self.args = args or {}
         self.future = future
         self.pg_ps = pg_ps        # PG-addressed op (pgls)
         self.pg: Optional[PG] = None
@@ -185,7 +187,15 @@ class Objecter(Dispatcher, MonHunter):
 
     def _scan_requests(self) -> None:
         """Recompute targets; resend what moved; adopt the homeless
-        (ref: Objecter.cc:1182 handle_osd_map -> _scan_requests)."""
+        (ref: Objecter.cc:1182 handle_osd_map -> _scan_requests).
+
+        The homeless list is swapped out BEFORE the drain: a resend
+        whose target is gone fails synchronously through
+        ms_handle_reset, which re-parks the op onto self.homeless — if
+        the drain iterated self.homeless directly it would pick the op
+        straight back up and spin forever (resend -> reset -> re-park
+        -> resend ...) while holding the lock, livelocking every other
+        thread.  Parked ops wait for the rescan timer instead."""
         for op in list(self.in_flight.values()):
             old = op.target_osd
             self._calc_target(op)
@@ -195,8 +205,8 @@ class Objecter(Dispatcher, MonHunter):
                     self.homeless.append(op)
                 else:
                     self._send_op(op)
-        still_homeless = []
-        for op in self.homeless:
+        pending, self.homeless = self.homeless, []
+        for op in pending:
             if op.pool not in self.osdmap.pools:
                 # pool deleted while the op was parked
                 self._complete_op(op, OSDOpReply(
@@ -207,8 +217,7 @@ class Objecter(Dispatcher, MonHunter):
                 self.in_flight[op.tid] = op
                 self._send_op(op)
             else:
-                still_homeless.append(op)
-        self.homeless = still_homeless
+                self.homeless.append(op)
 
     # ------------------------------------------------------ target calc
     def _calc_target(self, op: _Op) -> None:
@@ -232,11 +241,12 @@ class Objecter(Dispatcher, MonHunter):
     # -------------------------------------------------------- op submit
     def submit(self, pool: int, oid: str, op: str, offset: int = 0,
                length: int = 0, data: bytes = b"",
-               pg_ps: Optional[int] = None) -> OpFuture:
+               pg_ps: Optional[int] = None,
+               args: Optional[dict] = None) -> OpFuture:
         """(ref: Objecter.cc:2378 _op_submit)."""
         fut = OpFuture()
         o = _Op(next(self._tid), pool, oid, op, offset, length, data,
-                fut, pg_ps=pg_ps)
+                fut, pg_ps=pg_ps, args=args)
         with self._lock:
             if self.osdmap.epoch > 0 and pool not in self.osdmap.pools:
                 # pool does not exist in the current map: fail fast
@@ -297,7 +307,7 @@ class Objecter(Dispatcher, MonHunter):
         self.ms.connect(f"osd.{op.target_osd}").send_message(OSDOp(
             pgid=op.pg, oid=op.oid, op=op.op, tid=op.tid,
             epoch=self.osdmap.epoch, offset=op.offset,
-            length=op.length, data=op.data))
+            length=op.length, data=op.data, args=op.args))
 
     def _handle_reply(self, msg: OSDOpReply) -> None:
         with self._lock:

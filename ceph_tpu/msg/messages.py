@@ -64,13 +64,13 @@ class ECSubReadReply(Message):
 class RepOpWrite(Message):
     """Replica write fan-out for replicated pools
     (ref: src/messages/MOSDRepOp.h; ReplicatedBackend.cc
-    issue_op/sub_op_modify)."""
+    issue_op/sub_op_modify).  Carries the client op's mutation vector
+    (see osd/mutations.py) — the analogue of MOSDRepOp's serialized
+    ObjectStore::Transaction payload."""
     pgid: Any = None
     tid: int = 0
     oid: str = ""
-    offset: int = 0
-    data: bytes = b""
-    delete: bool = False
+    mutations: list = field(default_factory=list)
     version: Any = None
     log_entries: list = field(default_factory=list)
 
@@ -136,7 +136,8 @@ class ScrubMapReply(Message):
 @dataclass
 class PGPush(Message):
     """Full-object push (recovery/backfill payload,
-    ref: src/messages/MOSDPGPush.h)."""
+    ref: src/messages/MOSDPGPush.h — PushOp carries data, attrs and
+    omap entries; ReplicatedBackend::build_push_op)."""
     pgid: Any = None
     oid: str = ""
     data: bytes = b""
@@ -144,6 +145,9 @@ class PGPush(Message):
     version: Any = None
     whiteout: bool = False     # delete tombstone push
     force: bool = False        # scrub repair: overwrite same-version
+    attrs: dict = field(default_factory=dict)    # user xattrs
+    omap: dict = field(default_factory=dict)
+    omap_hdr: bytes = b""
 
 
 # ---------------------------------------------------------------- client
@@ -152,7 +156,10 @@ class PGPush(Message):
 @dataclass
 class OSDOp(Message):
     """Client op to the primary (ref: src/messages/MOSDOp.h).
-    op: 'write'|'read'|'delete'|'stat' with args."""
+    op names the sub-op (write/read/setxattr/omap_setkeys/...);
+    `args` carries op-specific parameters the way MOSDOp's osd_op
+    vector carries per-op payloads (src/include/rados.h
+    CEPH_OSD_OP_*)."""
     pgid: Any = None
     oid: str = ""
     op: str = ""
@@ -161,6 +168,7 @@ class OSDOp(Message):
     offset: int = 0
     length: int = 0
     data: bytes = b""
+    args: dict = field(default_factory=dict)
 
 
 @dataclass

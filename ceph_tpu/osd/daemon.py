@@ -30,6 +30,8 @@ from ..msg.messages import (ECSubRead, ECSubReadReply, ECSubWrite,
 from ..msg.mon_client import MonHunter
 from ..msg.messenger import Dispatcher, LocalNetwork, Message, Messenger
 from ..store import MemStore, StoreError
+from . import mutations as mut
+from .mutations import MutationError
 from .ec_backend import ECBackend, ECPGShard
 from .osdmap import OSDMap
 from .pg_types import EVersion
@@ -37,6 +39,11 @@ from .replicated_backend import ReplicatedBackend, ReplicatedPGShard
 from .types import PG, POOL_TYPE_ERASURE
 from ..crush.types import CRUSH_ITEM_NONE
 from ..mon.osd_monitor import DEFAULT_EC_PROFILE
+
+#: errno-name -> numeric result for client replies (ref: the rc values
+#: MOSDOpReply carries; errno(3))
+_ERRNO = {"ENOENT": -2, "EIO": -5, "EEXIST": -17, "EINVAL": -22,
+          "ENODATA": -61, "EOPNOTSUPP": -95, "ESTALE": -116}
 
 
 class _PGState:
@@ -228,10 +235,11 @@ class OSDDaemon(Dispatcher, MonHunter):
             for oid in msg.oids:
                 if not shard.exists(oid):
                     continue
-                data = shard.read(oid)
+                data, attrs, omap, hdr = shard.push_payload(oid)
                 self.ms.connect(msg.src).send_message(PGPush(
                     pgid=msg.pgid, oid=oid, data=data, size=len(data),
-                    version=shard.object_version(oid)))
+                    version=shard.object_version(oid),
+                    attrs=attrs, omap=omap, omap_hdr=hdr))
             return True
         if isinstance(msg, PGPush):
             self._handle_push(msg)
@@ -564,7 +572,9 @@ class OSDDaemon(Dispatcher, MonHunter):
 
     def _apply_push(self, shard: ReplicatedPGShard, oid: str,
                     data: bytes, version, whiteout: bool,
-                    force: bool = False) -> None:
+                    force: bool = False, attrs: dict | None = None,
+                    omap: dict | None = None,
+                    omap_hdr: bytes = b"") -> None:
         """Full-object overwrite, but never let an older version clobber
         newer local data (pushes can race regular writes).  `force`
         (scrub repair) overwrites a same-version corrupted copy."""
@@ -578,8 +588,17 @@ class OSDDaemon(Dispatcher, MonHunter):
             shard.apply_write(oid, 0, b"", True, EVersion(*ver), [])
             return
         if inv is not None:
+            # whiteout first: apply_mutations then recreates from a
+            # clean slate, dropping any stale attrs/omap of the old copy
             shard.apply_write(oid, 0, b"", True, None, [])
-        shard.apply_write(oid, 0, data, False, EVersion(*ver), [])
+        muts: list[tuple] = [(mut.M_WRITEFULL, data)]
+        if attrs:
+            muts.append((mut.M_SETXATTRS, attrs))
+        if omap:
+            muts.append((mut.M_OMAP_SETKEYS, omap))
+        if omap_hdr:
+            muts.append((mut.M_OMAP_SETHEADER, omap_hdr))
+        shard.apply_mutations(oid, muts, EVersion(*ver), [])
 
     def _handle_push(self, msg: PGPush) -> None:
         st = self.pgs.get(msg.pgid)
@@ -588,7 +607,9 @@ class OSDDaemon(Dispatcher, MonHunter):
             # into the store (it would be reported by a later scan)
             return
         self._apply_push(st.shard, msg.oid, msg.data, msg.version,
-                         msg.whiteout, force=msg.force)
+                         msg.whiteout, force=msg.force,
+                         attrs=msg.attrs, omap=msg.omap,
+                         omap_hdr=msg.omap_hdr)
         if st.recovering and msg.oid in st.pull_pending:
             st.pull_pending.discard(msg.oid)
             if not st.pull_pending and not st.scan_pending:
@@ -606,12 +627,16 @@ class OSDDaemon(Dispatcher, MonHunter):
                     stale.setdefault(oid, []).append(osd)
         for oid, osds in stale.items():
             my_ver, whiteout = mine[oid]
-            data = b"" if whiteout else st.shard.read(oid)
+            if whiteout:
+                data, attrs, omap, hdr = b"", {}, {}, b""
+            else:
+                data, attrs, omap, hdr = st.shard.push_payload(oid)
             for osd in osds:
                 self.perf.inc("recovery_push")
                 self.ms.connect(f"osd.{osd}").send_message(PGPush(
                     pgid=pg, oid=oid, data=data, size=len(data),
-                    version=my_ver, whiteout=whiteout))
+                    version=my_ver, whiteout=whiteout,
+                    attrs=attrs, omap=omap, omap_hdr=hdr))
         st.recovering = False
         dout("osd", 10).write("%s: pg %s recovered", self.name, pg)
 
@@ -679,6 +704,8 @@ class OSDDaemon(Dispatcher, MonHunter):
     def _copies_match(a: dict, b: dict) -> bool:
         return (a["version"] == b["version"] and a["size"] == b["size"]
                 and a["crc"] == b["crc"]
+                and a.get("attrs_crc") == b.get("attrs_crc")
+                and a.get("omap_crc") == b.get("omap_crc")
                 and a["whiteout"] == b["whiteout"] and b["ok"])
 
     def _scrub_compare_replicated(self, pg: PG, st: _PGState) -> None:
@@ -713,14 +740,15 @@ class OSDDaemon(Dispatcher, MonHunter):
                 continue
             ver = tuple(auth["version"])
             if auth["whiteout"]:
-                data = b""
+                data, attrs, omap, hdr = b"", {}, {}, b""
             else:
-                data = st.shard.read(oid)
+                data, attrs, omap, hdr = st.shard.push_payload(oid)
             for osd in bad:
                 self.ms.connect(f"osd.{osd}").send_message(PGPush(
                     pgid=pg, oid=oid, data=data, size=len(data),
                     version=ver, whiteout=auth["whiteout"],
-                    force=True))
+                    force=True, attrs=attrs, omap=omap,
+                    omap_hdr=hdr))
             sc.repaired += 1    # per object, matching the EC path
 
     def _scrub_compare_ec(self, pg: PG, st: _PGState) -> None:
@@ -738,12 +766,26 @@ class OSDDaemon(Dispatcher, MonHunter):
             auth_whiteout = any(
                 e.get("whiteout") for e in healthy
                 if tuple(e.get("version", (0, 0))) == auth_ver)
+            # majority user-xattr digest among healthy current shards
+            # (attrs are replicated on every shard, so a divergent
+            # digest marks that shard inconsistent)
+            attr_counts: dict = {}
+            for e in healthy:
+                if tuple(e.get("version", (0, 0))) == auth_ver and \
+                        e.get("attrs_crc") is not None:
+                    attr_counts[e["attrs_crc"]] = \
+                        attr_counts.get(e["attrs_crc"], 0) + 1
+            auth_attrs = max(attr_counts, key=attr_counts.get) \
+                if attr_counts else None
             bad_shards = []
             for osd, m in sc.maps.items():
                 e = m.get(oid)
                 if e is None or not e["ok"] or \
                         tuple(e.get("version", (0, 0))) < auth_ver or \
-                        bool(e.get("whiteout")) != auth_whiteout:
+                        bool(e.get("whiteout")) != auth_whiteout or \
+                        (auth_attrs is not None and not auth_whiteout
+                         and e.get("attrs_crc") is not None
+                         and e["attrs_crc"] != auth_attrs):
                     bad_shards.append(osd_to_shard[osd])
             if not bad_shards:
                 continue
@@ -894,47 +936,23 @@ class OSDDaemon(Dispatcher, MonHunter):
             self._reply(msg, -1, "ESTALE")
             return
         self.perf.inc("op")
-        if msg.op in ("write", "write_full"):
-            self.perf.inc("op_w")
-            self.perf.inc("op_w_bytes", len(msg.data))
-        elif msg.op == "read":
+        if msg.op == "read":
             self.perf.inc("op_r")
         b = st.backend
         try:
-            # failed writes answer ESTALE, not EIO: a fan-out that lost
-            # a shard mid-map-change may be partially applied, and the
-            # client's retry against the re-peered acting set is the
-            # converging behavior (the reference requeues such ops on
-            # the PG through peering instead)
-            if msg.op == "write":
+            muts = self._op_to_mutations(st, msg)
+            if muts is not None:
+                self.perf.inc("op_w")
+                self.perf.inc("op_w_bytes", mut.mutation_bytes(muts))
+                # failed writes answer ESTALE, not EIO: a fan-out that
+                # lost a shard mid-map-change may be partially applied,
+                # and the client's retry against the re-peered acting
+                # set is the converging behavior (the reference
+                # requeues such ops on the PG through peering instead)
                 b.submit_transaction(
-                    msg.oid, msg.offset, msg.data,
+                    msg.oid, muts,
                     lambda ok, m=msg: self._reply(
                         m, 0 if ok else -116, "" if ok else "ESTALE"))
-            elif msg.op == "write_full":
-                # delete-then-write through the ordered pipeline so a
-                # longer prior object leaves no tail
-                def after_delete(_ok, m=msg):
-                    b.submit_transaction(
-                        m.oid, 0, m.data,
-                        lambda ok2, m2=m: self._reply(
-                            m2, 0 if ok2 else -116,
-                            "" if ok2 else "ESTALE"))
-                if self._object_exists(st, msg.oid):
-                    b.submit_transaction(msg.oid, 0, b"", after_delete,
-                                         delete=True)
-                else:
-                    after_delete(True)
-            elif msg.op == "delete":
-                if b.object_size(msg.oid) == 0 and not \
-                        self._object_exists(st, msg.oid):
-                    self._reply(msg, -2, "ENOENT")
-                    return
-                b.submit_transaction(
-                    msg.oid, 0, b"",
-                    lambda ok, m=msg: self._reply(
-                        m, 0 if ok else -116, "" if ok else "ESTALE"),
-                    delete=True)
             elif msg.op == "read":
                 self._do_read(st, msg)
             elif msg.op == "stat":
@@ -943,6 +961,10 @@ class OSDDaemon(Dispatcher, MonHunter):
                     return
                 self._reply(msg, 0,
                             attrs={"size": b.object_size(msg.oid)})
+            elif msg.op in ("getxattr", "getxattrs", "omap_get_vals",
+                            "omap_get_keys", "omap_get_vals_by_keys",
+                            "omap_get_header"):
+                self._do_meta_read(st, msg)
             elif msg.op == "pgls":
                 # PG object listing (ref: MOSDOp CEPH_OSD_OP_PGLS /
                 # PrimaryLogPG::do_pg_op)
@@ -953,8 +975,91 @@ class OSDDaemon(Dispatcher, MonHunter):
                                   repair=msg.op == "scrub-repair")
             else:
                 self._reply(msg, -22, "EINVAL")
+        except MutationError as err:
+            self._reply(msg, _ERRNO.get(err.errno_name, -22),
+                        err.errno_name)
         except StoreError as err:
-            self._reply(msg, -5, err.errno_name)
+            self._reply(msg, _ERRNO.get(err.errno_name, -5),
+                        err.errno_name)
+
+    def _op_to_mutations(self, st: _PGState, msg: OSDOp):
+        """Translate a client op into its mutation vector, or None for
+        non-mutating ops (ref: PrimaryLogPG::do_osd_ops's op switch).
+        Raises MutationError/StoreError for precondition failures."""
+        op = msg.op
+        a = msg.args or {}
+        if op == "write":
+            muts = [(mut.M_WRITE, msg.offset, msg.data)]
+        elif op == "write_full":
+            muts = [(mut.M_WRITEFULL, msg.data)]
+        elif op == "append":
+            muts = [(mut.M_APPEND, msg.data)]
+        elif op == "truncate":
+            muts = [(mut.M_TRUNCATE, int(a.get("size", msg.offset)))]
+        elif op == "zero":
+            muts = [(mut.M_ZERO, msg.offset, msg.length)]
+        elif op == "delete":
+            if not self._object_exists(st, msg.oid):
+                raise StoreError("ENOENT", msg.oid)
+            muts = [(mut.M_DELETE,)]
+        elif op == "create":
+            if a.get("exclusive") and self._object_exists(st, msg.oid):
+                raise StoreError("EEXIST", msg.oid)
+            muts = [(mut.M_CREATE,)]
+        elif op == "setxattr":
+            muts = [(mut.M_SETXATTRS, {a["name"]: a["value"]})]
+        elif op == "rmxattr":
+            # ENODATA when absent (ref: PrimaryLogPG CEPH_OSD_OP_RMXATTR)
+            st.shard.getxattr(msg.oid, a["name"])
+            muts = [(mut.M_RMXATTR, a["name"])]
+        elif op == "omap_setkeys":
+            muts = [(mut.M_OMAP_SETKEYS, dict(a["kv"]))]
+        elif op == "omap_rmkeys":
+            muts = [(mut.M_OMAP_RMKEYS, list(a["keys"]))]
+        elif op == "omap_clear":
+            muts = [(mut.M_OMAP_CLEAR,)]
+        elif op == "omap_set_header":
+            muts = [(mut.M_OMAP_SETHEADER, a["data"])]
+        elif op == "writev":
+            # atomic compound mutation vector (ObjectWriteOperation)
+            muts = [tuple(m) for m in a["ops"]]
+        else:
+            return None
+        return mut.validate(muts, ec_pool=isinstance(st.shard,
+                                                     ECPGShard))
+
+    def _do_meta_read(self, st: _PGState, msg: OSDOp) -> None:
+        """xattr/omap reads served from the primary's local shard
+        (attrs are on every EC shard; omap is replicated-only)."""
+        shard, a = st.shard, msg.args or {}
+        ec = isinstance(shard, ECPGShard)
+        if msg.op == "getxattr":
+            self._reply(msg, 0, attrs={"value": shard.getxattr(
+                msg.oid, a["name"])})
+        elif msg.op == "getxattrs":
+            self._reply(msg, 0, attrs={"xattrs": shard.getxattrs(
+                msg.oid)})
+        elif ec:
+            raise MutationError(
+                "EOPNOTSUPP", "erasure-coded pools do not support omap")
+        elif msg.op == "omap_get_header":
+            self._reply(msg, 0,
+                        attrs={"header": shard.omap_get_header(msg.oid)})
+        elif msg.op == "omap_get_vals_by_keys":
+            vals = shard.omap_get(msg.oid)
+            self._reply(msg, 0, attrs={"vals": {
+                k: vals[k] for k in a.get("keys", []) if k in vals}})
+        else:       # omap_get_vals / omap_get_keys with pagination
+            vals = shard.omap_get(msg.oid)
+            after = a.get("after", "")
+            maxn = int(a.get("max", 1 << 30))
+            keys = sorted(k for k in vals if k > after)
+            page, more = keys[:maxn], len(keys) > maxn
+            if msg.op == "omap_get_keys":
+                self._reply(msg, 0, attrs={"keys": page, "more": more})
+            else:
+                self._reply(msg, 0, attrs={
+                    "vals": {k: vals[k] for k in page}, "more": more})
 
     def _object_exists(self, st: _PGState, oid: str) -> bool:
         return st.shard.exists(oid)

@@ -34,6 +34,7 @@ from ..msg.messages import (ECSubRead, ECSubReadReply, ECSubWrite,
                             ECSubWriteReply)
 from ..store import ObjectId, StoreError, Transaction
 from . import ecutil
+from . import mutations as mut
 from .ecutil import HashInfo, StripeInfo
 from .pg_log import PGLog
 from .pg_types import (DELETE, EVersion, MODIFY, PGLogEntry, PGMissing,
@@ -166,6 +167,20 @@ class ECPGShard:
         except StoreError:
             return None
 
+    # -- metadata reads (user xattrs are replicated on every shard, so
+    #    the primary's local shard serves them) ------------------------
+    def getxattrs(self, oid: str) -> dict[str, bytes]:
+        soid = ObjectId(oid, shard=self.shard)
+        if not self.exists(oid):
+            raise StoreError("ENOENT", oid)
+        return mut.user_xattrs(self.store.getattrs(self.cid, soid))
+
+    def getxattr(self, oid: str, name: str) -> bytes:
+        xattrs = self.getxattrs(oid)
+        if name not in xattrs:
+            raise StoreError("ENODATA", f"{oid} xattr {name}")
+        return xattrs[name]
+
     def object_size(self, oid: str) -> int:
         """Logical object size from the oi xattr."""
         soid = ObjectId(oid, shard=self.shard)
@@ -230,6 +245,8 @@ class ECPGShard:
                     entry["ok"] = (
                         hd.get_total_chunk_size() == len(buf) and
                         crc == hd.get_chunk_hash(self.shard))
+                entry["attrs_crc"] = mut.meta_digest(mut.user_xattrs(
+                    self.store.getattrs(self.cid, soid)))
             out[oid] = entry
         return out
 
@@ -239,15 +256,22 @@ class ECPGShard:
 
 @dataclass
 class _Write:
-    """One RMW pipeline op (ref: ECBackend.h Op)."""
+    """One RMW pipeline op (ref: ECBackend.h Op).
+
+    The client's mutation vector is classified when the op leaves
+    waiting_state (all earlier same-object ops committed, so sizes are
+    stable): `effect` holds the single data effect as
+    ("write", off, data) / ("truncate", size) / ("full", data) / None
+    (metadata-only); meta mutations ride along into every shard txn."""
     tid: int
     oid: str
-    offset: int
-    data: bytes
+    mutations: list
     delete: bool
     version: EVersion
     on_all_commit: Callable
     # pipeline state
+    effect: Optional[tuple] = None
+    meta: list = field(default_factory=list)
     reads_needed: Optional[tuple[int, int]] = None   # logical (off,len)
     reads_ready: bool = False    # RMW reads landed (or none needed)
     read_error: bool = False
@@ -372,9 +396,8 @@ class ECBackend:
     # write path (ref: ECBackend.cc:1479 submit_transaction,
     #             :1832 start_rmw, :2138 check_ops)
     # ==================================================================
-    def submit_transaction(self, oid: str, offset: int, data: bytes,
-                           on_all_commit: Callable,
-                           delete: bool = False) -> int:
+    def submit_transaction(self, oid: str, muts: list,
+                           on_all_commit: Callable) -> int:
         with self._lock:
             tid = self._next_tid()
             # a write against an object the primary shard is missing
@@ -386,7 +409,8 @@ class ECBackend:
             if pm is not None and pm.is_missing(oid):
                 on_all_commit(False)
                 return tid
-            op = _Write(tid=tid, oid=oid, offset=offset, data=data,
+            delete = mut.is_delete(muts)
+            op = _Write(tid=tid, oid=oid, mutations=list(muts),
                         delete=delete, version=self._next_version(),
                         on_all_commit=on_all_commit)
             op.log_entry = PGLogEntry(
@@ -443,6 +467,7 @@ class ECBackend:
         if op.delete:
             op.reads_ready = True
             return True
+        self._classify(op)
         plan = self._write_plan(op)
         if plan is None:
             op.reads_ready = True         # aligned append: no reads
@@ -454,6 +479,38 @@ class ECBackend:
             lambda results, errors, op=op: self._rmw_reads_done(
                 op, results, errors))
         return True
+
+    def _classify(self, op: _Write) -> None:
+        """Resolve the mutation vector against the now-stable object
+        size into one data effect + the metadata tail
+        (ref: ECTransaction::get_write_plan derives the same per-op
+        extent plan)."""
+        op.meta = mut.meta_mutations(op.mutations)
+        op.effect = None
+        size = self.object_size(op.oid)
+        for m in mut.data_mutations(op.mutations):
+            kind = m[0]
+            if kind == mut.M_WRITE:
+                op.effect = ("write", m[1], m[2])
+            elif kind == mut.M_APPEND:
+                op.effect = ("write", size, m[1])
+            elif kind == mut.M_WRITEFULL:
+                op.effect = ("full", m[1])
+            elif kind == mut.M_ZERO:
+                off, length = m[1], m[2]
+                end = min(off + length, size)
+                if end > off:       # zero never extends (librados)
+                    op.effect = ("write", off, b"\0" * (end - off))
+            elif kind == mut.M_TRUNCATE:
+                t = m[1]
+                if t == size:
+                    op.effect = None
+                elif t > size:
+                    # extending truncate materializes the zero tail so
+                    # reconstructing reads see real chunks
+                    op.effect = ("write", size, b"\0" * (t - size))
+                else:
+                    op.effect = ("truncate", t)
 
     def _try_reads_to_commit(self) -> bool:
         """Commit ONLY the front of waiting_reads once its reads are in
@@ -472,14 +529,22 @@ class ECBackend:
         return progressed
 
     def _write_plan(self, op: _Write) -> Optional[tuple[int, int]]:
-        """Which logical range must be read before this write can be
+        """Which logical range must be read before this op can be
         encoded (ref: ECTransaction.h get_write_plan: the stripes the
         write only partially overwrites).  None = no RMW read."""
+        if op.effect is None or op.effect[0] == "full":
+            return None                  # metadata-only / full replace
         old_size = self.object_size(op.oid)
         if old_size == 0:
             return None
+        if op.effect[0] == "truncate":
+            # keep the partial tail stripe's surviving bytes
+            t = op.effect[1]
+            start = self.sinfo.logical_to_prev_stripe_offset(t)
+            return None if t == start else (start, t - start)
+        _, offset, data = op.effect
         start, length = self.sinfo.offset_len_to_stripe_bounds(
-            (op.offset, max(len(op.data), 1)))
+            (offset, max(len(data), 1)))
         old_aligned = self.sinfo.logical_to_next_stripe_offset(old_size)
         read_start = start
         read_end = min(start + length, old_aligned)
@@ -488,7 +553,7 @@ class ECBackend:
         # full-stripe overwrite of existing stripes still merges with
         # nothing — skip the read when the write covers those stripes
         # entirely
-        w_start, w_end = op.offset, op.offset + len(op.data)
+        w_start, w_end = offset, offset + len(data)
         if w_start <= read_start and w_end >= read_end:
             return None
         return (read_start, read_end - read_start)
@@ -520,6 +585,8 @@ class ECBackend:
                 for s in self._alive_shards()}
             new_size = 0
             shards = {}
+        elif op.effect is None:
+            shard_txns = self._meta_txns(op)
         else:
             shards, shard_txns, new_size = self._encode_write(op)
         op.pending_shards = set(shard_txns)
@@ -535,20 +602,72 @@ class ECBackend:
                     op.pending_shards.discard(s)
         self._maybe_commit_done(op)
 
+    def _apply_meta(self, txn: Transaction, cid: str, soid,
+                    metas: list) -> None:
+        """Apply the metadata tail of a mutation vector to one shard's
+        txn.  User xattrs live on EVERY shard (the reference stores
+        attrs with each shard object — ECTransaction::generate_
+        transactions setattrs fan out identically)."""
+        for m in metas:
+            if m[0] == mut.M_SETXATTRS:
+                txn.setattrs(cid, soid, {mut.uxattr_key(k): bytes(v)
+                                         for k, v in m[1].items()})
+            elif m[0] == mut.M_RMXATTR:
+                txn.rmattr(cid, soid, mut.uxattr_key(m[1]))
+            # M_CREATE: the leading touch creates the shard object
+
+    def _meta_txns(self, op: _Write) -> dict[int, Transaction]:
+        """Metadata-only transaction: no encode, per-shard attr
+        updates + version bump."""
+        cid = pg_cid(self.pgid)
+        size = self.object_size(op.oid)
+        existed = self.local_shard.exists(op.oid)
+        txns = {}
+        for s in self._alive_shards():
+            soid = ObjectId(op.oid, shard=s)
+            txn = Transaction().touch(cid, soid)
+            self._apply_meta(txn, cid, soid, op.meta)
+            attrs = {OI_ATTR: {"size": size,
+                               "version": (op.version.epoch,
+                                           op.version.version)}}
+            if not existed:
+                attrs[HINFO_ATTR] = HashInfo(self.k + self.m).to_dict()
+            txn.setattrs(cid, soid, attrs)
+            txns[s] = txn
+        return txns
+
     def _encode_write(self, op: _Write):
         """Merge old+new logical bytes, batch-encode, build shard txns."""
         sinfo = self.sinfo
         old_size = self.object_size(op.oid)
-        start, length = sinfo.offset_len_to_stripe_bounds(
-            (op.offset, max(len(op.data), 1)))
+        kind = op.effect[0]
+        if kind == "full":
+            data = op.effect[1]
+            offset, start = 0, 0
+            length = sinfo.logical_to_next_stripe_offset(len(data))
+            new_size = len(data)
+        elif kind == "truncate":
+            t = op.effect[1]
+            start = sinfo.logical_to_prev_stripe_offset(t)
+            offset, data = start, b""
+            length = sinfo.logical_to_next_stripe_offset(t) - start
+            new_size = t
+        else:
+            _, offset, data = op.effect
+            start, length = sinfo.offset_len_to_stripe_bounds(
+                (offset, max(len(data), 1)))
+            new_size = max(old_size, offset + len(data))
         seg = bytearray(length)
         if op.old_segment:
             seg[:len(op.old_segment)] = op.old_segment
-        rel = op.offset - start
-        seg[rel:rel + len(op.data)] = op.data
+        if kind == "truncate":
+            # drop everything past the new end within the tail stripe
+            seg = seg[:op.effect[1] - start]
+            seg += b"\0" * (-len(seg) % sinfo.stripe_width)
+        rel = offset - start
+        seg[rel:rel + len(data)] = data
         shards = ecutil.encode(sinfo, self.ec, bytes(seg))
         chunk_off = sinfo.aligned_logical_offset_to_chunk_offset(start)
-        new_size = max(old_size, op.offset + len(op.data))
         cid = pg_cid(self.pgid)
 
         # cumulative hinfo only survives pure stripe-aligned appends:
@@ -556,24 +675,39 @@ class ECBackend:
         # object ended exactly on a stripe boundary and this write
         # begins there (ref: the reference maintains HashInfo for
         # appends; ec overwrites invalidate it)
-        is_append = start == old_size
-        old_hinfo = self.local_shard._hinfo(
+        # a full replace re-encodes the whole stream, so its hinfo is
+        # rebuilt fresh (cumulative from chunk 0) rather than invalidated
+        is_append = (start == old_size and kind == "write") \
+            or kind == "full"
+        old_hinfo = None if kind == "full" else self.local_shard._hinfo(
             ObjectId(op.oid, shard=self.local_shard.shard))
         # one hinfo for all shards (it carries every shard's hash);
         # computed once — _next_hinfo advances the cumulative state
-        hi_dict = self._next_hinfo(
-            old_hinfo, chunk_off, shards, is_append).to_dict()
+        if kind == "truncate":
+            hi = HashInfo(0)
+            hi.total_chunk_size = chunk_off + (
+                len(next(iter(shards.values()))) if shards else 0)
+            hi_dict = hi.to_dict()
+        else:
+            hi_dict = self._next_hinfo(
+                old_hinfo, chunk_off, shards, is_append).to_dict()
         txns = {}
         for s in self._alive_shards():
             soid = ObjectId(op.oid, shard=s)
             txn = Transaction()
-            txn.write(cid, soid, chunk_off, shards[s])
+            txn.touch(cid, soid)
+            if kind in ("full", "truncate"):
+                # discard shard bytes past the new chunk extent
+                txn.truncate(cid, soid, chunk_off)
+            if shards.get(s, b"") or kind == "write":
+                txn.write(cid, soid, chunk_off, shards.get(s, b""))
             txn.setattrs(cid, soid, {
                 OI_ATTR: {"size": new_size,
                           "version": (op.version.epoch,
                                       op.version.version)},
                 HINFO_ATTR: hi_dict,
             })
+            self._apply_meta(txn, cid, soid, op.meta)
             txns[s] = txn
         return shards, txns, new_size
 
@@ -781,7 +915,10 @@ class ECBackend:
                     length = max(limit - off, 0)
             end = min(off + length, limit)
             results[oid] = logical[max(off - base, 0):max(end - base, 0)]
-        rd.on_complete(results, errors)
+        if rd.want_attrs:
+            rd.on_complete(results, errors, rd.shard_attrs)
+        else:
+            rd.on_complete(results, errors)
 
     def _oi_size(self, rd: _Read, oid: str) -> Optional[int]:
         attrs = rd.shard_attrs.get(oid, {})
@@ -815,15 +952,30 @@ class ECBackend:
         targets = sorted(set(target_shards))
         # read enough shards (+ attrs) to rebuild the logical object
         self.objects_read_and_reconstruct(
-            {oid: None}, lambda r, e: self._recovery_reads_done(
-                oid, targets, r, e, on_done, version),
+            {oid: None}, lambda r, e, a=None: self._recovery_reads_done(
+                oid, targets, r, e, on_done, version, a),
             for_recovery=True, want_attrs=True)
 
     def _recovery_reads_done(self, oid: str, targets, results, errors,
-                             on_done, version=None) -> None:
+                             on_done, version=None,
+                             shard_attrs=None) -> None:
         if errors.get(oid) or oid not in results:
             on_done(False)
             return
+        # authoritative user xattrs: from the surviving shard with the
+        # newest oi version (ties -> lowest shard index) — determinism
+        # matters when a half-applied attr update races a failure
+        user_attrs: dict = {}
+        per_shard = (shard_attrs or {}).get(oid, {})
+        best = None
+        for s in sorted(per_shard):
+            a = per_shard[s]
+            oi = a.get(OI_ATTR) or {}
+            ver = tuple(oi.get("version", (0, 0)))
+            if best is None or ver > best[0]:
+                best = (ver, mut.user_xattrs(a))
+        if best is not None:
+            user_attrs = best[1]
         with self._lock:
             logical = results[oid]
             # re-encode the full object: every shard's chunk stream
@@ -870,7 +1022,9 @@ class ECBackend:
                            OI_ATTR: {"size": size,
                                      "version": (version.epoch,
                                                  version.version)},
-                           HINFO_ATTR: hinfo.to_dict()}))
+                           HINFO_ATTR: hinfo.to_dict(),
+                           **{mut.uxattr_key(k): v
+                              for k, v in user_attrs.items()}}))
                 tid = self._next_tid()
                 msg = ECSubWrite(pgid=self.pgid, tid=tid, shard=s,
                                  txn=txn, log_entries=[])

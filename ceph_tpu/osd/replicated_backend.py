@@ -16,6 +16,7 @@ from typing import Callable
 from ..common.log import dout
 from ..msg.messages import RepOpReply, RepOpWrite
 from ..store import ObjectId, StoreError, Transaction
+from . import mutations as mut
 from .ec_backend import OI_ATTR, pg_cid
 from .pg_log import PGLog
 from .pg_types import DELETE, EVersion, MODIFY, PGLogEntry, ZERO_VERSION
@@ -38,12 +39,15 @@ class ReplicatedPGShard:
     # version (ref: the cache-tier whiteout concept, object_info flag
     # FLAG_WHITEOUT): recovery compares versions, so a delete must be
     # a versioned event or a stale replica would resurrect the object.
-    def apply_write(self, oid: str, offset: int, data: bytes,
-                    delete: bool, version, log_entries) -> bool:
+    def apply_mutations(self, oid: str, muts: list, version,
+                        log_entries) -> bool:
+        """Apply a mutation vector as one atomic store transaction
+        (the replica-side analogue of the reference's per-repop
+        ObjectStore::Transaction built by PrimaryLogPG::do_osd_ops)."""
         soid = ObjectId(oid)
         txn = Transaction()
         try:
-            if delete:
+            if mut.is_delete(muts):
                 if self.store.exists(self.cid, soid):
                     txn.remove(self.cid, soid)
                 txn.touch(self.cid, soid)
@@ -54,13 +58,13 @@ class ReplicatedPGShard:
                 if self._is_whiteout(soid):
                     txn.remove(self.cid, soid)
                     txn.touch(self.cid, soid)
-                    old = 0
+                    size = 0
                 else:
-                    old = self.object_size(oid)
-                txn.write(self.cid, soid, offset, data)
+                    size = self.object_size(oid)
+                    txn.touch(self.cid, soid)
+                size = self._build_mutation_txn(txn, soid, muts, size)
                 txn.setattr(self.cid, soid, OI_ATTR,
-                            {"size": max(old, offset + len(data)),
-                             "version": version})
+                            {"size": size, "version": version})
             if not txn.empty():
                 self.store.queue_transaction(txn)
             for e in log_entries:
@@ -72,6 +76,77 @@ class ReplicatedPGShard:
                                  self.pgid, err)
             return False
 
+    def _build_mutation_txn(self, txn: Transaction, soid: ObjectId,
+                            muts: list, size: int) -> int:
+        """Append store ops for each mutation; returns the new logical
+        size (tracked in the oi xattr like the reference's object_info_t
+        size field)."""
+        for m in muts:
+            kind = m[0]
+            if kind == mut.M_WRITE:
+                _, off, data = m
+                txn.write(self.cid, soid, off, data)
+                size = max(size, off + len(data))
+            elif kind == mut.M_WRITEFULL:
+                data = m[1]
+                txn.truncate(self.cid, soid, 0)
+                txn.write(self.cid, soid, 0, data)
+                size = len(data)
+            elif kind == mut.M_APPEND:
+                data = m[1]
+                txn.write(self.cid, soid, size, data)
+                size += len(data)
+            elif kind == mut.M_TRUNCATE:
+                newsz = m[1]
+                txn.truncate(self.cid, soid, newsz)
+                size = newsz
+            elif kind == mut.M_ZERO:
+                _, off, length = m
+                # librados zero never extends the object
+                # (ref: PrimaryLogPG CEPH_OSD_OP_ZERO: trims the range
+                # to the object size)
+                end = min(off + length, size)
+                if end > off:
+                    txn.zero(self.cid, soid, off, end - off)
+            elif kind == mut.M_CREATE:
+                pass                      # the leading touch created it
+            elif kind == mut.M_SETXATTRS:
+                txn.setattrs(self.cid, soid,
+                             {mut.uxattr_key(k): bytes(v)
+                              for k, v in m[1].items()})
+            elif kind == mut.M_RMXATTR:
+                txn.rmattr(self.cid, soid, mut.uxattr_key(m[1]))
+            elif kind == mut.M_OMAP_SETKEYS:
+                txn.omap_setkeys(self.cid, soid, m[1])
+            elif kind == mut.M_OMAP_RMKEYS:
+                txn.omap_rmkeys(self.cid, soid, m[1])
+            elif kind == mut.M_OMAP_CLEAR:
+                txn.omap_clear(self.cid, soid)
+                txn.rmattr(self.cid, soid, mut.OMAP_HEADER_ATTR)
+            elif kind == mut.M_OMAP_SETHEADER:
+                txn.setattr(self.cid, soid, mut.OMAP_HEADER_ATTR,
+                            bytes(m[1]))
+            else:
+                raise StoreError("EINVAL", f"bad mutation {kind}")
+        return size
+
+    def apply_write(self, oid: str, offset: int, data: bytes,
+                    delete: bool, version, log_entries) -> bool:
+        """Whole-object convenience used by recovery pushes."""
+        muts = [(mut.M_DELETE,)] if delete \
+            else [(mut.M_WRITE, offset, data)]
+        return self.apply_mutations(oid, muts, version, log_entries)
+
+    def push_payload(self, oid: str) -> tuple:
+        """(data, user_attrs, omap, omap_hdr) for a recovery/repair
+        push (ref: ReplicatedBackend::build_push_op gathers data,
+        attrs and omap into the PushOp)."""
+        soid = ObjectId(oid)
+        return (self.read(oid),
+                mut.user_xattrs(self.store.getattrs(self.cid, soid)),
+                dict(self.store.omap_get(self.cid, soid)),
+                self.omap_get_header(oid))
+
     def _is_whiteout(self, soid: ObjectId) -> bool:
         try:
             return bool(self.store.getattr(self.cid, soid,
@@ -80,8 +155,8 @@ class ReplicatedPGShard:
             return False
 
     def handle_rep_write(self, m: RepOpWrite, whoami: int) -> RepOpReply:
-        ok = self.apply_write(m.oid, m.offset, m.data, m.delete,
-                              m.version, m.log_entries)
+        ok = self.apply_mutations(m.oid, m.mutations, m.version,
+                                  m.log_entries)
         return RepOpReply(pgid=m.pgid, tid=m.tid, from_osd=whoami,
                           committed=ok)
 
@@ -99,6 +174,34 @@ class ReplicatedPGShard:
                                       OI_ATTR)["size"]
         except StoreError:
             return 0
+
+    # -- metadata reads (primary-local; ref: PrimaryLogPG getattr/omap
+    #    op handling reads the local object like any replicated read) --
+    def getxattrs(self, oid: str) -> dict[str, bytes]:
+        if not self.exists(oid):
+            raise StoreError("ENOENT", oid)
+        return mut.user_xattrs(self.store.getattrs(self.cid,
+                                                   ObjectId(oid)))
+
+    def getxattr(self, oid: str, name: str) -> bytes:
+        xattrs = self.getxattrs(oid)
+        if name not in xattrs:
+            raise StoreError("ENODATA", f"{oid} xattr {name}")
+        return xattrs[name]
+
+    def omap_get(self, oid: str) -> dict[str, bytes]:
+        if not self.exists(oid):
+            raise StoreError("ENOENT", oid)
+        return dict(self.store.omap_get(self.cid, ObjectId(oid)))
+
+    def omap_get_header(self, oid: str) -> bytes:
+        if not self.exists(oid):
+            raise StoreError("ENOENT", oid)
+        try:
+            return bytes(self.store.getattr(self.cid, ObjectId(oid),
+                                            mut.OMAP_HEADER_ATTR))
+        except StoreError:
+            return b""
 
     def object_version(self, oid: str) -> tuple[int, int]:
         """(epoch, version) from the oi xattr; (0,0) when unknown —
@@ -156,10 +259,22 @@ class ReplicatedPGShard:
                 out[oid] = {"version": ver, "size": -1, "crc": None,
                             "whiteout": False, "ok": False}
                 continue
-            out[oid] = {"version": ver, "size": len(data),
-                        "crc": int(crc32c(0xFFFFFFFF, data))
-                        if deep else None,
-                        "whiteout": False, "ok": True}
+            entry = {"version": ver, "size": len(data),
+                     "crc": int(crc32c(0xFFFFFFFF, data))
+                     if deep else None,
+                     "whiteout": False, "ok": True}
+            if deep:
+                # metadata digests: divergent xattrs/omap are an
+                # inconsistency too (ref: ScrubMap::object attrs +
+                # omap_digest)
+                soid = ObjectId(oid)
+                entry["attrs_crc"] = mut.meta_digest(
+                    mut.user_xattrs(self.store.getattrs(self.cid,
+                                                        soid)))
+                entry["omap_crc"] = mut.meta_digest(
+                    self.store.omap_get(self.cid, soid),
+                    self.omap_get_header(oid))
+            out[oid] = entry
         return out
 
 
@@ -208,17 +323,47 @@ class ReplicatedBackend:
                                      self.last_version.version + 1)
         return self.last_version
 
+    def _resolve_muts(self, oid: str, muts: list) -> list:
+        """Normalize size-relative mutations (append, zero-clamp)
+        against the primary's authoritative object size BEFORE the
+        replica fan-out.  A replica whose local state lags (e.g. a
+        recovery push racing this write) would otherwise resolve
+        `append` against a different size and diverge at the same
+        version — the reference avoids this the same way: the primary
+        serializes the concrete extent into the repop transaction."""
+        out = []
+        size = self.local_shard.object_size(oid)
+        for m in muts:
+            kind = m[0]
+            if kind == mut.M_APPEND:
+                m = (mut.M_WRITE, size, m[1])
+            elif kind == mut.M_ZERO:
+                end = min(m[1] + m[2], size)
+                if end <= m[1]:
+                    continue                   # nothing within bounds
+                m = (mut.M_ZERO, m[1], end - m[1])
+            if m[0] == mut.M_WRITE:
+                size = max(size, m[1] + len(m[2]))
+            elif m[0] == mut.M_WRITEFULL:
+                size = len(m[1])
+            elif m[0] == mut.M_TRUNCATE:
+                size = m[1]
+            out.append(m)
+        return out
+
     # -- writes (ref: ReplicatedBackend.cc:1069 submit_transaction) ----
-    def submit_transaction(self, oid: str, offset: int, data: bytes,
-                           on_all_commit: Callable,
-                           delete: bool = False) -> int:
+    def submit_transaction(self, oid: str, muts: list,
+                           on_all_commit: Callable) -> int:
+        """Apply a mutation vector locally then fan it out to every
+        acting replica; `on_all_commit(ok)` once all committed."""
         with self._lock:
             tid = self._next_tid()
             version = self._next_version()
-            entry = PGLogEntry(DELETE if delete else MODIFY, oid,
-                               version)
-            ok = self.local_shard.apply_write(oid, offset, data, delete,
-                                              version, [entry])
+            muts = self._resolve_muts(oid, muts)
+            entry = PGLogEntry(DELETE if mut.is_delete(muts) else MODIFY,
+                               oid, version)
+            ok = self.local_shard.apply_mutations(oid, muts, version,
+                                                  [entry])
             if not ok:
                 on_all_commit(False)
                 return tid
@@ -231,8 +376,8 @@ class ReplicatedBackend:
                            pending=set(replicas))
             self.in_flight[tid] = op
             msg = RepOpWrite(pgid=self.pgid, tid=tid, oid=oid,
-                             offset=offset, data=data, delete=delete,
-                             version=version, log_entries=[entry])
+                             mutations=list(muts), version=version,
+                             log_entries=[entry])
             for s in replicas:
                 if not self.send(s, msg):
                     op.failed.add(s)

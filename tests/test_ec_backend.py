@@ -91,15 +91,15 @@ class Cluster:
     def write(self, oid, off, data):
         out = {}
         self.backend.submit_transaction(
-            oid, off, data, lambda ok: out.setdefault("ok", ok))
+            oid, [("write", off, data)],
+            lambda ok: out.setdefault("ok", ok))
         assert "ok" in out, "write did not complete synchronously"
         return out["ok"]
 
     def delete(self, oid):
         out = {}
         self.backend.submit_transaction(
-            oid, 0, b"", lambda ok: out.setdefault("ok", ok),
-            delete=True)
+            oid, [("delete",)], lambda ok: out.setdefault("ok", ok))
         return out["ok"]
 
     def read(self, oid, off=0, length=0):
@@ -282,9 +282,11 @@ def test_per_object_write_ordering(cl):
     w = cl.backend.sinfo.stripe_width
     order = []
     cl.backend.submit_transaction(
-        "obj", 0, b"A" * w, lambda ok: order.append(("w1", ok)))
+        "obj", [("write", 0, b"A" * w)],
+        lambda ok: order.append(("w1", ok)))
     cl.backend.submit_transaction(
-        "obj", 10, b"B" * 10, lambda ok: order.append(("w2", ok)))
+        "obj", [("write", 10, b"B" * 10)],
+        lambda ok: order.append(("w2", ok)))
     assert order == [("w1", True), ("w2", True)]
     assert cl.read("obj") == b"A" * 10 + b"B" * 10 + b"A" * (w - 20)
 
@@ -340,9 +342,9 @@ def test_async_delivery_preserves_shard_log_order(cl):
     done = []
     # w2: RMW overwrite on 'a' (reads pend on shard 1); w3: fresh 'b'
     cl.backend.submit_transaction(
-        "a", 5, b"patch", lambda ok: done.append(("a", ok)))
+        "a", [("write", 5, b"patch")], lambda ok: done.append(("a", ok)))
     cl.backend.submit_transaction(
-        "b", 0, b"B" * w, lambda ok: done.append(("b", ok)))
+        "b", [("write", 0, b"B" * w)], lambda ok: done.append(("b", ok)))
     # nothing may commit while the earlier op's reads are in flight:
     # the later no-read write must NOT overtake
     assert done == []

@@ -1,0 +1,347 @@
+"""Extended librados op surface: append/truncate/zero/create, xattrs,
+omap, and atomic compound WriteOps — replicated AND erasure-coded pools
+(ref: src/osd/PrimaryLogPG.cc do_osd_ops op switch :5770;
+src/include/rados.h CEPH_OSD_OP_*; librados op surface
+src/librados/librados_cxx.cc).  Also: metadata survives recovery and
+deep scrub detects metadata divergence."""
+import numpy as np
+import pytest
+
+from ceph_tpu.client import RadosError, WriteOp
+from ceph_tpu.testing import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(n_osd=6, threaded=True)
+    c.wait_all_up()
+    r = c.rados()
+    r.pool_create("rp", pg_num=16, pool_type="replicated")
+    r.mon_command({"prefix": "osd erasure-code-profile set",
+                   "name": "k2m2",
+                   "profile": {"plugin": "tpu", "k": "2", "m": "2",
+                               "crush-failure-domain": "host"}})
+    r.pool_create("ecp", pg_num=16, pool_type="erasure",
+                  erasure_code_profile="k2m2")
+    yield c, r
+    c.shutdown()
+
+
+@pytest.fixture(params=["rp", "ecp"])
+def io(cluster, request):
+    _, r = cluster
+    return r.open_ioctx(request.param)
+
+
+@pytest.fixture()
+def rio(cluster):
+    _, r = cluster
+    return r.open_ioctx("rp")
+
+
+def _oid(request_node_name, suffix=""):
+    return request_node_name.replace("[", "_").replace("]", "") + suffix
+
+
+# ------------------------------------------------------------ data ops
+
+def test_append(io, request):
+    oid = _oid(request.node.name)
+    io.write_full(oid, b"abc")
+    io.append(oid, b"defgh")
+    assert io.read(oid) == b"abcdefgh"
+    assert io.stat(oid)["size"] == 8
+
+
+def test_append_creates(io, request):
+    oid = _oid(request.node.name)
+    io.append(oid, b"fresh")
+    assert io.read(oid) == b"fresh"
+
+
+def test_truncate_down_and_up(io, request):
+    oid = _oid(request.node.name)
+    rng = np.random.default_rng(3)
+    payload = rng.integers(0, 256, 5000, dtype=np.uint8).tobytes()
+    io.write_full(oid, payload)
+    io.truncate(oid, 1234)
+    assert io.read(oid) == payload[:1234]
+    # extending truncate zero-fills (ref: CEPH_OSD_OP_TRUNCATE)
+    io.truncate(oid, 2000)
+    assert io.read(oid) == payload[:1234] + b"\0" * (2000 - 1234)
+    assert io.stat(oid)["size"] == 2000
+
+
+def test_truncate_to_zero(io, request):
+    oid = _oid(request.node.name)
+    io.write_full(oid, b"x" * 4096)
+    io.truncate(oid, 0)
+    assert io.read(oid) == b""
+    assert io.stat(oid)["size"] == 0
+
+
+def test_zero_within_and_past_size(io, request):
+    oid = _oid(request.node.name)
+    io.write_full(oid, b"\xaa" * 1000)
+    io.zero(oid, 100, 200)
+    data = io.read(oid)
+    assert data[:100] == b"\xaa" * 100
+    assert data[100:300] == b"\0" * 200
+    assert data[300:] == b"\xaa" * 700
+    # zero never extends (librados semantics)
+    io.zero(oid, 900, 500)
+    assert io.stat(oid)["size"] == 1000
+    assert io.read(oid)[900:] == b"\0" * 100
+
+
+def test_create_exclusive(io, request):
+    oid = _oid(request.node.name)
+    io.create(oid, exclusive=True)
+    assert io.stat(oid)["size"] == 0
+    with pytest.raises(RadosError, match="EEXIST"):
+        io.create(oid, exclusive=True)
+    io.create(oid)                       # non-exclusive: fine
+
+
+def test_write_full_shrinks(io, request):
+    """A shorter write_full leaves no tail of the longer old object."""
+    oid = _oid(request.node.name)
+    io.write_full(oid, b"L" * 9000)
+    io.write_full(oid, b"s" * 10)
+    assert io.read(oid) == b"s" * 10
+    assert io.stat(oid)["size"] == 10
+
+
+# ------------------------------------------------------------- xattrs
+
+def test_xattr_roundtrip(io, request):
+    oid = _oid(request.node.name)
+    io.write_full(oid, b"body")
+    io.set_xattr(oid, "user.k1", b"v1")
+    io.set_xattr(oid, "user.k2", b"v2")
+    assert io.get_xattr(oid, "user.k1") == b"v1"
+    assert io.get_xattrs(oid) == {"user.k1": b"v1", "user.k2": b"v2"}
+    io.rm_xattr(oid, "user.k1")
+    assert io.get_xattrs(oid) == {"user.k2": b"v2"}
+    with pytest.raises(RadosError, match="ENODATA"):
+        io.get_xattr(oid, "user.k1")
+    with pytest.raises(RadosError, match="ENODATA"):
+        io.rm_xattr(oid, "user.k1")
+    # body untouched by metadata ops
+    assert io.read(oid) == b"body"
+
+
+def test_xattr_on_missing_object(io, request):
+    oid = _oid(request.node.name)
+    with pytest.raises(RadosError, match="ENOENT"):
+        io.get_xattr(oid, "a")
+    # setxattr creates the object (any write-class op does)
+    io.set_xattr(oid, "a", b"1")
+    assert io.stat(oid)["size"] == 0
+    assert io.get_xattr(oid, "a") == b"1"
+
+
+# --------------------------------------------------------------- omap
+
+def test_omap_roundtrip(rio, request):
+    oid = _oid(request.node.name)
+    rio.write_full(oid, b"")
+    rio.set_omap(oid, {"b": b"2", "a": b"1", "c": b"3"})
+    vals, more = rio.get_omap_vals(oid)
+    assert vals == {"a": b"1", "b": b"2", "c": b"3"} and not more
+    rio.remove_omap_keys(oid, ["b"])
+    keys, _ = rio.get_omap_keys(oid)
+    assert keys == ["a", "c"]
+    assert rio.get_omap_vals_by_keys(oid, ["a", "zz"]) == {"a": b"1"}
+    rio.set_omap_header(oid, b"HDR")
+    assert rio.get_omap_header(oid) == b"HDR"
+    rio.clear_omap(oid)
+    assert rio.get_omap_vals(oid)[0] == {}
+    assert rio.get_omap_header(oid) == b""
+
+
+def test_omap_pagination(rio, request):
+    oid = _oid(request.node.name)
+    rio.set_omap(oid, {f"k{i:03d}": str(i).encode() for i in range(20)})
+    vals, more = rio.get_omap_vals(oid, max_return=7)
+    assert len(vals) == 7 and more
+    assert min(vals) == "k000" and max(vals) == "k006"
+    vals2, more2 = rio.get_omap_vals(oid, after="k006", max_return=50)
+    assert len(vals2) == 13 and not more2
+
+
+def test_omap_rejected_on_ec_pool(cluster, request):
+    _, r = cluster
+    io = r.open_ioctx("ecp")
+    oid = _oid(request.node.name)
+    io.write_full(oid, b"x")
+    with pytest.raises(RadosError, match="EOPNOTSUPP"):
+        io.set_omap(oid, {"k": b"v"})
+    with pytest.raises(RadosError, match="EOPNOTSUPP"):
+        io.get_omap_vals(oid)
+
+
+# ----------------------------------------------------- compound WriteOp
+
+def test_writeop_atomic_compound(rio, request):
+    oid = _oid(request.node.name)
+    op = (WriteOp().write_full(b"payload")
+          .set_xattr("tag", b"t1")
+          .set_omap({"idx": b"7"}))
+    rio.operate(oid, op)
+    assert rio.read(oid) == b"payload"
+    assert rio.get_xattr(oid, "tag") == b"t1"
+    assert rio.get_omap_vals(oid)[0] == {"idx": b"7"}
+
+
+def test_writeop_ec_data_plus_xattr(cluster, request):
+    _, r = cluster
+    io = r.open_ioctx("ecp")
+    oid = _oid(request.node.name)
+    io.operate(oid, WriteOp().write_full(b"E" * 4096)
+               .set_xattr("m", b"1"))
+    assert io.read(oid) == b"E" * 4096
+    assert io.get_xattr(oid, "m") == b"1"
+    # EC allows at most one data mutation per compound op
+    with pytest.raises(RadosError, match="EINVAL"):
+        io.operate(oid, WriteOp().write(b"a", 0).append(b"b"))
+
+
+def test_writev_malformed_rejected(rio, request):
+    """Wire-level malformed mutation vectors answer EINVAL instead of
+    crashing the op handler (arity/type/range validation)."""
+    oid = _oid(request.node.name)
+    ob = rio.rados.objecter
+    for bad_ops in ([["write", 0]],            # short tuple
+                    [["truncate", -5]],        # negative size
+                    [["write", "x", b"d"]],    # bad offset type
+                    [["nosuch", 1]],           # unknown op
+                    [["setxattrs", {"k": 3}]]):  # non-bytes value
+        fut = ob.submit(rio.pool_id, oid, "writev",
+                        args={"ops": bad_ops})
+        assert ob.wait_sync(fut.done, 10, ev=fut._ev), bad_ops
+        assert fut.errno_name == "EINVAL", bad_ops
+    assert not rio.list_objects().count(oid)
+
+
+def test_append_resolved_at_primary(cluster, request):
+    """The replica fan-out carries a concrete (write, offset) — not a
+    size-relative append a lagging replica could mis-resolve."""
+    c, r = cluster
+    io = r.open_ioctx("rp")
+    oid = _oid(request.node.name)
+    io.write_full(oid, b"base")
+    io.append(oid, b"+tail")
+    pid = r.pool_lookup("rp")
+    m = r.objecter.osdmap
+    raw = m.object_locator_to_pg(oid, pid)
+    pg = m.pools[pid].raw_pg_to_pg(raw)
+    _, _, acting, _ = m.pg_to_up_acting_osds(raw)
+    for osd in acting:
+        assert c.osds[osd].pgs[pg].shard.read(oid) == b"base+tail"
+
+
+# ------------------------------------------- metadata through recovery
+
+def test_replicated_recovery_carries_metadata(cluster, request):
+    """Kill an acting OSD; after re-peering+recovery the new copy has
+    the xattrs, omap and header, not just the data."""
+    c, r = cluster
+    io = r.open_ioctx("rp")
+    oid = _oid(request.node.name)
+    io.operate(oid, WriteOp().write_full(b"D" * 2048)
+               .set_xattr("x", b"xv").set_omap({"o": b"ov"})
+               .set_omap_header(b"H"))
+    pid = r.pool_lookup("rp")
+    m = r.objecter.osdmap
+    raw = m.object_locator_to_pg(oid, pid)
+    _, _, acting, primary = m.pg_to_up_acting_osds(raw)
+    victim = next(o for o in acting if o != primary)
+    e0 = m.epoch
+    # mark it out: CRUSH remaps the PG onto a newcomer, which must
+    # receive the full copy (data + metadata) through recovery pushes
+    r.mon_command({"prefix": "osd out", "ids": [victim]})
+    r.objecter.wait_for_map(e0 + 1)
+
+    # the replacement member eventually holds the full copy
+    import time
+    deadline = time.monotonic() + 20
+    ok = False
+    while time.monotonic() < deadline and not ok:
+        m2 = r.objecter.osdmap
+        _, _, acting2, _ = m2.pg_to_up_acting_osds(raw)
+        pg = m2.pools[pid].raw_pg_to_pg(raw)
+        newcomer = [o for o in acting2 if o not in acting and o >= 0]
+        if newcomer:
+            st = c.osds[newcomer[0]].pgs.get(pg)
+            if st is not None and st.shard is not None and \
+                    st.shard.exists(oid):
+                data, attrs, omap, hdr = st.shard.push_payload(oid)
+                ok = (data == b"D" * 2048 and attrs == {"x": b"xv"}
+                      and omap == {"o": b"ov"} and hdr == b"H")
+        time.sleep(0.1)
+    assert ok, "recovered copy is missing data or metadata"
+    # restore the osd for later tests
+    r.mon_command({"prefix": "osd in", "ids": [victim]})
+
+
+def test_scrub_detects_and_repairs_omap_divergence(cluster, request):
+    """Silently corrupt one replica's omap; deep scrub flags the object
+    and repair restores it (ref: omap_digest comparison in
+    be_compare_scrubmaps)."""
+    c, r = cluster
+    io = r.open_ioctx("rp")
+    oid = _oid(request.node.name)
+    io.write_full(oid, b"scrubme")
+    io.set_omap(oid, {"good": b"1"})
+    pid = r.pool_lookup("rp")
+    m = r.objecter.osdmap
+    raw = m.object_locator_to_pg(oid, pid)
+    pg = m.pools[pid].raw_pg_to_pg(raw)
+    _, _, acting, primary = m.pg_to_up_acting_osds(raw)
+    victim = next(o for o in acting if o != primary)
+    # corrupt the replica's omap directly in its store
+    from ceph_tpu.osd.ec_backend import pg_cid
+    from ceph_tpu.store import ObjectId, Transaction
+    c.osds[victim].store.queue_transaction(
+        Transaction().omap_setkeys(pg_cid(pg), ObjectId(oid),
+                                   {"evil": b"666"}))
+    res = r.pg_scrub(pid, pg.ps)
+    assert oid in res["inconsistent"]
+    res2 = r.pg_scrub(pid, pg.ps, repair=True)
+    assert oid in res2["inconsistent"] and res2["repaired"] >= 1
+    # divergence gone
+    res3 = r.pg_scrub(pid, pg.ps)
+    assert res3["inconsistent"] == []
+
+
+def test_ec_xattr_survives_shard_rebuild(cluster, request):
+    """Wipe one EC shard's attrs; scrub-repair rebuilds the shard with
+    the user xattrs restored from the survivors."""
+    c, r = cluster
+    io = r.open_ioctx("ecp")
+    oid = _oid(request.node.name)
+    rng = np.random.default_rng(11)
+    payload = rng.integers(0, 256, 8192, dtype=np.uint8).tobytes()
+    io.write_full(oid, payload)
+    io.set_xattr(oid, "keep", b"me")
+    pid = r.pool_lookup("ecp")
+    m = r.objecter.osdmap
+    raw = m.object_locator_to_pg(oid, pid)
+    pg = m.pools[pid].raw_pg_to_pg(raw)
+    _, _, acting, primary = m.pg_to_up_acting_osds(raw)
+    sidx, victim = next((i, o) for i, o in enumerate(acting)
+                        if o != primary and 0 <= o < (1 << 30))
+    from ceph_tpu.osd.mutations import uxattr_key
+    from ceph_tpu.osd.ec_backend import pg_cid
+    from ceph_tpu.store import ObjectId, Transaction
+    c.osds[victim].store.queue_transaction(
+        Transaction().rmattr(pg_cid(pg), ObjectId(oid, shard=sidx),
+                             uxattr_key("keep")))
+    res = r.pg_scrub(pid, pg.ps, repair=True)
+    assert oid in res["inconsistent"]
+    # shard attrs restored
+    attrs = c.osds[victim].store.getattrs(pg_cid(pg),
+                                          ObjectId(oid, shard=sidx))
+    assert attrs.get(uxattr_key("keep")) == b"me"
+    assert io.read(oid) == payload

@@ -9,8 +9,14 @@ recalc/resend on map changes is inherited.
 """
 from __future__ import annotations
 
+import itertools
+
 from ..msg.messenger import LocalNetwork
 from .objecter import Objecter, OpFuture
+
+#: watch-cookie mint (process-wide: cookies must be unique per client
+#: name even when several IoCtx instances race)
+_cookie_seq = itertools.count(1)
 
 ERRNO = {"EIO": 5, "ENOENT": 2, "EINVAL": 22, "ESTALE": 116}
 
@@ -183,6 +189,56 @@ class IoCtx:
 
     def operate(self, oid: str, op: "WriteOp") -> None:
         self._wait(self.aio_operate(oid, op))
+
+    # -- watch/notify (ref: librados IoCtx::watch2/notify2/unwatch2) ---
+    def watch(self, oid: str, callback, cookie: str | None = None
+              ) -> str:
+        """Register `callback(notify_id, notifier, payload) -> reply`
+        on the object; returns the watch cookie.  The watch survives
+        primary moves (client-side linger re-registration)."""
+        cookie = cookie or \
+            f"{self.rados.objecter.name}.w{next(_cookie_seq)}"
+        fut = self.rados.objecter.watch_register(
+            self.pool_id, oid, cookie, callback)
+        try:
+            self._wait(fut)
+        except Exception:
+            self.rados.objecter.watches.pop(cookie, None)
+            raise
+        return cookie
+
+    def unwatch(self, oid: str, cookie: str) -> None:
+        self._wait(self.rados.objecter.watch_unregister(
+            self.pool_id, oid, cookie))
+
+    def notify(self, oid: str, payload=None, timeout: float = 10.0
+               ) -> tuple[dict, list]:
+        """Fan a notification out to every watcher; returns
+        (replies, timed_out) keyed "client/cookie"."""
+        fut = self.rados.objecter.submit(
+            self.pool_id, oid, "notify",
+            args={"payload": payload, "timeout": timeout})
+        ob = self.rados.objecter
+        if not ob.wait_sync(fut.done,
+                            max(self.rados.op_timeout, timeout + 5.0),
+                            ev=fut._ev):
+            raise TimeoutError("notify timed out")
+        if fut.result < 0:
+            raise RadosError(fut.errno_name or "EIO")
+        return fut.attrs["replies"], fut.attrs["timeouts"]
+
+    def exec(self, oid: str, cls: str, method: str, indata=None):
+        """Invoke an object-class method on the object's primary OSD
+        (ref: librados IoCtx::exec / CEPH_OSD_OP_CALL)."""
+        return self._sync("exec", oid,
+                          args={"cls": cls, "method": method,
+                                "indata": indata}).attrs.get("out")
+
+    def aio_exec(self, oid: str, cls: str, method: str,
+                 indata=None) -> OpFuture:
+        return self.rados.objecter.submit(
+            self.pool_id, oid, "exec",
+            args={"cls": cls, "method": method, "indata": indata})
 
     # -- xattrs (ref: librados::IoCtx::{get,set,rm}xattr) --------------
     def set_xattr(self, oid: str, name: str, value: bytes) -> None:

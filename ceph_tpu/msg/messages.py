@@ -182,6 +182,18 @@ class OSDOpReply(Message):
     epoch: int = 0
 
 
+@dataclass
+class MWatchNotify(Message):
+    """OSD -> watching client: a notify fired on an object you watch
+    (ref: src/messages/MWatchNotify.h)."""
+    pool: int = -1
+    oid: str = ""
+    notify_id: int = 0
+    cookie: str = ""
+    notifier: str = ""
+    payload: Any = None
+
+
 # ---------------------------------------------------------------- maps/mon
 
 

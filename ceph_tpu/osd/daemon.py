@@ -23,8 +23,8 @@ from ..common.options import global_config
 from ..ec import registry as ec_registry
 from ..msg.messages import (ECSubRead, ECSubReadReply, ECSubWrite,
                             ECSubWriteReply, MMap, MOSDBoot,
-                            MMonSubscribe, MOSDFailure, OSDOp,
-                            OSDOpReply, PGPull, PGPush, PGScan,
+                            MMonSubscribe, MOSDFailure, MWatchNotify,
+                            OSDOp, OSDOpReply, PGPull, PGPush, PGScan,
                             PGScanReply, Ping, PingReply, RepOpReply,
                             RepOpWrite, ScrubMapReply, ScrubMapRequest)
 from ..msg.mon_client import MonHunter
@@ -42,8 +42,9 @@ from ..mon.osd_monitor import DEFAULT_EC_PROFILE
 
 #: errno-name -> numeric result for client replies (ref: the rc values
 #: MOSDOpReply carries; errno(3))
-_ERRNO = {"ENOENT": -2, "EIO": -5, "EEXIST": -17, "EINVAL": -22,
-          "ENODATA": -61, "EOPNOTSUPP": -95, "ESTALE": -116}
+_ERRNO = {"ENOENT": -2, "EIO": -5, "EBUSY": -16, "EEXIST": -17,
+          "EINVAL": -22, "ENODATA": -61, "EOPNOTSUPP": -95,
+          "ESTALE": -116, "ECANCELED": -125}
 
 
 class _PGState:
@@ -64,6 +65,10 @@ class _PGState:
         self.ec_jobs_failed = False
         self.recovery_gen = 0      # invalidates stale job callbacks
         self.scrub = None          # active _ScrubState (primary only)
+        # watch/notify (primary only; in-memory like the reference's
+        # Watch objects on the PG — clients re-establish via linger
+        # when the primary moves, ref: src/osd/Watch.cc)
+        self.watchers: dict[str, dict[tuple, dict]] = {}
 
 
 class _ScrubState:
@@ -114,6 +119,10 @@ class OSDDaemon(Dispatcher, MonHunter):
         #: (a "hung" osd — the heartbeat_inject_failure analogue,
         #: ref: src/common/options.cc:774)
         self.inject_heartbeat_mute = False
+        # in-flight notifies: notify_id -> state
+        # (ref: src/osd/Watch.cc Notify)
+        self._notifies: dict[int, dict] = {}
+        self._notify_ids = itertools.count(1)
         self.hbmap = HeartbeatMap()
         self._hb_handle = self.hbmap.add_worker(
             f"{self.name}.tick",
@@ -158,7 +167,13 @@ class OSDDaemon(Dispatcher, MonHunter):
             self._handle_map(msg)
             return True
         if isinstance(msg, OSDOp):
-            self._handle_client_op(msg)
+            # serialize op execution: the TCP backend delivers each
+            # connection on its own reader thread, so without this two
+            # clients' read-modify-write ops (cls exec, omap updates)
+            # could interleave (the reference executes ops under the
+            # PG lock — PrimaryLogPG::do_request holds pg->lock)
+            with self._lock:
+                self._handle_client_op(msg)
             return True
         if isinstance(msg, ECSubWrite):
             st = self.pgs.get(msg.pgid)
@@ -965,6 +980,10 @@ class OSDDaemon(Dispatcher, MonHunter):
                             "omap_get_keys", "omap_get_vals_by_keys",
                             "omap_get_header"):
                 self._do_meta_read(st, msg)
+            elif msg.op == "exec":
+                self._do_exec(st, msg)
+            elif msg.op in ("watch", "notify", "notify_ack"):
+                self._do_watch_notify(st, msg)
             elif msg.op == "pgls":
                 # PG object listing (ref: MOSDOp CEPH_OSD_OP_PGLS /
                 # PrimaryLogPG::do_pg_op)
@@ -1027,6 +1046,129 @@ class OSDDaemon(Dispatcher, MonHunter):
             return None
         return mut.validate(muts, ec_pool=isinstance(st.shard,
                                                      ECPGShard))
+
+    def _do_exec(self, st: _PGState, msg: OSDOp) -> None:
+        """CEPH_OSD_OP_CALL: run an object-class method on the primary
+        (ref: PrimaryLogPG.cc do_osd_ops OP_CALL -> ClassHandler;
+        method API src/objclass/objclass.h).  Queued mutations commit
+        atomically through the backend pipeline; the method's output
+        rides back in the reply."""
+        from ..cls import ClsError, MethodContext, class_handler
+        a = msg.args or {}
+        if isinstance(st.shard, ECPGShard):
+            self._reply(msg, _ERRNO["EOPNOTSUPP"], "EOPNOTSUPP")
+            return
+        try:
+            _flags, fn = class_handler.resolve(a["cls"], a["method"])
+            ctx = MethodContext(st.shard, msg.oid)
+            out = fn(ctx, a.get("indata"))
+        except ClsError as err:
+            self._reply(msg, _ERRNO.get(err.errno_name, -22),
+                        err.errno_name)
+            return
+        except Exception:
+            # malformed indata (missing keys, wrong types) is wire
+            # input: answer EINVAL, never leave the op unreplied
+            dout("osd", 1).write("%s: cls %s.%s raised", self.name,
+                                 a.get("cls"), a.get("method"))
+            self._reply(msg, -22, "EINVAL")
+            return
+        if not ctx.mutations:
+            self._reply(msg, 0, attrs={"out": out})
+            return
+        muts = mut.validate(ctx.mutations, ec_pool=False)
+        st.backend.submit_transaction(
+            msg.oid, muts,
+            lambda ok, m=msg, o=out: self._reply(
+                m, 0 if ok else -116, "" if ok else "ESTALE",
+                attrs={"out": o}))
+
+    # ---------------------------------------------------- watch/notify
+    # (ref: src/osd/Watch.cc Watch/Notify; PrimaryLogPG do_osd_ops
+    # CEPH_OSD_OP_WATCH / handle_watch_timeout; MWatchNotify fan-out)
+    def _do_watch_notify(self, st: _PGState, msg: OSDOp) -> None:
+        a = msg.args or {}
+        if msg.op == "watch":
+            key = (msg.src, a["cookie"])
+            if a.get("action", "watch") == "watch":
+                if not self._object_exists(st, msg.oid):
+                    self._reply(msg, -2, "ENOENT")
+                    return
+                st.watchers.setdefault(msg.oid, {})[key] = {
+                    "client": msg.src, "cookie": a["cookie"]}
+            else:
+                st.watchers.get(msg.oid, {}).pop(key, None)
+            self._reply(msg, 0)
+        elif msg.op == "notify":
+            self._start_notify(st, msg, a)
+        else:                                   # notify_ack
+            nid = a["notify_id"]
+            with self._lock:
+                state = self._notifies.get(nid)
+                if state is not None:
+                    key = (msg.src, a["cookie"])
+                    if key in state["pending"]:
+                        state["pending"].discard(key)
+                        state["replies"][f"{msg.src}/{a['cookie']}"] = \
+                            a.get("reply")
+            self._reply(msg, 0)
+            if state is not None:
+                self._maybe_notify_done(nid)
+
+    def _start_notify(self, st: _PGState, msg: OSDOp, a: dict) -> None:
+        watchers = dict(st.watchers.get(msg.oid, {}))
+        if not watchers:
+            self._reply(msg, 0, attrs={"replies": {}, "timeouts": []})
+            return
+        nid = next(self._notify_ids)
+        # every watcher is pending BEFORE any send: an ack can arrive
+        # on another connection's reader thread the instant the send
+        # completes, and must find its key present
+        state = {"msg": msg, "pending": set(watchers), "replies": {},
+                 "timeouts": [], "done": False, "timer": None}
+        with self._lock:
+            self._notifies[nid] = state
+        for key, w in watchers.items():
+            wn = MWatchNotify(pool=msg.pgid.pool, oid=msg.oid,
+                              notify_id=nid, cookie=w["cookie"],
+                              notifier=msg.src,
+                              payload=a.get("payload"))
+            if not self.ms.connect(w["client"]).send_message(wn):
+                # watcher endpoint is gone: reap the watch (the
+                # reference expires it via handle_watch_timeout)
+                st.watchers.get(msg.oid, {}).pop(key, None)
+                with self._lock:
+                    state["pending"].discard(key)
+                    state["timeouts"].append(f"{key[0]}/{key[1]}")
+        t = threading.Timer(float(a.get("timeout", 10.0)),
+                            self._notify_timeout, args=(nid,))
+        t.daemon = True
+        state["timer"] = t
+        t.start()
+        self._maybe_notify_done(nid)
+
+    def _notify_timeout(self, nid: int) -> None:
+        with self._lock:
+            state = self._notifies.get(nid)
+            if state is None or state["done"]:
+                return
+            state["timeouts"].extend(
+                f"{c}/{k}" for c, k in sorted(state["pending"]))
+            state["pending"].clear()
+        self._maybe_notify_done(nid)
+
+    def _maybe_notify_done(self, nid: int) -> None:
+        with self._lock:
+            state = self._notifies.get(nid)
+            if state is None or state["pending"] or state["done"]:
+                return
+            state["done"] = True
+            del self._notifies[nid]
+            if state["timer"] is not None:
+                state["timer"].cancel()
+        self._reply(state["msg"], 0,
+                    attrs={"replies": state["replies"],
+                           "timeouts": state["timeouts"]})
 
     def _do_meta_read(self, st: _PGState, msg: OSDOp) -> None:
         """xattr/omap reads served from the primary's local shard

@@ -1,0 +1,151 @@
+"""Object classes: exec op + built-in lock/refcount/version classes
+(ref: src/osd/ClassHandler.cc, src/objclass/objclass.h,
+src/cls/{lock,refcount,version})."""
+import pytest
+
+from ceph_tpu.client import RadosError
+from ceph_tpu.testing import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(n_osd=4, threaded=True)
+    c.wait_all_up()
+    r = c.rados()
+    r.pool_create("meta", pg_num=8)
+    r.mon_command({"prefix": "osd erasure-code-profile set",
+                   "name": "k2m1",
+                   "profile": {"plugin": "tpu", "k": "2", "m": "1",
+                               "crush-failure-domain": "osd"}})
+    r.pool_create("ecm", pg_num=8, pool_type="erasure",
+                  erasure_code_profile="k2m1")
+    yield c, r
+    c.shutdown()
+
+
+@pytest.fixture()
+def io(cluster):
+    _, r = cluster
+    return r.open_ioctx("meta")
+
+
+def test_unknown_class_or_method(io):
+    with pytest.raises(RadosError, match="EOPNOTSUPP"):
+        io.exec("o", "nope", "x")
+    with pytest.raises(RadosError, match="EOPNOTSUPP"):
+        io.exec("o", "lock", "nope")
+
+
+def test_exec_rejected_on_ec_pool(cluster):
+    _, r = cluster
+    e = r.open_ioctx("ecm")
+    with pytest.raises(RadosError, match="EOPNOTSUPP"):
+        e.exec("o", "lock", "get_info", {"name": "l"})
+
+
+# ---------------------------------------------------------------- lock
+
+def test_lock_exclusive_lifecycle(io):
+    oid = "locked"
+    io.exec(oid, "lock", "lock",
+            {"name": "owner", "type": "exclusive",
+             "client": "client.A", "cookie": "c1", "desc": "test"})
+    # the lock op created the object (like the reference's lock_obj)
+    assert io.stat(oid)["size"] == 0
+    info = io.exec(oid, "lock", "get_info", {"name": "owner"})
+    assert info["type"] == "exclusive"
+    assert [l["client"] for l in info["lockers"]] == ["client.A"]
+    # another client is excluded
+    with pytest.raises(RadosError, match="EBUSY"):
+        io.exec(oid, "lock", "lock",
+                {"name": "owner", "type": "exclusive",
+                 "client": "client.B", "cookie": "c2"})
+    # renew by the same (client, cookie) is fine
+    io.exec(oid, "lock", "lock",
+            {"name": "owner", "type": "exclusive",
+             "client": "client.A", "cookie": "c1"})
+    # unlock, then B can take it
+    io.exec(oid, "lock", "unlock",
+            {"name": "owner", "client": "client.A", "cookie": "c1"})
+    io.exec(oid, "lock", "lock",
+            {"name": "owner", "type": "exclusive",
+             "client": "client.B", "cookie": "c2"})
+    with pytest.raises(RadosError, match="ENOENT"):
+        io.exec(oid, "lock", "unlock",
+                {"name": "owner", "client": "client.A", "cookie": "c1"})
+
+
+def test_lock_shared_and_break(io):
+    oid = "shlock"
+    for cl in ("client.A", "client.B"):
+        io.exec(oid, "lock", "lock",
+                {"name": "s", "type": "shared", "client": cl,
+                 "cookie": "k"})
+    info = io.exec(oid, "lock", "get_info", {"name": "s"})
+    assert len(info["lockers"]) == 2
+    # shared blocks exclusive
+    with pytest.raises(RadosError, match="EBUSY"):
+        io.exec(oid, "lock", "lock",
+                {"name": "s", "type": "exclusive",
+                 "client": "client.C", "cookie": "k"})
+    # break one locker out
+    io.exec(oid, "lock", "break_lock",
+            {"name": "s", "locker": "client.A", "cookie": "k"})
+    info = io.exec(oid, "lock", "get_info", {"name": "s"})
+    assert [l["client"] for l in info["lockers"]] == ["client.B"]
+    assert io.exec(oid, "lock", "list_locks", {}) == ["s"]
+
+
+# ------------------------------------------------------------ refcount
+
+def test_refcount_lifecycle(io):
+    oid = "refobj"
+    io.write_full(oid, b"shared data")
+    io.exec(oid, "refcount", "get", {"tag": "t1"})
+    io.exec(oid, "refcount", "get", {"tag": "t2"})
+    assert io.exec(oid, "refcount", "read", {})["refs"] == ["t1", "t2"]
+    io.exec(oid, "refcount", "put", {"tag": "t1"})
+    assert io.exec(oid, "refcount", "read", {})["refs"] == ["t2"]
+    # last put removes the object (ref: cls_rc_refcount_put)
+    io.exec(oid, "refcount", "put", {"tag": "t2"})
+    with pytest.raises(RadosError, match="ENOENT"):
+        io.read(oid)
+
+
+# ------------------------------------------------------------- version
+
+def test_version_gating(io):
+    oid = "ver"
+    io.write_full(oid, b"v")
+    io.exec(oid, "version", "set", {"ver": 5})
+    assert io.exec(oid, "version", "read", {})["ver"] == 5
+    io.exec(oid, "version", "inc", {})
+    assert io.exec(oid, "version", "read", {})["ver"] == 6
+    io.exec(oid, "version", "check", {"ver": 6, "cond": "eq"})
+    with pytest.raises(RadosError, match="ECANCELED"):
+        io.exec(oid, "version", "check", {"ver": 7, "cond": "eq"})
+    # conditional inc: gate holds -> bump; gate fails -> ECANCELED
+    io.exec(oid, "version", "inc", {"ver": 6, "cond": "eq"})
+    with pytest.raises(RadosError, match="ECANCELED"):
+        io.exec(oid, "version", "inc", {"ver": 6, "cond": "eq"})
+    assert io.exec(oid, "version", "read", {})["ver"] == 7
+
+
+def test_cls_mutations_are_atomic_and_replicated(cluster, io):
+    """A cls write lands on every acting replica (it goes through the
+    normal repop fan-out)."""
+    c, r = cluster
+    oid = "replock"
+    io.exec(oid, "lock", "lock",
+            {"name": "n", "type": "exclusive", "client": "x",
+             "cookie": ""})
+    pid = r.pool_lookup("meta")
+    m = r.objecter.osdmap
+    raw = m.object_locator_to_pg(oid, pid)
+    pg = m.pools[pid].raw_pg_to_pg(raw)
+    _, _, acting, _ = m.pg_to_up_acting_osds(raw)
+    import json
+    for osd in acting:
+        shard = c.osds[osd].pgs[pg].shard
+        st = json.loads(shard.getxattr(oid, "lock.n"))
+        assert list(st["lockers"]) == ["x/"]

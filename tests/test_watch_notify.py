@@ -1,0 +1,120 @@
+"""watch/notify: object notification fan-out with acks, timeouts and
+linger re-registration across primary moves
+(ref: src/osd/Watch.cc, src/messages/MWatchNotify.h,
+librados watch2/notify2)."""
+import time
+
+import pytest
+
+from ceph_tpu.client import RadosError
+from ceph_tpu.testing import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(n_osd=4, threaded=True)
+    c.wait_all_up()
+    r = c.rados()
+    r.pool_create("wp", pg_num=8)
+    yield c, r
+    c.shutdown()
+
+
+def test_watch_missing_object(cluster):
+    _, r = cluster
+    io = r.open_ioctx("wp")
+    with pytest.raises(RadosError, match="ENOENT"):
+        io.watch("ghost", lambda *a: None)
+
+
+def test_notify_no_watchers(cluster):
+    _, r = cluster
+    io = r.open_ioctx("wp")
+    io.write_full("lonely", b"x")
+    replies, timeouts = io.notify("lonely", payload={"ping": 1})
+    assert replies == {} and timeouts == []
+
+
+def test_notify_roundtrip_two_clients(cluster):
+    c, r = cluster
+    io = r.open_ioctx("wp")
+    io.write_full("obj", b"watched")
+    # second, independent client watches
+    r2 = c.rados()
+    io2 = r2.open_ioctx("wp")
+    got = []
+
+    def cb(notify_id, notifier, payload):
+        got.append((notifier, payload))
+        return {"seen": payload["n"] + 1}
+
+    cookie = io2.watch("obj", cb)
+    try:
+        replies, timeouts = io.notify("obj", payload={"n": 41})
+        assert timeouts == []
+        assert list(replies.values()) == [{"seen": 42}]
+        assert got and got[0][1] == {"n": 41}
+        assert got[0][0] == r.objecter.name     # notifier identity
+        # watcher sees its own notify too
+        replies2, _ = io2.notify("obj", payload={"n": 1})
+        assert list(replies2.values()) == [{"seen": 2}]
+    finally:
+        io2.unwatch("obj", cookie)
+        r2.shutdown()
+    # after unwatch, notifies see nobody
+    replies3, timeouts3 = io.notify("obj", payload={"n": 0})
+    assert replies3 == {} and timeouts3 == []
+
+
+def test_notify_timeout_on_dead_watcher(cluster):
+    """A watcher whose endpoint vanished is reported in timeouts, and
+    the notify completes promptly rather than hanging."""
+    c, r = cluster
+    io = r.open_ioctx("wp")
+    io.write_full("tobj", b"x")
+    r2 = c.rados()
+    io2 = r2.open_ioctx("tobj" and "wp")
+    cookie = io2.watch("tobj", lambda *a: None)
+    # hard-kill the watcher client (no unwatch)
+    r2.shutdown()
+    t0 = time.monotonic()
+    replies, timeouts = io.notify("tobj", payload=1, timeout=3.0)
+    assert replies == {}
+    assert len(timeouts) == 1 and cookie in timeouts[0]
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_watch_survives_primary_move(cluster):
+    """Marking the primary out moves the PG; the linger re-registers
+    the watch on the new primary and notifies still arrive."""
+    c, r = cluster
+    io = r.open_ioctx("wp")
+    io.write_full("mobj", b"x")
+    r2 = c.rados()
+    io2 = r2.open_ioctx("wp")
+    got = []
+    cookie = io2.watch("mobj", lambda nid, who, p: got.append(p) or "ok")
+    try:
+        pid = r.pool_lookup("wp")
+        m = r.objecter.osdmap
+        raw = m.object_locator_to_pg("mobj", pid)
+        _, _, _, primary = m.pg_to_up_acting_osds(raw)
+        e0 = m.epoch
+        r.mon_command({"prefix": "osd out", "ids": [primary]})
+        r.objecter.wait_for_map(e0 + 1)
+        r2.objecter.wait_for_map(e0 + 1)
+        _, _, _, primary2 = \
+            r.objecter.osdmap.pg_to_up_acting_osds(raw)
+        assert primary2 != primary
+        # give the relinger a beat, then notify through the new primary
+        deadline = time.monotonic() + 10
+        replies = {}
+        while time.monotonic() < deadline and not replies:
+            replies, _ = io.notify("mobj", payload="moved",
+                                   timeout=2.0)
+        assert list(replies.values()) == ["ok"]
+        assert "moved" in got
+    finally:
+        io2.unwatch("mobj", cookie)
+        r2.shutdown()
+        r.mon_command({"prefix": "osd in", "ids": [primary]})

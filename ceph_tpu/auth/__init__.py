@@ -1,0 +1,222 @@
+"""cephx-lite: shared-secret authentication with session tickets.
+
+The reference's cephx (ref: src/auth/cephx/CephxProtocol.{h,cc}) in
+reduced form, keeping the protocol shape:
+
+* a **KeyRing** holds per-entity secrets; the mon holds everyone's
+  (ref: src/auth/KeyRing.cc, mon AuthMonitor's key server);
+* a client proves identity with an HMAC over a fresh nonce + server
+  challenge (ref: CephxAuthorizer's challenge round-trip), and both
+  sides DERIVE the session key from (entity secret, nonce, challenge)
+  — it never crosses the wire, mirroring how cephx wraps the session
+  key under the entity secret;
+* the mon answers with a **ticket**: the session key + entity +
+  expiry, sealed under the *service secret* every daemon shares
+  (ref: service ticket encrypted with the service's rotating key) —
+  daemons can open it; clients cannot forge it;
+* afterwards every message carries `auth = (ticket, sig)` where sig
+  is an HMAC under the session key over the message header AND
+  payload fields, the msgr-v2 message-signing analogue
+  (ref: CEPHX_REQUIRE_SIGNATURES / ProtocolV2 auth signatures): a
+  captured ticket cannot be replayed onto a forged op.
+
+Sealing is authenticate-only (HMAC tag, no confidentiality): the
+threat model this layer exists to test is impersonation and
+unauthorized cluster access, not wire snooping; swap `_seal/_open`
+for AES-GCM to get the rest.
+
+Modes (ref: auth_cluster_required option): "none" (default) or
+"cephx".
+"""
+from __future__ import annotations
+
+import hashlib
+import hmac as _hmac
+import json
+import os
+import time
+
+from ..common.log import dout
+from ..msg.messages import MAuthReply, MAuthRequest
+
+SERVICE_ENTITY = "service"           # the shared service-secret slot
+
+
+def generate_key() -> str:
+    return os.urandom(16).hex()
+
+
+def _mac(secret: str, blob: bytes) -> str:
+    return _hmac.new(secret.encode(), blob,
+                     hashlib.sha256).hexdigest()
+
+
+class KeyRing:
+    """entity -> secret (ref: src/auth/KeyRing.h).  JSON file format:
+    {"osd.0": "<hex>", ...}."""
+
+    def __init__(self, keys: dict[str, str] | None = None):
+        self.keys: dict[str, str] = dict(keys or {})
+
+    @classmethod
+    def generate(cls, entities) -> "KeyRing":
+        kr = cls({SERVICE_ENTITY: generate_key()})
+        for e in entities:
+            kr.keys[e] = generate_key()
+        return kr
+
+    @classmethod
+    def load(cls, path: str) -> "KeyRing":
+        with open(path) as f:
+            return cls(json.load(f))
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.keys, f, indent=1)
+
+    def get(self, entity: str) -> str | None:
+        return self.keys.get(entity)
+
+    def subset(self, *entities: str) -> "KeyRing":
+        """A daemon's keyring: its own key + the service secret."""
+        return KeyRing({e: self.keys[e] for e in
+                        (*entities, SERVICE_ENTITY) if e in self.keys})
+
+
+def _derive_session_key(secret: str, nonce: str, challenge: str) -> str:
+    return _mac(secret, f"session|{nonce}|{challenge}".encode())
+
+
+def _seal(secret: str, payload: dict) -> dict:
+    blob = json.dumps(payload, sort_keys=True)
+    return {"blob": blob, "tag": _mac(secret, blob.encode())}
+
+
+def _open(secret: str, sealed: dict) -> dict | None:
+    if not isinstance(sealed, dict) or "blob" not in sealed:
+        return None
+    if not _hmac.compare_digest(
+            _mac(secret, sealed["blob"].encode()),
+            sealed.get("tag", "")):
+        return None
+    return json.loads(sealed["blob"])
+
+
+def _canon(msg) -> bytes:
+    """Byte-stable digest input covering header AND payload: a
+    captured ticket must not be reattachable to a forged op (the TCP
+    transport is reachable by unauthenticated processes).  Pickle of
+    the field tuple is deterministic for our message payloads
+    (primitives/dicts/dataclasses; dict insertion order survives the
+    unpickle, so receiver-side re-canonicalization matches)."""
+    import dataclasses
+    import pickle
+    fields = tuple((f.name, getattr(msg, f.name))
+                   for f in dataclasses.fields(msg)
+                   if f.name != "auth")
+    return pickle.dumps((msg.type_name, fields), protocol=4)
+
+
+class CephxServer:
+    """Mon-side authenticator (ref: CephxServiceHandler +
+    AuthMonitor's key server)."""
+
+    def __init__(self, keyring: KeyRing,
+                 ticket_ttl: float = 3600.0):
+        self.keyring = keyring
+        self.ttl = ticket_ttl
+
+    def handle_request(self, msg: MAuthRequest) -> MAuthReply:
+        secret = self.keyring.get(msg.entity)
+        challenge = os.urandom(8).hex()
+        if secret is None:
+            return MAuthReply(result=-1, errstr="unknown entity")
+        want = _mac(secret, f"auth|{msg.entity}|{msg.nonce}".encode())
+        if not _hmac.compare_digest(want, msg.sig):
+            dout("auth", 1).write("cephx: bad signature from %s",
+                                  msg.entity)
+            return MAuthReply(result=-13, errstr="bad signature")
+        # fresh challenge binds the session key to this exchange
+        session_key = _derive_session_key(secret, msg.nonce, challenge)
+        ticket = _seal(self.keyring.get(SERVICE_ENTITY), {
+            "entity": msg.entity, "session_key": session_key,
+            "expires": time.time() + self.ttl})
+        return MAuthReply(result=0, challenge=challenge,
+                          ticket=ticket)
+
+
+class CephxClient:
+    """Per-daemon/client signer (ref: CephxClientHandler)."""
+
+    def __init__(self, entity: str, secret: str):
+        self.entity = entity
+        self.secret = secret
+        self.nonce = os.urandom(8).hex()
+        self.session_key: str | None = None
+        self.ticket: dict | None = None
+
+    def build_request(self) -> MAuthRequest:
+        self.nonce = os.urandom(8).hex()
+        return MAuthRequest(
+            entity=self.entity, nonce=self.nonce,
+            sig=_mac(self.secret,
+                     f"auth|{self.entity}|{self.nonce}".encode()))
+
+    def ingest_reply(self, msg: MAuthReply) -> bool:
+        if msg.result != 0:
+            return False
+        self.session_key = _derive_session_key(
+            self.secret, self.nonce, msg.challenge)
+        self.ticket = msg.ticket
+        return True
+
+    @property
+    def authenticated(self) -> bool:
+        return self.session_key is not None
+
+    @classmethod
+    def self_mint(cls, entity: str,
+                  service_secret: str,
+                  ttl: float = 365 * 86400.0) -> "CephxClient":
+        """Daemon-side shortcut: an entity that HOLDS the service
+        secret (mon/osd/mds — the reference distributes rotating
+        service keys to daemons) mints its own ticket locally instead
+        of doing the wire handshake."""
+        c = cls(entity, service_secret)
+        c.session_key = generate_key()
+        c.ticket = _seal(service_secret, {
+            "entity": entity, "session_key": c.session_key,
+            "expires": time.time() + ttl})
+        return c
+
+    def sign(self, msg):
+        """Attach (ticket, sig) to an outgoing message copy."""
+        if self.session_key is None:
+            return msg
+        msg.auth = {"ticket": self.ticket,
+                    "sig": _mac(self.session_key, _canon(msg))}
+        return msg
+
+
+class CephxVerifier:
+    """Service-side message gate (ref: the require-signatures check in
+    Protocol/ms_verify_authorizer)."""
+
+    #: always-allowed types: the auth handshake itself, plus replies
+    #: going TO clients (verified by them only if they hold keys)
+    EXEMPT = {"MAuthRequest", "MAuthReply"}
+
+    def __init__(self, service_secret: str):
+        self.service_secret = service_secret
+
+    def verify(self, msg) -> bool:
+        if msg.type_name in self.EXEMPT:
+            return True
+        auth = getattr(msg, "auth", None)
+        if not auth:
+            return False
+        ticket = _open(self.service_secret, auth.get("ticket"))
+        if ticket is None or ticket["expires"] < time.time():
+            return False
+        want = _mac(ticket["session_key"], _canon(msg))
+        return _hmac.compare_digest(want, auth.get("sig", ""))

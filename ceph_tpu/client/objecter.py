@@ -15,9 +15,9 @@ import threading
 from typing import Optional
 
 from ..common.log import dout
-from ..msg.messages import (MMap, MMonCommand, MMonCommandAck,
-                            MMonSubscribe, MWatchNotify, OSDOp,
-                            OSDOpReply)
+from ..msg.messages import (MAuthReply, MMap, MMonCommand,
+                            MMonCommandAck, MMonSubscribe,
+                            MWatchNotify, OSDOp, OSDOpReply)
 from ..msg.mon_client import MonHunter
 from ..msg.messenger import Dispatcher, LocalNetwork, Message, Messenger
 from ..osd.osdmap import OSDMap
@@ -76,8 +76,17 @@ class Objecter(Dispatcher, MonHunter):
     """(ref: src/osdc/Objecter.h:1204)."""
 
     def __init__(self, network: LocalNetwork, name: str | None = None,
-                 mon="mon.0", threaded: bool = True):
+                 mon="mon.0", threaded: bool = True,
+                 auth_secret: str | None = None):
         self.name = name or f"client.{next(_client_ids)}"
+        # cephx: clients do the wire handshake (they hold only their
+        # own secret); until the mon's ticket arrives nothing but the
+        # MAuthRequest goes out (ref: MonClient::authenticate)
+        self._cephx = None
+        self.auth_error: str | None = None
+        if auth_secret is not None:
+            from ..auth import CephxClient
+            self._cephx = CephxClient(self.name, auth_secret)
         self._init_mons(mon)
         self.osdmap = OSDMap()
         self._map_ev = threading.Event()
@@ -106,6 +115,10 @@ class Objecter(Dispatcher, MonHunter):
     # ------------------------------------------------------------ setup
     def start(self) -> None:
         self.ms.start()
+        if self._cephx is not None and not self._cephx.authenticated:
+            self.ms.connect(self.mon).send_message(
+                self._cephx.build_request())
+            return        # subscription follows the MAuthReply
         self.ms.connect(self.mon).send_message(
             MMonSubscribe(what="osdmap", start=1))
 
@@ -133,13 +146,26 @@ class Objecter(Dispatcher, MonHunter):
         return done()
 
     def wait_for_map(self, epoch: int = 1, timeout: float = 30.0) -> None:
-        if not self.wait_sync(lambda: self.osdmap.epoch >= epoch,
-                              timeout):
+        if not self.wait_sync(lambda: self.osdmap.epoch >= epoch or
+                              self.auth_error is not None, timeout):
             raise TimeoutError(
                 f"no osdmap >= e{epoch} (have e{self.osdmap.epoch})")
+        if self.auth_error is not None and self.osdmap.epoch < epoch:
+            raise PermissionError(f"cephx: {self.auth_error}")
 
     # --------------------------------------------------------- dispatch
     def ms_dispatch(self, msg: Message) -> bool:
+        if isinstance(msg, MAuthReply):
+            if self._cephx is None:
+                return True
+            if self._cephx.ingest_reply(msg):
+                self.ms.auth_signer = self._cephx
+                self.ms.connect(self.mon).send_message(
+                    MMonSubscribe(what="osdmap", start=1))
+            else:
+                self.auth_error = msg.errstr or "authentication failed"
+                self._map_ev.set()       # unblock connect() waiters
+            return True
         if isinstance(msg, MMap):
             self._handle_map(msg)
             return True
@@ -153,6 +179,10 @@ class Objecter(Dispatcher, MonHunter):
         return False
 
     def _hunt_greeting(self) -> list:
+        if self._cephx is not None and not self._cephx.authenticated:
+            # a mon failover mid-handshake: re-authenticate at the new
+            # mon first — an unsigned subscription would be dropped
+            return [self._cephx.build_request()]
         return [MMonSubscribe(what="osdmap",
                               start=self.osdmap.epoch + 1)]
 
@@ -375,8 +405,12 @@ class Objecter(Dispatcher, MonHunter):
                 _, _, _, primary = self.osdmap.pg_to_up_acting_osds(raw)
             except KeyError:
                 continue
-            if primary >= 0 and primary != w.get("osd") and \
-                    self.osdmap.is_up(primary):
+            if primary < 0 or not self.osdmap.is_up(primary):
+                # no live primary: whoever comes back (even the same
+                # OSD, restarted with empty watch state) must get a
+                # fresh registration
+                w["osd"] = None
+            elif primary != w.get("osd"):
                 self.submit(w["pool"], w["oid"], "watch",
                             args={"cookie": cookie, "action": "watch"})
 

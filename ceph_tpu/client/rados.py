@@ -33,9 +33,10 @@ class Rados:
 
     def __init__(self, network: LocalNetwork, name: str | None = None,
                  mon="mon.0", op_timeout: float = 30.0,
-                 threaded: bool = True):
+                 threaded: bool = True, auth_secret: str | None = None):
         self.objecter = Objecter(network, name=name, mon=mon,
-                                 threaded=threaded)
+                                 threaded=threaded,
+                                 auth_secret=auth_secret)
         self.op_timeout = op_timeout
         self._connected = False
 

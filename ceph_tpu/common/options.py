@@ -143,6 +143,11 @@ OPTIONS: dict[str, Option] = {opt.name: opt for opt in [
        desc="distinct failure reporters required to mark an OSD down",
        runtime=True),
     _o("mon_min_osdmap_epochs", T.UINT, 500, L.DEV),
+    _o("osd_mon_report_interval", T.SECS, 5.0, L.ADVANCED,
+       desc="seconds between pg-stat reports to the mon",
+       runtime=True),
+    _o("mon_osd_stale_report_grace", T.SECS, 60.0, L.ADVANCED,
+       desc="flag osds whose last pg-stat report is older than this"),
     # balancer (ref: OSDMap.cc calc_pg_upmaps knobs)
     _o("upmap_max_deviation", T.UINT, 5, L.BASIC, runtime=True,
        desc="target max PG-count deviation per OSD"),

@@ -1,0 +1,90 @@
+"""OpTracker: in-flight op tracking with per-stage timestamps.
+
+(ref: src/common/TrackedOp.{h,cc} — TrackedOp::mark_event history,
+OpTracker::dump_ops_in_flight / dump_historic_ops served through the
+admin socket; the slow-op age warning mirrors
+osd_op_complaint_time.)
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+
+class TrackedOp:
+    """(ref: TrackedOp.h:214)."""
+
+    __slots__ = ("desc", "start", "events", "done_at")
+
+    def __init__(self, desc: str, now: float):
+        self.desc = desc
+        self.start = now
+        self.events: list[tuple[float, str]] = [(now, "initiated")]
+        self.done_at: float | None = None
+
+    def mark_event(self, name: str, now: float | None = None) -> None:
+        self.events.append((time.monotonic() if now is None else now,
+                            name))
+
+    def dump(self, now: float) -> dict:
+        end = self.done_at if self.done_at is not None else now
+        return {"description": self.desc,
+                "age": round(now - self.start, 6),
+                "duration": round(end - self.start, 6),
+                "events": [{"time": round(t - self.start, 6),
+                            "event": e} for t, e in self.events]}
+
+
+class OpTracker:
+    """(ref: TrackedOp.h:64 OpTracker)."""
+
+    def __init__(self, history_size: int = 20,
+                 complaint_time: float = 30.0):
+        self._lock = threading.Lock()
+        self._inflight: dict[object, TrackedOp] = {}
+        self._historic: deque[TrackedOp] = deque(maxlen=history_size)
+        self.complaint_time = complaint_time
+
+    def start(self, key, desc: str) -> TrackedOp:
+        op = TrackedOp(desc, time.monotonic())
+        with self._lock:
+            self._inflight[key] = op
+        return op
+
+    def mark(self, key, event: str) -> None:
+        with self._lock:
+            op = self._inflight.get(key)
+        if op is not None:
+            op.mark_event(event)
+
+    def finish(self, key, event: str = "done") -> None:
+        with self._lock:
+            op = self._inflight.pop(key, None)
+            if op is None:
+                return
+            now = time.monotonic()
+            op.events.append((now, event))
+            op.done_at = now
+            self._historic.append(op)
+
+    # -- dumps (ref: OpTracker::dump_ops_in_flight :282) ----------------
+    def dump_in_flight(self) -> dict:
+        now = time.monotonic()
+        with self._lock:
+            ops = [op.dump(now) for op in self._inflight.values()]
+        return {"num_ops": len(ops), "ops": ops}
+
+    def dump_historic(self) -> dict:
+        now = time.monotonic()
+        with self._lock:
+            ops = [op.dump(now) for op in self._historic]
+        return {"num_ops": len(ops), "ops": ops}
+
+    def slow_ops(self) -> list[dict]:
+        """Ops older than the complaint threshold
+        (ref: OpTracker::check_ops_in_flight)."""
+        now = time.monotonic()
+        with self._lock:
+            return [op.dump(now) for op in self._inflight.values()
+                    if now - op.start > self.complaint_time]

@@ -1,0 +1,11 @@
+"""cephfs-lite: a POSIX-ish file namespace on RADOS.
+
+Single-rank metadata server + libcephfs-like client
+(ref: src/mds + src/client, radically reduced: one rank, no caps/
+locks/fragmentation — but the same storage shapes: dentry-omap
+directory objects in a metadata pool, write-ahead journal, striped
+file data objects `{ino}.{objno}` in a data pool)."""
+from .client import CephFS, FileHandle
+from .mds import MDSDaemon
+
+__all__ = ["MDSDaemon", "CephFS", "FileHandle"]

@@ -1,0 +1,217 @@
+"""CephFS client: libcephfs-like API over MDS metadata + striped data.
+
+(ref: src/client/Client.cc — path ops go to the MDS, file data goes
+straight to the data pool through the file layout's striping; size
+updates flow back to the MDS the way cap flushes carry size/mtime).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+
+from ..client import RadosError
+from ..msg.messages import MClientReply, MClientRequest
+from ..msg.messenger import Dispatcher, Message
+from ..osdc.striper import StripeLayout, Striper
+
+
+class CephFSError(Exception):
+    def __init__(self, errno_name: str, msg: str = ""):
+        self.errno_name = errno_name
+        super().__init__(f"{errno_name}: {msg}" if msg else errno_name)
+
+
+def fs_data_obj(ino: int, objectno: int) -> str:
+    """(ref: file object naming {ino:x}.{objno:08x},
+    src/osdc/Striper.cc format_oid)."""
+    return f"{ino:x}.{objectno:08x}"
+
+
+class _MDSSession(Dispatcher):
+    """Request/reply channel to the MDS riding the Rados client's
+    messenger (ref: Client::send_request / MetaSession)."""
+
+    def __init__(self, rados, mds: str):
+        self.ms = rados.objecter.ms
+        self.mds = mds
+        self._tids = itertools.count(1)
+        self._pending: dict[int, tuple[threading.Event, list]] = {}
+        self._rados = rados
+        self.ms.add_dispatcher(self)
+
+    def ms_dispatch(self, msg: Message) -> bool:
+        if not isinstance(msg, MClientReply):
+            return False
+        entry = self._pending.pop(msg.tid, None)
+        if entry is None:
+            return True
+        ev, slot = entry
+        slot.append(msg)
+        ev.set()
+        return True
+
+    def call(self, op: str, args: dict, timeout: float = 30.0):
+        import time
+        tid = next(self._tids)
+        ev, slot = threading.Event(), []
+        self._pending[tid] = (ev, slot)
+        # retry the SEND until the MDS endpoint exists (a client can
+        # race the rank's bind at boot); once a send succeeded the
+        # request is never re-sent — a lost reply must not replay a
+        # non-idempotent op (ref: Client request resend is gated on
+        # session state the same way)
+        deadline = time.monotonic() + timeout
+        msg = MClientRequest(tid=tid, op=op, args=args)
+        while not self.ms.connect(self.mds).send_message(msg):
+            if time.monotonic() >= deadline:
+                self._pending.pop(tid, None)
+                raise TimeoutError(f"mds {self.mds} unreachable")
+            time.sleep(0.25)
+        if not self._rados.objecter.wait_sync(ev.is_set, timeout,
+                                              ev=ev):
+            self._pending.pop(tid, None)
+            raise TimeoutError(f"mds op {op} timed out")
+        rep = slot[0]
+        if rep.result < 0:
+            raise CephFSError(rep.errno_name or "EIO", op)
+        return rep.out
+
+
+class FileHandle:
+    """Open file (ref: src/client/Fh.h)."""
+
+    def __init__(self, fs: "CephFS", path: str, rec: dict):
+        self.fs = fs
+        self.path = path
+        self.ino = rec["ino"]
+        self.layout = StripeLayout(**rec["layout"])
+        self.size = rec.get("size", 0)
+        self._io = fs.rados.open_ioctx(rec["pool"])
+
+    # -- data path (ref: Client::_write -> Striper + Objecter) ---------
+    def write(self, offset: int, data: bytes) -> int:
+        futs = []
+        for ext in Striper.file_to_extents(self.layout, offset,
+                                           len(data)):
+            buf = data[ext.logical_offset - offset:
+                       ext.logical_offset - offset + ext.length]
+            futs.append(self._io.aio_write(
+                fs_data_obj(self.ino, ext.objectno), buf,
+                offset=ext.offset))
+        for f in futs:
+            self._io._wait(f)
+        if offset + len(data) > self.size:
+            self.size = offset + len(data)
+            self.fs._session.call("setattr", {"path": self.path,
+                                              "size": self.size})
+        return len(data)
+
+    def read(self, offset: int, length: int = 0) -> bytes:
+        if length == 0 or offset + length > self.size:
+            length = max(0, self.size - offset)
+        if length == 0:
+            return b""
+        out = bytearray(length)
+        pend = []
+        for ext in Striper.file_to_extents(self.layout, offset,
+                                           length):
+            pend.append((ext, self._io.aio_read(
+                fs_data_obj(self.ino, ext.objectno),
+                length=ext.length, offset=ext.offset)))
+        for ext, fut in pend:
+            try:
+                buf = self._io._wait(fut).data
+            except RadosError as ex:
+                if ex.errno_name != "ENOENT":
+                    raise
+                buf = b""                        # sparse hole
+            dst = ext.logical_offset - offset
+            out[dst:dst + len(buf)] = buf
+        return bytes(out)
+
+    def fsync(self) -> None:
+        self.fs._session.call("setattr", {"path": self.path,
+                                          "size": self.size})
+
+    def close(self) -> None:
+        self.fsync()
+
+
+class CephFS:
+    """(ref: libcephfs.h surface, pythonized)."""
+
+    def __init__(self, rados, mds: str = "mds.0"):
+        self.rados = rados
+        self._session = _MDSSession(rados, mds)
+
+    # -- namespace ------------------------------------------------------
+    def mkdir(self, path: str) -> None:
+        self._session.call("mkdir", {"path": path})
+
+    def mkdirs(self, path: str) -> None:
+        parts = [p for p in path.strip("/").split("/") if p]
+        for i in range(1, len(parts) + 1):
+            try:
+                self.mkdir("/" + "/".join(parts[:i]))
+            except CephFSError as e:
+                if e.errno_name != "EEXIST":
+                    raise
+
+    def listdir(self, path: str = "/") -> list[str]:
+        return sorted(self._session.call("readdir", {"path": path}))
+
+    def stat(self, path: str) -> dict:
+        return self._session.call("lookup", {"path": path})
+
+    def exists(self, path: str) -> bool:
+        try:
+            self.stat(path)
+            return True
+        except CephFSError:
+            return False
+
+    def rename(self, src: str, dst: str) -> None:
+        self._session.call("rename", {"src": src, "dst": dst})
+
+    def rmdir(self, path: str) -> None:
+        self._session.call("rmdir", {"path": path})
+
+    def unlink(self, path: str) -> None:
+        rec = self._session.call("unlink", {"path": path})
+        # purge data objects (ref: the reference defers this to the
+        # MDS PurgeQueue; the client-side purge keeps one moving part)
+        layout = StripeLayout(**rec["layout"])
+        io = self.rados.open_ioctx(rec["pool"])
+        size = rec.get("size", 0)
+        if size:
+            objnos = {e.objectno for e in
+                      Striper.file_to_extents(layout, 0, size)}
+            for objno in sorted(objnos):
+                try:
+                    io.remove(fs_data_obj(rec["ino"], objno))
+                except RadosError:
+                    pass
+
+    # -- files ----------------------------------------------------------
+    def open(self, path: str, mode: str = "r",
+             layout: dict | None = None) -> FileHandle:
+        if "w" in mode or "a" in mode or "+" in mode:
+            rec = self._session.call("create", {"path": path,
+                                                "layout": layout})
+        else:
+            rec = self.stat(path)
+            if rec["type"] != "f":
+                raise CephFSError("EISDIR", path)
+        return FileHandle(self, path, rec)
+
+    def write_file(self, path: str, data: bytes) -> None:
+        fh = self.open(path, "w")
+        fh.write(0, data)
+        fh.close()
+
+    def read_file(self, path: str) -> bytes:
+        fh = self.open(path)
+        return fh.read(0)
+
+    def statfs(self) -> dict:
+        return self._session.call("statfs", {})

@@ -1,0 +1,347 @@
+"""MDS: the metadata server rank.
+
+Reference shapes kept (ref: src/mds/MDSRank.cc dispatch;
+src/mds/CDir.cc dirfrag storage — directories are RADOS objects whose
+omap maps dentry name -> inode; src/mds/MDLog.cc + src/osdc/
+Journaler.cc — every metadata mutation is journaled to a RADOS object
+before the dirfrag update, and replayed on startup):
+
+* `dir.<ino:x>` objects in the metadata pool hold one directory each:
+  omap dentry name -> JSON inode record (primary dentries embed the
+  inode, like CDentry::linkage).
+* `mds.journal` is the write-ahead log: each op appends one JSON line
+  (seq, op, omap deltas) BEFORE the dirfrag omap update; `mds.meta`
+  tracks `applied_seq` (advanced lazily every few ops, so a crash
+  leaves a replay window) and the inode allocator.  On boot the MDS
+  replays entries past applied_seq — all deltas are idempotent
+  upserts/deletes, so replay converges (ref: MDLog::replay).
+* File data never touches the MDS: clients stripe `{ino:x}.{objno:08x}`
+  objects into the data pool themselves (ref: file_layout_t +
+  Striper), and report size growth via setattr like cap flushes.
+
+Single rank, synchronous ops, no client caps — the concurrency story
+is the mon-style "one dispatch at a time" lock.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from ..client import RadosError, WriteOp
+from ..common.log import dout
+from ..msg.messages import MClientReply, MClientRequest
+from ..msg.messenger import Dispatcher, Message, Messenger
+
+ROOT_INO = 1
+JOURNAL_OBJ = "mds.journal"
+META_OBJ = "mds.meta"
+#: applied_seq persists every N ops: the gap is the replay window
+APPLY_EVERY = 8
+
+_ERRNO = {"ENOENT": -2, "EEXIST": -17, "ENOTDIR": -20, "EISDIR": -21,
+          "EINVAL": -22, "ENOTEMPTY": -39}
+
+
+def dir_obj(ino: int) -> str:
+    return f"dir.{ino:x}"
+
+
+class MDSError(Exception):
+    def __init__(self, errno_name: str, msg: str = ""):
+        self.errno_name = errno_name
+        super().__init__(f"{errno_name}: {msg}" if msg else errno_name)
+
+
+class MDSDaemon(Dispatcher):
+    """mds.<rank> — rank 0 only (ref: src/mds/MDSDaemon.cc)."""
+
+    def __init__(self, network, rados, rank: int = 0,
+                 metadata_pool: str = "cephfs_metadata",
+                 data_pool: str = "cephfs_data",
+                 threaded: bool = True):
+        self.name = f"mds.{rank}"
+        self.rados = rados
+        for pool in (metadata_pool, data_pool):
+            try:
+                rados.pool_lookup(pool)
+            except RadosError:
+                rados.pool_create(pool, pg_num=32)
+        self.meta = rados.open_ioctx(metadata_pool)
+        self.data_pool = data_pool
+        self._lock = threading.RLock()
+        self._seq = 0
+        self._next_ino = ROOT_INO + 1
+        self._ops_since_apply = 0
+        self._mkfs_or_replay()
+        self.ms = Messenger.create(network, self.name,
+                                   threaded=threaded)
+        self.ms.add_dispatcher(self)
+
+    def init(self) -> None:
+        self.ms.start()
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._persist_applied()
+        self.ms.shutdown()
+
+    # ------------------------------------------------------ journal/WAL
+    def _mkfs_or_replay(self) -> None:
+        """(ref: MDSRank boot: journal replay before going active)."""
+        try:
+            meta = self.meta.get_omap_vals(META_OBJ)[0]
+        except RadosError:
+            # fresh fs: root dir + meta + empty journal
+            self.meta.create(META_OBJ)
+            self.meta.create(JOURNAL_OBJ)
+            self.meta.create(dir_obj(ROOT_INO))
+            self.meta.set_omap(META_OBJ, {
+                "applied_seq": b"0", "next_ino": str(ROOT_INO + 1)
+                .encode()})
+            return
+        applied = int(meta.get("applied_seq", b"0"))
+        self._next_ino = int(meta.get("next_ino", b"2"))
+        try:
+            raw = self.meta.read(JOURNAL_OBJ)
+        except RadosError:
+            raw = b""
+        replayed = 0
+        for line in raw.splitlines():
+            if not line.strip():
+                continue
+            ent = json.loads(line)
+            self._seq = max(self._seq, ent["seq"])
+            self._next_ino = max(self._next_ino,
+                                 ent.get("next_ino", 0))
+            if ent["seq"] <= applied:
+                continue
+            self._apply_deltas(ent["deltas"])
+            replayed += 1
+        if replayed:
+            dout("mds", 1).write("%s: replayed %d journal entries",
+                                 self.name, replayed)
+        self._persist_applied()
+        if self._seq > 1000:                      # trim (ref: MDLog trim)
+            self.meta.write_full(JOURNAL_OBJ, b"")
+
+    def _journal(self, op: str, deltas: list) -> None:
+        """Append-then-apply: the WAL write lands before the dirfrag
+        mutation (ref: Journaler::append_entry + flush)."""
+        self._seq += 1
+        line = json.dumps({"seq": self._seq, "op": op,
+                           "next_ino": self._next_ino,
+                           "deltas": deltas}) + "\n"
+        self.meta.append(JOURNAL_OBJ, line.encode())
+        self._apply_deltas(deltas)
+        self._ops_since_apply += 1
+        if self._ops_since_apply >= APPLY_EVERY:
+            self._persist_applied()
+
+    def _apply_deltas(self, deltas: list) -> None:
+        """Idempotent omap upserts/deletes on dirfrag objects."""
+        for d in deltas:
+            kind, obj = d[0], d[1]
+            if kind == "set":
+                self.meta.operate(obj, WriteOp().set_omap(
+                    {k: v.encode() for k, v in d[2].items()}))
+            elif kind == "rm":
+                try:
+                    self.meta.remove_omap_keys(obj, d[2])
+                except RadosError:
+                    pass
+            elif kind == "rmobj":
+                try:
+                    self.meta.remove(obj)
+                except RadosError:
+                    pass
+            elif kind == "mkobj":
+                self.meta.create(obj)
+
+    def _persist_applied(self) -> None:
+        self.meta.set_omap(META_OBJ, {
+            "applied_seq": str(self._seq).encode(),
+            "next_ino": str(self._next_ino).encode()})
+        self._ops_since_apply = 0
+
+    # ------------------------------------------------------- name space
+    def _readdir(self, ino: int) -> dict[str, dict]:
+        try:
+            vals, _ = self.meta.get_omap_vals(dir_obj(ino))
+        except RadosError:
+            raise MDSError("ENOENT", f"dir ino {ino:x}")
+        return {k: json.loads(v) for k, v in vals.items()}
+
+    def _resolve(self, path: str) -> tuple[int, str, dict | None]:
+        """path -> (parent ino, final name, dentry|None).
+        (ref: MDCache::path_traverse)."""
+        parts = [p for p in path.strip("/").split("/") if p]
+        if not parts:
+            return 0, "", {"ino": ROOT_INO, "type": "d"}
+        ino = ROOT_INO
+        for i, comp in enumerate(parts[:-1]):
+            ents = self._readdir(ino)
+            d = ents.get(comp)
+            if d is None:
+                raise MDSError("ENOENT", "/".join(parts[:i + 1]))
+            if d["type"] != "d":
+                raise MDSError("ENOTDIR", comp)
+            ino = d["ino"]
+        ents = self._readdir(ino)
+        return ino, parts[-1], ents.get(parts[-1])
+
+    def _alloc_ino(self) -> int:
+        ino = self._next_ino
+        self._next_ino += 1
+        return ino
+
+    # ------------------------------------------------------- operations
+    def handle_op(self, op: str, args: dict):
+        """Returns the reply payload; raises MDSError.
+        (ref: Server::dispatch_client_request op switch)."""
+        with self._lock:
+            return getattr(self, f"_op_{op}")(args)
+
+    def _op_mkdir(self, a):
+        parent, name, dent = self._resolve(a["path"])
+        if not name:
+            raise MDSError("EEXIST", "/")
+        if dent is not None:
+            raise MDSError("EEXIST", a["path"])
+        ino = self._alloc_ino()
+        rec = {"ino": ino, "type": "d",
+               "mtime": time.time()}
+        self._journal("mkdir", [
+            ("mkobj", dir_obj(ino)),
+            ("set", dir_obj(parent), {name: json.dumps(rec)})])
+        return rec
+
+    def _op_create(self, a):
+        parent, name, dent = self._resolve(a["path"])
+        if not name:
+            raise MDSError("EISDIR", "/")
+        if dent is not None:
+            if dent["type"] == "d":
+                raise MDSError("EISDIR", a["path"])
+            return dent                    # open-existing
+        ino = self._alloc_ino()
+        rec = {"ino": ino, "type": "f", "size": 0,
+               "mtime": time.time(),
+               "layout": a.get("layout") or
+               {"stripe_unit": 1 << 16, "stripe_count": 4,
+                "object_size": 1 << 18},
+               "pool": self.data_pool}
+        self._journal("create", [
+            ("set", dir_obj(parent), {name: json.dumps(rec)})])
+        return rec
+
+    def _op_lookup(self, a):
+        _parent, _name, dent = self._resolve(a["path"])
+        if dent is None:
+            raise MDSError("ENOENT", a["path"])
+        return dent
+
+    def _op_readdir(self, a):
+        _parent, _name, dent = self._resolve(a["path"])
+        if dent is None:
+            raise MDSError("ENOENT", a["path"])
+        if dent["type"] != "d":
+            raise MDSError("ENOTDIR", a["path"])
+        return self._readdir(dent["ino"])
+
+    def _op_unlink(self, a):
+        parent, name, dent = self._resolve(a["path"])
+        if dent is None:
+            raise MDSError("ENOENT", a["path"])
+        if dent["type"] == "d":
+            raise MDSError("EISDIR", a["path"])
+        self._journal("unlink", [("rm", dir_obj(parent), [name])])
+        return dent                      # client purges the data objs
+
+    def _op_rmdir(self, a):
+        parent, name, dent = self._resolve(a["path"])
+        if dent is None:
+            raise MDSError("ENOENT", a["path"])
+        if dent["type"] != "d":
+            raise MDSError("ENOTDIR", a["path"])
+        if self._readdir(dent["ino"]):
+            raise MDSError("ENOTEMPTY", a["path"])
+        self._journal("rmdir", [
+            ("rm", dir_obj(parent), [name]),
+            ("rmobj", dir_obj(dent["ino"]))])
+        return None
+
+    def _op_rename(self, a):
+        """(ref: Server::handle_client_rename, single-rank so no
+        subtree migration)."""
+        src = "/" + "/".join(p for p in a["src"].split("/") if p)
+        dst = "/" + "/".join(p for p in a["dst"].split("/") if p)
+        sp, sname, sdent = self._resolve(a["src"])
+        if sdent is None:
+            raise MDSError("ENOENT", a["src"])
+        if src == dst:
+            return sdent                 # POSIX: rename to self is a no-op
+        if dst.startswith(src + "/"):
+            # a directory cannot move into its own subtree
+            # (ref: the rename cycle check in Server::handle_client_rename)
+            raise MDSError("EINVAL", f"{dst} is inside {src}")
+        dp, dname, ddent = self._resolve(a["dst"])
+        if not dname:
+            raise MDSError("EINVAL", a["dst"])
+        if ddent is not None:
+            if ddent["type"] == "d":
+                if self._readdir(ddent["ino"]):
+                    raise MDSError("ENOTEMPTY", a["dst"])
+            elif sdent["type"] == "d":
+                raise MDSError("ENOTDIR", a["dst"])
+        deltas = [("set", dir_obj(dp), {dname: json.dumps(sdent)}),
+                  ("rm", dir_obj(sp), [sname])]
+        if ddent is not None and ddent["type"] == "d":
+            deltas.append(("rmobj", dir_obj(ddent["ino"])))
+        self._journal("rename", deltas)
+        return sdent
+
+    def _op_setattr(self, a):
+        parent, name, dent = self._resolve(a["path"])
+        if dent is None:
+            raise MDSError("ENOENT", a["path"])
+        for k in ("size", "mtime"):
+            if k in a:
+                dent[k] = a[k]
+        self._journal("setattr", [
+            ("set", dir_obj(parent), {name: json.dumps(dent)})])
+        return dent
+
+    def _op_statfs(self, a):
+        def count(ino):
+            files = dirs = 0
+            for d in self._readdir(ino).values():
+                if d["type"] == "d":
+                    dirs += 1
+                    f2, d2 = count(d["ino"])
+                    files, dirs = files + f2, dirs + d2
+                else:
+                    files += 1
+            return files, dirs
+        files, dirs = count(ROOT_INO)
+        return {"files": files, "dirs": dirs,
+                "next_ino": self._next_ino}
+
+    # --------------------------------------------------------- dispatch
+    def ms_dispatch(self, msg: Message) -> bool:
+        if not isinstance(msg, MClientRequest):
+            return False
+        try:
+            out = self.handle_op(msg.op, msg.args)
+            reply = MClientReply(tid=msg.tid, result=0, out=out)
+        except MDSError as e:
+            reply = MClientReply(tid=msg.tid,
+                                 result=_ERRNO.get(e.errno_name, -22),
+                                 errno_name=e.errno_name)
+        except (KeyError, AttributeError, TypeError, ValueError) as e:
+            reply = MClientReply(tid=msg.tid, result=-22,
+                                 errno_name="EINVAL")
+            dout("mds", 1).write("%s: bad request %s: %s", self.name,
+                                 msg.op, e)
+        self.ms.connect(msg.src).send_message(reply)
+        return True

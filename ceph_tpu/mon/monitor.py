@@ -28,16 +28,19 @@ from collections import deque
 
 from ..common.log import dout
 from ..common.options import global_config
-from ..msg.messages import (MMap, MMonCommand, MMonCommandAck,
+from ..msg.messages import (MAuthRequest, MConfig, MMap, MMonCommand,
+                            MMonCommandAck,
                             MMonElection, MMonForward, MMonLease,
                             MMonLeaseAck, MMonSubscribe, MOSDBoot,
                             MOSDFailure, MPaxosAccept, MPaxosBegin,
                             MPaxosCommit, MPaxosStoreSync,
-                            MPaxosSyncReq)
+                            MPaxosSyncReq, MPGStats)
 from ..msg.messenger import Dispatcher, LocalNetwork, Message, Messenger
 from ..osd.osdmap import CEPH_OSD_AUTOOUT, CEPH_OSD_IN, OSDMap
+from .config_monitor import ConfigMonitor
 from .elector import Elector
 from .osd_monitor import OSDMonitor
+from .pg_map import OSDStatReport, PGMap, health_checks, health_status
 from .paxos import Paxos
 from .store import MonitorStore
 
@@ -71,7 +74,7 @@ class Monitor(Dispatcher):
                  initial_map: OSDMap | None = None,
                  initial_wrapper=None, store: MonitorStore | None = None,
                  threaded: bool = True, clock=time.monotonic,
-                 mon_ranks: list[int] | None = None):
+                 mon_ranks: list[int] | None = None, keyring=None):
         self.name = f"mon.{rank}"
         self.rank = rank
         #: injectable clock so harnesses can run the failure/auto-out
@@ -80,12 +83,27 @@ class Monitor(Dispatcher):
         self.store = store or MonitorStore()
         self.paxos = Paxos(self.store)
         self.osdmon = OSDMonitor(self.paxos, initial_map, initial_wrapper)
+        self.configmon = ConfigMonitor(self.paxos)
         self.ms = Messenger.create(network, self.name, threaded=threaded)
+        # cephx: the mon runs the key server and gates inbound traffic
+        # (ref: AuthMonitor + CephxServiceHandler)
+        self.cephx = None
+        if keyring is not None:
+            from ..auth import (SERVICE_ENTITY, CephxClient,
+                                CephxServer, CephxVerifier)
+            self.cephx = CephxServer(keyring)
+            svc = keyring.get(SERVICE_ENTITY)
+            self.ms.auth_verifier = CephxVerifier(svc)
+            self.ms.auth_signer = CephxClient.self_mint(self.name, svc)
         self.ms.add_dispatcher(self)
         # osdmap subscribers: entity -> next epoch they need
         self._subs: dict[str, int] = {}
+        # config subscribers: entity -> last version sent
+        self._config_subs: dict[str, int] = {}
         # failure reports: target osd -> {reporter: stamp}
         self._failure_reports: dict[int, dict[int, float]] = {}
+        # cluster statistics digest (ref: src/mon/PGMap.h)
+        self.pgmap = PGMap()
         self._down_stamp: dict[int, float] = {}
         self._lock = threading.RLock()
         # ---- quorum state ------------------------------------------
@@ -113,13 +131,39 @@ class Monitor(Dispatcher):
     # ------------------------------------------------------------ setup
     def init(self) -> None:
         self.osdmon.init()
+        self.configmon.init()
         self.ms.start()
         if not self.standalone:
             self.elector.start()
             self._persist_elector()
 
     def shutdown(self) -> None:
+        if getattr(self, "asok", None) is not None:
+            self.asok.shutdown()
         self.ms.shutdown()
+
+    def start_admin_socket(self, path: str) -> None:
+        """`ceph daemon mon.N <cmd>` endpoint
+        (ref: Monitor::do_admin_command)."""
+        from ..common.admin_socket import AdminSocket
+        a = AdminSocket(path)
+
+        def _via_preprocess(prefix):
+            def fn(c):
+                with self._lock:
+                    res = self._preprocess_mon_command(
+                        {**c, "prefix": prefix})
+                r, outs, outb = res
+                return r, outb if outb is not None else outs
+            return fn
+        for p in ("status", "health", "df", "quorum_status",
+                  "pg stat"):
+            a.register(p.replace(" ", "_") if p == "pg stat" else p,
+                       f"mon {p}", _via_preprocess(p))
+        a.register("config show", "live config",
+                   lambda c: (0, global_config().dump()))
+        a.start()
+        self.asok = a
 
     @property
     def osdmap(self) -> OSDMap:
@@ -152,6 +196,8 @@ class Monitor(Dispatcher):
         # fresh reign: re-stage on top of the committed state
         self.osdmon.update_from_paxos()
         self.osdmon.create_pending()
+        self.configmon.update_from_paxos()
+        self.configmon.create_pending()
         self._persist_elector()
         self._broadcast_lease()
         self._publish()
@@ -180,7 +226,7 @@ class Monitor(Dispatcher):
             self._chg_inflight_reply = None
             cb(-11, errno_name, None)
         while self._chg_queue:
-            _stage, reply_cb = self._chg_queue.popleft()
+            _stage, reply_cb, _svc = self._chg_queue.popleft()
             if reply_cb is not None:
                 reply_cb(-11, errno_name, None)
         self._chg_busy = False
@@ -195,14 +241,20 @@ class Monitor(Dispatcher):
                     last_committed=self.paxos.last_committed))
 
     def _on_peon_commit(self) -> None:
-        """A replicated value landed on this peon: refresh the service
+        """A replicated value landed on this peon: refresh the services
         and serve our subscribers."""
         self.osdmon.update_from_paxos()
+        self.configmon.update_from_paxos()
         self._publish()
 
     # -------------------------------------------------------- dispatch
     def ms_dispatch(self, msg: Message) -> bool:
         with self._lock:
+            if isinstance(msg, MAuthRequest):
+                if self.cephx is not None:
+                    self.ms.connect(msg.src).send_message(
+                        self.cephx.handle_request(msg))
+                return True
             if isinstance(msg, MMonCommand):
                 self._handle_wire_command(msg.cmd, msg.src, msg.tid)
                 return True
@@ -218,6 +270,19 @@ class Monitor(Dispatcher):
                 if self._relay_if_peon(msg):
                     return True
                 self._handle_failure(msg)
+                return True
+            if isinstance(msg, MPGStats):
+                self.pgmap.ingest(OSDStatReport(
+                    osd=msg.osd, epoch=msg.epoch, stamp=msg.stamp,
+                    pg_stats=msg.pg_stats, kb_total=msg.kb_total,
+                    kb_used=msg.kb_used, kb_avail=msg.kb_avail))
+                # mirror OSD-originated reports to the other mons so
+                # status/health/df answer the same from any rank (the
+                # reference replicates the digest via MgrStatMonitor)
+                if msg.src.startswith("osd."):
+                    for r in self.mon_ranks:
+                        if r != self.rank:
+                            self._send_rank(r, msg)
                 return True
             if isinstance(msg, MMonElection):
                 self.elector.handle(msg)
@@ -337,9 +402,17 @@ class Monitor(Dispatcher):
                           client: str = "", tid: int = 0) -> None:
         """preprocess locally; stage writes through the change queue
         (leader) or forward them to it (peon,
-        ref: Monitor::forward_request_leader)."""
+        ref: Monitor::forward_request_leader).  The prefix routes to
+        the owning PaxosService (ref: Monitor::dispatch_op's service
+        fan-out)."""
+        res = self._preprocess_mon_command(cmdmap)
+        if res is not None:
+            reply_cb(*res)
+            return
+        svc = self.configmon if str(cmdmap.get("prefix", ""))\
+            .startswith("config") else self.osdmon
         try:
-            res = self.osdmon.preprocess_command(cmdmap)
+            res = svc.preprocess_command(cmdmap)
         except (KeyError, ValueError, TypeError) as ex:
             reply_cb(-22, f"invalid command arguments: {ex}", None)
             return
@@ -355,7 +428,83 @@ class Monitor(Dispatcher):
                 tid=tid, client=client, cmd=cmdmap))
             return
         self._submit_change(
-            lambda: self.osdmon.prepare_command(cmdmap), reply_cb)
+            lambda: svc.prepare_command(cmdmap), reply_cb, svc)
+
+    # ------------------------------------------- cluster-level commands
+    # (ref: Monitor::handle_command's mon-level table — `ceph -s`
+    #  Monitor.cc get_cluster_status, health get_health, df from PGMap)
+    def quorum(self) -> list[int]:
+        if self.standalone:
+            return [self.rank]
+        return sorted(self.paxos.quorum or [self.rank])
+
+    def _preprocess_mon_command(self, cmdmap: dict):
+        prefix = cmdmap.get("prefix", "")
+        if prefix not in ("status", "health", "health detail", "df",
+                          "pg stat", "pg dump", "quorum_status",
+                          "mon stat"):
+            return None
+        now = self.clock()
+        up = {o for o in range(self.osdmap.max_osd)
+              if self.osdmap.is_up(o)}
+        pgs = self.pgmap.primary_pgs(up)    # one digest per command
+        checks = health_checks(
+            self.osdmap, self.pgmap, self.quorum(), self.mon_ranks,
+            now, stale_after=global_config()
+            ["mon_osd_stale_report_grace"], pgs=pgs)
+        if prefix in ("health", "health detail"):
+            out = {"status": health_status(checks),
+                   "checks": {k: {"severity": v["severity"],
+                                  "summary": v["summary"]}
+                              for k, v in checks.items()}}
+            if prefix == "health detail":
+                for k, v in checks.items():
+                    out["checks"][k]["detail"] = v["detail"]
+            return 0, out["status"], out
+        if prefix in ("quorum_status", "mon stat"):
+            return 0, "", {"quorum": self.quorum(),
+                           "leader": self.leader_rank,
+                           "mons": list(self.mon_ranks),
+                           "election_epoch": self.elector.epoch}
+        if prefix == "pg stat":
+            t = self.pgmap.totals(pgs)
+            states = self.pgmap.pg_states(pgs)
+            return 0, (f"{t['num_pgs']} pgs: "
+                       + ", ".join(f"{n} {s}" for s, n in
+                                   sorted(states.items()))
+                       + f"; {t['num_objects']} objects"), \
+                {"states": states, **t}
+        if prefix == "pg dump":
+            return 0, "", pgs
+        if prefix == "df":
+            d = self.pgmap.df(pgs, up)
+            d["pools"] = {
+                self.osdmap.pool_names.get(pid, str(pid)): st
+                for pid, st in d["pools"].items()}
+            return 0, "", d
+        # status == `ceph -s`
+        n_in = sum(1 for o in range(self.osdmap.max_osd)
+                   if self.osdmap.exists(o) and self.osdmap.is_in(o))
+        exists = sum(1 for o in range(self.osdmap.max_osd)
+                     if self.osdmap.exists(o))
+        t = self.pgmap.totals(pgs)
+        return 0, "", {
+            "health": {"status": health_status(checks),
+                       "checks": {k: v["summary"]
+                                  for k, v in checks.items()}},
+            "monmap": {"mons": list(self.mon_ranks),
+                       "quorum": self.quorum(),
+                       "leader": self.leader_rank},
+            "osdmap": {"epoch": self.osdmap.epoch, "num_osds": exists,
+                       "num_up_osds": len(up), "num_in_osds": n_in},
+            "pgmap": {"num_pgs": t["num_pgs"],
+                      "pgs_by_state": self.pgmap.pg_states(pgs),
+                      "num_objects": t["num_objects"],
+                      "bytes_data": t["bytes"],
+                      **{k: v for k, v in
+                         self.pgmap.df(pgs, up).items()
+                         if k != "pools"}},
+        }
 
     def handle_command(self, cmdmap: dict) -> tuple[int, str, object]:
         """Synchronous command path (tests/CLI).  Completes inline on a
@@ -365,8 +514,13 @@ class Monitor(Dispatcher):
         slot: dict = {}
         with self._lock:
             if not self.standalone:
+                res = self._preprocess_mon_command(cmdmap)
+                if res is not None:
+                    return res
+                svc = self.configmon if str(cmdmap.get("prefix", ""))\
+                    .startswith("config") else self.osdmon
                 try:
-                    res = self.osdmon.preprocess_command(cmdmap)
+                    res = svc.preprocess_command(cmdmap)
                 except (KeyError, ValueError, TypeError) as ex:
                     return -22, f"invalid command arguments: {ex}", None
                 if res is not None:
@@ -383,11 +537,11 @@ class Monitor(Dispatcher):
         return slot["r"], slot["outs"], slot["outb"]
 
     # ---------------------------------------------- serialized changes
-    def _submit_change(self, stage, reply_cb=None) -> None:
-        """stage() runs prepare handlers against pending_inc and
-        returns (r, outs, outb) or None; the proposal commits before
-        the next change stages (the reference's paxos plug)."""
-        self._chg_queue.append((stage, reply_cb))
+    def _submit_change(self, stage, reply_cb=None, svc=None) -> None:
+        """stage() runs prepare handlers against the service's pending
+        state and returns (r, outs, outb) or None; the proposal commits
+        before the next change stages (the reference's paxos plug)."""
+        self._chg_queue.append((stage, reply_cb, svc or self.osdmon))
         self._pump_changes()
 
     def _pump_changes(self) -> None:
@@ -398,18 +552,18 @@ class Monitor(Dispatcher):
             return
         if self._catchup_pending:
             return   # collect phase: lease acks will pump us
-        stage, reply_cb = self._chg_queue.popleft()
+        stage, reply_cb, svc = self._chg_queue.popleft()
         try:
             res = stage()
         except (KeyError, ValueError, TypeError) as ex:
-            self.osdmon.create_pending()
+            svc.create_pending()
             if reply_cb is not None:
                 reply_cb(-22, f"invalid command arguments: {ex}", None)
             self._pump_changes()
             return
         r, outs, outb = res if res is not None else (0, "", None)
-        if r != 0 or self.osdmon._is_pending_empty():
-            self.osdmon.create_pending()
+        if r != 0 or svc._is_pending_empty():
+            svc.create_pending()
             if reply_cb is not None:
                 reply_cb(r, outs, outb)
             self._pump_changes()
@@ -425,14 +579,29 @@ class Monitor(Dispatcher):
                 reply_cb(r, outs, outb)
             self._pump_changes()
 
-        self.osdmon.propose_pending(on_done=committed)
+        svc.propose_pending(on_done=committed)
 
     # ---------------------------------------------------- subscriptions
     def _handle_subscribe(self, msg: MMonSubscribe) -> None:
+        if msg.what == "config":
+            self._config_subs[msg.src] = 0
+            self._send_config(msg.src)
+            return
         if msg.what != "osdmap":
             return
         self._subs[msg.src] = msg.start or 1
         self._send_maps(msg.src)
+
+    def _send_config(self, entity: str) -> None:
+        """Push the entity's merged config when it changed since the
+        last push (ref: ConfigMonitor::send_config / check_all_subs)."""
+        ver = self.configmon.get_last_committed()
+        if self._config_subs.get(entity, 0) >= ver:
+            return
+        self._config_subs[entity] = ver
+        self.ms.connect(entity).send_message(MConfig(
+            version=ver,
+            values=self.configmon.entity_config(entity)))
 
     def _send_maps(self, entity: str) -> None:
         """Send everything from the subscriber's next epoch to current
@@ -464,6 +633,8 @@ class Monitor(Dispatcher):
         """Push new epochs to all subscribers (post-commit)."""
         for entity in list(self._subs):
             self._send_maps(entity)
+        for entity in list(self._config_subs):
+            self._send_config(entity)
 
     # ------------------------------------------------------------- boot
     def _handle_boot(self, msg: MOSDBoot) -> None:
@@ -521,9 +692,16 @@ class Monitor(Dispatcher):
         if len(reports) >= need:
             self._mark_down(target)
 
+    def _mark_down_pgmap(self, osd: int) -> None:
+        """Drop a downed OSD's stat report: its capacity must leave the
+        df totals and its stale primary claims must not fight the new
+        primary's (ref: PGMap purged on osd removal)."""
+        self.pgmap.forget(osd)
+
     def _mark_down(self, osd: int) -> None:
         self._failure_reports.pop(osd, None)
         self._down_stamp[osd] = self.clock()
+        self._mark_down_pgmap(osd)
 
         def stage():
             if self.osdmap.is_down(osd):

@@ -183,6 +183,63 @@ class OSDOpReply(Message):
 
 
 @dataclass
+class MPGStats(Message):
+    """osd -> mon: periodic pg + usage stat report
+    (ref: src/messages/MPGStats.h; osd_stat_t / pg_stat_t)."""
+    osd: int = -1
+    epoch: int = 0
+    stamp: float = 0.0
+    pg_stats: dict = field(default_factory=dict)
+    kb_total: int = 0
+    kb_used: int = 0
+    kb_avail: int = 0
+
+
+@dataclass
+class MAuthRequest(Message):
+    """client/daemon -> mon: prove identity (ref: src/messages/MAuth.h
+    + CephxAuthorizer)."""
+    entity: str = ""
+    nonce: str = ""
+    sig: str = ""
+
+
+@dataclass
+class MAuthReply(Message):
+    """(ref: src/messages/MAuthReply.h): session ticket or failure."""
+    result: int = 0
+    errstr: str = ""
+    challenge: str = ""
+    ticket: Any = None
+
+
+@dataclass
+class MClientRequest(Message):
+    """client -> mds metadata op (ref: src/messages/MClientRequest.h;
+    op codes CEPH_MDS_OP_* src/include/ceph_fs.h)."""
+    tid: int = 0
+    op: str = ""
+    args: dict = field(default_factory=dict)
+
+
+@dataclass
+class MClientReply(Message):
+    """(ref: src/messages/MClientReply.h)."""
+    tid: int = 0
+    result: int = 0
+    errno_name: str = ""
+    out: Any = None
+
+
+@dataclass
+class MConfig(Message):
+    """mon -> daemon: your merged centralized-config view changed
+    (ref: src/messages/MConfig.h)."""
+    version: int = 0
+    values: dict = field(default_factory=dict)
+
+
+@dataclass
 class MWatchNotify(Message):
     """OSD -> watching client: a notify fired on an object you watch
     (ref: src/messages/MWatchNotify.h)."""

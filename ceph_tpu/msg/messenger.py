@@ -34,6 +34,10 @@ class Message:
     # filled in by the transport on send:
     src: EntityName = field(default="", compare=False)
     seq: int = field(default=0, compare=False)
+    # cephx message signature (ticket + hmac), attached by the
+    # sender's auth handler when auth is enabled
+    # (ref: Message signing under session keys, msgr v2)
+    auth: Optional[dict] = field(default=None, compare=False)
 
     @property
     def type_name(self) -> str:
@@ -77,6 +81,10 @@ class Messenger:
         self._queue: "queue.Queue[Optional[Message]]" = queue.Queue()
         self._thread: threading.Thread | None = None
         self._running = False
+        # cephx hooks: signer stamps outgoing copies, verifier gates
+        # incoming (None = auth off; ref: ms_verify_authorizer)
+        self.auth_signer = None
+        self.auth_verifier = None
 
     # -- factory (ref: Messenger.cc:21 Messenger::create) ---------------
     @staticmethod
@@ -125,6 +133,8 @@ class Messenger:
         # broadcast loop) while earlier sends are still in flight
         import dataclasses
         msg = dataclasses.replace(msg, src=self.name, seq=next(_seq))
+        if self.auth_signer is not None:
+            msg = self.auth_signer.sign(msg)
         return self.network.route(self.name, peer, msg)
 
     def enqueue(self, msg: Message) -> None:
@@ -159,6 +169,12 @@ class Messenger:
                     traceback.format_exc())
 
     def _deliver(self, msg: Message) -> None:
+        if self.auth_verifier is not None and \
+                not self.auth_verifier.verify(msg):
+            dout("ms", 1).write(
+                "%s: dropping unauthenticated %s from %s", self.name,
+                msg.type_name, msg.src)
+            return
         for d in self.dispatchers:
             if d.ms_dispatch(msg):
                 return

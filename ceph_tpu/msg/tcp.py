@@ -71,10 +71,18 @@ class TcpMessenger:
         self.dispatchers: list[Dispatcher] = []
         self._lock = threading.Lock()
         self._out: dict[str, socket.socket] = {}   # peer -> conn
+        # connections learned from inbound traffic: lets us answer
+        # peers with no monmap address (clients are not in the monmap;
+        # the reference learns entity addrs from the connection banner
+        # and replies over the accepted socket)
+        self._learned: dict[str, socket.socket] = {}
         self._running = False
         self._listener: socket.socket | None = None
         self._threads: list[threading.Thread] = []
         self._seq = 0
+        # cephx hooks (same surface as the in-process messenger)
+        self.auth_signer = None
+        self.auth_verifier = None
 
     # -- messenger surface ----------------------------------------------
     def add_dispatcher(self, d: Dispatcher) -> None:
@@ -84,14 +92,20 @@ class TcpMessenger:
         return Connection(self, peer)
 
     def start(self) -> None:
-        host, port = self.addr_map[self.name]
+        self._running = True
+        addr = self.addr_map.get(self.name)
+        if addr is None:
+            # client-only endpoint: no listener; replies arrive over
+            # the connections we initiate (ref: clients don't bind —
+            # Objecter traffic flows over its outgoing Connections)
+            return
+        host, port = addr
         self._listener = socket.socket(socket.AF_INET,
                                        socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET,
                                   socket.SO_REUSEADDR, 1)
         self._listener.bind((host, port))
         self._listener.listen(64)
-        self._running = True
         t = threading.Thread(target=self._accept_loop,
                              name=f"tcp-accept-{self.name}", daemon=True)
         t.start()
@@ -124,24 +138,31 @@ class TcpMessenger:
         with self._lock:
             self._seq += 1
             msg = dataclasses.replace(msg, src=self.name, seq=self._seq)
+            if self.auth_signer is not None:
+                msg = self.auth_signer.sign(msg)
             try:
                 payload = pickle.dumps(msg)
             except Exception as ex:
                 dout("ms", 0).write("%s: unpicklable %s: %s", self.name,
                                     msg.type_name, ex)
                 return False
+            learned = False
             sock = self._out.get(peer)
+            if sock is None and peer not in self.addr_map:
+                sock = self._learned.get(peer)
+                learned = sock is not None
             if sock is None:
                 sock = self._connect_peer(peer)
                 if sock is None:
                     self.handle_reset(peer)
                     return False
                 self._out[peer] = sock
+                self._spawn_reader(sock)
             try:
                 send_frame(sock, payload)
                 return True
             except OSError:
-                self._out.pop(peer, None)
+                (self._learned if learned else self._out).pop(peer, None)
                 try:
                     sock.close()
                 except OSError:
@@ -169,12 +190,19 @@ class TcpMessenger:
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            t = threading.Thread(target=self._read_loop, args=(conn,),
-                                 daemon=True)
-            t.start()
-            self._threads.append(t)
+            self._spawn_reader(conn, learn=True)
 
-    def _read_loop(self, conn: socket.socket) -> None:
+    def _spawn_reader(self, conn: socket.socket,
+                      learn: bool = False) -> None:
+        """Every socket gets a reader — outbound ones too, so a peer
+        that answers over OUR connection (it has no address for us) is
+        heard."""
+        t = threading.Thread(target=self._read_loop,
+                             args=(conn, learn), daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _read_loop(self, conn: socket.socket, learn: bool) -> None:
         peer = None
         try:
             while self._running:
@@ -182,20 +210,39 @@ class TcpMessenger:
                 if frame is None:
                     break
                 msg = pickle.loads(frame)
+                # authenticate BEFORE learning: otherwise a forged
+                # frame could hijack the learned reply route for the
+                # entity it spoofs (verified by the cephx e2e drive)
+                if self.auth_verifier is not None and \
+                        not self.auth_verifier.verify(msg):
+                    dout("ms", 1).write(
+                        "%s: dropping unauthenticated %s from %s",
+                        self.name, msg.type_name, msg.src)
+                    continue
+                if learn:
+                    # every verified frame refreshes the route (a
+                    # reset elsewhere may have dropped the mapping)
+                    with self._lock:
+                        self._learned[msg.src] = conn
                 peer = msg.src
-                self._deliver(msg)
+                self._deliver_verified(msg)
         except (OSError, ValueError, pickle.UnpicklingError) as ex:
-            dout("ms", 1).write("%s: read error from %s: %s", self.name,
-                                peer, ex)
+            if self._running:      # shutdown closes sockets under us
+                dout("ms", 1).write("%s: read error from %s: %s",
+                                    self.name, peer, ex)
         finally:
             try:
                 conn.close()
             except OSError:
                 pass
-            if peer is not None and self._running:
-                self.handle_reset(peer)
+            if peer is not None:
+                with self._lock:
+                    if self._learned.get(peer) is conn:
+                        del self._learned[peer]
+                if self._running:
+                    self.handle_reset(peer)
 
-    def _deliver(self, msg: Message) -> None:
+    def _deliver_verified(self, msg: Message) -> None:
         for d in self.dispatchers:
             try:
                 if d.ms_dispatch(msg):

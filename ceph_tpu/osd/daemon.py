@@ -22,11 +22,12 @@ from ..common.log import dout
 from ..common.options import global_config
 from ..ec import registry as ec_registry
 from ..msg.messages import (ECSubRead, ECSubReadReply, ECSubWrite,
-                            ECSubWriteReply, MMap, MOSDBoot,
-                            MMonSubscribe, MOSDFailure, MWatchNotify,
-                            OSDOp, OSDOpReply, PGPull, PGPush, PGScan,
-                            PGScanReply, Ping, PingReply, RepOpReply,
-                            RepOpWrite, ScrubMapReply, ScrubMapRequest)
+                            ECSubWriteReply, MConfig, MMap, MOSDBoot,
+                            MMonSubscribe, MOSDFailure, MPGStats,
+                            MWatchNotify, OSDOp, OSDOpReply, PGPull,
+                            PGPush, PGScan, PGScanReply, Ping,
+                            PingReply, RepOpReply, RepOpWrite,
+                            ScrubMapReply, ScrubMapRequest)
 from ..msg.mon_client import MonHunter
 from ..msg.messenger import Dispatcher, LocalNetwork, Message, Messenger
 from ..store import MemStore, StoreError
@@ -93,7 +94,8 @@ class OSDDaemon(Dispatcher, MonHunter):
 
     def __init__(self, network: LocalNetwork, whoami: int,
                  store: Optional[MemStore] = None, mon="mon.0",
-                 threaded: bool = False, perf_collection=None):
+                 threaded: bool = False, perf_collection=None,
+                 keyring=None):
         self.whoami = whoami
         self.name = f"osd.{whoami}"
         # mon may be a single name or a failover list
@@ -123,6 +125,11 @@ class OSDDaemon(Dispatcher, MonHunter):
         # (ref: src/osd/Watch.cc Notify)
         self._notifies: dict[int, dict] = {}
         self._notify_ids = itertools.count(1)
+        self._last_stat_report = 0.0
+        # in-flight/historic op tracking (ref: src/common/TrackedOp.h)
+        from ..common.tracked_op import OpTracker
+        self.op_tracker = OpTracker()
+        self.asok = None
         self.hbmap = HeartbeatMap()
         self._hb_handle = self.hbmap.add_worker(
             f"{self.name}.tick",
@@ -139,6 +146,14 @@ class OSDDaemon(Dispatcher, MonHunter):
                     "map_epochs"):
             self.perf.add_u64_counter(key)
         self.ms = Messenger.create(network, self.name, threaded=threaded)
+        if keyring is not None:
+            # daemons hold the service secret (the reference's rotating
+            # service keys), so their tickets mint locally; inbound
+            # traffic must carry a valid ticket + signature
+            from ..auth import SERVICE_ENTITY, CephxClient, CephxVerifier
+            svc = keyring.get(SERVICE_ENTITY)
+            self.ms.auth_signer = CephxClient.self_mint(self.name, svc)
+            self.ms.auth_verifier = CephxVerifier(svc)
         self.ms.add_dispatcher(self)
 
     # ------------------------------------------------------------ setup
@@ -147,14 +162,58 @@ class OSDDaemon(Dispatcher, MonHunter):
         self.ms.connect(self.mon).send_message(MOSDBoot(osd=self.whoami))
         self.ms.connect(self.mon).send_message(
             MMonSubscribe(what="osdmap", start=1))
+        self.ms.connect(self.mon).send_message(
+            MMonSubscribe(what="config"))
 
     def shutdown(self) -> None:
+        if self.asok is not None:
+            self.asok.shutdown()
         self.ms.shutdown()
+
+    # -------------------------------------------------- admin socket
+    def start_admin_socket(self, path: str) -> None:
+        """`ceph daemon osd.N <cmd>` endpoint
+        (ref: OSD::asok_command src/osd/OSD.cc:2712)."""
+        from ..common.admin_socket import AdminSocket
+        a = AdminSocket(path)
+        a.register("perf dump", "dump perf counters",
+                   lambda c: (0, self.perf.dump()))
+        a.register("config show", "dump live config values",
+                   lambda c: (0, global_config().dump()))
+        a.register("config diff", "values changed from defaults",
+                   lambda c: (0, global_config().diff()))
+        a.register("config get", "get one option",
+                   lambda c: (0, global_config()[c["var"]]))
+
+        def _config_set(c):
+            global_config().set(c["var"], c["val"])
+            return 0, "success"
+        a.register("config set", "set one option", _config_set)
+        a.register("dump_ops_in_flight", "ops currently executing",
+                   lambda c: (0, self.op_tracker.dump_in_flight()))
+        a.register("dump_historic_ops", "recently completed ops",
+                   lambda c: (0, self.op_tracker.dump_historic()))
+        a.register("dump_blocked_ops", "ops over the complaint age",
+                   lambda c: (0, self.op_tracker.slow_ops()))
+
+        def _status(c):
+            with self._lock:
+                return 0, {"whoami": self.whoami,
+                           "osdmap_epoch": self.osdmap.epoch,
+                           "num_pgs": len(self.pgs),
+                           "pgs_recovering": self.pgs_recovering()}
+        a.register("status", "daemon status", _status)
+        a.start()
+        self.asok = a
 
     def _hunt_greeting(self) -> list:
         return [MOSDBoot(osd=self.whoami),
                 MMonSubscribe(what="osdmap",
-                              start=self.osdmap.epoch + 1)]
+                              start=self.osdmap.epoch + 1),
+                # the new mon's _config_subs doesn't know us: without
+                # re-subscribing, centralized config changes would
+                # silently stop reaching this daemon after a failover
+                MMonSubscribe(what="config")]
 
     def ms_handle_reset(self, peer: str) -> None:
         """Our mon went away: hunt to the next one (shared MonHunter
@@ -166,13 +225,21 @@ class OSDDaemon(Dispatcher, MonHunter):
         if isinstance(msg, MMap):
             self._handle_map(msg)
             return True
+        if isinstance(msg, MConfig):
+            self._apply_config(msg)
+            return True
         if isinstance(msg, OSDOp):
+            self.op_tracker.start(
+                (msg.src, msg.tid),
+                f"osd_op({msg.src} tid={msg.tid} {msg.op} "
+                f"{msg.pgid} {msg.oid})")
             # serialize op execution: the TCP backend delivers each
             # connection on its own reader thread, so without this two
             # clients' read-modify-write ops (cls exec, omap updates)
             # could interleave (the reference executes ops under the
             # PG lock — PrimaryLogPG::do_request holds pg->lock)
             with self._lock:
+                self.op_tracker.mark((msg.src, msg.tid), "dispatched")
                 self._handle_client_op(msg)
             return True
         if isinstance(msg, ECSubWrite):
@@ -288,6 +355,33 @@ class OSDDaemon(Dispatcher, MonHunter):
         return False
 
     # ----------------------------------------------------------- maps
+    def _apply_config(self, msg: MConfig) -> None:
+        """Apply the mon's centralized config view
+        (ref: md_config_t::set_mon_vals — unknown names warn, known
+        names apply and fire observers, and values ABSENT from the new
+        view revert to their defaults so `config rm` takes effect on
+        running daemons)."""
+        cfg = global_config()
+        gone = getattr(self, "_mon_config_keys", set()) \
+            - set(msg.values)
+        for name in gone:
+            try:
+                cfg.set(name, cfg.schema[name].default)
+            except (KeyError, ValueError, TypeError):
+                pass
+        applied = set()
+        for name, value in msg.values.items():
+            try:
+                cfg.set(name, value)
+                applied.add(name)
+            except KeyError:
+                dout("osd", 4).write("%s: ignoring unknown config %s",
+                                     self.name, name)
+            except (ValueError, TypeError) as ex:
+                dout("osd", 1).write("%s: bad config %s=%r: %s",
+                                     self.name, name, value, ex)
+        self._mon_config_keys = applied
+
     def _handle_map(self, msg: MMap) -> None:
         with self._lock:
             old_up = {o for o in range(self.osdmap.max_osd)
@@ -894,6 +988,13 @@ class OSDDaemon(Dispatcher, MonHunter):
             self._hb_last.clear()
             self._hb_reported.clear()
         self._hb_now = now
+        # periodic pg-stat report (ref: OSD.cc tick -> send MPGStats
+        # through the mgr in the reference; direct to the mon here)
+        if now - self._last_stat_report >= \
+                global_config()["osd_mon_report_interval"] or \
+                now < self._last_stat_report:
+            self._last_stat_report = now
+            self._send_pg_stats(now)
         # mon keepalive: a dead mon only becomes visible when we send
         # to it — the failed send triggers the hunt to the next mon
         # (ref: MonClient tick/keepalive)
@@ -930,9 +1031,56 @@ class OSDDaemon(Dispatcher, MonHunter):
             else:
                 self._hb_reported.discard(p)
 
+    # ------------------------------------------------------- pg stats
+    def _send_pg_stats(self, now: float) -> None:
+        """Primary-reported per-PG stats + store usage
+        (ref: src/osd/OSD.cc collect_pg_stats / pg_stat_t states
+        src/osd/osd_types.cc pg_state_string)."""
+        pg_stats: dict[str, dict] = {}
+        # under the daemon lock: the dispatcher thread rebuilds
+        # self.pgs on map changes (heartbeat_peers does the same)
+        with self._lock:
+            pg_items = list(self.pgs.items())
+        for pg, st in pg_items:
+            if st.shard is None:
+                continue
+            primary = st.acting_primary == self.whoami
+            if not primary:
+                continue
+            pool = self.osdmap.pools.get(pg.pool)
+            width = pool.size if pool is not None else len(st.acting)
+            alive = sum(1 for o in st.acting
+                        if 0 <= o < CRUSH_ITEM_NONE)
+            state = ["active"]
+            if st.recovering:
+                state.append("recovering")
+            if alive < width:
+                state.append("degraded")
+            elif not st.recovering:
+                state.append("clean")
+            if st.scrub is not None:
+                state.append("scrubbing")
+            objs = st.shard.objects()
+            nbytes = sum(st.shard.object_size(o) for o in objs)
+            order = ["active", "clean", "degraded", "recovering",
+                     "scrubbing"]
+            pg_stats[str(pg)] = {
+                "state": "+".join(sorted(state, key=order.index)),
+                "num_objects": len(objs), "bytes": nbytes,
+                "acting": list(st.acting), "primary": True}
+        fs = self.store.statfs()
+        self.ms.connect(self.mon).send_message(MPGStats(
+            osd=self.whoami, epoch=self.osdmap.epoch, stamp=now,
+            pg_stats=pg_stats, kb_total=fs["total"] // 1024,
+            kb_used=fs["used"] // 1024,
+            kb_avail=fs["available"] // 1024))
+
     # ---------------------------------------------------- client ops
     def _reply(self, msg: OSDOp, result: int, errno_name: str = "",
                data: bytes = b"", attrs: dict | None = None) -> None:
+        self.op_tracker.finish((msg.src, msg.tid),
+                               "commit_sent" if result == 0
+                               else f"error:{errno_name}")
         self.ms.connect(msg.src).send_message(OSDOpReply(
             tid=msg.tid, result=result, errno_name=errno_name,
             data=data, attrs=attrs or {}, epoch=self.osdmap.epoch))

@@ -1,0 +1,6 @@
+"""rgw-lite: S3-flavored object gateway over the RADOS client
+(ref: src/rgw — radosgw's REST frontend + bucket-index-on-omap
+data layout, radically reduced)."""
+from .gateway import RGWGateway
+
+__all__ = ["RGWGateway"]

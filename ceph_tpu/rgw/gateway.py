@@ -1,0 +1,407 @@
+"""S3-flavored HTTP gateway over RADOS.
+
+The radosgw analogue (ref: src/rgw/rgw_main.cc REST frontend;
+src/rgw/rgw_rados.cc data layout).  Faithful structure, reduced
+surface:
+
+* **Bucket index is omap** on a per-bucket index object — exactly the
+  reference's layout (ref: src/cls/rgw bucket index objects; here the
+  index is maintained with plain omap ops instead of the cls_rgw
+  transaction dance).
+* **Object data** lives in RADOS objects named `<bucket>/<key>`;
+  multipart parts are separate RADOS objects assembled on complete
+  (ref: rgw multipart: RGWCompleteMultipart assembles the manifest —
+  here parts are concatenated since striping policy is the Striper's
+  job).
+* REST: ListBuckets / Create/Delete/HeadBucket, Put/Get/Head/Delete
+  Object, ListObjectsV2 (prefix + max-keys + continuation), multipart
+  initiate/upload-part/complete/abort.  XML shapes follow S3 close
+  enough for scripted clients.
+
+No request signing: the reference supports anonymous access; cephx
+for S3 keys is out of scope this round.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, quote, unquote, urlparse
+from xml.etree import ElementTree as ET
+from xml.sax.saxutils import escape
+
+from ..client import RadosError, WriteOp
+
+#: omap object holding the bucket registry (name -> creation meta)
+BUCKETS_OBJ = ".rgw.buckets.list"
+
+
+def _index_obj(bucket: str) -> str:
+    return f".rgw.index.{bucket}"
+
+
+def _data_obj(bucket: str, key: str) -> str:
+    return f"{bucket}/{key}"
+
+
+class S3Error(Exception):
+    def __init__(self, status: int, code: str, msg: str = ""):
+        self.status = status
+        self.code = code
+        self.msg = msg or code
+        super().__init__(code)
+
+
+class RGWGateway:
+    """One gateway instance bound to an HTTP port, backed by a pool."""
+
+    def __init__(self, rados, pool: str = "rgw",
+                 host: str = "127.0.0.1", port: int = 0):
+        self.rados = rados
+        try:
+            rados.pool_lookup(pool)
+        except RadosError:
+            rados.pool_create(pool, pg_num=32)
+        self.io = rados.open_ioctx(pool)
+        try:
+            self.io.create(BUCKETS_OBJ)
+        except RadosError:
+            pass
+        gw = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):      # quiet
+                pass
+
+            def _run(self, method):
+                try:
+                    gw._route(self, method)
+                except S3Error as e:
+                    body = (f'<?xml version="1.0"?><Error><Code>'
+                            f"{e.code}</Code><Message>{escape(e.msg)}"
+                            f"</Message></Error>").encode()
+                    self.send_response(e.status)
+                    self.send_header("Content-Type", "application/xml")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except (RadosError, OSError) as e:
+                    body = str(e).encode()
+                    self.send_response(500)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+
+            def do_GET(self):
+                self._run("GET")
+
+            def do_PUT(self):
+                self._run("PUT")
+
+            def do_POST(self):
+                self._run("POST")
+
+            def do_DELETE(self):
+                self._run("DELETE")
+
+            def do_HEAD(self):
+                self._run("HEAD")
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        name="rgw", daemon=True)
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    # -- helpers ---------------------------------------------------------
+    def _buckets(self) -> dict[str, dict]:
+        vals, _ = self.io.get_omap_vals(BUCKETS_OBJ)
+        return {k: json.loads(v) for k, v in vals.items()}
+
+    def _require_bucket(self, bucket: str) -> None:
+        if bucket not in self._buckets():
+            raise S3Error(404, "NoSuchBucket", bucket)
+
+    def _index(self, bucket: str) -> dict[str, dict]:
+        try:
+            vals, _ = self.io.get_omap_vals(_index_obj(bucket))
+        except RadosError:
+            return {}
+        return {k: json.loads(v) for k, v in vals.items()}
+
+    @staticmethod
+    def _respond(h, status: int, body: bytes = b"",
+                 ctype: str = "application/xml",
+                 headers: dict | None = None) -> None:
+        h.send_response(status)
+        h.send_header("Content-Type", ctype)
+        h.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            h.send_header(k, v)
+        h.end_headers()
+        if h.command != "HEAD":
+            h.wfile.write(body)
+
+    @staticmethod
+    def _read_body(h) -> bytes:
+        n = int(h.headers.get("Content-Length", 0))
+        return h.rfile.read(n) if n else b""
+
+    # -- routing ---------------------------------------------------------
+    def _route(self, h, method: str) -> None:
+        u = urlparse(h.path)
+        q = {k: v[0] for k, v in parse_qs(u.query,
+                                          keep_blank_values=True).items()}
+        parts = unquote(u.path).lstrip("/").split("/", 1)
+        bucket = parts[0]
+        key = parts[1] if len(parts) > 1 else ""
+        if not bucket:
+            if method != "GET":
+                raise S3Error(405, "MethodNotAllowed")
+            return self._list_buckets(h)
+        if not key:
+            return self._bucket_op(h, method, bucket, q)
+        return self._object_op(h, method, bucket, key, q)
+
+    # -- service level ---------------------------------------------------
+    def _list_buckets(self, h) -> None:
+        ents = "".join(
+            f"<Bucket><Name>{escape(b)}</Name><CreationDate>"
+            f"{m['created']}</CreationDate></Bucket>"
+            for b, m in sorted(self._buckets().items()))
+        self._respond(h, 200, (
+            '<?xml version="1.0"?><ListAllMyBucketsResult>'
+            f"<Buckets>{ents}</Buckets>"
+            "</ListAllMyBucketsResult>").encode())
+
+    # -- bucket level ----------------------------------------------------
+    def _bucket_op(self, h, method: str, bucket: str, q: dict) -> None:
+        if method == "PUT":
+            meta = json.dumps({"created": time.strftime(
+                "%Y-%m-%dT%H:%M:%S.000Z", time.gmtime())}).encode()
+            self.io.operate(BUCKETS_OBJ,
+                            WriteOp().set_omap({bucket: meta}))
+            self.io.create(_index_obj(bucket))
+            return self._respond(h, 200,
+                                 headers={"Location": f"/{bucket}"})
+        self._require_bucket(bucket)
+        if method in ("GET", "HEAD"):
+            if method == "HEAD":
+                return self._respond(h, 200)
+            return self._list_objects(h, bucket, q)
+        if method == "DELETE":
+            if self._index(bucket):
+                raise S3Error(409, "BucketNotEmpty", bucket)
+            self.io.remove_omap_keys(BUCKETS_OBJ, [bucket])
+            try:
+                self.io.remove(_index_obj(bucket))
+            except RadosError:
+                pass
+            return self._respond(h, 204)
+        raise S3Error(405, "MethodNotAllowed", method)
+
+    def _list_objects(self, h, bucket: str, q: dict) -> None:
+        """ListObjectsV2 (ref: RGWListBucket)."""
+        prefix = q.get("prefix", "")
+        max_keys = int(q.get("max-keys", 1000))
+        token = q.get("continuation-token", "")
+        idx = self._index(bucket)
+        keys = sorted(k for k in idx
+                      if k.startswith(prefix) and k > token
+                      and not k.startswith(".upload."))
+        page, truncated = keys[:max_keys], len(keys) > max_keys
+        ents = "".join(
+            f"<Contents><Key>{escape(k)}</Key>"
+            f"<Size>{idx[k]['size']}</Size>"
+            f"<ETag>&quot;{idx[k]['etag']}&quot;</ETag>"
+            f"<LastModified>{idx[k]['mtime']}</LastModified>"
+            "</Contents>" for k in page)
+        nxt = (f"<NextContinuationToken>{escape(page[-1])}"
+               "</NextContinuationToken>") if truncated else ""
+        self._respond(h, 200, (
+            '<?xml version="1.0"?><ListBucketResult>'
+            f"<Name>{escape(bucket)}</Name>"
+            f"<Prefix>{escape(prefix)}</Prefix>"
+            f"<KeyCount>{len(page)}</KeyCount>"
+            f"<IsTruncated>{str(truncated).lower()}</IsTruncated>"
+            f"{nxt}{ents}</ListBucketResult>").encode())
+
+    # -- object level ----------------------------------------------------
+    def _object_op(self, h, method: str, bucket: str, key: str,
+                   q: dict) -> None:
+        self._require_bucket(bucket)
+        if method == "POST" and "uploads" in q:
+            return self._initiate_multipart(h, bucket, key)
+        if method == "POST" and "uploadId" in q:
+            return self._complete_multipart(h, bucket, key,
+                                            q["uploadId"])
+        if method == "PUT" and "uploadId" in q:
+            return self._upload_part(h, bucket, key, q)
+        if method == "DELETE" and "uploadId" in q:
+            return self._abort_multipart(h, bucket, key, q["uploadId"])
+        if method == "PUT":
+            return self._put_object(h, bucket, key)
+        idx = self._index(bucket)
+        if key not in idx:
+            raise S3Error(404, "NoSuchKey", key)
+        meta = idx[key]
+        if method == "HEAD":
+            return self._respond(
+                h, 200, b"", "application/octet-stream",
+                {"ETag": f'"{meta["etag"]}"',
+                 "Content-Length-Hint": str(meta["size"])})
+        if method == "GET":
+            data = self.io.read(_data_obj(bucket, key))
+            return self._respond(h, 200, data,
+                                 "application/octet-stream",
+                                 {"ETag": f'"{meta["etag"]}"'})
+        if method == "DELETE":
+            try:
+                self.io.remove(_data_obj(bucket, key))
+            except RadosError:
+                pass
+            self.io.remove_omap_keys(_index_obj(bucket), [key])
+            return self._respond(h, 204)
+        raise S3Error(405, "MethodNotAllowed", method)
+
+    def _put_object(self, h, bucket: str, key: str) -> None:
+        data = self._read_body(h)
+        etag = hashlib.md5(data).hexdigest()
+        self.io.write_full(_data_obj(bucket, key), data)
+        self._write_index(bucket, key, len(data), etag)
+        self._respond(h, 200, headers={"ETag": f'"{etag}"'})
+
+    def _write_index(self, bucket: str, key: str, size: int,
+                     etag: str) -> None:
+        meta = {"size": size, "etag": etag,
+                "mtime": time.strftime("%Y-%m-%dT%H:%M:%S.000Z",
+                                       time.gmtime())}
+        self.io.set_omap(_index_obj(bucket),
+                         {key: json.dumps(meta).encode()})
+
+    # -- multipart (ref: rgw RGWInitMultipart/CompleteMultipart) ---------
+    def _initiate_multipart(self, h, bucket: str, key: str) -> None:
+        upload_id = uuid.uuid4().hex
+        self.io.set_omap(_index_obj(bucket), {
+            f".upload.{upload_id}": json.dumps(
+                {"key": key, "parts": {}}).encode()})
+        self._respond(h, 200, (
+            '<?xml version="1.0"?><InitiateMultipartUploadResult>'
+            f"<Bucket>{escape(bucket)}</Bucket><Key>{escape(key)}</Key>"
+            f"<UploadId>{upload_id}</UploadId>"
+            "</InitiateMultipartUploadResult>").encode())
+
+    def _upload_meta(self, bucket: str, upload_id: str) -> dict:
+        vals = self.io.get_omap_vals_by_keys(
+            _index_obj(bucket), [f".upload.{upload_id}"])
+        if not vals:
+            raise S3Error(404, "NoSuchUpload", upload_id)
+        return json.loads(vals[f".upload.{upload_id}"])
+
+    def _upload_part(self, h, bucket: str, key: str, q: dict) -> None:
+        upload_id = q["uploadId"]
+        n = int(q.get("partNumber", 1))
+        meta = self._upload_meta(bucket, upload_id)
+        data = self._read_body(h)
+        etag = hashlib.md5(data).hexdigest()
+        part_obj = f".part.{upload_id}.{n}"
+        self.io.write_full(part_obj, data)
+        meta["parts"][str(n)] = {"size": len(data), "etag": etag}
+        self.io.set_omap(_index_obj(bucket), {
+            f".upload.{upload_id}": json.dumps(meta).encode()})
+        self._respond(h, 200, headers={"ETag": f'"{etag}"'})
+
+    def _complete_multipart(self, h, bucket: str, key: str,
+                            upload_id: str) -> None:
+        meta = self._upload_meta(bucket, upload_id)
+        body = self._read_body(h)
+        wanted = []
+        if body:
+            root = ET.fromstring(body)
+            for p in root.iter():
+                if p.tag.endswith("PartNumber"):
+                    wanted.append(int(p.text))
+        if not wanted:
+            wanted = sorted(int(n) for n in meta["parts"])
+        blob = bytearray()
+        etags = []
+        for n in wanted:
+            if str(n) not in meta["parts"]:
+                raise S3Error(400, "InvalidPart", str(n))
+            blob += self.io.read(f".part.{upload_id}.{n}")
+            etags.append(meta["parts"][str(n)]["etag"])
+        etag = hashlib.md5(
+            b"".join(bytes.fromhex(e) for e in etags)).hexdigest() \
+            + f"-{len(wanted)}"
+        self.io.write_full(_data_obj(bucket, key), bytes(blob))
+        self._write_index(bucket, key, len(blob), etag)
+        self._cleanup_upload(bucket, upload_id, meta)
+        self._respond(h, 200, (
+            '<?xml version="1.0"?><CompleteMultipartUploadResult>'
+            f"<Key>{escape(key)}</Key><ETag>&quot;{etag}&quot;</ETag>"
+            "</CompleteMultipartUploadResult>").encode())
+
+    def _abort_multipart(self, h, bucket: str, key: str,
+                         upload_id: str) -> None:
+        meta = self._upload_meta(bucket, upload_id)
+        self._cleanup_upload(bucket, upload_id, meta)
+        self._respond(h, 204)
+
+    def _cleanup_upload(self, bucket: str, upload_id: str,
+                        meta: dict) -> None:
+        for n in meta["parts"]:
+            try:
+                self.io.remove(f".part.{upload_id}.{n}")
+            except RadosError:
+                pass
+        self.io.remove_omap_keys(_index_obj(bucket),
+                                 [f".upload.{upload_id}"])
+
+
+def main(argv=None) -> int:
+    """radosgw entrypoint: serve S3 over a TCP cluster."""
+    import argparse
+    ap = argparse.ArgumentParser(prog="ceph-tpu-rgw")
+    ap.add_argument("--monmap", required=True)
+    ap.add_argument("--port", type=int, default=7480)
+    ap.add_argument("--pool", default="rgw")
+    a = ap.parse_args(argv)
+    import json as _json
+    import os
+    from ..client import Rados
+    from ..msg.tcp import TcpNet
+    with open(a.monmap) as f:
+        mm = _json.load(f)
+    addrs = {k: tuple(v) for k, v in mm["addrs"].items()}
+    r = Rados(TcpNet(addrs),
+              name=f"client.rgw{os.getpid() % 10000}").connect()
+    gw = RGWGateway(r, pool=a.pool, port=a.port)
+    gw.start()
+    print(f"rgw: serving S3 on :{gw.port} pool={a.pool}", flush=True)
+    import signal
+    ev = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: ev.set())
+    try:
+        ev.wait()
+    except KeyboardInterrupt:
+        pass
+    gw.shutdown()
+    r.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

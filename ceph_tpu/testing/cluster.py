@@ -18,7 +18,8 @@ from ..osd.daemon import OSDDaemon
 
 class MiniCluster:
     def __init__(self, n_osd: int = 6, osds_per_host: int = 1,
-                 threaded: bool = True, n_mon: int = 1):
+                 threaded: bool = True, n_mon: int = 1,
+                 auth: str = "none"):
         import copy
         self.network = LocalNetwork()
         self.threaded = threaded
@@ -26,6 +27,15 @@ class MiniCluster:
         from ..common.perf_counters import PerfCountersCollection
         self.perf_collection = PerfCountersCollection()
         ranks = list(range(n_mon))
+        # cephx: one cluster keyring; daemons get it whole, clients
+        # get per-entity secrets minted on demand (ref: ceph-authtool
+        # provisioning + AuthMonitor key server)
+        self.keyring = None
+        if auth == "cephx":
+            from ..auth import KeyRing
+            self.keyring = KeyRing.generate(
+                [f"mon.{r}" for r in ranks]
+                + [f"osd.{o}" for o in range(n_osd)])
         self.mon_names = [f"mon.{r}" for r in ranks]
         self.osds: dict[int, OSDDaemon] = {}
         self._stores: dict[int, object] = {}
@@ -39,7 +49,8 @@ class MiniCluster:
                 initial_map=copy.deepcopy(m),
                 initial_wrapper=copy.deepcopy(w),
                 threaded=threaded, clock=self._clock,
-                mon_ranks=ranks if n_mon > 1 else None)
+                mon_ranks=ranks if n_mon > 1 else None,
+                keyring=self.keyring)
             self.mons[r].init()
         self.mon = self.mons[0]      # rank 0 wins elections when alive
         if not threaded and n_mon > 1:
@@ -83,7 +94,7 @@ class MiniCluster:
         d = OSDDaemon(self.network, osd, store=store,
                       threaded=self.threaded,
                       perf_collection=self.perf_collection,
-                      mon=self.mon_names)
+                      mon=self.mon_names, keyring=self.keyring)
         self._stores[osd] = d.store
         d.init()
         self.osds[osd] = d
@@ -112,7 +123,27 @@ class MiniCluster:
         return self.mgr
 
     # ---------------------------------------------------------- client
-    def rados(self, timeout: float = 30.0) -> Rados:
+    def rados(self, timeout: float = 30.0,
+              auth_secret: str | None = None) -> Rados:
+        if self.keyring is not None and auth_secret is None:
+            # mint this client's key into the shared keyring
+            from ..auth import generate_key
+            import itertools as _it
+            if not hasattr(self, "_client_keys"):
+                self._client_keys = _it.count(1)
+            name = f"client.mc{next(self._client_keys)}"
+            auth_secret = generate_key()
+            self.keyring.keys[name] = auth_secret
+            r = Rados(self.network, name=name, op_timeout=timeout,
+                      threaded=self.threaded, mon=self.mon_names,
+                      auth_secret=auth_secret)
+            self.clients.append(r)
+            if self.threaded:
+                r.connect(timeout)
+            else:
+                raise NotImplementedError(
+                    "cephx MiniCluster requires threaded mode")
+            return r
         r = Rados(self.network, op_timeout=timeout,
                   threaded=self.threaded, mon=self.mon_names)
         self.clients.append(r)   # before connect: pump() must see it

@@ -37,9 +37,16 @@ def run_mon(args) -> int:
     m, w = build_initial(mm.get("n_osd", 0),
                          osds_per_host=mm.get("osds_per_host", 1))
     ranks = mm.get("mon_ranks", [0])
+    keyring = None
+    if args.keyring:
+        from ..auth import KeyRing
+        keyring = KeyRing.load(args.keyring)
     mon = Monitor(net, rank=args.rank, initial_map=m, initial_wrapper=w,
-                  mon_ranks=ranks if len(ranks) > 1 else None)
+                  mon_ranks=ranks if len(ranks) > 1 else None,
+                  keyring=keyring)
     mon.init()
+    if args.asok:
+        mon.start_admin_socket(args.asok)
     print(f"mon.{args.rank}: serving on "
           f"{mm['addrs'][f'mon.{args.rank}']}", flush=True)
     _serve(lambda: mon.tick(), interval=1.0)
@@ -59,8 +66,14 @@ def run_osd(args) -> int:
         from ..store import JournaledStore
         store = JournaledStore(args.data_dir)
         store.mount()
-    d = OSDDaemon(net, args.id, mon=mons, store=store)
+    keyring = None
+    if args.keyring:
+        from ..auth import KeyRing
+        keyring = KeyRing.load(args.keyring)
+    d = OSDDaemon(net, args.id, mon=mons, store=store, keyring=keyring)
     d.init()
+    if args.asok:
+        d.start_admin_socket(args.asok)
     print(f"osd.{args.id}: serving on "
           f"{mm['addrs'][f'osd.{args.id}']}", flush=True)
     interval = global_config()["osd_heartbeat_interval"]
@@ -68,6 +81,26 @@ def run_osd(args) -> int:
     d.shutdown()
     if store is not None:
         store.umount()
+    return 0
+
+
+def run_mds(args) -> int:
+    """(ref: src/ceph_mds.cc)."""
+    import os
+    from ..client import Rados
+    from ..fs.mds import MDSDaemon
+    from ..msg.tcp import TcpNet
+    mm = load_monmap(args.monmap)
+    net = TcpNet(mm["addrs"])
+    r = Rados(TcpNet(mm["addrs"]),
+              name=f"client.mds{os.getpid() % 10000}").connect()
+    mds = MDSDaemon(net, r, rank=args.rank)
+    mds.init()
+    print(f"mds.{args.rank}: serving on "
+          f"{mm['addrs'][f'mds.{args.rank}']}", flush=True)
+    _serve(lambda: None, interval=1.0)
+    mds.shutdown()
+    r.shutdown()
     return 0
 
 
@@ -93,14 +126,26 @@ def main(argv=None) -> int:
     pm = sub.add_parser("mon")
     pm.add_argument("--rank", type=int, default=0)
     pm.add_argument("--monmap", required=True)
+    pm.add_argument("--asok", default="",
+                    help="admin socket path (`ceph daemon` endpoint)")
+    pm.add_argument("--keyring", default="",
+                    help="cephx keyring JSON (enables auth)")
     po = sub.add_parser("osd")
     po.add_argument("--id", type=int, required=True)
     po.add_argument("--monmap", required=True)
     po.add_argument("--data-dir", default="",
                     help="durable store directory (JournaledStore); "
                          "in-memory when omitted")
+    po.add_argument("--asok", default="",
+                    help="admin socket path (`ceph daemon` endpoint)")
+    po.add_argument("--keyring", default="",
+                    help="cephx keyring JSON (enables auth)")
+    pd = sub.add_parser("mds")
+    pd.add_argument("--rank", type=int, default=0)
+    pd.add_argument("--monmap", required=True)
     args = ap.parse_args(argv)
-    return run_mon(args) if args.role == "mon" else run_osd(args)
+    return {"mon": run_mon, "osd": run_osd,
+            "mds": run_mds}[args.role](args)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,251 @@
+"""`rados` CLI: pool/object utility + IO benchmark.
+
+The analogue of the reference's rados tool (ref: src/tools/rados/
+rados.cc — usage :168; obj_bencher engine src/common/obj_bencher.cc:
+`rados bench` aio pipeline with fixed concurrency, bandwidth/latency
+summary :471-560).
+
+Connects to a running cluster via --monmap (the TCP daemon world of
+tools/daemon_main.py), or tests inject an in-process `Rados`.
+
+    rados --monmap mm.json lspools
+    rados --monmap mm.json mkpool data 64
+    rados --monmap mm.json put data obj ./file
+    rados --monmap mm.json bench data 10 write -b 65536 -t 16
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def _connect(args):
+    import json
+    from ..client import Rados
+    from ..msg.tcp import TcpNet
+    with open(args.monmap) as f:
+        mm = json.load(f)
+    addrs = {k: tuple(v) for k, v in mm["addrs"].items()}
+    # ad-hoc client: not in the monmap — daemons answer over the
+    # connections we open (learned-connection replies)
+    name = f"client.{os.getpid() % 50000 + 10000}"
+    return Rados(TcpNet(addrs), name=name,
+                 op_timeout=args.timeout).connect(args.timeout)
+
+
+# ------------------------------------------------------------ commands
+
+def cmd_lspools(r, a, out):
+    for p in r.list_pools():
+        print(p, file=out)
+
+
+def cmd_mkpool(r, a, out):
+    r.pool_create(a.pool, pg_num=a.pg_num)
+    print(f"successfully created pool {a.pool}", file=out)
+
+
+def cmd_rmpool(r, a, out):
+    rc, outs, _ = r.mon_command(
+        {"prefix": "osd pool delete", "pool": a.pool,
+         "yes_i_really_really_mean_it": True})
+    if rc < 0:
+        print(f"error: {outs}", file=sys.stderr)
+        return
+    print(f"successfully deleted pool {a.pool}", file=out)
+
+
+def cmd_ls(r, a, out):
+    io = r.open_ioctx(a.pool)
+    for o in io.list_objects():
+        print(o, file=out)
+
+
+def cmd_put(r, a, out):
+    data = sys.stdin.buffer.read() if a.infile == "-" else \
+        open(a.infile, "rb").read()
+    r.open_ioctx(a.pool).write_full(a.obj, data)
+
+
+def cmd_get(r, a, out):
+    data = r.open_ioctx(a.pool).read(a.obj)
+    if a.outfile == "-":
+        out.write(data.decode(errors="replace"))
+    else:
+        with open(a.outfile, "wb") as f:
+            f.write(data)
+
+
+def cmd_rm(r, a, out):
+    r.open_ioctx(a.pool).remove(a.obj)
+
+
+def cmd_stat(r, a, out):
+    st = r.open_ioctx(a.pool).stat(a.obj)
+    print(f"{a.pool}/{a.obj} size {st['size']}", file=out)
+
+
+def cmd_setxattr(r, a, out):
+    r.open_ioctx(a.pool).set_xattr(a.obj, a.name, a.value.encode())
+
+
+def cmd_getxattr(r, a, out):
+    v = r.open_ioctx(a.pool).get_xattr(a.obj, a.name)
+    print(v.decode(errors="replace"), file=out)
+
+
+def cmd_listxattr(r, a, out):
+    for k in sorted(r.open_ioctx(a.pool).get_xattrs(a.obj)):
+        print(k, file=out)
+
+
+def cmd_setomapval(r, a, out):
+    r.open_ioctx(a.pool).set_omap(a.obj, {a.key: a.value.encode()})
+
+
+def cmd_listomapvals(r, a, out):
+    vals, _ = r.open_ioctx(a.pool).get_omap_vals(a.obj)
+    for k in sorted(vals):
+        print(f"{k}\n value ({len(vals[k])} bytes) :", file=out)
+        print(vals[k].decode(errors="replace"), file=out)
+
+
+# ---------------------------------------------------------------- bench
+# (ref: src/common/obj_bencher.cc ObjBencher::write_bench /
+#  seq_read_bench: fixed-depth aio pipeline, per-op latency tracking,
+#  bandwidth summary)
+
+def _bench(r, a, out):
+    io = r.open_ioctx(a.pool)
+    size, depth, secs = a.block_size, a.concurrency, a.seconds
+    prefix = f"benchmark_data_{os.getpid()}_"
+    payload = os.urandom(size)
+    lat: list[float] = []
+    t0 = time.monotonic()
+    n_done = 0
+
+    if a.mode == "write":
+        submit = lambda i: io.aio_write_full(prefix + str(i), payload)
+    else:
+        # seq read over whatever a prior write bench left behind
+        objs = sorted(o for o in io.list_objects()
+                      if o.startswith("benchmark_data_"))
+        if not objs:
+            print("no benchmark objects; run write first", file=out)
+            return 1
+        submit = lambda i: io.aio_read(objs[i % len(objs)])
+
+    in_flight: list[tuple[float, object]] = []
+    i = 0
+    deadline = t0 + secs
+    while True:
+        now = time.monotonic()
+        if now < deadline:
+            while len(in_flight) < depth:
+                in_flight.append((time.monotonic(), submit(i)))
+                i += 1
+        elif not in_flight:
+            break
+        start, fut = in_flight[0]
+        fut.wait(max(1.0, a.timeout))
+        in_flight.pop(0)
+        lat.append(time.monotonic() - start)
+        n_done += 1
+        if fut.result < 0:
+            print(f"op failed: {fut.errno_name}", file=out)
+            return 1
+    elapsed = time.monotonic() - t0
+    mb = n_done * size / 1e6
+    print(f"Total time run:         {elapsed:.4f}", file=out)
+    print(f"Total {a.mode}s made:      {n_done}", file=out)
+    print(f"{a.mode} size:             {size}", file=out)
+    print(f"Bandwidth (MB/sec):     {mb / elapsed:.3f}", file=out)
+    print(f"Average IOPS:           {n_done / elapsed:.0f}", file=out)
+    print(f"Average Latency(s):     {sum(lat) / len(lat):.6f}",
+          file=out)
+    print(f"Max latency(s):         {max(lat):.6f}", file=out)
+    print(f"Min latency(s):         {min(lat):.6f}", file=out)
+    if a.mode == "write" and not a.no_cleanup:
+        for j in range(i):
+            try:
+                io.remove(prefix + str(j))
+            except Exception:
+                pass
+    return 0
+
+
+def main(argv=None, rados=None, out=None) -> int:
+    out = out or sys.stdout
+    ap = argparse.ArgumentParser(
+        prog="rados", description="object store utility")
+    ap.add_argument("--monmap", help="monmap JSON (TCP cluster)")
+    ap.add_argument("--timeout", type=float, default=30.0)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("lspools")
+    p = sub.add_parser("mkpool")
+    p.add_argument("pool")
+    p.add_argument("pg_num", type=int, nargs="?", default=32)
+    p = sub.add_parser("rmpool")
+    p.add_argument("pool")
+    p = sub.add_parser("ls")
+    p.add_argument("pool")
+    p = sub.add_parser("put")
+    p.add_argument("pool"), p.add_argument("obj")
+    p.add_argument("infile")
+    p = sub.add_parser("get")
+    p.add_argument("pool"), p.add_argument("obj")
+    p.add_argument("outfile", nargs="?", default="-")
+    p = sub.add_parser("rm")
+    p.add_argument("pool"), p.add_argument("obj")
+    p = sub.add_parser("stat")
+    p.add_argument("pool"), p.add_argument("obj")
+    p = sub.add_parser("setxattr")
+    p.add_argument("pool"), p.add_argument("obj")
+    p.add_argument("name"), p.add_argument("value")
+    p = sub.add_parser("getxattr")
+    p.add_argument("pool"), p.add_argument("obj"), p.add_argument("name")
+    p = sub.add_parser("listxattr")
+    p.add_argument("pool"), p.add_argument("obj")
+    p = sub.add_parser("setomapval")
+    p.add_argument("pool"), p.add_argument("obj")
+    p.add_argument("key"), p.add_argument("value")
+    p = sub.add_parser("listomapvals")
+    p.add_argument("pool"), p.add_argument("obj")
+    p = sub.add_parser("bench")
+    p.add_argument("pool")
+    p.add_argument("seconds", type=float)
+    p.add_argument("mode", choices=["write", "seq"])
+    p.add_argument("-b", "--block-size", type=int, default=4 << 20)
+    p.add_argument("-t", "--concurrency", type=int, default=16)
+    p.add_argument("--no-cleanup", action="store_true")
+    a = ap.parse_args(argv)
+
+    own = rados is None
+    if own:
+        if not a.monmap:
+            ap.error("--monmap required (or pass rados=)")
+        rados = _connect(a)
+    try:
+        from ..client import RadosError
+        try:
+            if a.cmd == "bench":
+                return _bench(rados, a, out) or 0
+            {"lspools": cmd_lspools, "mkpool": cmd_mkpool,
+             "rmpool": cmd_rmpool, "ls": cmd_ls, "put": cmd_put,
+             "get": cmd_get, "rm": cmd_rm, "stat": cmd_stat,
+             "setxattr": cmd_setxattr, "getxattr": cmd_getxattr,
+             "listxattr": cmd_listxattr, "setomapval": cmd_setomapval,
+             "listomapvals": cmd_listomapvals}[a.cmd](rados, a, out)
+            return 0
+        except RadosError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+    finally:
+        if own:
+            rados.shutdown()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -69,14 +69,34 @@ class VstartShell:
         if cmd in ("quit", "exit"):
             return False
         if cmd == "status":
-            r, outs, outb = self.rados.mon_command(
-                {"prefix": "osd stat"})
+            # `ceph -s` (ref: Monitor.cc get_cluster_status)
+            _r, _outs, s = self.rados.mon_command({"prefix": "status"})
             st = self.mgr.status()
             pools = ", ".join(self.rados.list_pools()) or "-"
-            self._print(f"  cluster: {outs}")
+            h = s["health"]
+            self._print(f"  health:  {h['status']}"
+                        + ("" if not h["checks"] else
+                           "  [" + "; ".join(h["checks"].values())
+                           + "]"))
+            self._print(f"  mon:     quorum {s['monmap']['quorum']} "
+                        f"leader mon.{s['monmap']['leader']}")
+            om = s["osdmap"]
+            self._print(f"  osd:     {om['num_osds']} osds: "
+                        f"{om['num_up_osds']} up, "
+                        f"{om['num_in_osds']} in (e{om['epoch']})")
+            pm = s["pgmap"]
+            self._print(f"  data:    {pm['num_pgs']} pgs "
+                        f"{dict(pm['pgs_by_state'])}, "
+                        f"{pm['num_objects']} objects, "
+                        f"{pm['bytes_data']} bytes")
             self._print(f"  pools:   {pools}")
             self._print(f"  balancer: active={st['active']} "
                         f"score={st['score']}")
+            return True
+        if cmd in ("health", "df"):
+            _r, outs, outb = self.rados.mon_command({"prefix": cmd})
+            self._print(outs if cmd == "health"
+                        else json.dumps(outb, indent=1))
             return True
         if cmd == "osd":
             return self._osd(toks[1:])

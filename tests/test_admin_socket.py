@@ -1,0 +1,97 @@
+"""Admin socket + OpTracker (ref: src/common/admin_socket.cc,
+src/common/TrackedOp.h)."""
+import time
+
+import pytest
+
+from ceph_tpu.common.admin_socket import AdminSocket, admin_command
+from ceph_tpu.common.tracked_op import OpTracker
+from ceph_tpu.testing import MiniCluster
+
+
+def test_admin_socket_roundtrip(tmp_path):
+    sock = str(tmp_path / "a.asok")
+    a = AdminSocket(sock)
+    a.register("echo", "echo back", lambda c: (0, c.get("x", "?")))
+    a.register("fail", "always fails", lambda c: (-5, "EIO"))
+    a.start()
+    try:
+        rc, out = admin_command(sock, {"prefix": "echo", "x": 42})
+        assert rc == 0 and out == 42
+        rc, out = admin_command(sock, "fail")
+        assert rc == -5
+        rc, out = admin_command(sock, "nope")
+        assert rc == -22 and "unknown" in out
+        rc, out = admin_command(sock, "help")
+        assert rc == 0 and "echo" in out
+    finally:
+        a.shutdown()
+
+
+def test_op_tracker():
+    t = OpTracker(history_size=3, complaint_time=0.05)
+    t.start("k1", "op one")
+    t.mark("k1", "queued")
+    assert t.dump_in_flight()["num_ops"] == 1
+    time.sleep(0.08)
+    assert len(t.slow_ops()) == 1
+    t.finish("k1")
+    assert t.dump_in_flight()["num_ops"] == 0
+    h = t.dump_historic()
+    assert h["num_ops"] == 1
+    assert [e["event"] for e in h["ops"][0]["events"]] == \
+        ["initiated", "queued", "done"]
+    # history ring bounded
+    for i in range(5):
+        t.start(i, f"op{i}")
+        t.finish(i)
+    assert t.dump_historic()["num_ops"] == 3
+
+
+def test_osd_admin_socket_end_to_end(tmp_path):
+    c = MiniCluster(n_osd=2, threaded=True)
+    try:
+        c.wait_all_up()
+        r = c.rados()
+        r.pool_create("ap", pg_num=8)
+        io = r.open_ioctx("ap")
+        sock = str(tmp_path / "osd0.asok")
+        c.osds[0].start_admin_socket(sock)
+        for i in range(6):
+            io.write_full(f"o{i}", b"data")
+        rc, perf = admin_command(sock, "perf dump")
+        assert rc == 0 and perf["op"] > 0
+        rc, st = admin_command(sock, "status")
+        assert rc == 0 and st["whoami"] == 0 and st["num_pgs"] > 0
+        rc, hist = admin_command(sock, "dump_historic_ops")
+        assert rc == 0 and hist["num_ops"] > 0
+        ev = [e["event"] for e in hist["ops"][-1]["events"]]
+        assert ev[0] == "initiated" and "dispatched" in ev
+        rc, infl = admin_command(sock, "dump_ops_in_flight")
+        assert rc == 0 and isinstance(infl["ops"], list)
+        rc, cfg = admin_command(sock, "config show")
+        assert rc == 0 and "osd_heartbeat_interval" in cfg
+        rc, _ = admin_command(sock, {"prefix": "config set",
+                                     "var": "log_level", "val": "2"})
+        assert rc == 0
+        rc, v = admin_command(sock, {"prefix": "config get",
+                                     "var": "log_level"})
+        assert rc == 0 and v == 2
+    finally:
+        c.shutdown()
+
+
+def test_mon_admin_socket(tmp_path):
+    c = MiniCluster(n_osd=2, threaded=True)
+    try:
+        c.wait_all_up()
+        sock = str(tmp_path / "mon.asok")
+        c.mon.start_admin_socket(sock)
+        rc, s = admin_command(sock, "status")
+        assert rc == 0 and s["osdmap"]["num_up_osds"] == 2
+        rc, q = admin_command(sock, "quorum_status")
+        assert rc == 0 and q["leader"] == 0
+        rc, h = admin_command(sock, "health")
+        assert rc == 0
+    finally:
+        c.shutdown()

@@ -1,0 +1,133 @@
+"""cephfs-lite: MDS + client over RADOS (ref: src/mds, src/client;
+dirfrag omap layout, journal replay, striped file data)."""
+import pytest
+
+from ceph_tpu.fs import CephFS, MDSDaemon
+from ceph_tpu.fs.client import CephFSError
+from ceph_tpu.testing import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def fs_cluster():
+    c = MiniCluster(n_osd=4, threaded=True)
+    c.wait_all_up()
+    mds = MDSDaemon(c.network, c.rados())
+    mds.init()
+    fs = CephFS(c.rados())
+    yield c, mds, fs
+    mds.shutdown()
+    c.shutdown()
+
+
+def test_namespace_crud(fs_cluster):
+    _c, _mds, fs = fs_cluster
+    fs.mkdir("/a")
+    fs.mkdirs("/a/b/c")
+    assert fs.listdir("/a") == ["b"]
+    assert fs.listdir("/a/b") == ["c"]
+    with pytest.raises(CephFSError, match="EEXIST"):
+        fs.mkdir("/a")
+    with pytest.raises(CephFSError, match="ENOENT"):
+        fs.listdir("/nope")
+    st = fs.stat("/a/b")
+    assert st["type"] == "d"
+    fs.rmdir("/a/b/c")
+    assert fs.listdir("/a/b") == []
+    with pytest.raises(CephFSError, match="ENOTEMPTY"):
+        fs.rmdir("/a")
+
+
+def test_file_io_striped(fs_cluster):
+    c, _mds, fs = fs_cluster
+    fs.mkdirs("/data")
+    import numpy as np
+    payload = np.random.default_rng(5).integers(
+        0, 256, 300_000, dtype=np.uint8).tobytes()
+    fs.write_file("/data/blob.bin", payload)
+    assert fs.read_file("/data/blob.bin") == payload
+    st = fs.stat("/data/blob.bin")
+    assert st["type"] == "f" and st["size"] == len(payload)
+    # partial read + overwrite + sparse hole
+    fh = fs.open("/data/blob.bin")
+    assert fh.read(1000, 500) == payload[1000:1500]
+    fh = fs.open("/data/blob.bin", "w")
+    fh.write(100, b"PATCH")
+    fh.close()
+    assert fs.read_file("/data/blob.bin")[100:105] == b"PATCH"
+    # data is striped: more than one rados object holds the bytes
+    io = fs.rados.open_ioctx("cephfs_data")
+    ino = st["ino"]
+    objs = [o for o in io.list_objects() if o.startswith(f"{ino:x}.")]
+    assert len(objs) > 1
+
+
+def test_rename_and_unlink(fs_cluster):
+    _c, _mds, fs = fs_cluster
+    fs.mkdirs("/r")
+    fs.write_file("/r/one", b"1st")
+    fs.rename("/r/one", "/r/two")
+    assert not fs.exists("/r/one")
+    assert fs.read_file("/r/two") == b"1st"
+    # rename over an existing file replaces it
+    fs.write_file("/r/three", b"3rd")
+    fs.rename("/r/two", "/r/three")
+    assert fs.read_file("/r/three") == b"1st"
+    st = fs.stat("/r/three")
+    fs.unlink("/r/three")
+    assert not fs.exists("/r/three")
+    # data objects purged
+    io = fs.rados.open_ioctx("cephfs_data")
+    ino = st["ino"]
+    assert not [o for o in io.list_objects()
+                if o.startswith(f"{ino:x}.")]
+    with pytest.raises(CephFSError, match="ENOENT"):
+        fs.unlink("/r/three")
+
+
+def test_rename_self_and_subtree_guards(fs_cluster):
+    _c, _mds, fs = fs_cluster
+    fs.mkdirs("/g/sub")
+    fs.write_file("/g/f", b"x")
+    # POSIX: rename onto itself is a no-op, NOT a delete
+    fs.rename("/g/f", "/g/f")
+    assert fs.read_file("/g/f") == b"x"
+    # a directory cannot move into its own subtree
+    with pytest.raises(CephFSError, match="EINVAL"):
+        fs.rename("/g", "/g/sub/g2")
+
+
+def test_statfs(fs_cluster):
+    _c, _mds, fs = fs_cluster
+    s = fs.statfs()
+    assert s["files"] >= 0 and s["dirs"] >= 2
+
+
+def test_mds_journal_replay():
+    """Kill the MDS mid-window (journal written, dirfrags not yet
+    marked applied) — a restarted rank replays and converges
+    (ref: MDLog::replay)."""
+    c = MiniCluster(n_osd=2, threaded=True)
+    try:
+        c.wait_all_up()
+        mds = MDSDaemon(c.network, c.rados())
+        mds.init()
+        fs = CephFS(c.rados())
+        fs.mkdirs("/j/deep")
+        fs.write_file("/j/deep/f", b"journaled")
+        # simulate a crash BEFORE the applied_seq checkpoint: wipe the
+        # dirfrag update for one entry by replaying from scratch — the
+        # meta object still has an older applied_seq (APPLY_EVERY=8,
+        # few ops done, so applied_seq persisted only at mkfs)
+        mds.ms.shutdown()               # hard stop: no flush
+        mds2 = MDSDaemon(c.network, c.rados())
+        mds2.init()
+        fs2 = CephFS(c.rados())
+        assert fs2.listdir("/j/deep") == ["f"]
+        assert fs2.read_file("/j/deep/f") == b"journaled"
+        # allocator must not reuse inos after replay
+        st_old = fs2.stat("/j/deep/f")
+        fs2.write_file("/j/new", b"post-replay")
+        assert fs2.stat("/j/new")["ino"] > st_old["ino"]
+        mds2.shutdown()
+    finally:
+        c.shutdown()

@@ -1,0 +1,125 @@
+"""ConfigMonitor: centralized config through the mon quorum
+(ref: src/mon/ConfigMonitor.cc, src/messages/MConfig.h)."""
+import pytest
+
+from ceph_tpu.common.options import global_config
+from ceph_tpu.testing import MiniCluster
+
+
+@pytest.fixture()
+def cluster():
+    c = MiniCluster(n_osd=2, threaded=True)
+    c.wait_all_up()
+    yield c, c.rados()
+    c.shutdown()
+
+
+def test_set_get_dump_rm(cluster):
+    _, r = cluster
+    rc, outs, _ = r.mon_command({"prefix": "config set", "who": "osd",
+                                 "name": "osd_heartbeat_interval",
+                                 "value": "2.5"})
+    assert rc == 0, outs
+    rc, _, val = r.mon_command({"prefix": "config get", "who": "osd.1",
+                                "name": "osd_heartbeat_interval"})
+    assert rc == 0 and val == "2.5"
+    # precedence: entity beats type beats global
+    r.mon_command({"prefix": "config set", "who": "global",
+                   "name": "ms_type", "value": "local"})
+    r.mon_command({"prefix": "config set", "who": "osd.1",
+                   "name": "osd_heartbeat_interval", "value": "9"})
+    rc, _, merged = r.mon_command({"prefix": "config get",
+                                   "who": "osd.1"})
+    assert merged["osd_heartbeat_interval"] == "9"
+    assert merged["ms_type"] == "local"
+    rc, _, other = r.mon_command({"prefix": "config get",
+                                  "who": "osd.0"})
+    assert other["osd_heartbeat_interval"] == "2.5"
+    rc, _, dump = r.mon_command({"prefix": "config dump"})
+    assert dump["osd"]["osd_heartbeat_interval"] == "2.5"
+    # rm
+    r.mon_command({"prefix": "config rm", "who": "osd.1",
+                   "name": "osd_heartbeat_interval"})
+    rc, _, merged = r.mon_command({"prefix": "config get",
+                                   "who": "osd.1"})
+    assert merged["osd_heartbeat_interval"] == "2.5"
+    rc, outs, _ = r.mon_command({"prefix": "config get", "who": "osd.1",
+                                 "name": "nope_not_set"})
+    assert rc == -2
+
+
+def test_config_pushed_to_osds(cluster):
+    """A committed config set reaches subscribed daemons and applies
+    to their live options registry."""
+    _, r = cluster
+    cfg = global_config()
+    old = cfg["osd_heartbeat_interval"]
+    try:
+        rc, _, _ = r.mon_command({"prefix": "config set", "who": "osd",
+                                  "name": "osd_heartbeat_interval",
+                                  "value": "3.25"})
+        assert rc == 0
+        import time
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and \
+                cfg["osd_heartbeat_interval"] != 3.25:
+            time.sleep(0.05)
+        assert cfg["osd_heartbeat_interval"] == 3.25
+    finally:
+        cfg.set("osd_heartbeat_interval", old)
+
+
+def test_config_rm_reverts_on_daemons(cluster):
+    """`config rm` must revert the live value on running daemons, not
+    just stop future pushes (ref: md_config_t::set_mon_vals)."""
+    _, r = cluster
+    cfg = global_config()
+    default = cfg.schema["osd_heartbeat_interval"].default
+    import time
+    r.mon_command({"prefix": "config set", "who": "osd",
+                   "name": "osd_heartbeat_interval", "value": "2.25"})
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and \
+            cfg["osd_heartbeat_interval"] != 2.25:
+        time.sleep(0.05)
+    assert cfg["osd_heartbeat_interval"] == 2.25
+    r.mon_command({"prefix": "config rm", "who": "osd",
+                   "name": "osd_heartbeat_interval"})
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and \
+            cfg["osd_heartbeat_interval"] != default:
+        time.sleep(0.05)
+    assert cfg["osd_heartbeat_interval"] == default
+
+
+def test_config_survives_quorum_failover():
+    """Values committed through a 3-mon quorum survive killing the
+    leader — the new leader serves the same committed state."""
+    c = MiniCluster(n_osd=2, n_mon=3, threaded=True)
+    try:
+        c.wait_all_up()
+        r = c.rados()
+        rc, outs, _ = r.mon_command({"prefix": "config set",
+                                     "who": "global",
+                                     "name": "mon_lease",
+                                     "value": "7"})
+        assert rc == 0, outs
+        leader = c.leader()
+        assert leader is not None
+        c.kill_mon(leader.rank)
+        import time
+        deadline = time.monotonic() + 30
+        val = None
+        while time.monotonic() < deadline:
+            try:
+                rc, _, val = r.objecter.mon_command(
+                    {"prefix": "config get", "who": "mon.1",
+                     "name": "mon_lease"}, timeout=5.0)
+                if rc == 0:
+                    break
+            except TimeoutError:
+                pass
+            time.sleep(0.25)
+        assert val == "7"
+    finally:
+        c.shutdown()

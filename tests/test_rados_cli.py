@@ -1,0 +1,79 @@
+"""rados CLI tool + bench (ref: src/tools/rados/rados.cc,
+src/common/obj_bencher.cc)."""
+import io as iomod
+
+import pytest
+
+from ceph_tpu.testing import MiniCluster
+from ceph_tpu.tools.rados_cli import main
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(n_osd=4, threaded=True)
+    c.wait_all_up()
+    r = c.rados()
+    yield c, r
+    c.shutdown()
+
+
+def run(r, *argv):
+    out = iomod.StringIO()
+    rc = main(list(argv), rados=r, out=out)
+    return rc, out.getvalue()
+
+
+def test_pool_and_object_lifecycle(cluster, tmp_path):
+    _, r = cluster
+    rc, out = run(r, "mkpool", "clip", "16")
+    assert rc == 0 and "successfully created" in out
+    rc, out = run(r, "lspools")
+    assert "clip" in out.split()
+
+    src = tmp_path / "in.bin"
+    src.write_bytes(b"cli payload " * 50)
+    assert run(r, "put", "clip", "obj1", str(src))[0] == 0
+    rc, out = run(r, "stat", "clip", "obj1")
+    assert rc == 0 and f"size {len(b'cli payload ' * 50)}" in out
+    rc, out = run(r, "ls", "clip")
+    assert out.split() == ["obj1"]
+
+    dst = tmp_path / "out.bin"
+    assert run(r, "get", "clip", "obj1", str(dst))[0] == 0
+    assert dst.read_bytes() == src.read_bytes()
+
+    assert run(r, "setxattr", "clip", "obj1", "k", "v")[0] == 0
+    rc, out = run(r, "getxattr", "clip", "obj1", "k")
+    assert rc == 0 and out.strip() == "v"
+    rc, out = run(r, "listxattr", "clip", "obj1")
+    assert out.split() == ["k"]
+
+    assert run(r, "setomapval", "clip", "obj1", "ok", "ov")[0] == 0
+    rc, out = run(r, "listomapvals", "clip", "obj1")
+    assert "ok" in out and "ov" in out
+
+    assert run(r, "rm", "clip", "obj1")[0] == 0
+    assert run(r, "ls", "clip")[1].split() == []
+    # errors surface as rc=1, not tracebacks
+    assert run(r, "stat", "clip", "gone")[0] == 1
+
+
+def test_bench_write_then_seq(cluster):
+    _, r = cluster
+    run(r, "mkpool", "benchp", "16")
+    rc, out = run(r, "bench", "benchp", "2", "write",
+                  "-b", "65536", "-t", "8", "--no-cleanup")
+    assert rc == 0
+    assert "Bandwidth (MB/sec):" in out and "Average IOPS:" in out
+    assert float(out.split("Bandwidth (MB/sec):")[1].split()[0]) > 0
+    rc, out = run(r, "bench", "benchp", "1", "seq", "-b", "65536",
+                  "-t", "8")
+    assert rc == 0 and "Average Latency(s):" in out
+
+
+def test_pool_delete(cluster):
+    _, r = cluster
+    run(r, "mkpool", "doomed", "8")
+    rc, out = run(r, "rmpool", "doomed")
+    assert rc == 0 and "successfully deleted" in out
+    assert "doomed" not in run(r, "lspools")[1].split()

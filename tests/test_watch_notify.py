@@ -73,7 +73,7 @@ def test_notify_timeout_on_dead_watcher(cluster):
     io = r.open_ioctx("wp")
     io.write_full("tobj", b"x")
     r2 = c.rados()
-    io2 = r2.open_ioctx("tobj" and "wp")
+    io2 = r2.open_ioctx("wp")
     cookie = io2.watch("tobj", lambda *a: None)
     # hard-kill the watcher client (no unwatch)
     r2.shutdown()

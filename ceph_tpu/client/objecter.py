@@ -355,10 +355,20 @@ class Objecter(Dispatcher, MonHunter):
 
     def _send_op(self, op: _Op) -> None:
         op.attempts += 1
+        args = op.args
+        pool = self.osdmap.pools.get(op.pool)
+        if pool is not None and getattr(pool, "snap_seq", 0):
+            # every op carries the client's SnapContext so the primary
+            # COWs against the snapshot the CLIENT saw, even when the
+            # OSD's map lags (ref: MOSDOp carries snapc; Objecter
+            # fills it from the pool in _op_submit)
+            args = dict(args)
+            args["snapc"] = {"seq": pool.snap_seq,
+                             "snaps": sorted(pool.snaps)}
         self.ms.connect(f"osd.{op.target_osd}").send_message(OSDOp(
             pgid=op.pg, oid=op.oid, op=op.op, tid=op.tid,
             epoch=self.osdmap.epoch, offset=op.offset,
-            length=op.length, data=op.data, args=op.args))
+            length=op.length, data=op.data, args=args))
 
     # ---------------------------------------------------- watch/notify
     # (ref: Objecter linger ops + librados watch/notify API)

@@ -126,10 +126,12 @@ class IoCtx:
         return self.rados.objecter.submit(self.pool_id, oid,
                                           "write_full", data=data)
 
-    def aio_read(self, oid: str, length: int = 0, offset: int = 0
-                 ) -> OpFuture:
+    def aio_read(self, oid: str, length: int = 0, offset: int = 0,
+                 snapid: int | None = None) -> OpFuture:
+        args = {"snapid": snapid} if snapid is not None else None
         return self.rados.objecter.submit(self.pool_id, oid, "read",
-                                          offset=offset, length=length)
+                                          offset=offset, length=length,
+                                          args=args)
 
     def aio_remove(self, oid: str) -> OpFuture:
         return self.rados.objecter.submit(self.pool_id, oid, "delete")
@@ -160,8 +162,12 @@ class IoCtx:
     def write_full(self, oid: str, data: bytes) -> None:
         self._wait(self.aio_write_full(oid, data))
 
-    def read(self, oid: str, length: int = 0, offset: int = 0) -> bytes:
-        return self._wait(self.aio_read(oid, length, offset)).data
+    def read(self, oid: str, length: int = 0, offset: int = 0,
+             snapid: int | None = None) -> bytes:
+        """snapid reads the object's state at that pool snapshot
+        (ref: IoCtx::snap_set_read + read)."""
+        return self._wait(self.aio_read(oid, length, offset,
+                                        snapid)).data
 
     def remove(self, oid: str) -> None:
         self._wait(self.aio_remove(oid))
@@ -190,6 +196,65 @@ class IoCtx:
 
     def operate(self, oid: str, op: "WriteOp") -> None:
         self._wait(self.aio_operate(oid, op))
+
+    # -- pool snapshots (ref: librados IoCtx::snap_* family) -----------
+    _MON_ERRNO = {-2: "ENOENT", -17: "EEXIST", -22: "EINVAL",
+                  -95: "EOPNOTSUPP"}
+
+    def snap_create(self, name: str) -> None:
+        """(ref: rados_ioctx_snap_create -> osd pool mksnap)."""
+        pool = self._pool_name()
+        rc, outs, _ = self.rados.mon_command(
+            {"prefix": "osd pool mksnap", "pool": pool, "snap": name})
+        if rc < 0:
+            raise RadosError(self._MON_ERRNO.get(rc, "EINVAL"), outs)
+        # wait for the map carrying the snap (snap_lookup + the COW
+        # context both come from it)
+        if not self.rados.objecter.wait_sync(
+                lambda: name in self.list_pool_snaps().values(),
+                self.rados.op_timeout):
+            raise TimeoutError(f"snap {name} never appeared in map")
+
+    def snap_remove(self, name: str) -> None:
+        pool = self._pool_name()
+        rc, outs, _ = self.rados.mon_command(
+            {"prefix": "osd pool rmsnap", "pool": pool, "snap": name})
+        if rc < 0:
+            raise RadosError(self._MON_ERRNO.get(rc, "EINVAL"), outs)
+        if not self.rados.objecter.wait_sync(
+                lambda: name not in self.list_pool_snaps().values(),
+                self.rados.op_timeout):
+            raise TimeoutError(f"snap {name} never left the map")
+
+    def snap_lookup(self, name: str) -> int:
+        """snap name -> snapid from the client's map
+        (ref: rados_ioctx_snap_lookup)."""
+        pool = self.rados.objecter.osdmap.pools.get(self.pool_id)
+        for sid, n in (pool.snaps if pool else {}).items():
+            if n == name:
+                return sid
+        raise RadosError("ENOENT", f"snap {name}")
+
+    def list_pool_snaps(self) -> dict[int, str]:
+        pool = self.rados.objecter.osdmap.pools.get(self.pool_id)
+        return dict(pool.snaps) if pool else {}
+
+    def snap_rollback(self, oid: str, snap_name: str) -> None:
+        """(ref: rados_ioctx_snap_rollback)."""
+        self._sync("rollback", oid,
+                   args={"snapid": self.snap_lookup(snap_name)})
+
+    def list_snaps(self, oid: str) -> dict:
+        """Per-object snapshot state: clone tags -> covered snapids
+        (ref: rados_ioctx_snap_list / listsnaps)."""
+        return self._sync("list_snaps", oid).attrs
+
+    def _pool_name(self) -> str:
+        m = self.rados.objecter.osdmap
+        name = m.pool_names.get(self.pool_id)
+        if name is None:
+            raise RadosError("ENOENT", f"pool {self.pool_id}")
+        return name
 
     # -- watch/notify (ref: librados IoCtx::watch2/notify2/unwatch2) ---
     def watch(self, oid: str, callback, cookie: str | None = None

@@ -28,6 +28,7 @@ from .paxos import Paxos, PaxosService
 from .store import StoreTransaction
 
 EEXIST, ENOENT, EINVAL, EPERM, EALREADY, EBUSY = 17, 2, 22, 1, 114, 16
+EOPNOTSUPP = 95
 
 # the reference's default profile (osd_pool_default_erasure_code_profile,
 # src/common/options.cc) is jerasure k=2 m=1; ours defaults to the tpu
@@ -367,6 +368,38 @@ class OSDMonitor(PaxosService):
                      "to proceed"), None
             self.pending_inc.old_pools.append(pid)
             return 0, f"pool '{cmdmap['pool']}' removed", None
+        if prefix in ("osd pool mksnap", "osd pool rmsnap"):
+            # pool snapshots (ref: OSDMonitor.cc prepare_command
+            # "osd pool mksnap" -> pg_pool_t::add_snap, snap_seq bump)
+            pid = self._pool_by_name(cmdmap.get("pool", ""))
+            if pid is None:
+                return -ENOENT, "pool does not exist", None
+            snap = cmdmap.get("snap", "")
+            if not snap:
+                return -EINVAL, "missing snap name", None
+            pool = self.pending_inc.new_pools.get(pid) or \
+                copy.deepcopy(m.pools[pid])
+            if prefix == "osd pool mksnap":
+                if pool.is_erasure():
+                    return -EOPNOTSUPP, \
+                        "pool snapshots on erasure-coded pools are " \
+                        "not supported here", None
+                if snap in pool.snaps.values():
+                    return -EEXIST, f"snap {snap} already exists", None
+                pool.snap_seq += 1
+                pool.snaps = dict(pool.snaps)
+                pool.snaps[pool.snap_seq] = snap
+                outs = f"created pool {cmdmap['pool']} snap {snap}"
+            else:
+                sid = next((i for i, n in pool.snaps.items()
+                            if n == snap), None)
+                if sid is None:
+                    return -ENOENT, f"snap {snap} does not exist", None
+                pool.snaps = {i: n for i, n in pool.snaps.items()
+                              if i != sid}
+                outs = f"removed pool {cmdmap['pool']} snap {snap}"
+            self.pending_inc.new_pools[pid] = pool
+            return 0, outs, None
         if prefix == "osd pool set":
             pid = self._pool_by_name(cmdmap.get("pool", ""))
             if pid is None:

@@ -73,6 +73,12 @@ class RepOpWrite(Message):
     mutations: list = field(default_factory=list)
     version: Any = None
     log_entries: list = field(default_factory=list)
+    # snapshot COW decided at the primary (ref: the SnapContext the
+    # primary folds into the repop transaction): clone the pre-write
+    # head as oid@clone_snap covering `clone_covers` snapids
+    clone_snap: Any = None
+    clone_covers: list = field(default_factory=list)
+    snap_seq: int = 0            # pool snap_seq at this write
 
 
 @dataclass
@@ -148,6 +154,9 @@ class PGPush(Message):
     attrs: dict = field(default_factory=dict)    # user xattrs
     omap: dict = field(default_factory=dict)
     omap_hdr: bytes = b""
+    #: snapshot history rides along:
+    #: {snap_seq, items: [{snap, covers, data, attrs, omap}]}
+    clones: dict = field(default_factory=dict)
 
 
 # ---------------------------------------------------------------- client

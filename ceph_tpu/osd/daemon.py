@@ -321,7 +321,8 @@ class OSDDaemon(Dispatcher, MonHunter):
                 self.ms.connect(msg.src).send_message(PGPush(
                     pgid=msg.pgid, oid=oid, data=data, size=len(data),
                     version=shard.object_version(oid),
-                    attrs=attrs, omap=omap, omap_hdr=hdr))
+                    attrs=attrs, omap=omap, omap_hdr=hdr,
+                    clones=shard.clone_payloads(oid)))
             return True
         if isinstance(msg, PGPush):
             self._handle_push(msg)
@@ -435,6 +436,9 @@ class OSDDaemon(Dispatcher, MonHunter):
                         (st.backend is None) == (acting_p != self.whoami):
                     if st.backend is not None:
                         st.backend.epoch = m.epoch
+                        if isinstance(st.backend, ReplicatedBackend):
+                            st.backend.pool_snap_seq = pool.snap_seq
+                            st.backend.pool_snaps = dict(pool.snaps)
                         if st.recovering:
                             # a scanned/pulled-from peer may have died:
                             # restart the (idempotent) recovery against
@@ -470,6 +474,8 @@ class OSDDaemon(Dispatcher, MonHunter):
                             pg, self.whoami, acting, st.shard,
                             send=self._make_send(pg), epoch=m.epoch,
                             tid_gen=self._tid_gen)
+                        st.backend.pool_snap_seq = pool.snap_seq
+                        st.backend.pool_snaps = dict(pool.snaps)
                 self.pgs[pg] = st
                 if st.backend is not None:
                     # new primary or acting change: re-peer (empty
@@ -683,7 +689,8 @@ class OSDDaemon(Dispatcher, MonHunter):
                     data: bytes, version, whiteout: bool,
                     force: bool = False, attrs: dict | None = None,
                     omap: dict | None = None,
-                    omap_hdr: bytes = b"") -> None:
+                    omap_hdr: bytes = b"",
+                    clones: dict | None = None) -> None:
         """Full-object overwrite, but never let an older version clobber
         newer local data (pushes can race regular writes).  `force`
         (scrub repair) overwrites a same-version corrupted copy."""
@@ -695,6 +702,7 @@ class OSDDaemon(Dispatcher, MonHunter):
             return
         if whiteout:
             shard.apply_write(oid, 0, b"", True, EVersion(*ver), [])
+            shard.apply_clone_payloads(oid, clones or {})
             return
         if inv is not None:
             # whiteout first: apply_mutations then recreates from a
@@ -708,6 +716,7 @@ class OSDDaemon(Dispatcher, MonHunter):
         if omap_hdr:
             muts.append((mut.M_OMAP_SETHEADER, omap_hdr))
         shard.apply_mutations(oid, muts, EVersion(*ver), [])
+        shard.apply_clone_payloads(oid, clones or {})
 
     def _handle_push(self, msg: PGPush) -> None:
         st = self.pgs.get(msg.pgid)
@@ -718,7 +727,7 @@ class OSDDaemon(Dispatcher, MonHunter):
         self._apply_push(st.shard, msg.oid, msg.data, msg.version,
                          msg.whiteout, force=msg.force,
                          attrs=msg.attrs, omap=msg.omap,
-                         omap_hdr=msg.omap_hdr)
+                         omap_hdr=msg.omap_hdr, clones=msg.clones)
         if st.recovering and msg.oid in st.pull_pending:
             st.pull_pending.discard(msg.oid)
             if not st.pull_pending and not st.scan_pending:
@@ -740,12 +749,14 @@ class OSDDaemon(Dispatcher, MonHunter):
                 data, attrs, omap, hdr = b"", {}, {}, b""
             else:
                 data, attrs, omap, hdr = st.shard.push_payload(oid)
+            clones = st.shard.clone_payloads(oid)
             for osd in osds:
                 self.perf.inc("recovery_push")
                 self.ms.connect(f"osd.{osd}").send_message(PGPush(
                     pgid=pg, oid=oid, data=data, size=len(data),
                     version=my_ver, whiteout=whiteout,
-                    attrs=attrs, omap=omap, omap_hdr=hdr))
+                    attrs=attrs, omap=omap, omap_hdr=hdr,
+                    clones=clones))
         st.recovering = False
         dout("osd", 10).write("%s: pg %s recovered", self.name, pg)
 
@@ -815,6 +826,7 @@ class OSDDaemon(Dispatcher, MonHunter):
                 and a["crc"] == b["crc"]
                 and a.get("attrs_crc") == b.get("attrs_crc")
                 and a.get("omap_crc") == b.get("omap_crc")
+                and a.get("clones_crc") == b.get("clones_crc")
                 and a["whiteout"] == b["whiteout"] and b["ok"])
 
     def _scrub_compare_replicated(self, pg: PG, st: _PGState) -> None:
@@ -852,12 +864,13 @@ class OSDDaemon(Dispatcher, MonHunter):
                 data, attrs, omap, hdr = b"", {}, {}, b""
             else:
                 data, attrs, omap, hdr = st.shard.push_payload(oid)
+            clones = st.shard.clone_payloads(oid)
             for osd in bad:
                 self.ms.connect(f"osd.{osd}").send_message(PGPush(
                     pgid=pg, oid=oid, data=data, size=len(data),
                     version=ver, whiteout=auth["whiteout"],
                     force=True, attrs=attrs, omap=omap,
-                    omap_hdr=hdr))
+                    omap_hdr=hdr, clones=clones))
             sc.repaired += 1    # per object, matching the EC path
 
     def _scrub_compare_ec(self, pg: PG, st: _PGState) -> None:
@@ -1115,7 +1128,8 @@ class OSDDaemon(Dispatcher, MonHunter):
                 b.submit_transaction(
                     msg.oid, muts,
                     lambda ok, m=msg: self._reply(
-                        m, 0 if ok else -116, "" if ok else "ESTALE"))
+                        m, 0 if ok else -116, "" if ok else "ESTALE"),
+                    snapc=(msg.args or {}).get("snapc"))
             elif msg.op == "read":
                 self._do_read(st, msg)
             elif msg.op == "stat":
@@ -1128,6 +1142,8 @@ class OSDDaemon(Dispatcher, MonHunter):
                             "omap_get_keys", "omap_get_vals_by_keys",
                             "omap_get_header"):
                 self._do_meta_read(st, msg)
+            elif msg.op in ("rollback", "list_snaps"):
+                self._do_snap_op(st, msg)
             elif msg.op == "exec":
                 self._do_exec(st, msg)
             elif msg.op in ("watch", "notify", "notify_ack"):
@@ -1229,7 +1245,8 @@ class OSDDaemon(Dispatcher, MonHunter):
             msg.oid, muts,
             lambda ok, m=msg, o=out: self._reply(
                 m, 0 if ok else -116, "" if ok else "ESTALE",
-                attrs={"out": o}))
+                attrs={"out": o}),
+            snapc=a.get("snapc"))
 
     # ---------------------------------------------------- watch/notify
     # (ref: src/osd/Watch.cc Watch/Notify; PrimaryLogPG do_osd_ops
@@ -1318,6 +1335,47 @@ class OSDDaemon(Dispatcher, MonHunter):
                     attrs={"replies": state["replies"],
                            "timeouts": state["timeouts"]})
 
+    # -------------------------------------------------- pool snapshots
+    def _do_snap_op(self, st: _PGState, msg: OSDOp) -> None:
+        """rollback / list_snaps (ref: CEPH_OSD_OP_ROLLBACK ->
+        PrimaryLogPG::_rollback_to; list_snaps from the SnapSet)."""
+        if isinstance(st.shard, ECPGShard):
+            self._reply(msg, _ERRNO["EOPNOTSUPP"], "EOPNOTSUPP")
+            return
+        a = msg.args or {}
+        if msg.op == "list_snaps":
+            oi = st.shard.head_oi(msg.oid)
+            if not oi:
+                self._reply(msg, -2, "ENOENT")
+                return
+            self._reply(msg, 0, attrs={
+                "clones": st.shard.clone_tags(msg.oid),
+                "head_exists": not oi.get("whiteout", False),
+                "snap_seq": oi.get("snap_seq", 0)})
+            return
+        snapid = int(a["snapid"])
+        res = st.shard.resolve_snap(msg.oid, snapid)
+        snapc = a.get("snapc")
+        if res == "head":
+            self._reply(msg, 0)            # head already == snap state
+        elif res is None:
+            # object absent at that snap: rollback removes the head
+            # (ref: _rollback_to's whiteout path)
+            if self._object_exists(st, msg.oid):
+                st.backend.submit_transaction(
+                    msg.oid, [(mut.M_DELETE,)],
+                    lambda ok, m=msg: self._reply(
+                        m, 0 if ok else -116, "" if ok else "ESTALE"),
+                    snapc=snapc)
+            else:
+                self._reply(msg, 0)
+        else:
+            st.backend.submit_transaction(
+                msg.oid, [(mut.M_ROLLBACK, res)],
+                lambda ok, m=msg: self._reply(
+                    m, 0 if ok else -116, "" if ok else "ESTALE"),
+                snapc=snapc)
+
     def _do_meta_read(self, st: _PGState, msg: OSDOp) -> None:
         """xattr/omap reads served from the primary's local shard
         (attrs are on every EC shard; omap is replicated-only)."""
@@ -1356,9 +1414,25 @@ class OSDDaemon(Dispatcher, MonHunter):
 
     def _do_read(self, st: _PGState, msg: OSDOp) -> None:
         b = st.backend
+        snapid = (msg.args or {}).get("snapid")
+        if snapid is not None and not isinstance(
+                st.shard, ReplicatedPGShard):
+            self._reply(msg, _ERRNO["EOPNOTSUPP"], "EOPNOTSUPP")
+            return
         if isinstance(b, ReplicatedBackend):
             try:
-                data = b.read(msg.oid, msg.offset, msg.length)
+                if snapid is not None:
+                    res = st.shard.resolve_snap(msg.oid, int(snapid))
+                    if res is None:
+                        self._reply(msg, -2, "ENOENT")
+                        return
+                    if res == "head":
+                        data = b.read(msg.oid, msg.offset, msg.length)
+                    else:
+                        data = st.shard.read_clone(
+                            msg.oid, res, msg.offset, msg.length)
+                else:
+                    data = b.read(msg.oid, msg.offset, msg.length)
                 self.perf.inc("op_r_bytes", len(data))
                 self._reply(msg, 0, data=data)
             except StoreError as err:

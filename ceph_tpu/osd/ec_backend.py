@@ -397,7 +397,9 @@ class ECBackend:
     #             :1832 start_rmw, :2138 check_ops)
     # ==================================================================
     def submit_transaction(self, oid: str, muts: list,
-                           on_all_commit: Callable) -> int:
+                           on_all_commit: Callable,
+                           snapc: dict | None = None) -> int:
+        # snapc ignored: EC pools don't support snapshots here
         with self._lock:
             tid = self._next_tid()
             # a write against an object the primary shard is missing

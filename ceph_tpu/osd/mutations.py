@@ -25,6 +25,8 @@ M_TRUNCATE = "truncate"        # (M_TRUNCATE, size)
 M_ZERO = "zero"                # (M_ZERO, off, len)
 M_DELETE = "delete"            # (M_DELETE,)
 M_CREATE = "create"            # (M_CREATE,)  (existence enforced above)
+M_ROLLBACK = "rollback"        # (M_ROLLBACK, clone_tag) — restore head
+#                                from a snapshot clone (replicated only)
 M_SETXATTRS = "setxattrs"      # (M_SETXATTRS, {name: bytes})
 M_RMXATTR = "rmxattr"          # (M_RMXATTR, name)
 M_OMAP_SETKEYS = "omap_setkeys"    # (M_OMAP_SETKEYS, {key: bytes})
@@ -32,7 +34,8 @@ M_OMAP_RMKEYS = "omap_rmkeys"      # (M_OMAP_RMKEYS, [key])
 M_OMAP_CLEAR = "omap_clear"        # (M_OMAP_CLEAR,)
 M_OMAP_SETHEADER = "omap_setheader"  # (M_OMAP_SETHEADER, bytes)
 
-DATA_MUTATIONS = {M_WRITE, M_WRITEFULL, M_APPEND, M_TRUNCATE, M_ZERO}
+DATA_MUTATIONS = {M_WRITE, M_WRITEFULL, M_APPEND, M_TRUNCATE, M_ZERO,
+                  M_ROLLBACK}
 OMAP_MUTATIONS = {M_OMAP_SETKEYS, M_OMAP_RMKEYS, M_OMAP_CLEAR,
                   M_OMAP_SETHEADER}
 META_MUTATIONS = {M_SETXATTRS, M_RMXATTR, M_CREATE} | OMAP_MUTATIONS
@@ -85,6 +88,7 @@ _MUT_SPEC = {
     M_ZERO: (_chk_off, _chk_off),
     M_DELETE: (),
     M_CREATE: (),
+    M_ROLLBACK: (_chk_off,),
     M_SETXATTRS: (_chk_kv,),
     M_RMXATTR: (lambda v: isinstance(v, str),),
     M_OMAP_SETKEYS: (_chk_kv,),
@@ -120,6 +124,10 @@ def validate(mutations: Iterable[tuple], ec_pool: bool) -> list[tuple]:
         if ec_pool and m[0] in OMAP_MUTATIONS:
             raise MutationError(
                 "EOPNOTSUPP", "erasure-coded pools do not support omap")
+        if ec_pool and m[0] == M_ROLLBACK:
+            raise MutationError(
+                "EOPNOTSUPP",
+                "snapshots are not supported on erasure-coded pools")
         if m[0] == M_DELETE and len(ms) > 1:
             raise MutationError("EINVAL", "delete must be sole mutation")
         out.append(m)

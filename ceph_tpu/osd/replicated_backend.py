@@ -40,22 +40,39 @@ class ReplicatedPGShard:
     # FLAG_WHITEOUT): recovery compares versions, so a delete must be
     # a versioned event or a stale replica would resurrect the object.
     def apply_mutations(self, oid: str, muts: list, version,
-                        log_entries) -> bool:
+                        log_entries, clone_snap=None,
+                        clone_covers=None, snap_seq: int = 0) -> bool:
         """Apply a mutation vector as one atomic store transaction
         (the replica-side analogue of the reference's per-repop
-        ObjectStore::Transaction built by PrimaryLogPG::do_osd_ops)."""
+        ObjectStore::Transaction built by PrimaryLogPG::do_osd_ops).
+
+        `clone_snap`/`clone_covers`: the primary's COW decision (ref:
+        PrimaryLogPG::make_writeable): before the mutation, the current
+        head is preserved as `oid@clone_snap`, serving reads for the
+        snapids in clone_covers.  Head object-info tracks `snap_seq`
+        (pool seq at last write) and the `clones` map."""
         soid = ObjectId(oid)
         txn = Transaction()
+        old_oi = self.head_oi(oid)
+        clones = dict(old_oi.get("clones", {}))
+        head_live = bool(old_oi) and not old_oi.get("whiteout")
         try:
+            if clone_snap is not None and head_live:
+                # COW: preserve the pre-write head (data+attrs+omap)
+                txn.clone(self.cid, soid,
+                          ObjectId(oid, snap=clone_snap))
+                clones[clone_snap] = list(clone_covers or [])
+            new_seq = max(old_oi.get("snap_seq", 0), snap_seq)
             if mut.is_delete(muts):
                 if self.store.exists(self.cid, soid):
                     txn.remove(self.cid, soid)
                 txn.touch(self.cid, soid)
                 txn.setattr(self.cid, soid, OI_ATTR,
                             {"size": 0, "version": version,
-                             "whiteout": True})
+                             "whiteout": True, "snap_seq": new_seq,
+                             "clones": clones})
             else:
-                if self._is_whiteout(soid):
+                if old_oi.get("whiteout"):
                     txn.remove(self.cid, soid)
                     txn.touch(self.cid, soid)
                     size = 0
@@ -64,7 +81,8 @@ class ReplicatedPGShard:
                     txn.touch(self.cid, soid)
                 size = self._build_mutation_txn(txn, soid, muts, size)
                 txn.setattr(self.cid, soid, OI_ATTR,
-                            {"size": size, "version": version})
+                            {"size": size, "version": version,
+                             "snap_seq": new_seq, "clones": clones})
             if not txn.empty():
                 self.store.queue_transaction(txn)
             for e in log_entries:
@@ -75,6 +93,44 @@ class ReplicatedPGShard:
             dout("osd", 0).write("%s replicated apply failed: %s",
                                  self.pgid, err)
             return False
+
+    def head_oi(self, oid: str) -> dict:
+        """The head's object-info attr ({} when absent)."""
+        try:
+            return dict(self.store.getattr(self.cid, ObjectId(oid),
+                                           OI_ATTR))
+        except StoreError:
+            return {}
+
+    # -- snapshots (ref: SnapSet resolution in PrimaryLogPG::find_object_context)
+    def resolve_snap(self, oid: str, snapid: int):
+        """What serves a read at `snapid`: a clone tag, "head", or
+        None (the object did not exist at that snap)."""
+        oi = self.head_oi(oid)
+        covering = sorted(
+            int(tag) for tag, covers in oi.get("clones", {}).items()
+            if snapid in covers)
+        if covering:
+            return covering[0]
+        if oi and not oi.get("whiteout") and \
+                snapid > oi.get("snap_seq", 0):
+            return "head"
+        return None
+
+    def read_clone(self, oid: str, tag: int, offset: int = 0,
+                   length: int = 0) -> bytes:
+        csoid = ObjectId(oid, snap=tag)
+        try:
+            size = self.store.getattr(self.cid, csoid,
+                                      OI_ATTR)["size"]
+        except StoreError:
+            raise StoreError("ENOENT", f"{oid}@{tag}")
+        return bytes(self.store.read(
+            self.cid, csoid, offset, length or max(0, size - offset)))
+
+    def clone_tags(self, oid: str) -> dict[int, list[int]]:
+        return {int(t): list(c) for t, c in
+                self.head_oi(oid).get("clones", {}).items()}
 
     def _build_mutation_txn(self, txn: Transaction, soid: ObjectId,
                             muts: list, size: int) -> int:
@@ -110,6 +166,17 @@ class ReplicatedPGShard:
                     txn.zero(self.cid, soid, off, end - off)
             elif kind == mut.M_CREATE:
                 pass                      # the leading touch created it
+            elif kind == mut.M_ROLLBACK:
+                # restore head wholesale from the clone: data, xattrs
+                # and omap all revert (ref: PrimaryLogPG _rollback_to)
+                tag = m[1]
+                csoid = ObjectId(soid.name, snap=tag)
+                if not self.store.exists(self.cid, csoid):
+                    raise StoreError("ENOENT",
+                                     f"{soid.name}@{tag} clone")
+                txn.clone(self.cid, csoid, soid)
+                size = self.store.getattr(self.cid, csoid,
+                                          OI_ATTR)["size"]
             elif kind == mut.M_SETXATTRS:
                 txn.setattrs(self.cid, soid,
                              {mut.uxattr_key(k): bytes(v)
@@ -147,6 +214,68 @@ class ReplicatedPGShard:
                 dict(self.store.omap_get(self.cid, soid)),
                 self.omap_get_header(oid))
 
+    def _clones_digest(self, oid: str) -> int:
+        from ..common.crc32c import crc32c
+        clone_digest = {}
+        for tag in sorted(self.clone_tags(oid)):
+            try:
+                cdata = self.store.read(
+                    self.cid, ObjectId(oid, snap=tag), 0, 0)
+            except StoreError:
+                cdata = b"\0MISSING"
+            clone_digest[str(tag)] = \
+                int(crc32c(0xFFFFFFFF, cdata)).to_bytes(4, "big")
+        return mut.meta_digest(clone_digest)
+
+    def clone_payloads(self, oid: str) -> dict:
+        """Snapshot state accompanying a push: the rebuilt copy must
+        serve snap reads too (ref: recovery pushes every clone of an
+        object, PGBackend::objects_list_range + per-clone PushOps).
+        {} when the object has no snapshot history."""
+        oi = self.head_oi(oid)
+        tags = self.clone_tags(oid)
+        if not tags and not oi.get("snap_seq"):
+            return {}
+        items = []
+        for tag, covers in sorted(tags.items()):
+            csoid = ObjectId(oid, snap=tag)
+            if not self.store.exists(self.cid, csoid):
+                continue
+            items.append({"snap": tag, "covers": covers,
+                          "data": bytes(self.store.read(
+                              self.cid, csoid, 0, 0)),
+                          "attrs": dict(self.store.getattrs(
+                              self.cid, csoid)),
+                          "omap": dict(self.store.omap_get(
+                              self.cid, csoid))})
+        return {"snap_seq": oi.get("snap_seq", 0), "items": items}
+
+    def apply_clone_payloads(self, oid: str, payload: dict) -> None:
+        """One atomic transaction for every clone AND the head-oi
+        graft: a crash between them would leave clones the head no
+        longer references (snap reads ENOENT, COW skipped)."""
+        if not payload:
+            return
+        txn = Transaction()
+        clones_map = {}
+        for c in payload.get("items", []):
+            clones_map[c["snap"]] = list(c["covers"])
+            csoid = ObjectId(oid, snap=c["snap"])
+            txn.touch(self.cid, csoid)
+            txn.truncate(self.cid, csoid, 0)
+            txn.write(self.cid, csoid, 0, c["data"])
+            txn.setattrs(self.cid, csoid, c["attrs"])
+            if c.get("omap"):
+                txn.omap_clear(self.cid, csoid)
+                txn.omap_setkeys(self.cid, csoid, c["omap"])
+        # graft the snap history back onto the freshly-pushed head oi
+        oi = self.head_oi(oid)
+        oi["clones"] = clones_map
+        oi["snap_seq"] = max(oi.get("snap_seq", 0),
+                             payload.get("snap_seq", 0))
+        txn.setattr(self.cid, ObjectId(oid), OI_ATTR, oi)
+        self.store.queue_transaction(txn)
+
     def _is_whiteout(self, soid: ObjectId) -> bool:
         try:
             return bool(self.store.getattr(self.cid, soid,
@@ -156,7 +285,10 @@ class ReplicatedPGShard:
 
     def handle_rep_write(self, m: RepOpWrite, whoami: int) -> RepOpReply:
         ok = self.apply_mutations(m.oid, m.mutations, m.version,
-                                  m.log_entries)
+                                  m.log_entries,
+                                  clone_snap=m.clone_snap,
+                                  clone_covers=m.clone_covers,
+                                  snap_seq=m.snap_seq)
         return RepOpReply(pgid=m.pgid, tid=m.tid, from_osd=whoami,
                           committed=ok)
 
@@ -216,21 +348,22 @@ class ReplicatedPGShard:
         return tuple(v) if v else (0, 0)
 
     def objects(self) -> list[str]:
-        """Client-visible objects (whiteouts excluded)."""
+        """Client-visible objects (whiteouts + snap clones excluded)."""
         if not self.store.collection_exists(self.cid):
             return []
         return sorted({o.name for o in self.store.collection_list(self.cid)
-                       if o.name != "pgmeta"
+                       if o.name != "pgmeta" and o.snap == -2
                        and not self._is_whiteout(o)})
 
     def inventory(self) -> dict[str, tuple]:
-        """Recovery inventory incl. whiteouts:
+        """Recovery inventory incl. whiteouts (head objects only —
+        clones travel with their head's pushes):
         oid -> ((epoch, version), whiteout)."""
         if not self.store.collection_exists(self.cid):
             return {}
         out = {}
         for o in self.store.collection_list(self.cid):
-            if o.name == "pgmeta":
+            if o.name == "pgmeta" or o.snap != -2:
                 continue
             out[o.name] = (self.object_version(o.name),
                            self._is_whiteout(o))
@@ -250,8 +383,13 @@ class ReplicatedPGShard:
         out: dict[str, dict] = {}
         for oid, (ver, whiteout) in self.inventory().items():
             if whiteout:
-                out[oid] = {"version": ver, "size": 0, "crc": None,
-                            "whiteout": True, "ok": True}
+                entry = {"version": ver, "size": 0, "crc": None,
+                         "whiteout": True, "ok": True}
+                if deep:
+                    # a deleted head can still carry live snapshot
+                    # clones — they must scrub like any replicated state
+                    entry["clones_crc"] = self._clones_digest(oid)
+                out[oid] = entry
                 continue
             try:
                 data = self.read(oid)
@@ -274,6 +412,9 @@ class ReplicatedPGShard:
                 entry["omap_crc"] = mut.meta_digest(
                     self.store.omap_get(self.cid, soid),
                     self.omap_get_header(oid))
+                # snapshot clones are replicated state too: a copy
+                # missing (or corrupting) a clone must scrub unequal
+                entry["clones_crc"] = self._clones_digest(oid)
             out[oid] = entry
         return out
 
@@ -304,6 +445,10 @@ class ReplicatedBackend:
         self._tid_gen = tid_gen    # see ECBackend: no tid reuse across
         self._lock = threading.RLock()      # backend rebuilds
         self.in_flight: dict[int, _RepWrite] = {}
+        # pool snapshot state (daemon refreshes on every map;
+        # ref: pg_pool_t snap_seq/snaps feeding the SnapContext)
+        self.pool_snap_seq = 0
+        self.pool_snaps: dict[int, str] = {}
 
     def _next_tid(self) -> int:
         if self._tid_gen is not None:
@@ -335,6 +480,15 @@ class ReplicatedBackend:
         size = self.local_shard.object_size(oid)
         for m in muts:
             kind = m[0]
+            if kind == mut.M_ROLLBACK:
+                try:
+                    size = self.local_shard.store.getattr(
+                        self.local_shard.cid,
+                        ObjectId(oid, snap=m[1]), OI_ATTR)["size"]
+                except StoreError:
+                    size = 0
+                out.append(m)
+                continue
             if kind == mut.M_APPEND:
                 m = (mut.M_WRITE, size, m[1])
             elif kind == mut.M_ZERO:
@@ -351,19 +505,51 @@ class ReplicatedBackend:
             out.append(m)
         return out
 
+    def _snap_context(self, snapc) -> tuple[int, list[int]]:
+        """Effective snapshot context: the newest of the client's
+        snapc and this primary's own pool state — a lagging OSD map
+        must not lose a snapshot the client already saw, and a lagging
+        client must not roll one back (ref: the snapc the MOSDOp
+        carries vs pool.snapc resolution in PrimaryLogPG)."""
+        seq, snaps = self.pool_snap_seq, sorted(self.pool_snaps)
+        if snapc and snapc.get("seq", 0) > seq:
+            seq, snaps = snapc["seq"], sorted(snapc.get("snaps", []))
+        return seq, snaps
+
+    def _cow_decision(self, oid: str, seq: int, snaps: list[int]):
+        """Does this write need to preserve the head as a clone first
+        (ref: PrimaryLogPG::make_writeable — head snapped since its
+        last write -> clone before mutating)?"""
+        if not seq:
+            return None, []
+        oi = self.local_shard.head_oi(oid)
+        if not oi or oi.get("whiteout"):
+            return None, []
+        prev = oi.get("snap_seq", 0)
+        if prev >= seq:
+            return None, []
+        covers = [s for s in snaps if prev < s <= seq]
+        if not covers:
+            return None, []        # the intervening snaps were deleted
+        return seq, covers
+
     # -- writes (ref: ReplicatedBackend.cc:1069 submit_transaction) ----
     def submit_transaction(self, oid: str, muts: list,
-                           on_all_commit: Callable) -> int:
+                           on_all_commit: Callable,
+                           snapc: dict | None = None) -> int:
         """Apply a mutation vector locally then fan it out to every
         acting replica; `on_all_commit(ok)` once all committed."""
         with self._lock:
             tid = self._next_tid()
             version = self._next_version()
             muts = self._resolve_muts(oid, muts)
+            seq, snaps = self._snap_context(snapc)
+            clone_snap, covers = self._cow_decision(oid, seq, snaps)
             entry = PGLogEntry(DELETE if mut.is_delete(muts) else MODIFY,
                                oid, version)
-            ok = self.local_shard.apply_mutations(oid, muts, version,
-                                                  [entry])
+            ok = self.local_shard.apply_mutations(
+                oid, muts, version, [entry], clone_snap=clone_snap,
+                clone_covers=covers, snap_seq=seq)
             if not ok:
                 on_all_commit(False)
                 return tid
@@ -377,7 +563,10 @@ class ReplicatedBackend:
             self.in_flight[tid] = op
             msg = RepOpWrite(pgid=self.pgid, tid=tid, oid=oid,
                              mutations=list(muts), version=version,
-                             log_entries=[entry])
+                             log_entries=[entry],
+                             clone_snap=clone_snap,
+                             clone_covers=covers or [],
+                             snap_seq=seq)
             for s in replicas:
                 if not self.send(s, msg):
                     op.failed.add(s)

@@ -140,6 +140,11 @@ class PGPool:
     pgp_num: int = 64
     flags: int = FLAG_HASHPSPOOL
     erasure_code_profile: str = ""
+    # pool snapshots (ref: pg_pool_t::snap_seq/snaps,
+    # osd_types.h:1331-1336): snap_seq is the newest snapid; snaps
+    # maps live snapid -> name
+    snap_seq: int = 0
+    snaps: dict = field(default_factory=dict)
     # derived
     pg_num_mask: int = field(default=0, repr=False)
     pgp_num_mask: int = field(default=0, repr=False)

@@ -357,11 +357,15 @@ class Objecter(Dispatcher, MonHunter):
         op.attempts += 1
         args = op.args
         pool = self.osdmap.pools.get(op.pool)
-        if pool is not None and getattr(pool, "snap_seq", 0):
+        if pool is not None and getattr(pool, "snap_seq", 0) \
+                and "snapc" not in args:
             # every op carries the client's SnapContext so the primary
             # COWs against the snapshot the CLIENT saw, even when the
             # OSD's map lags (ref: MOSDOp carries snapc; Objecter
-            # fills it from the pool in _op_submit)
+            # fills it from the pool in _op_submit).  An explicit
+            # snapc (self-managed snaps: the IoCtx's write context)
+            # always wins — the pool map knows nothing about
+            # self-managed snapids.
             args = dict(args)
             args["snapc"] = {"seq": pool.snap_seq,
                              "snaps": sorted(pool.snaps)}

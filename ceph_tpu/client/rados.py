@@ -115,16 +115,35 @@ class IoCtx:
     def __init__(self, rados: Rados, pool_id: int):
         self.rados = rados
         self.pool_id = pool_id
+        #: self-managed write SnapContext (ref: rados_ioctx_
+        #: selfmanaged_snap_set_write_ctx): when set, it rides with
+        #: every mutation from this IoCtx instead of the pool's
+        self.write_snapc: dict | None = None
+
+    def set_write_snapc(self, seq: int, snaps) -> None:
+        """(ref: selfmanaged_snap_set_write_ctx)."""
+        self.write_snapc = {"seq": int(seq),
+                            "snaps": sorted(int(s) for s in snaps)}
+
+    def _margs(self, extra: dict | None = None) -> dict | None:
+        """args for a mutating op: inject the self-managed snapc."""
+        if self.write_snapc is None:
+            return extra
+        out = dict(extra or {})
+        out["snapc"] = self.write_snapc
+        return out
 
     # -- async ---------------------------------------------------------
     def aio_write(self, oid: str, data: bytes, offset: int = 0
                   ) -> OpFuture:
         return self.rados.objecter.submit(self.pool_id, oid, "write",
-                                          offset=offset, data=data)
+                                          offset=offset, data=data,
+                                          args=self._margs())
 
     def aio_write_full(self, oid: str, data: bytes) -> OpFuture:
         return self.rados.objecter.submit(self.pool_id, oid,
-                                          "write_full", data=data)
+                                          "write_full", data=data,
+                                          args=self._margs())
 
     def aio_read(self, oid: str, length: int = 0, offset: int = 0,
                  snapid: int | None = None) -> OpFuture:
@@ -134,17 +153,19 @@ class IoCtx:
                                           args=args)
 
     def aio_remove(self, oid: str) -> OpFuture:
-        return self.rados.objecter.submit(self.pool_id, oid, "delete")
+        return self.rados.objecter.submit(self.pool_id, oid, "delete",
+                                          args=self._margs())
 
     def aio_append(self, oid: str, data: bytes) -> OpFuture:
         return self.rados.objecter.submit(self.pool_id, oid, "append",
-                                          data=data)
+                                          data=data, args=self._margs())
 
     def aio_operate(self, oid: str, op: "WriteOp") -> OpFuture:
         """Atomic compound mutation (ref: librados
         ObjectWriteOperation / IoCtx::operate)."""
-        return self.rados.objecter.submit(self.pool_id, oid, "writev",
-                                          args={"ops": list(op.ops)})
+        return self.rados.objecter.submit(
+            self.pool_id, oid, "writev",
+            args=self._margs({"ops": list(op.ops)}))
 
     # -- sync ----------------------------------------------------------
     def _wait(self, fut: OpFuture) -> OpFuture:
@@ -176,7 +197,14 @@ class IoCtx:
         fut = self.rados.objecter.submit(self.pool_id, oid, "stat")
         return self._wait(fut).attrs
 
+    _MUTATING_OPS = frozenset({
+        "truncate", "zero", "create", "setxattr", "rmxattr",
+        "omap_setkeys", "omap_rmkeys", "omap_clear",
+        "omap_set_header", "rollback", "exec"})
+
     def _sync(self, op: str, oid: str, **kw) -> OpFuture:
+        if op in self._MUTATING_OPS and self.write_snapc is not None:
+            kw["args"] = self._margs(kw.get("args"))
         return self._wait(self.rados.objecter.submit(
             self.pool_id, oid, op, **kw))
 
@@ -243,6 +271,28 @@ class IoCtx:
         """(ref: rados_ioctx_snap_rollback)."""
         self._sync("rollback", oid,
                    args={"snapid": self.snap_lookup(snap_name)})
+
+    def selfmanaged_snap_create(self) -> int:
+        """Allocate a client-managed snapid (ref:
+        rados_ioctx_selfmanaged_snap_create); the caller maintains the
+        write snapc via set_write_snapc."""
+        rc, outs, sid = self.rados.mon_command(
+            {"prefix": "osd pool selfmanaged-snap create",
+             "pool": self._pool_name()})
+        if rc < 0:
+            raise RadosError(self._MON_ERRNO.get(rc, "EINVAL"), outs)
+        return int(sid)
+
+    def selfmanaged_snap_remove(self, snapid: int) -> None:
+        rc, outs, _ = self.rados.mon_command(
+            {"prefix": "osd pool selfmanaged-snap rm",
+             "pool": self._pool_name(), "snapid": int(snapid)})
+        if rc < 0:
+            raise RadosError(self._MON_ERRNO.get(rc, "EINVAL"), outs)
+
+    def rollback_to_snapid(self, oid: str, snapid: int) -> None:
+        """Self-managed rollback by raw snapid."""
+        self._sync("rollback", oid, args={"snapid": int(snapid)})
 
     def list_snaps(self, oid: str) -> dict:
         """Per-object snapshot state: clone tags -> covered snapids

@@ -368,6 +368,31 @@ class OSDMonitor(PaxosService):
                      "to proceed"), None
             self.pending_inc.old_pools.append(pid)
             return 0, f"pool '{cmdmap['pool']}' removed", None
+        if prefix == "osd pool selfmanaged-snap create":
+            # allocate a snapid the CLIENT manages (ref:
+            # OSDMonitor's selfmanaged_snap path /
+            # rados_ioctx_selfmanaged_snap_create): snap_seq bumps,
+            # pool.snaps does NOT record it — the snapc travels with
+            # client IO instead
+            pid = self._pool_by_name(cmdmap.get("pool", ""))
+            if pid is None:
+                return -ENOENT, "pool does not exist", None
+            pool = self.pending_inc.new_pools.get(pid) or \
+                copy.deepcopy(m.pools[pid])
+            if pool.is_erasure():
+                return -EOPNOTSUPP, \
+                    "snapshots on erasure-coded pools are not " \
+                    "supported here", None
+            pool.snap_seq += 1
+            self.pending_inc.new_pools[pid] = pool
+            return 0, "", pool.snap_seq
+        if prefix == "osd pool selfmanaged-snap rm":
+            pid = self._pool_by_name(cmdmap.get("pool", ""))
+            if pid is None:
+                return -ENOENT, "pool does not exist", None
+            # retirement is client-side bookkeeping (clone trimming is
+            # lazy here, like a never-running snap trimmer)
+            return 0, "", None
         if prefix in ("osd pool mksnap", "osd pool rmsnap"):
             # pool snapshots (ref: OSDMonitor.cc prepare_command
             # "osd pool mksnap" -> pg_pool_t::add_snap, snap_seq bump)

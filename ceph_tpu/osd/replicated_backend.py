@@ -218,13 +218,20 @@ class ReplicatedPGShard:
         from ..common.crc32c import crc32c
         clone_digest = {}
         for tag in sorted(self.clone_tags(oid)):
+            csoid = ObjectId(oid, snap=tag)
             try:
-                cdata = self.store.read(
-                    self.cid, ObjectId(oid, snap=tag), 0, 0)
+                cdata = self.store.read(self.cid, csoid, 0, 0)
+                # rollback restores attrs/omap too, so scrub must
+                # cover them, not just the clone's bytes
+                meta = (mut.meta_digest(self.store.getattrs(
+                            self.cid, csoid))
+                        ^ mut.meta_digest(self.store.omap_get(
+                            self.cid, csoid)))
             except StoreError:
-                cdata = b"\0MISSING"
-            clone_digest[str(tag)] = \
-                int(crc32c(0xFFFFFFFF, cdata)).to_bytes(4, "big")
+                cdata, meta = b"\0MISSING", 0
+            clone_digest[str(tag)] = (
+                int(crc32c(0xFFFFFFFF, cdata)) ^ meta
+            ).to_bytes(8, "big", signed=False)
         return mut.meta_digest(clone_digest)
 
     def clone_payloads(self, oid: str) -> dict:
@@ -253,9 +260,14 @@ class ReplicatedPGShard:
     def apply_clone_payloads(self, oid: str, payload: dict) -> None:
         """One atomic transaction for every clone AND the head-oi
         graft: a crash between them would leave clones the head no
-        longer references (snap reads ENOENT, COW skipped)."""
-        if not payload:
+        longer references (snap reads ENOENT, COW skipped).
+
+        The pushed history is AUTHORITATIVE: local clones absent from
+        it (divergent-write leftovers) are removed and the clones map
+        replaced, or scrub repair could never converge."""
+        if not payload and not self.clone_tags(oid):
             return
+        payload = payload or {}
         txn = Transaction()
         clones_map = {}
         for c in payload.get("items", []):
@@ -268,6 +280,10 @@ class ReplicatedPGShard:
             if c.get("omap"):
                 txn.omap_clear(self.cid, csoid)
                 txn.omap_setkeys(self.cid, csoid, c["omap"])
+        for tag in self.clone_tags(oid):
+            if tag not in clones_map and self.store.exists(
+                    self.cid, ObjectId(oid, snap=tag)):
+                txn.remove(self.cid, ObjectId(oid, snap=tag))
         # graft the snap history back onto the freshly-pushed head oi
         oi = self.head_oi(oid)
         oi["clones"] = clones_map
@@ -506,14 +522,17 @@ class ReplicatedBackend:
         return out
 
     def _snap_context(self, snapc) -> tuple[int, list[int]]:
-        """Effective snapshot context: the newest of the client's
-        snapc and this primary's own pool state — a lagging OSD map
-        must not lose a snapshot the client already saw, and a lagging
-        client must not roll one back (ref: the snapc the MOSDOp
-        carries vs pool.snapc resolution in PrimaryLogPG)."""
-        seq, snaps = self.pool_snap_seq, sorted(self.pool_snaps)
-        if snapc and snapc.get("seq", 0) > seq:
-            seq, snaps = snapc["seq"], sorted(snapc.get("snaps", []))
+        """Effective snapshot context: the union of the client's snapc
+        and this primary's own pool state, newest seq wins — a lagging
+        OSD map must not lose a snapshot the client already saw, a
+        lagging client must not roll one back, and SELF-MANAGED snapids
+        (allocated at the mon but absent from pool.snaps — the librbd
+        model) exist only in the client's snapc (ref: the snapc the
+        MOSDOp carries vs pool snapc resolution in PrimaryLogPG)."""
+        seq = max(self.pool_snap_seq,
+                  (snapc or {}).get("seq", 0))
+        snaps = sorted(set(self.pool_snaps)
+                       | set((snapc or {}).get("snaps", [])))
         return seq, snaps
 
     def _cow_decision(self, oid: str, seq: int, snaps: list[int]):

@@ -78,9 +78,16 @@ class RBD:
 
 
 class Image:
-    """(ref: librbd::Image / ImageCtx)."""
+    """(ref: librbd::Image / ImageCtx).
 
-    def __init__(self, ioctx: IoCtx, name: str):
+    Snapshots are librbd-style SELF-MANAGED rados snaps (ref:
+    librbd::Operations::snap_create -> selfmanaged_snap_create +
+    per-image SnapContext on every data-object write): snapids live in
+    the image header, the write snapc rides on a private IoCtx, and
+    opening at a snapshot reads each data object at that snapid."""
+
+    def __init__(self, ioctx: IoCtx, name: str,
+                 snapshot: str | None = None):
         self.ioctx = ioctx
         self.name = name
         try:
@@ -94,7 +101,25 @@ class Image:
             stripe_unit=int(meta["stripe_unit"]),
             stripe_count=int(meta["stripe_count"]),
             object_size=1 << self.order)
+        self.snaps: dict[str, dict] = meta.get("snaps", {})
+        self._snap_id: int | None = None
+        if snapshot is not None:
+            if snapshot not in self.snaps:
+                raise RBDError(2, f"snapshot {snapshot!r} not found")
+            self._snap_id = self.snaps[snapshot]["id"]
+            self.size = int(self.snaps[snapshot]["size"])
+        # writes go through a private IoCtx carrying the image snapc
+        # (the caller's IoCtx must not inherit it)
+        self._wio = IoCtx(ioctx.rados, ioctx.pool_id)
+        self._refresh_snapc()
         self._open = True
+
+    def _refresh_snapc(self) -> None:
+        ids = sorted(s["id"] for s in self.snaps.values())
+        if ids:
+            self._wio.set_write_snapc(max(ids), ids)
+        else:
+            self._wio.write_snapc = None
 
     # -- metadata ------------------------------------------------------
     def stat(self) -> dict:
@@ -106,21 +131,19 @@ class Image:
                 "stripe_count": self.layout.stripe_count}
 
     def _object_span(self) -> int:
-        if self.size == 0:
-            return 0
-        last = Striper.file_to_extents(self.layout, self.size - 1, 1)
-        return max(e.objectno for e in last) + 1
+        return self._span_for(self.size)
 
     def resize(self, size: int) -> None:
         """Grow or shrink; shrink removes whole objects past the end
         (ref: librbd Operations::resize / object trimming)."""
         self._check_open()
+        self._check_writable()
         old_span = self._object_span()
         self.size = size
         new_span = self._object_span()
         for objno in range(new_span, old_span):
             try:
-                self.ioctx.remove(data_name(self.name, objno))
+                self._wio.remove(data_name(self.name, objno))
             except RadosError:
                 pass
         self._save_meta()
@@ -128,9 +151,61 @@ class Image:
     def _save_meta(self) -> None:
         meta = {"size": self.size, "order": self.order,
                 "stripe_unit": self.layout.stripe_unit,
-                "stripe_count": self.layout.stripe_count}
+                "stripe_count": self.layout.stripe_count,
+                "snaps": self.snaps}
         self.ioctx.write_full(header_name(self.name),
                               json.dumps(meta).encode())
+
+    # -- snapshots (ref: librbd::Operations snap_create/remove/rollback)
+    def snap_create(self, snap_name: str) -> None:
+        self._check_open()
+        self._check_writable()
+        if snap_name in self.snaps:
+            raise RBDError(17, f"snapshot {snap_name!r} exists")
+        sid = self._wio.selfmanaged_snap_create()
+        self.snaps[snap_name] = {"id": sid, "size": self.size}
+        self._refresh_snapc()
+        self._save_meta()
+
+    def snap_remove(self, snap_name: str) -> None:
+        self._check_open()
+        self._check_writable()
+        if snap_name not in self.snaps:
+            raise RBDError(2, f"snapshot {snap_name!r} not found")
+        sid = self.snaps.pop(snap_name)["id"]
+        self._wio.selfmanaged_snap_remove(sid)
+        self._refresh_snapc()
+        self._save_meta()
+
+    def snap_list(self) -> list[dict]:
+        return [{"name": n, "id": s["id"], "size": s["size"]}
+                for n, s in sorted(self.snaps.items(),
+                                   key=lambda kv: kv[1]["id"])]
+
+    def snap_rollback(self, snap_name: str) -> None:
+        """Restore every data object to its state at the snapshot
+        (ref: librbd snap_rollback iterates the objects)."""
+        self._check_open()
+        self._check_writable()
+        if snap_name not in self.snaps:
+            raise RBDError(2, f"snapshot {snap_name!r} not found")
+        snap = self.snaps[snap_name]
+        span = max(self._object_span(), self._span_for(snap["size"]))
+        for objno in range(span):
+            self._wio.rollback_to_snapid(
+                data_name(self.name, objno), snap["id"])
+        self.size = int(snap["size"])
+        self._save_meta()
+
+    def _span_for(self, size: int) -> int:
+        if size == 0:
+            return 0
+        last = Striper.file_to_extents(self.layout, size - 1, 1)
+        return max(e.objectno for e in last) + 1
+
+    def _check_writable(self) -> None:
+        if self._snap_id is not None:
+            raise RBDError(30, "image is open read-only at a snapshot")
 
     # -- IO ------------------------------------------------------------
     def _check_open(self) -> None:
@@ -146,16 +221,17 @@ class Image:
         """(ref: librbd io/ImageRequest.cc write path: extents through
         the striper, one object op per extent)."""
         self._check_open()
+        self._check_writable()
         length = self._clip(offset, len(data))
         futs = []
         for ext in Striper.file_to_extents(self.layout, offset, length):
             buf = data[ext.logical_offset - offset:
                        ext.logical_offset - offset + ext.length]
-            futs.append(self.ioctx.aio_write(
+            futs.append(self._wio.aio_write(
                 data_name(self.name, ext.objectno), buf,
                 offset=ext.offset))
         for f in futs:
-            self.ioctx._wait(f)
+            self._wio._wait(f)
         return length
 
     def read(self, offset: int, length: int) -> bytes:
@@ -167,7 +243,8 @@ class Image:
         for ext in Striper.file_to_extents(self.layout, offset, length):
             fut = self.ioctx.aio_read(
                 data_name(self.name, ext.objectno),
-                length=ext.length, offset=ext.offset)
+                length=ext.length, offset=ext.offset,
+                snapid=self._snap_id)
             pend.append((ext, fut))
         for ext, fut in pend:
             try:
@@ -184,17 +261,18 @@ class Image:
         """Zero a range (whole-object removes when covered,
         ref: io/ImageRequest.cc discard)."""
         self._check_open()
+        self._check_writable()
         length = self._clip(offset, length)
         obj_size = 1 << self.order
         for ext in Striper.file_to_extents(self.layout, offset, length):
             oid = data_name(self.name, ext.objectno)
             if ext.offset == 0 and ext.length == obj_size:
                 try:
-                    self.ioctx.remove(oid)
+                    self._wio.remove(oid)
                 except RadosError:
                     pass
             else:
-                self.ioctx.write(oid, b"\0" * ext.length,
+                self._wio.write(oid, b"\0" * ext.length,
                                  offset=ext.offset)
 
     def close(self) -> None:

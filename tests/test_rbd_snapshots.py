@@ -1,0 +1,94 @@
+"""RBD image snapshots over self-managed rados snaps
+(ref: librbd Operations::snap_create/rollback;
+rados_ioctx_selfmanaged_snap_* + per-image SnapContext)."""
+import numpy as np
+import pytest
+
+from ceph_tpu.rbd import RBD, Image
+from ceph_tpu.rbd.image import RBDError
+from ceph_tpu.testing import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(n_osd=4, threaded=True)
+    c.wait_all_up()
+    r = c.rados()
+    r.pool_create("rbd", pg_num=16)
+    yield c, r
+    c.shutdown()
+
+
+@pytest.fixture()
+def io(cluster):
+    _, r = cluster
+    return r.open_ioctx("rbd")
+
+
+def test_snap_create_read_back(io):
+    RBD().create(io, "disk", size=1 << 22, order=16)
+    img = Image(io, "disk")
+    rng = np.random.default_rng(9)
+    v1 = rng.integers(0, 256, 200_000, dtype=np.uint8).tobytes()
+    img.write(0, v1)
+    img.snap_create("s1")
+    v2 = rng.integers(0, 256, 200_000, dtype=np.uint8).tobytes()
+    img.write(0, v2)
+    img.snap_create("s2")
+    img.write(50_000, b"\xff" * 1000)
+    # live image has the latest bytes
+    live = img.read(0, 200_000)
+    assert live[50_000:51_000] == b"\xff" * 1000
+    # snapshots read back their frozen state
+    s1 = Image(io, "disk", snapshot="s1")
+    assert s1.read(0, 200_000) == v1
+    s2 = Image(io, "disk", snapshot="s2")
+    assert s2.read(0, 200_000) == v2
+    assert [s["name"] for s in img.snap_list()] == ["s1", "s2"]
+    # snapshot handles are read-only
+    with pytest.raises(RBDError):
+        s1.write(0, b"nope")
+    with pytest.raises(RBDError):
+        s1.snap_create("inner")
+
+
+def test_snap_rollback(io):
+    RBD().create(io, "rbk", size=1 << 20, order=16)
+    img = Image(io, "rbk")
+    img.write(0, b"stable state " * 1000)
+    img.snap_create("good")
+    img.write(0, b"BROKEN!!" * 2000)
+    img.snap_rollback("good")
+    assert img.read(0, 13_000) == (b"stable state " * 1000)
+    # rollback restores the size recorded at snap time
+    assert img.size == 1 << 20
+
+
+def test_snap_remove_and_missing(io):
+    RBD().create(io, "rmv", size=1 << 20, order=16)
+    img = Image(io, "rmv")
+    img.write(0, b"x" * 100)
+    img.snap_create("tmp")
+    img.snap_remove("tmp")
+    assert img.snap_list() == []
+    with pytest.raises(RBDError):
+        img.snap_remove("tmp")
+    with pytest.raises(RBDError):
+        Image(io, "rmv", snapshot="tmp")
+
+
+def test_snap_of_sparse_and_grown_image(io):
+    RBD().create(io, "grow", size=1 << 20, order=16)
+    img = Image(io, "grow")
+    img.write(0, b"A" * 10)
+    img.snap_create("small")
+    img.resize(1 << 21)
+    img.write((1 << 20) + 5, b"beyond old end")
+    snap = Image(io, "grow", snapshot="small")
+    assert snap.size == 1 << 20
+    assert snap.read(0, 10) == b"A" * 10
+    # reading at the snapshot never sees post-snap objects: at the
+    # snapshot's size the read clips empty, past it it's an error
+    assert snap.read((1 << 20) - 10, 10 ** 3) == b"\0" * 10
+    with pytest.raises(RBDError):
+        snap.read((1 << 20) + 1, 10)

@@ -15,6 +15,7 @@ import threading
 from typing import Optional
 
 from ..common.log import dout
+from ..common.options import global_config
 from ..msg.messages import (MAuthReply, MMap, MMonCommand,
                             MMonCommandAck, MMonSubscribe,
                             MWatchNotify, OSDOp, OSDOpReply)
@@ -70,6 +71,7 @@ class _Op:
         self.pg: Optional[PG] = None
         self.target_osd = -1
         self.attempts = 0
+        self.trace: Optional[dict] = None
 
 
 class Objecter(Dispatcher, MonHunter):
@@ -369,10 +371,14 @@ class Objecter(Dispatcher, MonHunter):
             args = dict(args)
             args["snapc"] = {"seq": pool.snap_seq,
                              "snaps": sorted(pool.snaps)}
+        if op.trace is None and global_config()["blkin_trace_all"]:
+            from ..common.tracing import new_trace
+            op.trace = new_trace()
         self.ms.connect(f"osd.{op.target_osd}").send_message(OSDOp(
             pgid=op.pg, oid=op.oid, op=op.op, tid=op.tid,
             epoch=self.osdmap.epoch, offset=op.offset,
-            length=op.length, data=op.data, args=args))
+            length=op.length, data=op.data, args=args,
+            trace=op.trace))
 
     # ---------------------------------------------------- watch/notify
     # (ref: Objecter linger ops + librados watch/notify API)

@@ -197,13 +197,11 @@ class IoCtx:
         fut = self.rados.objecter.submit(self.pool_id, oid, "stat")
         return self._wait(fut).attrs
 
-    _MUTATING_OPS = frozenset({
-        "truncate", "zero", "create", "setxattr", "rmxattr",
-        "omap_setkeys", "omap_rmkeys", "omap_clear",
-        "omap_set_header", "rollback", "exec"})
-
     def _sync(self, op: str, oid: str, **kw) -> OpFuture:
-        if op in self._MUTATING_OPS and self.write_snapc is not None:
+        # snapc is injected unconditionally: mutating ops need it for
+        # COW and the OSD ignores it on reads — an allowlist here
+        # would silently drop it for any op added later
+        if self.write_snapc is not None:
             kw["args"] = self._margs(kw.get("args"))
         return self._wait(self.rados.objecter.submit(
             self.pool_id, oid, op, **kw))

@@ -169,6 +169,8 @@ OPTIONS: dict[str, Option] = {opt.name: opt for opt in [
        runtime=True,
        desc="make MemStore reads of marked objects fail with EIO"),
     # logging
+    _o("blkin_trace_all", T.BOOL, False, L.DEV, runtime=True,
+       desc="trace every client op with distributed spans"),
     _o("log_level", T.UINT, 1, L.BASIC, runtime=True,
        desc="global default debug level", max=30),
 ]}

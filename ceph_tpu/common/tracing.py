@@ -1,0 +1,93 @@
+"""Distributed tracing: blkin/Zipkin-style spans across daemons.
+
+The reference threads a ZTracer::Trace through every Message
+(ref: src/msg/Message.h:263-264, src/common/zipkin_trace.h; spans
+emitted from the OSD pipeline via OpRequest::pg_trace,
+src/osd/ECBackend.cc:1508) with LTTng/blkin as the sink.  Here the
+trace context is a small dict riding the Message `trace` field —
+{"trace_id", "span", "parent"} — and each daemon keeps its own
+in-memory ring of finished spans, dumped via the admin socket
+(`dump_traces`); assembling a cross-daemon trace = filtering every
+daemon's ring by trace_id.
+
+Enabled by the `blkin_trace_all` option (ref: rbd/osd blkin trace
+options in src/common/options.cc).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+
+
+def _new_id() -> str:
+    return os.urandom(8).hex()
+
+
+def new_trace() -> dict:
+    """Root context for one client op (ref: ZTracer::Trace init)."""
+    return {"trace_id": _new_id(), "span": _new_id(), "parent": None}
+
+
+def child_of(ctx: dict | None) -> dict | None:
+    """Child context to ride a fan-out message."""
+    if not ctx:
+        return None
+    return {"trace_id": ctx["trace_id"], "span": _new_id(),
+            "parent": ctx["span"]}
+
+
+class Span:
+    __slots__ = ("trace_id", "span_id", "parent", "name", "service",
+                 "start", "end", "events")
+
+    def __init__(self, ctx: dict, name: str, service: str):
+        self.trace_id = ctx["trace_id"]
+        self.span_id = ctx["span"]
+        self.parent = ctx.get("parent")
+        self.name = name
+        self.service = service
+        self.start = time.monotonic()
+        self.end: float | None = None
+        self.events: list[tuple[float, str]] = []
+
+    def event(self, msg: str) -> None:
+        """(ref: ZTracer::Trace::event)."""
+        self.events.append((time.monotonic() - self.start, msg))
+
+    def dump(self) -> dict:
+        return {"trace_id": self.trace_id, "span_id": self.span_id,
+                "parent": self.parent, "name": self.name,
+                "service": self.service,
+                "duration": round((self.end or time.monotonic())
+                                  - self.start, 6),
+                "events": [{"t": round(t, 6), "event": e}
+                           for t, e in self.events]}
+
+
+class Tracer:
+    """Per-daemon span sink (the blkin collector stand-in)."""
+
+    def __init__(self, service: str = "", keep: int = 256):
+        self.service = service
+        self._lock = threading.Lock()
+        self._done: deque[Span] = deque(maxlen=keep)
+
+    def start_span(self, ctx: dict | None, name: str) -> Span | None:
+        if not ctx:
+            return None
+        return Span(ctx, name, self.service)
+
+    def finish(self, span: Span | None) -> None:
+        if span is None:
+            return
+        span.end = time.monotonic()
+        with self._lock:
+            self._done.append(span)
+
+    def dump(self, trace_id: str | None = None) -> list[dict]:
+        with self._lock:
+            spans = list(self._done)
+        return [s.dump() for s in spans
+                if trace_id is None or s.trace_id == trace_id]

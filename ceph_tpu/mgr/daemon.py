@@ -38,6 +38,8 @@ class MgrDaemon(Dispatcher, MonHunter):
         self.last_optimize: dict = {}
         self._tid = itertools.count(1)
         self._pending: set[int] = set()       # unacked command tids
+        self._sync_cmds: dict = {}            # tid -> (Event, slot)
+        self.prometheus = None
         self.failed_commands = 0
         self._lock = threading.RLock()
         self.ms = Messenger.create(network, self.name, threaded=threaded)
@@ -57,6 +59,8 @@ class MgrDaemon(Dispatcher, MonHunter):
             MMonSubscribe(what="osdmap", start=1))
 
     def shutdown(self) -> None:
+        if self.prometheus is not None:
+            self.prometheus.shutdown()
         self.ms.shutdown()
 
     # -------------------------------------------------------- dispatch
@@ -69,13 +73,43 @@ class MgrDaemon(Dispatcher, MonHunter):
         if isinstance(msg, MMonCommandAck):
             with self._lock:
                 self._pending.discard(msg.tid)
-                if msg.result != 0:
+                entry = self._sync_cmds.pop(msg.tid, None)
+                if msg.result != 0 and entry is None:
                     self.failed_commands += 1
                     dout("mgr", 0).write(
                         "%s: mon command failed (%d): %s", self.name,
                         msg.result, msg.outs)
+            if entry is not None:
+                ev, slot = entry
+                slot.update(r=msg.result, outs=msg.outs,
+                            outb=msg.outb)
+                ev.set()
             return True
         return False
+
+    def mon_command(self, cmd: dict,
+                    timeout: float = 30.0) -> tuple[int, str, object]:
+        """Synchronous round-trip (the prometheus module's command
+        channel)."""
+        tid = next(self._tid)
+        ev, slot = threading.Event(), {}
+        with self._lock:
+            self._sync_cmds[tid] = (ev, slot)
+        self.ms.connect(self.mon).send_message(
+            MMonCommand(tid=tid, cmd=cmd))
+        if not ev.wait(timeout):
+            with self._lock:
+                self._sync_cmds.pop(tid, None)
+            raise TimeoutError(f"mon command {cmd.get('prefix')!r}")
+        return slot["r"], slot["outs"], slot["outb"]
+
+    def start_prometheus(self, port: int = 0):
+        """Serve /metrics (ref: pybind/mgr/prometheus)."""
+        from .prometheus import PrometheusExporter
+        self.prometheus = PrometheusExporter(self.mon_command,
+                                             port=port)
+        self.prometheus.start()
+        return self.prometheus
 
     # ------------------------------------------------------- balancing
     def tick(self) -> int:

@@ -1,0 +1,146 @@
+"""Prometheus exporter: cluster + per-daemon metrics over HTTP.
+
+The mgr prometheus module analogue (ref: src/pybind/mgr/prometheus/
+module.py — health/osd/pool/pg metrics in the Prometheus exposition
+text format, scraped at /metrics).  Each scrape pulls fresh state
+through the mon command path (`status`, `df`, `osd perf dump`), so the
+exporter itself is stateless.
+"""
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+_HEALTH_VALUE = {"HEALTH_OK": 0, "HEALTH_WARN": 1, "HEALTH_ERR": 2}
+
+
+def _esc(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"')
+
+
+class _Builder:
+    def __init__(self):
+        self.lines: list[str] = []
+
+    def metric(self, name: str, help_text: str, kind: str = "gauge"):
+        self.lines.append(f"# HELP {name} {help_text}")
+        self.lines.append(f"# TYPE {name} {kind}")
+
+    def sample(self, name: str, value, labels: dict | None = None):
+        lbl = ""
+        if labels:
+            lbl = "{" + ",".join(f'{k}="{_esc(v)}"'
+                                 for k, v in sorted(labels.items())) \
+                + "}"
+        self.lines.append(f"{name}{lbl} {float(value):g}")
+
+    def render(self) -> str:
+        return "\n".join(self.lines) + "\n"
+
+
+class PrometheusExporter:
+    """Serve /metrics off a command channel (`mon_command(cmd) ->
+    (rc, outs, outb)`): a Rados handle or a Monitor both qualify."""
+
+    def __init__(self, mon_command, host: str = "127.0.0.1",
+                 port: int = 0):
+        self._cmd = mon_command
+        exporter = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path.rstrip("/") not in ("", "/metrics"):
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                try:
+                    body = exporter.collect().encode()
+                    status = 200
+                except Exception as ex:
+                    body = f"# collect failed: {ex}\n".encode()
+                    status = 500
+                self.send_response(status)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="prometheus",
+            daemon=True)
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    # -- collection (ref: prometheus/module.py Module.collect) ---------
+    def collect(self) -> str:
+        b = _Builder()
+        rc, _, status = self._cmd({"prefix": "status"})
+        if rc != 0:
+            raise RuntimeError("status unavailable")
+        b.metric("ceph_health_status",
+                 "cluster health (0=OK 1=WARN 2=ERR)")
+        b.sample("ceph_health_status",
+                 _HEALTH_VALUE.get(status["health"]["status"], 2))
+        om = status["osdmap"]
+        b.metric("ceph_osd_up", "osd up state")
+        b.metric("ceph_osd_in", "osd in state")
+        b.sample("ceph_osd_up", om["num_up_osds"])
+        b.sample("ceph_osd_in", om["num_in_osds"])
+        b.metric("ceph_osdmap_epoch", "current osdmap epoch",
+                 "counter")
+        b.sample("ceph_osdmap_epoch", om["epoch"])
+        pm = status["pgmap"]
+        b.metric("ceph_pg_total", "total placement groups")
+        b.sample("ceph_pg_total", pm["num_pgs"])
+        b.metric("ceph_pg_state", "pg count by state")
+        for state, n in sorted(pm.get("pgs_by_state", {}).items()):
+            b.sample("ceph_pg_state", n, {"state": state})
+        b.metric("ceph_cluster_total_bytes", "raw capacity")
+        b.sample("ceph_cluster_total_bytes", pm.get("total_kb", 0) * 1024)
+        b.metric("ceph_cluster_total_used_bytes", "raw used")
+        b.sample("ceph_cluster_total_used_bytes",
+                 pm.get("used_kb", 0) * 1024)
+        b.metric("ceph_objects", "total objects")
+        b.sample("ceph_objects", pm.get("num_objects", 0))
+
+        rc, _, df = self._cmd({"prefix": "df"})
+        if rc == 0:
+            b.metric("ceph_pool_objects", "objects per pool")
+            b.metric("ceph_pool_bytes", "logical bytes per pool")
+            for pool, st in sorted(df.get("pools", {}).items()):
+                b.sample("ceph_pool_objects", st["objects"],
+                         {"pool": pool})
+                b.sample("ceph_pool_bytes", st["bytes"],
+                         {"pool": pool})
+
+        rc, _, perf = self._cmd({"prefix": "osd perf dump"})
+        if rc == 0:
+            emitted: set[str] = set()
+            for daemon, counters in sorted(perf.items()):
+                for key, val in sorted(counters.items()):
+                    if isinstance(val, dict):   # long-run averages
+                        val = val.get("avg", 0.0)
+                    elif isinstance(val, list):  # histograms
+                        continue
+                    name = f"ceph_daemon_{key}"
+                    if name not in emitted:
+                        emitted.add(name)
+                        b.metric(name, f"per-daemon counter {key}",
+                                 "counter")
+                    b.sample(name, val, {"daemon": daemon})
+        return b.render()

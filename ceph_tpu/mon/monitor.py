@@ -275,7 +275,8 @@ class Monitor(Dispatcher):
                 self.pgmap.ingest(OSDStatReport(
                     osd=msg.osd, epoch=msg.epoch, stamp=msg.stamp,
                     pg_stats=msg.pg_stats, kb_total=msg.kb_total,
-                    kb_used=msg.kb_used, kb_avail=msg.kb_avail))
+                    kb_used=msg.kb_used, kb_avail=msg.kb_avail,
+                    perf=msg.perf))
                 # mirror OSD-originated reports to the other mons so
                 # status/health/df answer the same from any rank (the
                 # reference replicates the digest via MgrStatMonitor)
@@ -440,6 +441,14 @@ class Monitor(Dispatcher):
 
     def _preprocess_mon_command(self, cmdmap: dict):
         prefix = cmdmap.get("prefix", "")
+        if prefix == "osd perf dump":
+            # per-daemon counters as last reported (the mgr's
+            # prometheus module scrapes these; ref: DaemonState
+            # perf_counters aggregation in src/mgr/)
+            return 0, "", {f"osd.{o}": r.perf
+                           for o, r in sorted(
+                               self.pgmap.osd_reports.items())
+                           if r.perf}
         if prefix not in ("status", "health", "health detail", "df",
                           "pg stat", "pg dump", "quorum_status",
                           "mon stat"):

@@ -390,8 +390,16 @@ class OSDMonitor(PaxosService):
             pid = self._pool_by_name(cmdmap.get("pool", ""))
             if pid is None:
                 return -ENOENT, "pool does not exist", None
-            # retirement is client-side bookkeeping (clone trimming is
-            # lazy here, like a never-running snap trimmer)
+            # record removal so no future SnapContext covers the id
+            # (clone trimming stays lazy, like a never-running snap
+            # trimmer)
+            sid = int(cmdmap.get("snapid", 0))
+            if sid > 0:
+                pool = self.pending_inc.new_pools.get(pid) or \
+                    copy.deepcopy(m.pools[pid])
+                pool.removed_snaps = sorted(
+                    set(pool.removed_snaps) | {sid})
+                self.pending_inc.new_pools[pid] = pool
             return 0, "", None
         if prefix in ("osd pool mksnap", "osd pool rmsnap"):
             # pool snapshots (ref: OSDMonitor.cc prepare_command
@@ -422,6 +430,8 @@ class OSDMonitor(PaxosService):
                     return -ENOENT, f"snap {snap} does not exist", None
                 pool.snaps = {i: n for i, n in pool.snaps.items()
                               if i != sid}
+                pool.removed_snaps = sorted(
+                    set(pool.removed_snaps) | {sid})
                 outs = f"removed pool {cmdmap['pool']} snap {snap}"
             self.pending_inc.new_pools[pid] = pool
             return 0, outs, None

@@ -27,6 +27,7 @@ class OSDStatReport:
     kb_total: int = 0
     kb_used: int = 0
     kb_avail: int = 0
+    perf: dict = field(default_factory=dict)
 
 
 class PGMap:

@@ -202,6 +202,9 @@ class MPGStats(Message):
     kb_total: int = 0
     kb_used: int = 0
     kb_avail: int = 0
+    #: daemon perf counters (the MMgrReport payload in the reference —
+    #: piggybacked on the stat report here)
+    perf: dict = field(default_factory=dict)
 
 
 @dataclass

@@ -38,6 +38,9 @@ class Message:
     # sender's auth handler when auth is enabled
     # (ref: Message signing under session keys, msgr v2)
     auth: Optional[dict] = field(default=None, compare=False)
+    # blkin-style trace context riding the message
+    # (ref: Message.h:263 ZTracer::Trace trace)
+    trace: Optional[dict] = field(default=None, compare=False)
 
     @property
     def type_name(self) -> str:

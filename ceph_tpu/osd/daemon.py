@@ -130,6 +130,10 @@ class OSDDaemon(Dispatcher, MonHunter):
         from ..common.tracked_op import OpTracker
         self.op_tracker = OpTracker()
         self.asok = None
+        # blkin-style span sink (ref: OpRequest::pg_trace plumbing)
+        from ..common.tracing import Tracer
+        self.tracer = Tracer(self.name)
+        self._op_spans: dict = {}
         self.hbmap = HeartbeatMap()
         self._hb_handle = self.hbmap.add_worker(
             f"{self.name}.tick",
@@ -195,6 +199,9 @@ class OSDDaemon(Dispatcher, MonHunter):
                    lambda c: (0, self.op_tracker.dump_historic()))
         a.register("dump_blocked_ops", "ops over the complaint age",
                    lambda c: (0, self.op_tracker.slow_ops()))
+        a.register("dump_traces", "finished blkin spans "
+                   "(optionally trace_id=...)",
+                   lambda c: (0, self.tracer.dump(c.get("trace_id"))))
 
         def _status(c):
             with self._lock:
@@ -233,6 +240,11 @@ class OSDDaemon(Dispatcher, MonHunter):
                 (msg.src, msg.tid),
                 f"osd_op({msg.src} tid={msg.tid} {msg.op} "
                 f"{msg.pgid} {msg.oid})")
+            if msg.trace:
+                sp = self.tracer.start_span(
+                    msg.trace, f"osd_op:{msg.op}")
+                sp.event(f"oid={msg.oid}")
+                self._op_spans[(msg.src, msg.tid)] = sp
             # serialize op execution: the TCP backend delivers each
             # connection on its own reader thread, so without this two
             # clients' read-modify-write ops (cls exec, omap updates)
@@ -246,7 +258,12 @@ class OSDDaemon(Dispatcher, MonHunter):
             st = self.pgs.get(msg.pgid)
             if st is not None and st.shard is not None:
                 self.perf.inc("subop_w")
+                sp = self.tracer.start_span(msg.trace, "ec_sub_write")
                 reply = st.shard.handle_sub_write(msg)
+                if sp is not None:
+                    sp.event(f"shard={msg.shard} committed="
+                             f"{reply.committed}")
+                    self.tracer.finish(sp)
             else:
                 # map lag: nack so the sender's op/recovery fails fast
                 # instead of waiting on an ack that never comes
@@ -283,7 +300,12 @@ class OSDDaemon(Dispatcher, MonHunter):
             st = self.pgs.get(msg.pgid)
             if st is not None and st.shard is not None:
                 self.perf.inc("subop_w")
+                sp = self.tracer.start_span(msg.trace, "rep_write")
                 reply = st.shard.handle_rep_write(msg, self.whoami)
+                if sp is not None:
+                    sp.event(f"oid={msg.oid} committed="
+                             f"{reply.committed}")
+                    self.tracer.finish(sp)
                 self.ms.connect(msg.src).send_message(reply)
             return True
         if isinstance(msg, RepOpReply):
@@ -439,6 +461,8 @@ class OSDDaemon(Dispatcher, MonHunter):
                         if isinstance(st.backend, ReplicatedBackend):
                             st.backend.pool_snap_seq = pool.snap_seq
                             st.backend.pool_snaps = dict(pool.snaps)
+                            st.backend.pool_removed_snaps = \
+                                set(pool.removed_snaps)
                         if st.recovering:
                             # a scanned/pulled-from peer may have died:
                             # restart the (idempotent) recovery against
@@ -476,6 +500,8 @@ class OSDDaemon(Dispatcher, MonHunter):
                             tid_gen=self._tid_gen)
                         st.backend.pool_snap_seq = pool.snap_seq
                         st.backend.pool_snaps = dict(pool.snaps)
+                        st.backend.pool_removed_snaps = \
+                            set(pool.removed_snaps)
                 self.pgs[pg] = st
                 if st.backend is not None:
                     # new primary or acting change: re-peer (empty
@@ -1086,7 +1112,8 @@ class OSDDaemon(Dispatcher, MonHunter):
             osd=self.whoami, epoch=self.osdmap.epoch, stamp=now,
             pg_stats=pg_stats, kb_total=fs["total"] // 1024,
             kb_used=fs["used"] // 1024,
-            kb_avail=fs["available"] // 1024))
+            kb_avail=fs["available"] // 1024,
+            perf=self.perf.dump()))
 
     # ---------------------------------------------------- client ops
     def _reply(self, msg: OSDOp, result: int, errno_name: str = "",
@@ -1094,6 +1121,11 @@ class OSDDaemon(Dispatcher, MonHunter):
         self.op_tracker.finish((msg.src, msg.tid),
                                "commit_sent" if result == 0
                                else f"error:{errno_name}")
+        sp = self._op_spans.pop((msg.src, msg.tid), None)
+        if sp is not None:
+            sp.event("reply_sent" if result == 0
+                     else f"error:{errno_name}")
+            self.tracer.finish(sp)
         self.ms.connect(msg.src).send_message(OSDOpReply(
             tid=msg.tid, result=result, errno_name=errno_name,
             data=data, attrs=attrs or {}, epoch=self.osdmap.epoch))
@@ -1129,7 +1161,8 @@ class OSDDaemon(Dispatcher, MonHunter):
                     msg.oid, muts,
                     lambda ok, m=msg: self._reply(
                         m, 0 if ok else -116, "" if ok else "ESTALE"),
-                    snapc=(msg.args or {}).get("snapc"))
+                    snapc=(msg.args or {}).get("snapc"),
+                    trace=msg.trace)
             elif msg.op == "read":
                 self._do_read(st, msg)
             elif msg.op == "stat":

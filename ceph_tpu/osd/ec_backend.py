@@ -280,6 +280,7 @@ class _Write:
     failed_shards: set = field(default_factory=set)
     log_entry: Optional[PGLogEntry] = None
     phase: str = "state"      # state -> reads -> commit -> done
+    trace: Optional[dict] = None      # blkin context for fan-out spans
 
 
 @dataclass
@@ -398,7 +399,8 @@ class ECBackend:
     # ==================================================================
     def submit_transaction(self, oid: str, muts: list,
                            on_all_commit: Callable,
-                           snapc: dict | None = None) -> int:
+                           snapc: dict | None = None,
+                           trace: dict | None = None) -> int:
         # snapc ignored: EC pools don't support snapshots here
         with self._lock:
             tid = self._next_tid()
@@ -415,6 +417,7 @@ class ECBackend:
             op = _Write(tid=tid, oid=oid, mutations=list(muts),
                         delete=delete, version=self._next_version(),
                         on_all_commit=on_all_commit)
+            op.trace = trace
             op.log_entry = PGLogEntry(
                 DELETE if delete else MODIFY, oid, op.version,
                 prior_version=self._object_prior_version(oid))
@@ -592,9 +595,11 @@ class ECBackend:
         else:
             shards, shard_txns, new_size = self._encode_write(op)
         op.pending_shards = set(shard_txns)
+        from ..common.tracing import child_of
         for s, txn in shard_txns.items():
             msg = ECSubWrite(pgid=self.pgid, tid=op.tid, shard=s,
-                             txn=txn, log_entries=[op.log_entry])
+                             txn=txn, log_entries=[op.log_entry],
+                             trace=child_of(op.trace))
             if self.acting[s] == self.whoami:
                 reply = self.local_shard.handle_sub_write(msg)
                 self._on_write_reply(op, reply)

@@ -462,9 +462,11 @@ class ReplicatedBackend:
         self._lock = threading.RLock()      # backend rebuilds
         self.in_flight: dict[int, _RepWrite] = {}
         # pool snapshot state (daemon refreshes on every map;
-        # ref: pg_pool_t snap_seq/snaps feeding the SnapContext)
+        # ref: pg_pool_t snap_seq/snaps/removed_snaps feeding the
+        # SnapContext)
         self.pool_snap_seq = 0
         self.pool_snaps: dict[int, str] = {}
+        self.pool_removed_snaps: set[int] = set()
 
     def _next_tid(self) -> int:
         if self._tid_gen is not None:
@@ -531,8 +533,9 @@ class ReplicatedBackend:
         MOSDOp carries vs pool snapc resolution in PrimaryLogPG)."""
         seq = max(self.pool_snap_seq,
                   (snapc or {}).get("seq", 0))
-        snaps = sorted(set(self.pool_snaps)
-                       | set((snapc or {}).get("snaps", [])))
+        snaps = sorted((set(self.pool_snaps)
+                        | set((snapc or {}).get("snaps", [])))
+                       - self.pool_removed_snaps)
         return seq, snaps
 
     def _cow_decision(self, oid: str, seq: int, snaps: list[int]):
@@ -555,7 +558,8 @@ class ReplicatedBackend:
     # -- writes (ref: ReplicatedBackend.cc:1069 submit_transaction) ----
     def submit_transaction(self, oid: str, muts: list,
                            on_all_commit: Callable,
-                           snapc: dict | None = None) -> int:
+                           snapc: dict | None = None,
+                           trace: dict | None = None) -> int:
         """Apply a mutation vector locally then fan it out to every
         acting replica; `on_all_commit(ok)` once all committed."""
         with self._lock:
@@ -580,12 +584,13 @@ class ReplicatedBackend:
             op = _RepWrite(tid=tid, on_all_commit=on_all_commit,
                            pending=set(replicas))
             self.in_flight[tid] = op
+            from ..common.tracing import child_of
             msg = RepOpWrite(pgid=self.pgid, tid=tid, oid=oid,
                              mutations=list(muts), version=version,
                              log_entries=[entry],
                              clone_snap=clone_snap,
                              clone_covers=covers or [],
-                             snap_seq=seq)
+                             snap_seq=seq, trace=child_of(trace))
             for s in replicas:
                 if not self.send(s, msg):
                     op.failed.add(s)

@@ -140,11 +140,14 @@ class PGPool:
     pgp_num: int = 64
     flags: int = FLAG_HASHPSPOOL
     erasure_code_profile: str = ""
-    # pool snapshots (ref: pg_pool_t::snap_seq/snaps,
-    # osd_types.h:1331-1336): snap_seq is the newest snapid; snaps
-    # maps live snapid -> name
+    # pool snapshots (ref: pg_pool_t::snap_seq/snaps/removed_snaps,
+    # osd_types.h:1331-1340): snap_seq is the newest snapid; snaps
+    # maps live snapid -> name; removed_snaps keeps deleted ids out of
+    # every future SnapContext (a lagging client must not resurrect a
+    # deleted snapshot through the snapc union)
     snap_seq: int = 0
     snaps: dict = field(default_factory=dict)
+    removed_snaps: list = field(default_factory=list)  # JSON-safe ids
     # derived
     pg_num_mask: int = field(default=0, repr=False)
     pgp_num_mask: int = field(default=0, repr=False)

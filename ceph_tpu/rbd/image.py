@@ -191,9 +191,15 @@ class Image:
             raise RBDError(2, f"snapshot {snap_name!r} not found")
         snap = self.snaps[snap_name]
         span = max(self._object_span(), self._span_for(snap["size"]))
-        for objno in range(span):
-            self._wio.rollback_to_snapid(
-                data_name(self.name, objno), snap["id"])
+        # fan the per-object rollbacks out like the write path: one
+        # round of aio futures, not span sequential round trips
+        futs = [self._wio.rados.objecter.submit(
+                    self._wio.pool_id, data_name(self.name, objno),
+                    "rollback",
+                    args=self._wio._margs({"snapid": snap["id"]}))
+                for objno in range(span)]
+        for f in futs:
+            self._wio._wait(f)
         self.size = int(snap["size"])
         self._save_meta()
 

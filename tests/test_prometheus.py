@@ -1,0 +1,54 @@
+"""Prometheus exporter (ref: src/pybind/mgr/prometheus/module.py)."""
+import urllib.request
+
+import pytest
+
+from ceph_tpu.testing import MiniCluster
+
+
+def _scrape(port: int) -> str:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30) as r:
+        assert r.headers["Content-Type"].startswith("text/plain")
+        return r.read().decode()
+
+
+def test_metrics_endpoint():
+    c = MiniCluster(n_osd=3, threaded=True)
+    try:
+        c.wait_all_up()
+        r = c.rados()
+        r.pool_create("pm", pg_num=8)
+        io = r.open_ioctx("pm")
+        for i in range(5):
+            io.write_full(f"m{i}", b"x" * 100)
+        for _ in range(3):
+            c.tick()
+        mgr = c.start_mgr()
+        exp = mgr.start_prometheus()
+        text = _scrape(exp.port)
+        lines = dict(
+            l.rsplit(" ", 1) for l in text.splitlines()
+            if l and not l.startswith("#"))
+        assert lines["ceph_health_status"] == "0"
+        assert lines["ceph_osd_up"] == "3"
+        assert lines["ceph_pg_total"] == "8"
+        assert lines['ceph_pg_state{state="active+clean"}'] == "8"
+        assert lines["ceph_objects"] == "5"
+        assert lines['ceph_pool_objects{pool="pm"}'] == "5"
+        assert lines['ceph_pool_bytes{pool="pm"}'] == "500"
+        assert float(lines["ceph_cluster_total_bytes"]) > 0
+        # per-daemon counters from the piggybacked perf reports
+        assert float(lines['ceph_daemon_op{daemon="osd.0"}']) >= 0
+        # exposition format sanity: HELP/TYPE precede samples
+        assert text.index("# HELP ceph_health_status") < \
+            text.index("ceph_health_status 0")
+        # 404 for other paths
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{exp.port}/nope", timeout=10)
+    finally:
+        c.shutdown()
+
+
+import urllib.error  # noqa: E402  (used in the test above)

@@ -116,6 +116,26 @@ def test_rollback(io):
         io.read("rb-new")
 
 
+def test_removed_snap_never_resurrects(io):
+    """A lagging client's snapc must not re-create clones for a
+    deleted snapshot (pool removed_snaps filtering,
+    ref: pg_pool_t::removed_snaps)."""
+    oid = "zombie"
+    io.write_full(oid, b"content")
+    io.snap_create("doomed")
+    sid = io.snap_lookup("doomed")
+    io.snap_remove("doomed")
+    # lagging client: sends the stale snapc by hand
+    io.set_write_snapc(sid, [sid])
+    try:
+        io.write_full(oid, b"after removal")
+    finally:
+        io.write_snapc = None
+    assert io.list_snaps(oid)["clones"] == {}
+    with pytest.raises(RadosError, match="ENOENT"):
+        io.read(oid, snapid=sid)
+
+
 def test_write_cows_with_lagging_osd_map():
     """The client's SnapContext rides with the write: even when the
     primary's map hasn't caught up with a fresh snapshot, the COW

@@ -192,3 +192,15 @@ class CrushWrapper:
             root.item_weights.append(hb.weight)
             root.weight += hb.weight
         return cw
+
+
+# ------------------------------------------------- wire registration
+# (ref: CrushWrapper::encode — map + name/type/class tables)
+def _register_wire() -> None:
+    from ..msg.encoding import register_struct
+    register_struct(CrushWrapper, version=1, compat=1, fields=(
+        "crush", "type_map", "name_map", "class_map", "class_name",
+        "rule_name_map"))
+
+
+_register_wire()

@@ -14,8 +14,8 @@ specific entity ("osd.3") — most-specific wins at the daemon
 """
 from __future__ import annotations
 
-import pickle
 
+from ..msg import encoding as wire
 from .paxos import Paxos, PaxosService
 from .store import StoreTransaction
 
@@ -40,7 +40,7 @@ class ConfigMonitor(PaxosService):
     def encode_pending(self, tx: StoreTransaction) -> None:
         if getattr(self, "_bootstrap", False):
             self._bootstrap = False
-            self.put_version(tx, "v_1", pickle.dumps({}))
+            self.put_version(tx, "v_1", wire.encode({}))
             self.put_version(tx, "last_committed", 1)
             self.put_version(tx, "first_committed", 1)
             return
@@ -55,7 +55,7 @@ class ConfigMonitor(PaxosService):
             else:
                 new.setdefault(section, {})[name] = str(value)
         e = self.get_last_committed() + 1
-        self.put_version(tx, f"v_{e}", pickle.dumps(new))
+        self.put_version(tx, f"v_{e}", wire.encode(new))
         self.put_version(tx, "last_committed", e)
 
     def update_from_paxos(self) -> None:
@@ -63,7 +63,7 @@ class ConfigMonitor(PaxosService):
         if e:
             blob = self.get_version(f"v_{e}")
             if blob is not None:
-                self.config = pickle.loads(blob)
+                self.config = wire.decode(blob)
 
     def create_pending(self) -> None:
         self.pending = []

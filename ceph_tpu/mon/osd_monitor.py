@@ -15,7 +15,6 @@ the reference mon's parsed cmdmap; returns are (retcode, outs, outb).
 from __future__ import annotations
 
 import copy
-import pickle
 
 from ..common.log import dout
 from ..crush.wrapper import CrushWrapper
@@ -24,6 +23,7 @@ from ..osd.osdmap import (CEPH_OSD_EXISTS, CEPH_OSD_IN, CEPH_OSD_UP,
                           Incremental, OSDMap)
 from ..osd.types import (PG, PGPool, POOL_TYPE_ERASURE,
                          POOL_TYPE_REPLICATED)
+from ..msg import encoding as wire
 from .paxos import Paxos, PaxosService
 from .store import StoreTransaction
 
@@ -74,7 +74,7 @@ class OSDMonitor(PaxosService):
             self._bootstrap = None
             e = m.epoch
             self.put_version(tx, f"inc_{e}", None)
-            self.put_version(tx, f"full_{e}", pickle.dumps((m, w)))
+            self.put_version(tx, f"full_{e}", wire.encode((m, w)))
             self.put_version(tx, "last_committed", e)
             self.put_version(tx, "first_committed", e)
             return
@@ -87,8 +87,8 @@ class OSDMonitor(PaxosService):
         w = self._pending_wrapper or self.wrapper
         w = copy.deepcopy(w)
         w.crush = nm.crush
-        self.put_version(tx, f"inc_{e}", pickle.dumps(inc))
-        self.put_version(tx, f"full_{e}", pickle.dumps((nm, w)))
+        self.put_version(tx, f"inc_{e}", wire.encode(inc))
+        self.put_version(tx, f"full_{e}", wire.encode((nm, w)))
         self.put_version(tx, "last_committed", e)
         # trim history beyond mon_min_osdmap_epochs
         # (ref: OSDMonitor.cc get_trim_to / PaxosService maybe_trim)
@@ -108,7 +108,7 @@ class OSDMonitor(PaxosService):
         e = self.get_last_committed()
         if e and e != self.osdmap.epoch:
             blob = self.get_version(f"full_{e}")
-            self.osdmap, self.wrapper = pickle.loads(blob)
+            self.osdmap, self.wrapper = wire.decode(blob)
 
     def create_pending(self) -> None:
         self.pending_inc = Incremental(epoch=self.osdmap.epoch + 1)
@@ -122,11 +122,11 @@ class OSDMonitor(PaxosService):
     def get_full_map(self, epoch: int = 0) -> OSDMap | None:
         e = epoch or self.get_last_committed()
         blob = self.get_version(f"full_{e}")
-        return pickle.loads(blob)[0] if blob is not None else None
+        return wire.decode(blob)[0] if blob is not None else None
 
     def get_incremental(self, epoch: int) -> Incremental | None:
         blob = self.get_version(f"inc_{epoch}")
-        return pickle.loads(blob) if blob is not None else None
+        return wire.decode(blob) if blob is not None else None
 
     # ------------------------------------------------------------- crush
     def _get_pending_crush(self) -> CrushWrapper:

@@ -8,10 +8,11 @@ makes replay/replication possible later.
 """
 from __future__ import annotations
 
-import pickle
 import threading
 from dataclasses import dataclass, field
 from typing import Any, Iterator
+
+from ..msg import encoding as wire
 
 
 @dataclass
@@ -36,11 +37,16 @@ class StoreTransaction:
         return not self.ops
 
     def encode(self) -> bytes:
-        return pickle.dumps(self.ops)
+        """Typed wire encoding — paxos BEGIN/COMMIT carry these blobs
+        between mons (ref: MonitorDBStore.h Transaction::encode)."""
+        return wire.encode(self.ops)
 
     @classmethod
     def decode(cls, data: bytes) -> "StoreTransaction":
-        return cls(ops=pickle.loads(data))
+        ops = wire.decode(data)
+        if not isinstance(ops, list):
+            raise wire.WireError("store transaction must be a list")
+        return cls(ops=ops)
 
 
 class MonitorStore:
@@ -83,10 +89,14 @@ class MonitorStore:
             return iter(sorted(k[1] for k in self._data if k[0] == prefix))
 
     def export_data(self) -> bytes:
-        """Full snapshot for mon full-sync (ref: Monitor.cc sync_*)."""
+        """Full snapshot for mon full-sync (ref: Monitor.cc sync_*).
+        Typed encoding: the blob crosses the wire in MPaxosStoreSync."""
         with self._lock:
-            return pickle.dumps(self._data)
+            return wire.encode(self._data)
 
     def import_data(self, blob: bytes) -> None:
+        data = wire.decode(blob)
+        if not isinstance(data, dict):
+            raise wire.WireError("store snapshot must be a dict")
         with self._lock:
-            self._data = pickle.loads(blob)
+            self._data = data

@@ -218,11 +218,14 @@ class MAuthRequest(Message):
 
 @dataclass
 class MAuthReply(Message):
-    """(ref: src/messages/MAuthReply.h): session ticket or failure."""
+    """(ref: src/messages/MAuthReply.h): session ticket or failure.
+    `expires` is advertised in the clear so the client knows when to
+    renew (the sealed ticket is opaque to it)."""
     result: int = 0
     errstr: str = ""
     challenge: str = ""
     ticket: Any = None
+    expires: float = 0.0
 
 
 @dataclass
@@ -358,7 +361,7 @@ class MPaxosCommit(Message):
 class MPaxosStoreSync(Message):
     """Full-store sync for a mon lagging past the trim window
     (ref: src/mon/Monitor.cc sync_* full-store sync)."""
-    data: bytes = b""            # pickled store contents
+    data: bytes = b""            # wire-encoded store contents
     first_committed: int = 0
     last_committed: int = 0
 
@@ -414,3 +417,20 @@ class PingReply(Message):
     """(ref: MOSDPing.h PING_REPLY)."""
     epoch: int = 0
     stamp: float = 0.0
+
+
+# ------------------------------------------------- wire registration
+# Every message type is a versioned wire struct (ref: each
+# src/messages/*.h declares HEAD_VERSION/COMPAT_VERSION); bump a
+# type's version here when appending fields.
+def _register_all() -> None:
+    import dataclasses as _dc
+
+    from .encoding import register_struct
+    for _obj in list(globals().values()):
+        if isinstance(_obj, type) and issubclass(_obj, Message) and \
+                _dc.is_dataclass(_obj):
+            register_struct(_obj, version=1, compat=1)
+
+
+_register_all()

@@ -137,7 +137,12 @@ class Messenger:
         import dataclasses
         msg = dataclasses.replace(msg, src=self.name, seq=next(_seq))
         if self.auth_signer is not None:
-            msg = self.auth_signer.sign(msg)
+            try:
+                msg = self.auth_signer.sign(msg)
+            except ValueError as ex:     # WireError from _canon
+                dout("ms", 0).write("%s: unsignable %s: %s", self.name,
+                                    msg.type_name, ex)
+                return False
         return self.network.route(self.name, peer, msg)
 
     def enqueue(self, msg: Message) -> None:

@@ -1,12 +1,15 @@
 """TCP messenger backend: the framework over real sockets.
 
 The AsyncMessenger/posix analogue (ref: src/msg/async/AsyncMessenger.cc,
-PosixStack — event-driven sockets with per-peer Connections;
-ProtocolV2's framing reduced to length-prefixed pickle since peers are
-trusted same-version Python here).  Same dispatcher surface as the
-in-process transport (ceph_tpu.msg.messenger), so every daemon — mon,
-OSD, mgr, client — runs unmodified over localhost or a LAN, one process
-per daemon (the reference's deployment model).
+PosixStack — event-driven sockets with per-peer Connections).  Frames
+carry the versioned typed encoding from `ceph_tpu.msg.encoding`
+(preamble + struct payload + crc32c epilogue, the frames_v2 model —
+ref: src/msg/async/frames_v2.h:58-151); decoding constructs only
+registered wire structs and TLV primitives, never code.  Same
+dispatcher surface as the in-process transport
+(ceph_tpu.msg.messenger), so every daemon — mon, OSD, mgr, client —
+runs unmodified over localhost or a LAN, one process per daemon (the
+reference's deployment model).
 
 Addressing: a static name -> (host, port) map (the monmap analogue,
 ref: src/mon/MonMap.h + per-daemon bind addrs from the config).
@@ -16,12 +19,12 @@ a failed/refused connection reports ms_handle_reset to the sender.
 """
 from __future__ import annotations
 
-import pickle
 import socket
 import struct
 import threading
 
 from ..common.log import dout
+from .encoding import WireError, decode_message, encode_message
 from .messenger import Connection, Dispatcher, Message
 
 _HDR = struct.Struct("!I")
@@ -138,12 +141,14 @@ class TcpMessenger:
         with self._lock:
             self._seq += 1
             msg = dataclasses.replace(msg, src=self.name, seq=self._seq)
-            if self.auth_signer is not None:
-                msg = self.auth_signer.sign(msg)
             try:
-                payload = pickle.dumps(msg)
-            except Exception as ex:
-                dout("ms", 0).write("%s: unpicklable %s: %s", self.name,
+                # sign() canonicalizes through the wire codec too, so
+                # it must sit inside the WireError net with the encode
+                if self.auth_signer is not None:
+                    msg = self.auth_signer.sign(msg)
+                payload = encode_message(msg)
+            except WireError as ex:
+                dout("ms", 0).write("%s: unencodable %s: %s", self.name,
                                     msg.type_name, ex)
                 return False
             learned = False
@@ -209,7 +214,7 @@ class TcpMessenger:
                 frame = recv_frame(conn)
                 if frame is None:
                     break
-                msg = pickle.loads(frame)
+                msg = decode_message(frame)
                 # authenticate BEFORE learning: otherwise a forged
                 # frame could hijack the learned reply route for the
                 # entity it spoofs (verified by the cephx e2e drive)
@@ -226,7 +231,7 @@ class TcpMessenger:
                         self._learned[msg.src] = conn
                 peer = msg.src
                 self._deliver_verified(msg)
-        except (OSError, ValueError, pickle.UnpicklingError) as ex:
+        except (OSError, ValueError) as ex:
             if self._running:      # shutdown closes sockets under us
                 dout("ms", 1).write("%s: read error from %s: %s",
                                     self.name, peer, ex)

@@ -421,3 +421,19 @@ class OSDMap:
         self.pool_names[0] = "rbd"
         self.pool_max = 0
         self.epoch = 1
+
+
+# ------------------------------------------------- wire registration
+# OSDMap encodes as a versioned wire struct like the reference's
+# OSDMap::encode (ref: src/osd/OSDMap.cc encode w/ ENCODE_START).
+def _register_wire() -> None:
+    from ..msg.encoding import register_struct
+    register_struct(Incremental, version=1, compat=1)
+    register_struct(OSDMap, version=1, compat=1, fields=(
+        "epoch", "fsid", "max_osd", "osd_state", "osd_weight",
+        "osd_primary_affinity", "pools", "pool_names", "pool_max",
+        "crush", "pg_upmap", "pg_upmap_items", "pg_temp",
+        "primary_temp", "erasure_code_profiles", "flags"))
+
+
+_register_wire()

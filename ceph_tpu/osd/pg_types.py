@@ -150,3 +150,11 @@ class PGMissing:
 
     def __repr__(self) -> str:
         return f"PGMissing({self.items})"
+
+
+# wire registration (ref: osd_types.h eversion_t/pg_log_entry_t/
+# pg_missing_item each carry ENCODE_START versions)
+from ..msg.encoding import register_struct as _reg  # noqa: E402
+
+for _cls in (EVersion, PGShard, PGLogEntry, MissingItem):
+    _reg(_cls, version=1, compat=1)

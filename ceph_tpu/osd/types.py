@@ -204,3 +204,10 @@ class PGPool:
             return hash32_2(folded, np.full_like(folded, pool_id)) \
                 .astype(np.int64)
         return folded + pool_id
+
+
+# wire registration (ref: pg_t / pg_pool_t encode in osd_types.cc)
+from ..msg.encoding import register_struct as _reg  # noqa: E402
+
+_reg(PG, version=1, compat=1)
+_reg(PGPool, version=1, compat=1)

@@ -209,3 +209,11 @@ class ObjectStore(abc.ABC):
 
     @abc.abstractmethod
     def statfs(self) -> dict: ...
+
+
+# wire registration: transactions ride ECSubWrite frames between
+# shards (ref: ObjectStore::Transaction::encode, MOSDECSubOpWrite)
+from ..msg.encoding import register_struct as _reg  # noqa: E402
+
+_reg(ObjectId, version=1, compat=1)
+_reg(Transaction, version=1, compat=1)

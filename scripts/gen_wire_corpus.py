@@ -1,0 +1,39 @@
+#!/usr/bin/env python
+"""Generate tests/fixtures/wire_corpus.json — pinned wire encodings.
+
+The ceph-object-corpus analogue (ref: src/tools/ceph-dencoder +
+qa/workunits/erasure-code/encode-decode-non-regression.sh): one entry
+per wire type, encoding the canonical dencoder sample.  The committed
+file is the cross-round contract: `tests/test_wire_encoding.py` fails
+if any type's encoding drifts without a deliberate regeneration (which
+is this script).  Run from the repo root:
+
+    python scripts/gen_wire_corpus.py
+"""
+import json
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from ceph_tpu.msg import encoding as wire           # noqa: E402
+from ceph_tpu.tools import dencoder                 # noqa: E402
+
+OUT = pathlib.Path(__file__).resolve().parent.parent / "tests" / \
+    "fixtures" / "wire_corpus.json"
+
+
+def main() -> None:
+    corpus = {}
+    for name in dencoder.sample_names():
+        blob = wire.encode(dencoder.sample(name))
+        corpus[name] = blob.hex()
+    OUT.parent.mkdir(parents=True, exist_ok=True)
+    with open(OUT, "w") as f:
+        json.dump(corpus, f, indent=0, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {len(corpus)} corpus entries to {OUT}")
+
+
+if __name__ == "__main__":
+    main()

@@ -41,6 +41,32 @@ from ..msg.messages import MAuthReply, MAuthRequest
 
 SERVICE_ENTITY = "service"           # the shared service-secret slot
 
+#: daemon-class entity prefixes (everything else is a client).  The
+#: class rides inside the sealed ticket, so a client cannot upgrade
+#: itself (ref: cephx caps — "allow *" for daemons vs client caps).
+DAEMON_PREFIXES = frozenset({"osd", "mon", "mds", "mgr", SERVICE_ENTITY})
+
+#: message types a *client*-class ticket may send to daemons
+#: (ref: the effect of default client caps: client ops + mon
+#: subscriptions/commands + mds requests; daemon-internal traffic
+#: like RepOpWrite/ECSubWrite/MMap/MOSDFailure is daemon-only)
+CLIENT_ALLOWED = frozenset({
+    "OSDOp", "MMonSubscribe", "MMonCommand", "MClientRequest"})
+
+#: replay-window size: how far behind the highest-seen signing seq a
+#: message may arrive before it is considered stale (tolerates
+#: multi-connection reordering; ref: cephx challenge freshness)
+REPLAY_WINDOW = 1024
+
+#: renew this long before ticket expiry (ref: MonClient's
+#: _check_auth_rotating renews before ttl runs out)
+RENEW_MARGIN = 60.0
+
+
+def entity_class(entity: str) -> str:
+    return ("daemon" if entity.split(".", 1)[0] in DAEMON_PREFIXES
+            else "client")
+
 
 def generate_key() -> str:
     return os.urandom(16).hex()
@@ -105,16 +131,17 @@ def _open(secret: str, sealed: dict) -> dict | None:
 def _canon(msg) -> bytes:
     """Byte-stable digest input covering header AND payload: a
     captured ticket must not be reattachable to a forged op (the TCP
-    transport is reachable by unauthenticated processes).  Pickle of
-    the field tuple is deterministic for our message payloads
-    (primitives/dicts/dataclasses; dict insertion order survives the
-    unpickle, so receiver-side re-canonicalization matches)."""
+    transport is reachable by unauthenticated processes).  Uses the
+    typed wire codec — deterministic for our payload domain, and dict
+    insertion order survives the decode, so receiver-side
+    re-canonicalization matches what was signed."""
     import dataclasses
-    import pickle
+
+    from ..msg import encoding as wire
     fields = tuple((f.name, getattr(msg, f.name))
                    for f in dataclasses.fields(msg)
                    if f.name != "auth")
-    return pickle.dumps((msg.type_name, fields), protocol=4)
+    return wire.encode((msg.type_name, fields))
 
 
 class CephxServer:
@@ -138,22 +165,43 @@ class CephxServer:
             return MAuthReply(result=-13, errstr="bad signature")
         # fresh challenge binds the session key to this exchange
         session_key = _derive_session_key(secret, msg.nonce, challenge)
+        expires = time.time() + self.ttl
         ticket = _seal(self.keyring.get(SERVICE_ENTITY), {
             "entity": msg.entity, "session_key": session_key,
-            "expires": time.time() + self.ttl})
+            "cls": entity_class(msg.entity), "expires": expires})
         return MAuthReply(result=0, challenge=challenge,
-                          ticket=ticket)
+                          ticket=ticket, expires=expires)
 
 
 class CephxClient:
     """Per-daemon/client signer (ref: CephxClientHandler)."""
 
     def __init__(self, entity: str, secret: str):
+        import itertools
+        import threading
         self.entity = entity
         self.secret = secret
         self.nonce = os.urandom(8).hex()
         self.session_key: str | None = None
         self.ticket: dict | None = None
+        self.expires: float = 0.0
+        #: guards the (session_key, ticket) pair: renewal replies land
+        #: while other threads sign, and a MAC under the new key paired
+        #: with the old ticket would be dropped by every verifier
+        self._lock = threading.Lock()
+        #: monotonic signing sequence — receivers use it for replay
+        #: freshness (itertools.count is atomic under the GIL)
+        self._seq = itertools.count(1)
+        #: self_mint daemons keep the service secret to re-mint locally
+        self._mint_secret: str | None = None
+        self._mint_ttl: float = 0.0
+        self._renew_sent: float = 0.0
+        #: wire-handshake renewal: the channel owner (Objecter) sets
+        #: this to a callable that re-sends the MAuthRequest; sign()
+        #: fires it (throttled, off-thread) so EVERY traffic pattern —
+        #: data ops, mds sessions, mon commands — renews, not just
+        #: Objecter.operate()
+        self.renew_hook = None
 
     def build_request(self) -> MAuthRequest:
         self.nonce = os.urandom(8).hex()
@@ -165,14 +213,36 @@ class CephxClient:
     def ingest_reply(self, msg: MAuthReply) -> bool:
         if msg.result != 0:
             return False
-        self.session_key = _derive_session_key(
-            self.secret, self.nonce, msg.challenge)
-        self.ticket = msg.ticket
+        key = _derive_session_key(self.secret, self.nonce,
+                                  msg.challenge)
+        with self._lock:          # atomic (key, ticket, expiry) swap
+            self.session_key = key
+            self.ticket = msg.ticket
+            self.expires = msg.expires
         return True
 
     @property
     def authenticated(self) -> bool:
         return self.session_key is not None
+
+    @property
+    def needs_renewal(self) -> bool:
+        """True inside the renewal margin.  Callers owning a wire
+        channel re-run the MAuthRequest handshake; self-minted daemons
+        renew transparently in sign()."""
+        return (self.session_key is not None and self.expires > 0 and
+                time.time() > self.expires - RENEW_MARGIN)
+
+    def should_send_renewal(self, throttle: float = 5.0) -> bool:
+        """Rate-limited renewal trigger for wire-handshake clients."""
+        if self._mint_secret is not None or not self.needs_renewal:
+            return False
+        with self._lock:
+            now = time.time()
+            if now - self._renew_sent < throttle:
+                return False
+            self._renew_sent = now
+        return True
 
     @classmethod
     def self_mint(cls, entity: str,
@@ -183,18 +253,41 @@ class CephxClient:
         service keys to daemons) mints its own ticket locally instead
         of doing the wire handshake."""
         c = cls(entity, service_secret)
-        c.session_key = generate_key()
-        c.ticket = _seal(service_secret, {
-            "entity": entity, "session_key": c.session_key,
-            "expires": time.time() + ttl})
+        c._mint_secret = service_secret
+        c._mint_ttl = ttl
+        c._remint()
         return c
 
+    def _remint(self) -> None:
+        key = generate_key()
+        expires = time.time() + self._mint_ttl
+        ticket = _seal(self._mint_secret, {
+            "entity": self.entity, "session_key": key,
+            "cls": entity_class(self.entity), "expires": expires})
+        with self._lock:
+            self.session_key = key
+            self.expires = expires
+            self.ticket = ticket
+
     def sign(self, msg):
-        """Attach (ticket, sig) to an outgoing message copy."""
+        """Attach (ticket, seq, sig) to an outgoing message copy.  The
+        seq is covered by the MAC, so a captured message cannot be
+        replayed past the verifier's freshness window."""
         if self.session_key is None:
             return msg
-        msg.auth = {"ticket": self.ticket,
-                    "sig": _mac(self.session_key, _canon(msg))}
+        if self._mint_secret is not None and self.needs_renewal:
+            self._remint()       # local renewal: we hold the secret
+        elif self.renew_hook is not None and self.should_send_renewal():
+            # off-thread: sign() runs under transport locks, and the
+            # hook re-enters the messenger to send the MAuthRequest
+            import threading
+            threading.Thread(target=self.renew_hook,
+                             daemon=True).start()
+        seq = next(self._seq)
+        with self._lock:          # key+ticket must be the same session
+            key, ticket = self.session_key, self.ticket
+        msg.auth = {"ticket": ticket, "seq": seq,
+                    "sig": _mac(key, _canon(msg) + b"|seq=%d" % seq)}
         return msg
 
 
@@ -208,6 +301,11 @@ class CephxVerifier:
 
     def __init__(self, service_secret: str):
         self.service_secret = service_secret
+        import threading
+        self._lock = threading.Lock()
+        #: (entity, ticket_tag) -> (max_seq, seen-set) replay state;
+        #: keyed per session so a restarted entity gets a fresh window
+        self._sessions: "dict[tuple, tuple[int, set]]" = {}
 
     def verify(self, msg) -> bool:
         if msg.type_name in self.EXEMPT:
@@ -218,5 +316,45 @@ class CephxVerifier:
         ticket = _open(self.service_secret, auth.get("ticket"))
         if ticket is None or ticket["expires"] < time.time():
             return False
-        want = _mac(ticket["session_key"], _canon(msg))
-        return _hmac.compare_digest(want, auth.get("sig", ""))
+        # entity-class gate: a client-class ticket cannot send
+        # daemon-internal traffic (RepOpWrite/ECSubWrite/MMap/
+        # MOSDFailure/paxos...) even with a valid signature
+        if ticket.get("cls", "client") == "client" and \
+                msg.type_name not in CLIENT_ALLOWED:
+            dout("auth", 1).write(
+                "cephx: client-class %s may not send %s",
+                ticket.get("entity"), msg.type_name)
+            return False
+        seq = auth.get("seq", 0)
+        want = _mac(ticket["session_key"],
+                    _canon(msg) + b"|seq=%d" % seq)
+        if not _hmac.compare_digest(want, auth.get("sig", "")):
+            return False
+        return self._check_fresh(ticket, auth.get("ticket"), seq)
+
+    def _check_fresh(self, ticket: dict, sealed: dict, seq: int) -> bool:
+        """Per-(entity, session) replay window: each signing seq is
+        accepted once; anything at or below max_seen - REPLAY_WINDOW is
+        stale.  Tolerates reordering inside the window."""
+        key = (ticket.get("entity"), (sealed or {}).get("tag"))
+        with self._lock:
+            entry = self._sessions.pop(key, None)  # re-insert = LRU
+            max_seq, seen = entry if entry is not None else (0, set())
+            floor = max(0, max(max_seq, seq) - REPLAY_WINDOW)
+            if seq <= floor or seq in seen:
+                self._sessions[key] = (max_seq, seen)
+                dout("auth", 1).write("cephx: replayed/stale seq %d "
+                                      "from %s", seq, key[0])
+                return False
+            seen.add(seq)
+            if len(seen) > 2 * REPLAY_WINDOW:   # prune below the floor
+                seen = {s for s in seen if s > floor}
+            if len(self._sessions) >= 4096:
+                # evict least-recently-used sessions (dict order is
+                # re-insertion order, so the front IS the LRU end);
+                # active daemon sessions stay hot and keep their
+                # replay windows — only dead/stale peers age out
+                for k in list(self._sessions)[:256]:
+                    del self._sessions[k]
+            self._sessions[key] = (max(max_seq, seq), seen)
+        return True

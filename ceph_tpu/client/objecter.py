@@ -162,8 +162,16 @@ class Objecter(Dispatcher, MonHunter):
                 return True
             if self._cephx.ingest_reply(msg):
                 self.ms.auth_signer = self._cephx
+                # ticket renewal before expiry, fired from sign() so
+                # every traffic pattern renews — data ops, mds
+                # sessions, mon commands alike
+                # (ref: MonClient::_check_auth_rotating)
+                self._cephx.renew_hook = self._send_auth_renewal
+                # initial auth subscribes from scratch; a ticket
+                # renewal reply only needs maps we don't have yet
                 self.ms.connect(self.mon).send_message(
-                    MMonSubscribe(what="osdmap", start=1))
+                    MMonSubscribe(what="osdmap",
+                                  start=self.osdmap.epoch + 1))
             else:
                 self.auth_error = msg.errstr or "authentication failed"
                 self._map_ev.set()       # unblock connect() waiters
@@ -179,6 +187,13 @@ class Objecter(Dispatcher, MonHunter):
         if isinstance(msg, MMonCommandAck):
             return self._handle_command_ack(msg)
         return False
+
+    def _send_auth_renewal(self) -> None:
+        """Re-run the MAuthRequest handshake (called off-thread by the
+        signer's renewal hook)."""
+        if self._cephx is not None:
+            self.ms.connect(self.mon).send_message(
+                self._cephx.build_request())
 
     def _hunt_greeting(self) -> list:
         if self._cephx is not None and not self._cephx.authenticated:

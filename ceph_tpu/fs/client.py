@@ -180,29 +180,37 @@ class CephFS:
         rec = self._session.call("unlink", {"path": path})
         # purge data objects (ref: the reference defers this to the
         # MDS PurgeQueue; the client-side purge keeps one moving part)
-        layout = StripeLayout(**rec["layout"])
-        io = self.rados.open_ioctx(rec["pool"])
         size = rec.get("size", 0)
         if size:
-            objnos = {e.objectno for e in
-                      Striper.file_to_extents(layout, 0, size)}
-            for objno in sorted(objnos):
-                try:
-                    io.remove(fs_data_obj(rec["ino"], objno))
-                except RadosError:
-                    pass
+            self._purge_data(rec, size)
 
     # -- files ----------------------------------------------------------
     def open(self, path: str, mode: str = "r",
              layout: dict | None = None) -> FileHandle:
         if "w" in mode or "a" in mode or "+" in mode:
-            rec = self._session.call("create", {"path": path,
-                                                "layout": layout})
+            # 'w' carries O_TRUNC (POSIX); 'a'/'r+' keep existing bytes
+            rec = self._session.call("create", {
+                "path": path, "layout": layout,
+                "truncate": "w" in mode})
+            purge = rec.pop("purge_size", 0)
+            if purge:
+                self._purge_data(rec, purge)
         else:
             rec = self.stat(path)
             if rec["type"] != "f":
                 raise CephFSError("EISDIR", path)
         return FileHandle(self, path, rec)
+
+    def _purge_data(self, rec: dict, size: int) -> None:
+        layout = StripeLayout(**rec["layout"])
+        io = self.rados.open_ioctx(rec["pool"])
+        objnos = {e.objectno for e in
+                  Striper.file_to_extents(layout, 0, size)}
+        for objno in sorted(objnos):
+            try:
+                io.remove(fs_data_obj(rec["ino"], objno))
+            except RadosError:
+                pass
 
     def write_file(self, path: str, data: bytes) -> None:
         fh = self.open(path, "w")

@@ -101,6 +101,7 @@ class MDSDaemon(Dispatcher):
                 .encode()})
             return
         applied = int(meta.get("applied_seq", b"0"))
+        self._seq = applied          # stay monotonic across journal trims
         self._next_ino = int(meta.get("next_ino", b"2"))
         try:
             raw = self.meta.read(JOURNAL_OBJ)
@@ -122,8 +123,6 @@ class MDSDaemon(Dispatcher):
             dout("mds", 1).write("%s: replayed %d journal entries",
                                  self.name, replayed)
         self._persist_applied()
-        if self._seq > 1000:                      # trim (ref: MDLog trim)
-            self.meta.write_full(JOURNAL_OBJ, b"")
 
     def _journal(self, op: str, deltas: list) -> None:
         """Append-then-apply: the WAL write lands before the dirfrag
@@ -163,6 +162,11 @@ class MDSDaemon(Dispatcher):
             "applied_seq": str(self._seq).encode(),
             "next_ino": str(self._next_ino).encode()})
         self._ops_since_apply = 0
+        # Runtime trim (ref: MDLog::trim): everything <= applied_seq is
+        # fully applied, so the journal can be emptied.  Ordering
+        # matters — applied_seq persists first; a crash in between just
+        # replays already-applied idempotent deltas.
+        self.meta.write_full(JOURNAL_OBJ, b"")
 
     # ------------------------------------------------------- name space
     def _readdir(self, ino: int) -> dict[str, dict]:
@@ -223,7 +227,19 @@ class MDSDaemon(Dispatcher):
         if dent is not None:
             if dent["type"] == "d":
                 raise MDSError("EISDIR", a["path"])
-            return dent                    # open-existing
+            if not a.get("truncate"):
+                return dent                # open-existing ('r+'/'a')
+            # O_TRUNC semantics (ref: Server::handle_client_openc +
+            # inode truncate): size -> 0; the client purges the old
+            # data objects, mirroring how unlink purges client-side
+            old_size = dent.get("size", 0)
+            dent["size"] = 0
+            dent["mtime"] = time.time()
+            self._journal("truncate", [
+                ("set", dir_obj(parent), {name: json.dumps(dent)})])
+            out = dict(dent)
+            out["purge_size"] = old_size
+            return out
         ino = self._alloc_ino()
         rec = {"ino": ino, "type": "f", "size": 0,
                "mtime": time.time(),

@@ -148,8 +148,12 @@ class RGWGateway:
                  headers: dict | None = None) -> None:
         h.send_response(status)
         h.send_header("Content-Type", ctype)
-        h.send_header("Content-Length", str(len(body)))
-        for k, v in (headers or {}).items():
+        hdrs = dict(headers or {})
+        # HEAD replies advertise the real object size with no body
+        # (RFC 9110 §8.6 allows Content-Length without payload)
+        h.send_header("Content-Length",
+                      hdrs.pop("Content-Length", str(len(body))))
+        for k, v in hdrs.items():
             h.send_header(k, v)
         h.end_headers()
         if h.command != "HEAD":
@@ -262,7 +266,7 @@ class RGWGateway:
             return self._respond(
                 h, 200, b"", "application/octet-stream",
                 {"ETag": f'"{meta["etag"]}"',
-                 "Content-Length-Hint": str(meta["size"])})
+                 "Content-Length": str(meta["size"])})
         if method == "GET":
             data = self.io.read(_data_obj(bucket, key))
             return self._respond(h, 200, data,

@@ -26,6 +26,7 @@ def _stamp(msg, src, seq=1):
 
 
 def test_handshake_and_signatures():
+    from ceph_tpu.msg.messages import OSDOp
     kr = KeyRing.generate(["client.x"])
     server = CephxServer(kr)
     client = CephxClient("client.x", kr.get("client.x"))
@@ -33,15 +34,112 @@ def test_handshake_and_signatures():
     assert rep.result == 0
     assert client.ingest_reply(rep)
     ver = CephxVerifier(kr.get(SERVICE_ENTITY))
-    msg = client.sign(_stamp(Message(), "client.x", 7))
+    msg = client.sign(_stamp(OSDOp(oid="o", op="write"), "client.x", 7))
     assert ver.verify(msg)
     # header tampering invalidates the signature
     msg.seq = 8
     assert not ver.verify(msg)
     # unsigned fails; auth handshake types are exempt
-    assert not ver.verify(_stamp(Message(), "client.x"))
+    assert not ver.verify(_stamp(OSDOp(oid="o"), "client.x"))
     from ceph_tpu.msg.messages import MAuthRequest
     assert ver.verify(_stamp(MAuthRequest(), "client.x"))
+
+
+def test_replay_rejected():
+    """A captured signed message replayed verbatim must not verify a
+    second time (ref: cephx freshness; ADVICE r2 #2)."""
+    from ceph_tpu.msg.messages import OSDOp
+    kr = KeyRing.generate(["client.x"])
+    server = CephxServer(kr)
+    client = CephxClient("client.x", kr.get("client.x"))
+    assert client.ingest_reply(server.handle_request(
+        client.build_request()))
+    ver = CephxVerifier(kr.get(SERVICE_ENTITY))
+    msg = client.sign(_stamp(OSDOp(oid="victim", op="delete"),
+                             "client.x", 3))
+    assert ver.verify(msg)
+    assert not ver.verify(msg)            # verbatim replay
+    # later messages from the live session still verify
+    assert ver.verify(client.sign(_stamp(OSDOp(oid="o2", op="write"),
+                                         "client.x", 4)))
+    # a second verifier (another daemon) has its own window
+    ver2 = CephxVerifier(kr.get(SERVICE_ENTITY))
+    assert ver2.verify(msg)
+    assert not ver2.verify(msg)
+
+
+def test_entity_class_gating():
+    """Client-class tickets cannot send daemon-internal messages
+    (ref: cephx caps; ADVICE r2 #2)."""
+    from ceph_tpu.msg.messages import (MMonSubscribe, MOSDFailure,
+                                       RepOpWrite)
+    kr = KeyRing.generate(["client.x", "osd.1"])
+    server = CephxServer(kr)
+    ver = CephxVerifier(kr.get(SERVICE_ENTITY))
+    client = CephxClient("client.x", kr.get("client.x"))
+    assert client.ingest_reply(server.handle_request(
+        client.build_request()))
+    assert not ver.verify(client.sign(_stamp(
+        RepOpWrite(oid="o"), "client.x")))
+    assert not ver.verify(client.sign(_stamp(
+        MOSDFailure(target_osd=1), "client.x")))
+    assert ver.verify(client.sign(_stamp(
+        MMonSubscribe(), "client.x", 2)))
+    # daemon-class (self-minted with the service secret) may send them
+    osd = CephxClient.self_mint("osd.1", kr.get(SERVICE_ENTITY))
+    assert ver.verify(osd.sign(_stamp(RepOpWrite(oid="o"), "osd.1")))
+
+
+def test_ticket_renewal():
+    """Client re-handshakes before expiry; self-minted daemons re-mint
+    transparently (ref: MonClient::_check_auth_rotating; ADVICE r2 #1)."""
+    from ceph_tpu.msg.messages import OSDOp
+    kr = KeyRing.generate(["client.x"])
+    server = CephxServer(kr, ticket_ttl=30.0)   # inside RENEW_MARGIN
+    client = CephxClient("client.x", kr.get("client.x"))
+    assert client.ingest_reply(server.handle_request(
+        client.build_request()))
+    assert client.needs_renewal
+    assert client.should_send_renewal()
+    assert not client.should_send_renewal()     # throttled
+    # the renewal handshake refreshes key + ticket + expiry
+    server.ttl = 3600.0
+    assert client.ingest_reply(server.handle_request(
+        client.build_request()))
+    assert not client.needs_renewal
+    ver = CephxVerifier(kr.get(SERVICE_ENTITY))
+    assert ver.verify(client.sign(_stamp(OSDOp(oid="o"), "client.x")))
+    # self-minted short-ttl daemon: sign() re-mints, messages keep
+    # verifying instead of going dark at expiry
+    osd = CephxClient.self_mint("osd.0", kr.get(SERVICE_ENTITY),
+                                ttl=0.05)
+    stale_ticket = dict(osd.ticket)
+    time.sleep(0.1)                     # original ticket now expired
+    fresh = osd.sign(_stamp(Message(), "osd.0"))
+    assert fresh.auth["ticket"] != stale_ticket   # re-minted
+    assert ver.verify(fresh)
+
+
+def test_renew_hook_fires_from_sign():
+    """Wire-handshake clients renew from sign() — every traffic
+    pattern (data ops, mds sessions) triggers it, not just one
+    caller's submit path."""
+    import threading
+    from ceph_tpu.msg.messages import OSDOp
+    kr = KeyRing.generate(["client.x"])
+    server = CephxServer(kr, ticket_ttl=30.0)   # inside RENEW_MARGIN
+    client = CephxClient("client.x", kr.get("client.x"))
+    assert client.ingest_reply(server.handle_request(
+        client.build_request()))
+    fired = threading.Event()
+    client.renew_hook = fired.set
+    client.sign(_stamp(OSDOp(oid="o"), "client.x"))
+    assert fired.wait(5.0)
+    # throttled: a second sign inside the window does not re-fire
+    fired.clear()
+    client.sign(_stamp(OSDOp(oid="o2"), "client.x", 2))
+    time.sleep(0.05)
+    assert not fired.is_set()
 
 
 def test_bad_credentials_rejected():

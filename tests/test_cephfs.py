@@ -50,15 +50,38 @@ def test_file_io_striped(fs_cluster):
     # partial read + overwrite + sparse hole
     fh = fs.open("/data/blob.bin")
     assert fh.read(1000, 500) == payload[1000:1500]
-    fh = fs.open("/data/blob.bin", "w")
+    fh = fs.open("/data/blob.bin", "r+")
     fh.write(100, b"PATCH")
     fh.close()
-    assert fs.read_file("/data/blob.bin")[100:105] == b"PATCH"
+    patched = fs.read_file("/data/blob.bin")
+    assert patched[100:105] == b"PATCH"
+    assert patched[:100] == payload[:100]
+    assert patched[105:] == payload[105:]
     # data is striped: more than one rados object holds the bytes
     io = fs.rados.open_ioctx("cephfs_data")
     ino = st["ino"]
     objs = [o for o in io.list_objects() if o.startswith(f"{ino:x}.")]
     assert len(objs) > 1
+
+
+def test_open_w_truncates(fs_cluster):
+    """POSIX O_TRUNC: rewriting a shorter payload over a longer file
+    must not leave stale tail bytes (ref: Server::handle_client_openc
+    truncate semantics)."""
+    _c, _mds, fs = fs_cluster
+    fs.mkdirs("/t")
+    fs.write_file("/t/f", b"A" * 200_000)
+    fs.write_file("/t/f", b"short")
+    assert fs.read_file("/t/f") == b"short"
+    assert fs.stat("/t/f")["size"] == 5
+    # truncated tail objects are purged from the data pool
+    io = fs.rados.open_ioctx("cephfs_data")
+    ino = fs.stat("/t/f")["ino"]
+    objs = [o for o in io.list_objects() if o.startswith(f"{ino:x}.")]
+    assert len(objs) == 1
+    # 'a' keeps existing bytes
+    fh = fs.open("/t/f", "a")
+    assert fh.size == 5
 
 
 def test_rename_and_unlink(fs_cluster):

@@ -6,6 +6,7 @@ messenger; src/msg/Messenger.h): when chunk shards are device-resident
 on a `jax.sharding.Mesh`, the k+m shard traffic becomes XLA collectives
 riding ICI instead of host messages.
 """
+from .fabric import ICIFabric
 from .mesh_ec import MeshECCoder, make_mesh
 
-__all__ = ["MeshECCoder", "make_mesh"]
+__all__ = ["ICIFabric", "MeshECCoder", "make_mesh"]

@@ -1,0 +1,148 @@
+"""ICIFabric: the device-mesh chunk fan-out inside the OSD data plane.
+
+The framework's thesis made real: when an EC PG's acting OSDs are
+**co-resident** on one device mesh, the primary does not host-encode
+and ship chunk bytes through the messenger.  Instead:
+
+* the primary stages the stripe-aligned logical segment onto the
+  (stripe, shard) mesh and runs ONE `shard_map` step — partial GF(2)
+  bit-plane matmuls per device, combined with a `psum` over the
+  'shard' axis.  That collective IS the reference's per-shard write
+  fan-out (ref: src/osd/ECBackend.cc:2037-2070 — per-shard ECSubWrite
+  construction + MOSDECSubOpWrite sends), riding ICI instead of the
+  AsyncMessenger;
+* the host messenger still carries the *control plane*: ECSubWrite
+  messages shrink to metadata (tid, version, log entries, attrs txn)
+  plus a `fabric_key` naming the staged device buffers;
+* each acting shard resolves its `fabric_key` against the shared
+  fabric and pulls ONLY its chunk slice from the device it co-resides
+  with (`fetch_chunk` gathers the per-shard slice, not the stripe
+  batch), writes it into its object store, and accumulates its own
+  HashInfo crc locally.
+
+Non-resident acting sets (or plugins without a plain MXU matrix form —
+clay sub-chunks, lrc layers, legacy mappings) fall back to the host
+encode path transparently; the fabric is an accelerator, not a
+correctness dependency.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from .mesh_ec import MeshECCoder, make_mesh
+
+
+def _identity_mapping(ec) -> bool:
+    n = ec.get_chunk_count()
+    return all(ec.chunk_index(i) == i for i in range(n))
+
+
+class ICIFabric:
+    """Shared device-mesh coding fabric for co-resident OSD shards.
+
+    One instance per process/host; daemons register residency at boot
+    the way the reference's OSDs learn their NUMA/network locality.
+    """
+
+    def __init__(self, n_devices: int | None = None):
+        self.n_devices = n_devices
+        self.resident: set[int] = set()
+        self._lock = threading.Lock()
+        self._coders: dict = {}       # (k, m, matrix bytes) -> coder
+        self._meshes: dict = {}       # shard_ways-compat k -> mesh
+        self._staged: dict = {}       # fabric_key -> staging record
+        self.stats = {"staged": 0, "fetched": 0, "released": 0}
+
+    # ------------------------------------------------------- residency
+    def register_resident(self, osd_id: int) -> None:
+        with self._lock:
+            self.resident.add(osd_id)
+
+    def covers(self, acting) -> bool:
+        """All acting OSDs co-resident on this fabric's mesh."""
+        return bool(acting) and all(
+            a >= 0 and a in self.resident for a in acting)
+
+    # -------------------------------------------------------- support
+    def supports(self, ec) -> bool:
+        """Plain MXU-matrix plugins with identity chunk mapping and no
+        sub-chunks (the fabric step is one bit-plane matmul + psum)."""
+        return (getattr(ec, "encode_matrix", None) is not None
+                and ec.get_sub_chunk_count() == 1
+                and _identity_mapping(ec))
+
+    def _coder_for(self, ec) -> MeshECCoder:
+        k = ec.get_data_chunk_count()
+        m = ec.get_coding_chunk_count()
+        mat = np.ascontiguousarray(ec.encode_matrix, dtype=np.uint8)
+        key = (k, m, mat.tobytes())
+        with self._lock:
+            coder = self._coders.get(key)
+            if coder is None:
+                mesh = self._meshes.get(k)
+                if mesh is None:
+                    mesh = make_mesh(self.n_devices, k=k)
+                    self._meshes[k] = mesh
+                coder = MeshECCoder(k, m, mesh, encode_matrix=mat)
+                self._coders[key] = coder
+            return coder
+
+    # --------------------------------------------------------- staging
+    def stage_encode(self, key, ec, seg: bytes, chunk_size: int) -> int:
+        """Run the mesh encode step for one write and stage the
+        device-resident chunk arrays under `key`.
+
+        Returns the per-shard chunk length.  `seg` must be
+        stripe-aligned (primary guarantees it, as for the host path).
+        """
+        k = ec.get_data_chunk_count()
+        m = ec.get_coding_chunk_count()
+        width = k * chunk_size
+        if not seg or len(seg) % width:
+            raise ValueError("segment must be non-empty stripe-aligned")
+        nstripes = len(seg) // width
+        coder = self._coder_for(ec)
+        arr = np.frombuffer(seg, dtype=np.uint8).reshape(
+            nstripes, k, chunk_size)
+        # pad the stripe batch to the mesh's stripe axis (zero stripes
+        # encode to zero parity; fetch slices them back off)
+        stripe_ways = coder.mesh.devices.shape[0]
+        pad = -nstripes % stripe_ways
+        if pad:
+            arr = np.concatenate(
+                [arr, np.zeros((pad, k, chunk_size), dtype=np.uint8)])
+        data_dev = coder.shard_data(arr)
+        parity_dev = coder.encode(data_dev)     # the psum fan-out step
+        with self._lock:
+            self._staged[key] = {
+                "data": data_dev, "parity": parity_dev,
+                "k": k, "m": m, "cs": chunk_size, "S": nstripes}
+            self.stats["staged"] += 1
+        return nstripes * chunk_size
+
+    def fetch_chunk(self, key, shard: int) -> bytes:
+        """One shard's chunk stream (concatenated over stripes) from
+        the staged device arrays — the per-shard gather a co-resident
+        OSD does instead of receiving bytes in the sub-write."""
+        with self._lock:
+            rec = self._staged.get(key)
+            self.stats["fetched"] += 1
+        if rec is None:
+            raise KeyError(f"no staged write {key!r}")
+        k = rec["k"]
+        if shard < k:
+            sl = np.asarray(rec["data"][:, shard, :])
+        else:
+            sl = np.asarray(rec["parity"][:, shard - k, :])
+        return np.ascontiguousarray(sl[:rec["S"]]).tobytes()
+
+    def release(self, key) -> None:
+        with self._lock:
+            if self._staged.pop(key, None) is not None:
+                self.stats["released"] += 1
+
+    def staged_count(self) -> int:
+        with self._lock:
+            return len(self._staged)

@@ -17,7 +17,12 @@ from .messenger import Message
 @dataclass
 class ECSubWrite(Message):
     """Per-shard EC write (ref: src/messages/MOSDECSubOpWrite.h,
-    payload struct src/osd/ECMsgTypes.h ECSubWrite)."""
+    payload struct src/osd/ECMsgTypes.h ECSubWrite).
+
+    v2 appends the ICI-fabric fields: when `fabric_key` is set the
+    chunk bytes are NOT in `txn` — they sit staged on the shared
+    device mesh and the receiving shard gathers its slice locally
+    (ceph_tpu.dist.fabric; the message is control-plane only)."""
     pgid: Any = None
     tid: int = 0
     reqid: Any = None
@@ -26,6 +31,11 @@ class ECSubWrite(Message):
     txn: Any = None                 # store Transaction for this shard
     log_entries: list = field(default_factory=list)
     shard: int = -1
+    # --- v2: device-mesh fabric fan-out ---
+    oid: str = ""
+    fabric_key: Any = None          # (pgid, tid) staging key
+    chunk_off: int = 0              # chunk-space write offset
+    hinfo_append: bool = False      # cumulative crc append is valid
 
 
 @dataclass
@@ -247,6 +257,21 @@ class MClientReply(Message):
 
 
 @dataclass
+class MClientCaps(Message):
+    """Capability traffic between MDS and fs clients
+    (ref: src/messages/MClientCaps.h).  op: "revoke" (mds -> client:
+    give the listed caps back after flushing dirty state) | "flush"
+    (client -> mds: dirty size/mtime riding a cap return) | "ack"
+    (client -> mds: revoke complete)."""
+    op: str = ""
+    ino: int = 0
+    caps: int = 0                    # cap bits affected
+    seq: int = 0
+    size: int = -1                   # flushed size (-1 = clean)
+    mtime: float = 0.0
+
+
+@dataclass
 class MConfig(Message):
     """mon -> daemon: your merged centralized-config view changed
     (ref: src/messages/MConfig.h)."""
@@ -423,6 +448,12 @@ class PingReply(Message):
 # Every message type is a versioned wire struct (ref: each
 # src/messages/*.h declares HEAD_VERSION/COMPAT_VERSION); bump a
 # type's version here when appending fields.
+#: per-type (version, compat) overrides — bump when appending fields
+_VERSIONS: dict[str, tuple[int, int]] = {
+    "ECSubWrite": (2, 1),       # v2: ICI-fabric fields appended
+}
+
+
 def _register_all() -> None:
     import dataclasses as _dc
 
@@ -430,7 +461,8 @@ def _register_all() -> None:
     for _obj in list(globals().values()):
         if isinstance(_obj, type) and issubclass(_obj, Message) and \
                 _dc.is_dataclass(_obj):
-            register_struct(_obj, version=1, compat=1)
+            v, compat = _VERSIONS.get(_obj.__name__, (1, 1))
+            register_struct(_obj, version=v, compat=compat)
 
 
 _register_all()

@@ -95,9 +95,14 @@ class OSDDaemon(Dispatcher, MonHunter):
     def __init__(self, network: LocalNetwork, whoami: int,
                  store: Optional[MemStore] = None, mon="mon.0",
                  threaded: bool = False, perf_collection=None,
-                 keyring=None):
+                 keyring=None, fabric=None):
         self.whoami = whoami
         self.name = f"osd.{whoami}"
+        #: ICIFabric this OSD is device-mesh co-resident on (None =
+        #: host-only; ref: the ici transport mode, ceph_tpu.dist.fabric)
+        self.fabric = fabric
+        if fabric is not None:
+            fabric.register_resident(whoami)
         # mon may be a single name or a failover list
         self._init_mons(mon)
         self.store = store or MemStore()
@@ -484,13 +489,15 @@ class OSDDaemon(Dispatcher, MonHunter):
                     st.shard = ECPGShard(
                         pg, shard_idx, self.store,
                         ec.get_data_chunk_count(),
-                        ec.get_coding_chunk_count())
+                        ec.get_coding_chunk_count(),
+                        fabric=self.fabric)
                     if acting_p == self.whoami:
                         st.backend = ECBackend(
                             pg, ec, whoami=self.whoami, acting=acting,
                             local_shard=st.shard,
                             send=self._make_send(pg),
-                            epoch=m.epoch, tid_gen=self._tid_gen)
+                            epoch=m.epoch, tid_gen=self._tid_gen,
+                            fabric=self.fabric)
                 else:
                     st.shard = ReplicatedPGShard(pg, self.store)
                     if acting_p == self.whoami:

@@ -97,7 +97,8 @@ def ec_store_inventory(store, cid: str) -> dict:
 class ECPGShard:
     """Per-OSD shard service for one PG."""
 
-    def __init__(self, pgid, shard: int, store, k: int, m: int):
+    def __init__(self, pgid, shard: int, store, k: int, m: int,
+                 fabric=None):
         self.pgid = pgid
         self.shard = shard
         self.store = store
@@ -105,6 +106,10 @@ class ECPGShard:
         self.m = m
         self.cid = pg_cid(pgid)
         self.pg_log = PGLog()
+        #: shared ICIFabric when this OSD is device-mesh resident
+        #: (ceph_tpu.dist.fabric) — fabric sub-writes gather their
+        #: chunk slice from the mesh instead of the message
+        self.fabric = fabric
         if not store.collection_exists(self.cid):
             store.queue_transaction(
                 Transaction().create_collection(self.cid))
@@ -114,16 +119,54 @@ class ECPGShard:
         try:
             if m.txn is not None and not m.txn.empty():
                 self.store.queue_transaction(m.txn)
+            if m.fabric_key is not None:
+                self._apply_fabric_write(m)
             for e in m.log_entries:
                 if e.version > self.pg_log.log.head:
                     self.pg_log.append(e)
             committed = True
-        except StoreError as err:
+        except (StoreError, KeyError, ValueError) as err:
             dout("osd", 0).write("%s shard %s sub_write failed: %s",
                                  self.pgid, self.shard, err)
             committed = False
         return ECSubWriteReply(pgid=self.pgid, tid=m.tid,
                                shard=self.shard, committed=committed)
+
+    def _apply_fabric_write(self, m: ECSubWrite) -> None:
+        """Device-mesh data path: gather this shard's chunk slice from
+        the staged mesh arrays and apply it locally, maintaining the
+        shard's own cumulative HashInfo (the control txn in `m.txn`
+        carried everything else).  The mesh psum step replaced the
+        chunk-byte fan-out (ref: ECBackend.cc:2037-2070)."""
+        if self.fabric is None:
+            raise StoreError("EIO", "fabric write but not resident")
+        chunk = self.fabric.fetch_chunk(m.fabric_key, self.shard)
+        soid = ObjectId(m.oid, shard=self.shard)
+        hd = self._hinfo(soid)
+        if m.hinfo_append:
+            if m.chunk_off == 0:
+                hd = HashInfo(self.k + self.m)    # fresh stream
+            elif hd is None or not hd.has_chunk_hash() or \
+                    hd.get_total_chunk_size() != m.chunk_off:
+                hd = None                         # history broken
+            if hd is not None:
+                hd.append_shard(self.shard, m.chunk_off, chunk)
+        else:
+            hd = None
+        if hd is None:
+            # overwrite / inconsistent history: size tracked,
+            # cumulative hashes invalidated (host path does the same)
+            old_total = 0
+            prev = self._hinfo(soid)
+            if prev is not None:
+                old_total = prev.get_total_chunk_size()
+            hd = HashInfo(0)
+            hd.total_chunk_size = max(old_total,
+                                      m.chunk_off + len(chunk))
+        self.store.queue_transaction(
+            Transaction()
+            .write(self.cid, soid, m.chunk_off, chunk)
+            .setattrs(self.cid, soid, {HINFO_ATTR: hd.to_dict()}))
 
     # -- read side (ref: ECBackend.cc:987 handle_sub_read) -------------
     def handle_sub_read(self, m: ECSubRead) -> ECSubReadReply:
@@ -281,6 +324,10 @@ class _Write:
     log_entry: Optional[PGLogEntry] = None
     phase: str = "state"      # state -> reads -> commit -> done
     trace: Optional[dict] = None      # blkin context for fan-out spans
+    # ICI-fabric staging (set when the write rode the device mesh)
+    fabric_key: Optional[tuple] = None
+    chunk_off: int = 0
+    hinfo_append: bool = False
 
 
 @dataclass
@@ -312,9 +359,13 @@ class ECBackend:
                  acting: list[int],
                  local_shard: ECPGShard,
                  send: Callable[[int, object], bool],
-                 epoch: int = 1, tid_gen=None):
+                 epoch: int = 1, tid_gen=None, fabric=None):
         self.pgid = pgid
         self.ec = ec
+        #: ICIFabric when the acting set can be device-mesh co-resident
+        #: (ceph_tpu.dist.fabric); None or non-covering acting sets use
+        #: the host encode + messenger chunk fan-out
+        self.fabric = fabric
         self.k = ec.get_data_chunk_count()
         self.m = ec.get_coding_chunk_count()
         cs = ec.get_chunk_size(self.k * 4096)
@@ -366,6 +417,8 @@ class ECBackend:
             self.waiting_reads.clear()
             self.waiting_commit.clear()
         for op in writes:
+            if op.fabric_key is not None and self.fabric is not None:
+                self.fabric.release(op.fabric_key)
             op.on_all_commit(False)
         for rd in reads:
             rd.on_complete({}, {oid: "ESTALE" for oid in rd.reads})
@@ -599,7 +652,10 @@ class ECBackend:
         for s, txn in shard_txns.items():
             msg = ECSubWrite(pgid=self.pgid, tid=op.tid, shard=s,
                              txn=txn, log_entries=[op.log_entry],
-                             trace=child_of(op.trace))
+                             trace=child_of(op.trace),
+                             oid=op.oid, fabric_key=op.fabric_key,
+                             chunk_off=op.chunk_off,
+                             hinfo_append=op.hinfo_append)
             if self.acting[s] == self.whoami:
                 reply = self.local_shard.handle_sub_write(msg)
                 self._on_write_reply(op, reply)
@@ -673,9 +729,21 @@ class ECBackend:
             seg += b"\0" * (-len(seg) % sinfo.stripe_width)
         rel = offset - start
         seg[rel:rel + len(data)] = data
-        shards = ecutil.encode(sinfo, self.ec, bytes(seg))
         chunk_off = sinfo.aligned_logical_offset_to_chunk_offset(start)
         cid = pg_cid(self.pgid)
+
+        # ICI-fabric path: encode + chunk fan-out as one mesh collective
+        # step; messages become control-plane only (ref: the per-shard
+        # fan-out this replaces, ECBackend.cc:2037-2070)
+        if (self.fabric is not None and seg
+                and kind in ("write", "full")
+                and self.fabric.covers(
+                    [self.acting[s] for s in self._alive_shards()])
+                and self.fabric.supports(self.ec)):
+            return self._encode_write_fabric(op, kind, bytes(seg),
+                                             start, chunk_off,
+                                             old_size, new_size)
+        shards = ecutil.encode(sinfo, self.ec, bytes(seg))
 
         # cumulative hinfo only survives pure stripe-aligned appends:
         # start is stripe-aligned, so start == old_size iff the old
@@ -718,10 +786,42 @@ class ECBackend:
             txns[s] = txn
         return shards, txns, new_size
 
+    def _encode_write_fabric(self, op: _Write, kind: str, seg: bytes,
+                             start: int, chunk_off: int,
+                             old_size: int, new_size: int):
+        """Stage the encode on the device mesh; per-shard txns carry
+        only control metadata (touch/truncate/oi/meta) — each shard
+        gathers its chunk slice from the mesh and maintains its own
+        HashInfo locally (ECPGShard._apply_fabric_write)."""
+        key = (self.pgid, op.tid)
+        self.fabric.stage_encode(key, self.ec, seg,
+                                 self.sinfo.chunk_size)
+        op.fabric_key = key
+        op.chunk_off = chunk_off
+        op.hinfo_append = (start == old_size and kind == "write") \
+            or kind == "full"
+        cid = pg_cid(self.pgid)
+        txns = {}
+        for s in self._alive_shards():
+            soid = ObjectId(op.oid, shard=s)
+            txn = Transaction()
+            txn.touch(cid, soid)
+            if kind == "full":
+                txn.truncate(cid, soid, chunk_off)
+            txn.setattrs(cid, soid, {
+                OI_ATTR: {"size": new_size,
+                          "version": (op.version.epoch,
+                                      op.version.version)}})
+            self._apply_meta(txn, cid, soid, op.meta)
+            txns[s] = txn
+        return {}, txns, new_size
+
     def _next_hinfo(self, old: Optional[HashInfo], chunk_off: int,
                     shards: dict, is_append: bool) -> HashInfo:
         if is_append:
             hi = old if old is not None else HashInfo(self.k + self.m)
+            if not shards:                 # empty write (object create)
+                return hi
             if hi.has_chunk_hash() \
                     and hi.get_total_chunk_size() == chunk_off:
                 hi.append(chunk_off, shards)
@@ -757,6 +857,8 @@ class ECBackend:
     def _finish(self, op: _Write, ok: bool) -> None:
         if op in self.waiting_commit:
             self.waiting_commit.remove(op)
+        if op.fabric_key is not None and self.fabric is not None:
+            self.fabric.release(op.fabric_key)
         op.phase = "done"
         op.ok = ok
         self._try_finish_commits()

@@ -262,6 +262,24 @@ class HashInfo:
         self.projected_total_chunk_size = max(
             self.projected_total_chunk_size, self.total_chunk_size)
 
+    def append_shard(self, shard: int, old_size: int,
+                     buf: bytes) -> None:
+        """Shard-local cumulative append for the ICI-fabric path: the
+        chunk bytes exist only on the shard that fetched them, so each
+        shard advances ITS hash; other entries in this copy are never
+        consulted on this shard (handle_sub_read and scrub both check
+        `get_chunk_hash(self.shard)` only)."""
+        if old_size != self.total_chunk_size:
+            raise ValueError(
+                f"append at {old_size} but shard size is "
+                f"{self.total_chunk_size}")
+        if self.has_chunk_hash():
+            self.cumulative_shard_hashes[shard] = crc32c(
+                self.cumulative_shard_hashes[shard], buf)
+        self.total_chunk_size += len(buf)
+        self.projected_total_chunk_size = max(
+            self.projected_total_chunk_size, self.total_chunk_size)
+
     def get_chunk_hash(self, shard: int) -> int:
         return self.cumulative_shard_hashes[shard]
 

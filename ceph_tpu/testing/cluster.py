@@ -19,10 +19,13 @@ from ..osd.daemon import OSDDaemon
 class MiniCluster:
     def __init__(self, n_osd: int = 6, osds_per_host: int = 1,
                  threaded: bool = True, n_mon: int = 1,
-                 auth: str = "none"):
+                 auth: str = "none", fabric=None):
         import copy
         self.network = LocalNetwork()
         self.threaded = threaded
+        #: shared ICIFabric — OSDs become device-mesh co-resident and
+        #: EC writes ride the psum fan-out (ceph_tpu.dist.fabric)
+        self.fabric = fabric
         self._sim_now: float | None = None
         from ..common.perf_counters import PerfCountersCollection
         self.perf_collection = PerfCountersCollection()
@@ -94,7 +97,8 @@ class MiniCluster:
         d = OSDDaemon(self.network, osd, store=store,
                       threaded=self.threaded,
                       perf_collection=self.perf_collection,
-                      mon=self.mon_names, keyring=self.keyring)
+                      mon=self.mon_names, keyring=self.keyring,
+                      fabric=self.fabric)
         self._stores[osd] = d.store
         d.init()
         self.osds[osd] = d

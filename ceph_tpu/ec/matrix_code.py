@@ -79,6 +79,24 @@ def make_decode_matrix(encode_matrix: np.ndarray, k: int,
     return np.stack(rows).astype(np.uint8)
 
 
+def make_decode_matrix_full(encode_matrix: np.ndarray, k: int, n: int,
+                            decode_index: list[int],
+                            erasures: list[int]) -> np.ndarray:
+    """(nerrs x n) decode matrix over ALL n=k+m chunk slots.
+
+    Columns outside `decode_index` are zero, so the matmul consumes the
+    full chunk array in place — erased/unused slots contribute nothing
+    regardless of content and the survivor gather disappears entirely
+    (device-resident survivor selection: the selection IS the matrix).
+    The ISA-L analogue keeps gathering into dense buffers
+    (ErasureCodeIsa.cc:252-306); on the MXU the zero columns ride for
+    free in the same tiles."""
+    dmat = make_decode_matrix(encode_matrix, k, decode_index, erasures)
+    full = np.zeros((len(erasures), n), dtype=np.uint8)
+    full[:, decode_index] = dmat
+    return full
+
+
 class MatrixErasureCode(ErasureCode):
     """Systematic MDS matrix code with pluggable matmul.
 

@@ -130,5 +130,30 @@ class ErasureCodeTpu(MatrixErasureCode):
             self._decode_mm[sig] = mm
         return mm(data)
 
+    def decode_batch_full(self, erasures: list[int], data):
+        """Reconstruct `erasures` straight from the FULL chunk array —
+        device-resident survivor selection.
+
+        data: (..., k+m, N) with every chunk slot present; the content
+        of erased slots is ignored (their decode-matrix columns are
+        zero), so no survivor gather/copy happens on either host or
+        device.  Returns (..., len(erasures), N) on device.  Matrices
+        cached per erasure signature in HBM (ISA-L table-cache
+        analogue, ref: ErasureCodeIsaTableCache.cc)."""
+        from ..kernels.bitmatmul import GFMatmul
+        from ..matrix_code import make_decode_matrix_full
+        n = self.k + self.m
+        erased = sorted(int(e) for e in erasures)
+        sig = "full" + "".join(f"-{e}" for e in erased)
+        mm = self._decode_mm.get(sig)
+        if mm is None:
+            decode_index = [i for i in range(n)
+                            if i not in set(erased)][:self.k]
+            dmat = make_decode_matrix_full(self.encode_matrix, self.k,
+                                           n, decode_index, erased)
+            mm = GFMatmul(dmat)
+            self._decode_mm[sig] = mm
+        return mm(data)
+
 
 PLUGIN = ErasureCodePlugin("tpu", ErasureCodeTpu)

@@ -22,6 +22,10 @@ from ..crush.types import CRUSH_ITEM_NONE
 from .osdmap import (CEPH_OSD_DEFAULT_PRIMARY_AFFINITY, OSDMap)
 from .types import PG
 
+#: PGs per batched mapping dispatch (ParallelPGMapper-style sharding
+#: of the PG space; one executable, bounded device memory)
+BATCH_CHUNK = 1 << 16
+
 
 @dataclass
 class PoolMapping:
@@ -136,11 +140,25 @@ class OSDMapMapping:
         if ruleno >= 0:
             try:
                 cc = self._compiled(osdmap, pool_id)
-                res, cnt = cc.map_batch(
-                    pps, np.asarray(osdmap.osd_weight, dtype=np.int64),
-                    ruleno=ruleno, result_max=size, return_counts=True)
-                raw = np.asarray(res).copy()
-                counts = np.asarray(cnt).copy()
+                weights = np.asarray(osdmap.osd_weight, dtype=np.int64)
+                # fixed-size dispatches: one compiled executable reused
+                # across the pool, bounded device memory (a 1M-PG pool
+                # in one dispatch overruns a v5e-1's working set; the
+                # reference's ParallelPGMapper likewise shards the PG
+                # space, OSDMapMapping.h:115)
+                chunk = min(BATCH_CHUNK, npg)
+                for lo in range(0, npg, chunk):
+                    hi = min(lo + chunk, npg)
+                    sl = pps[lo:hi]
+                    if len(sl) < chunk:   # pad tail: same executable
+                        sl = np.concatenate(
+                            [sl, np.zeros(chunk - len(sl),
+                                          dtype=sl.dtype)])
+                    res, cnt = cc.map_batch(
+                        sl, weights, ruleno=ruleno, result_max=size,
+                        return_counts=True)
+                    raw[lo:hi] = np.asarray(res)[:hi - lo]
+                    counts[lo:hi] = np.asarray(cnt)[:hi - lo]
             except BatchUnsupported:
                 from ..crush import mapper as crush_mapper
                 ca = osdmap.crush.choose_args_get_with_fallback(pool_id)

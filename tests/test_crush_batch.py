@@ -231,3 +231,16 @@ def test_default_result_max_covers_chained_chooses():
     cc = compile_map(m)
     res = np.asarray(cc.map_batch([1, 2, 3], make_weight(m.max_devices)))
     assert res.shape[1] == 4  # 2 racks x 2 hosts
+
+
+def test_ln16_table_matches_computed():
+    """The precomputed 16-bit ln table is bit-identical to the
+    arithmetic crush_ln over the whole straw2 domain."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ceph_tpu.crush import batch as B
+    with jax.enable_x64(True):
+        u = jnp.arange(65536, dtype=jnp.int64)
+        want = np.asarray(B.crush_ln_vec(u))
+    assert np.array_equal(B._LN16, want)

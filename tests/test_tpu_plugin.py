@@ -93,3 +93,30 @@ def test_ragged_tail_sizes():
         data = rng.integers(0, 256, (5, n)).astype(np.uint8)
         assert np.array_equal(np.asarray(mm(data)),
                               gf.gf_matmul_bytes(mat, data))
+
+
+def test_decode_batch_full_matches_gathered():
+    """Device-resident survivor selection: the zero-column full-width
+    decode matrix reconstructs identically to the gathered decode path,
+    and garbage in erased slots is ignored."""
+    import numpy as np
+    from ceph_tpu.ec import registry
+    tpu = registry.factory("tpu", {"k": "4", "m": "2"})
+    rng = np.random.default_rng(42)
+    data = rng.integers(0, 256, (6, 4, 512), dtype=np.uint8)
+    parity = np.asarray(tpu.encode_batch(data))
+    chunks = np.concatenate([data, parity], axis=1)        # (S, 6, N)
+    for erasures in ([1], [0, 5], [2, 3]):
+        full = chunks.copy()
+        for e in erasures:
+            full[:, e] = rng.integers(0, 256, full[:, e].shape,
+                                      dtype=np.uint8)      # garbage
+        rec = np.asarray(tpu.decode_batch_full(erasures, full))
+        decode_index = [i for i in range(6)
+                        if i not in set(erasures)][:4]
+        survivors = chunks[:, decode_index, :]
+        want = np.asarray(tpu.decode_batch(decode_index, list(erasures),
+                                           survivors))
+        assert np.array_equal(rec, want)
+        for j, e in enumerate(sorted(erasures)):
+            assert np.array_equal(rec[:, j], chunks[:, e])

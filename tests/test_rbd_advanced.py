@@ -1,0 +1,240 @@
+"""librbd depth: exclusive lock arbitration, object map / fast-diff,
+snapshot-backed COW clones, flatten (ref: src/librbd/exclusive_lock/,
+src/librbd/object_map/, librbd clone + CopyupRequest; VERDICT r2 #6)."""
+import threading
+
+import pytest
+
+from ceph_tpu.rbd import RBD, Image, RBDError
+from ceph_tpu.rbd.image import ObjectMap, data_name
+from ceph_tpu.testing import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(n_osd=4, threaded=True)
+    c.wait_all_up()
+    r = c.rados()
+    r.pool_create("rbd", pg_num=8)
+    yield c
+    c.shutdown()
+
+
+def _io(c):
+    r = c.rados()
+    return r.open_ioctx("rbd")
+
+
+ORDER = 16      # 64 KiB objects keep the tests light
+
+
+def test_exclusive_lock_two_clients_contend(cluster):
+    """Two clients interleave writes; the lock hands off cooperatively
+    via watch/notify and both clients' writes land."""
+    io_a, io_b = _io(cluster), _io(cluster)
+    RBD().create(io_a, "locky", size=1 << 20, order=ORDER)
+    a = Image(io_a, "locky")
+    b = Image(io_b, "locky")
+    a.write(0, b"A" * 1000)
+    assert a.lock_owner
+    # b requests the lock; a releases via its watch callback
+    b.write(1000, b"B" * 1000)
+    assert b.lock_owner
+    assert not a.lock_owner
+    # and back again
+    a.write(2000, b"C" * 1000)
+    assert a.lock_owner and not b.lock_owner
+    got = a.read(0, 3000)
+    assert got == b"A" * 1000 + b"B" * 1000 + b"C" * 1000
+    a.close()
+    b.close()
+
+
+def test_exclusive_lock_dead_holder_broken(cluster):
+    """A holder whose client died (watch gone, no unlock) is detected
+    by live-watcher comparison and its lock broken
+    (ref: break_lock for blocklisted owners)."""
+    r_dead = cluster.rados()
+    io_dead = r_dead.open_ioctx("rbd")
+    io_live = _io(cluster)
+    RBD().create(io_live, "orphan", size=1 << 20, order=ORDER)
+    d = Image(io_dead, "orphan")
+    d.write(0, b"x" * 100)
+    assert d.lock_owner
+    # hard-kill the holder's client: watch disappears, lock remains
+    r_dead.shutdown()
+    survivor = Image(io_live, "orphan")
+    survivor.write(0, b"y" * 100)       # breaks the stale lock
+    assert survivor.lock_owner
+    assert survivor.read(0, 100) == b"y" * 100
+    survivor.close()
+
+
+def test_object_map_tracks_existence_and_du(cluster):
+    io = _io(cluster)
+    RBD().create(io, "mapped", size=1 << 20, order=ORDER)  # 16 objects
+    img = Image(io, "mapped")
+    img.write(0, b"z" * 100)                    # object 0
+    img.write(3 << ORDER, b"z" * (1 << ORDER))  # object 3, full
+    assert img.object_map.get(0) == ObjectMap.EXISTS
+    assert img.object_map.get(1) == ObjectMap.NONEXISTENT
+    assert img.object_map.get(3) == ObjectMap.EXISTS
+    assert img.du() == 2 * (1 << ORDER)
+    # discard a whole object drops it from the map
+    img.discard(3 << ORDER, 1 << ORDER)
+    assert img.object_map.get(3) == ObjectMap.NONEXISTENT
+    assert img.du() == 1 << ORDER
+    # the map survives reopen
+    img.close()
+    img2 = Image(io, "mapped")
+    assert img2.object_map.get(0) == ObjectMap.EXISTS
+    assert img2.object_map.get(3) == ObjectMap.NONEXISTENT
+    img2.close()
+
+
+def test_fast_diff_since_snapshot(cluster):
+    io = _io(cluster)
+    RBD().create(io, "differ", size=1 << 20, order=ORDER)
+    img = Image(io, "differ")
+    img.write(0, b"a" * 100)                     # obj 0
+    img.write(5 << ORDER, b"a" * 100)            # obj 5
+    img.snap_create("base")
+    # after the snap, the head map is clean -> empty diff
+    assert img.diff_since("base") == []
+    img.write(5 << ORDER, b"b" * 50)             # dirty obj 5
+    img.write(9 << ORDER, b"c" * 10)             # new obj 9
+    diff = img.diff_since("base")
+    assert [d["objectno"] for d in diff] == [5, 9]
+    assert all(d["exists"] for d in diff)
+    # diff since creation sees every existing object
+    assert [d["objectno"] for d in img.diff_since(None)] == [0, 5, 9]
+    img.snap_remove("base")
+    img.close()
+
+
+def test_clone_cow_read_write_flatten(cluster):
+    io = _io(cluster)
+    RBD().create(io, "parent", size=1 << 19, order=ORDER)  # 8 objects
+    p = Image(io, "parent")
+    p.write(0, b"P" * (1 << ORDER))          # obj 0 full
+    p.write(2 << ORDER, b"Q" * 4096)         # obj 2 partial
+    p.snap_create("gold")
+    with pytest.raises(RBDError):            # must protect first
+        RBD().clone(io, "parent", "gold", io, "child")
+    p.snap_protect("gold")
+    RBD().clone(io, "parent", "gold", io, "child")
+    assert ("rbd", "child") in p.children()
+    # parent writes after the snap do not leak into the clone
+    p.write(0, b"Z" * 100)
+
+    c = Image(io, "child")
+    # reads fall through to the parent snapshot
+    assert c.read(0, 100) == b"P" * 100
+    assert c.read(2 << ORDER, 4096) == b"Q" * 4096
+    assert c.read(5 << ORDER, 10) == b"\0" * 10
+    # partial write copies the parent object up, preserving its bytes
+    c.write((2 << ORDER) + 100, b"new")
+    got = c.read(2 << ORDER, 4096)
+    assert got[:100] == b"Q" * 100
+    assert got[100:103] == b"new"
+    assert got[103:] == b"Q" * (4096 - 103)
+    # parent object is untouched
+    assert p.read(2 << ORDER, 100) == b"Q" * 100
+    # snapshot can't be unprotected or removed while the clone lives
+    with pytest.raises(RBDError):
+        p.snap_unprotect("gold")
+    with pytest.raises(RBDError):
+        p.snap_remove("gold")
+    # flatten detaches: all parent blocks copied into the child
+    c.flatten()
+    assert c.parent is None
+    assert c.read(0, 100) == b"P" * 100
+    assert c.read(2 << ORDER, 100) == b"Q" * 100
+    p2 = Image(io, "parent")
+    assert ("rbd", "child") not in p2.children()
+    p2.snap_unprotect("gold")
+    p2.snap_remove("gold")
+    p2.close()
+    c.close()
+    p.close()
+
+
+def test_clone_discard_does_not_expose_parent(cluster):
+    io = _io(cluster)
+    RBD().create(io, "pdisc", size=1 << 18, order=ORDER)
+    p = Image(io, "pdisc")
+    p.write(0, b"S" * (1 << ORDER))
+    p.snap_create("s")
+    p.snap_protect("s")
+    RBD().clone(io, "pdisc", "s", io, "cdisc")
+    c = Image(io, "cdisc")
+    # whole-object discard inside the overlap must zero, not remove —
+    # a remove would resurrect the parent's bytes via fall-through
+    c.discard(0, 1 << ORDER)
+    assert c.read(0, 100) == b"\0" * 100
+    c.close()
+    p.close()
+
+
+def test_remove_guards(cluster):
+    io = _io(cluster)
+    RBD().create(io, "guarded", size=1 << 18, order=ORDER)
+    img = Image(io, "guarded")
+    img.write(0, b"g")
+    img.snap_create("s1")
+    img.close()
+    with pytest.raises(RBDError, match="snapshots"):
+        RBD().remove(io, "guarded")
+    img = Image(io, "guarded")
+    img.snap_remove("s1")
+    img.close()
+    RBD().remove(io, "guarded")
+    assert "guarded" not in RBD().list(io)
+
+
+def test_rbd_cli_verbs(cluster):
+    """rbd CLI verbs end-to-end (ref: src/tools/rbd/; cram-style CLI
+    tier src/test/cli/rbd/)."""
+    import io as _io_mod
+    from ceph_tpu.tools.rbd_cli import main
+    r = cluster.rados()
+
+    def run(*argv):
+        buf = _io_mod.StringIO()
+        rc = main(list(argv), rados=r, out=buf)
+        return rc, buf.getvalue()
+
+    rc, _ = run("-p", "rbd", "create", "cli_img", "--size", "1M",
+                "--order", "16")
+    assert rc == 0
+    rc, out = run("-p", "rbd", "ls")
+    assert rc == 0 and "cli_img" in out.splitlines()
+    rc, out = run("-p", "rbd", "info", "cli_img")
+    assert rc == 0 and "1 MiB" in out
+    rc, _ = run("-p", "rbd", "snap", "create", "cli_img@s1")
+    assert rc == 0
+    rc, _ = run("-p", "rbd", "snap", "protect", "cli_img@s1")
+    assert rc == 0
+    rc, out = run("-p", "rbd", "snap", "ls", "cli_img")
+    assert rc == 0 and "s1" in out and "protected" in out
+    rc, _ = run("-p", "rbd", "clone", "cli_img@s1", "cli_child")
+    assert rc == 0
+    rc, out = run("-p", "rbd", "children", "cli_img")
+    assert rc == 0 and "rbd/cli_child" in out
+    rc, out = run("-p", "rbd", "info", "cli_child")
+    assert rc == 0 and "parent: rbd/cli_img@s1" in out
+    rc, out = run("-p", "rbd", "du", "cli_img")
+    assert rc == 0 and "used" in out
+    rc, _ = run("-p", "rbd", "flatten", "cli_child")
+    assert rc == 0
+    rc, out = run("-p", "rbd", "children", "cli_img")
+    assert rc == 0 and "cli_child" not in out
+    rc, _ = run("-p", "rbd", "snap", "unprotect", "cli_img@s1")
+    assert rc == 0
+    rc, _ = run("-p", "rbd", "snap", "rm", "cli_img@s1")
+    assert rc == 0
+    rc, _ = run("-p", "rbd", "rm", "cli_child")
+    assert rc == 0
+    # removing a missing image fails cleanly
+    rc, _ = run("-p", "rbd", "rm", "ghost")
+    assert rc == 1

@@ -13,13 +13,18 @@ surface:
   (ref: rgw multipart: RGWCompleteMultipart assembles the manifest —
   here parts are concatenated since striping policy is the Striper's
   job).
+* **Bucket index is SHARDED**: keys hash across N index shard objects
+  (ref: rgw bucket index shards, rgw_rados bucket_index_max_shards /
+  rgw_shard_id — the single-object index was the exact bottleneck the
+  reference's sharding removes); listings merge the shards.
 * REST: ListBuckets / Create/Delete/HeadBucket, Put/Get/Head/Delete
-  Object, ListObjectsV2 (prefix + max-keys + continuation), multipart
-  initiate/upload-part/complete/abort.  XML shapes follow S3 close
-  enough for scripted clients.
+  Object, CopyObject (x-amz-copy-source), ListObjectsV2 (prefix +
+  max-keys + continuation), multipart initiate/upload-part/complete/
+  abort.  XML shapes follow S3 close enough for scripted clients.
 
-No request signing: the reference supports anonymous access; cephx
-for S3 keys is out of scope this round.
+**Auth**: with a keyring, every request must carry a valid AWS SigV4
+signature whose access key is a cephx entity (ref: src/rgw/
+rgw_auth_s3.cc); without one the gateway is anonymous (test mode).
 """
 from __future__ import annotations
 
@@ -34,13 +39,24 @@ from xml.etree import ElementTree as ET
 from xml.sax.saxutils import escape
 
 from ..client import RadosError, WriteOp
+from .auth import SigV4Error, verify as sigv4_verify
 
 #: omap object holding the bucket registry (name -> creation meta)
 BUCKETS_OBJ = ".rgw.buckets.list"
+#: index shards per bucket (ref: rgw_override_bucket_index_max_shards)
+DEFAULT_INDEX_SHARDS = 8
 
 
-def _index_obj(bucket: str) -> str:
-    return f".rgw.index.{bucket}"
+def _shard_of(key: str, nshards: int) -> int:
+    """Stable key -> shard placement (ref: rgw_shard_id — hash mod)."""
+    if nshards <= 1:
+        return 0
+    h = hashlib.md5(key.encode()).digest()
+    return int.from_bytes(h[:4], "big") % nshards
+
+
+def _index_obj(bucket: str, shard: int = 0) -> str:
+    return f".rgw.index.{bucket}.{shard}"
 
 
 def _data_obj(bucket: str, key: str) -> str:
@@ -59,8 +75,14 @@ class RGWGateway:
     """One gateway instance bound to an HTTP port, backed by a pool."""
 
     def __init__(self, rados, pool: str = "rgw",
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 keyring=None, index_shards: int = DEFAULT_INDEX_SHARDS):
         self.rados = rados
+        #: cephx keyring doubling as the S3 credential store
+        #: (ref: radosgw users in the cluster auth database); None =
+        #: anonymous gateway
+        self.keyring = keyring
+        self.index_shards = index_shards
         try:
             rados.pool_lookup(pool)
         except RadosError:
@@ -80,6 +102,15 @@ class RGWGateway:
 
             def _run(self, method):
                 try:
+                    body = gw._read_body(self)
+                    self._body = body
+                    if gw.keyring is not None:
+                        try:
+                            self.s3_user = sigv4_verify(
+                                method, self.path, self.headers, body,
+                                gw.keyring.get)
+                        except SigV4Error as e:
+                            raise S3Error(403, e.code, str(e))
                     gw._route(self, method)
                 except S3Error as e:
                     body = (f'<?xml version="1.0"?><Error><Code>'
@@ -131,16 +162,39 @@ class RGWGateway:
         vals, _ = self.io.get_omap_vals(BUCKETS_OBJ)
         return {k: json.loads(v) for k, v in vals.items()}
 
-    def _require_bucket(self, bucket: str) -> None:
-        if bucket not in self._buckets():
+    def _require_bucket(self, bucket: str) -> dict:
+        b = self._buckets().get(bucket)
+        if b is None:
             raise S3Error(404, "NoSuchBucket", bucket)
+        return b
+
+    def _nshards(self, bucket: str) -> int:
+        b = self._buckets().get(bucket) or {}
+        return int(b.get("shards", 1))
 
     def _index(self, bucket: str) -> dict[str, dict]:
-        try:
-            vals, _ = self.io.get_omap_vals(_index_obj(bucket))
-        except RadosError:
-            return {}
-        return {k: json.loads(v) for k, v in vals.items()}
+        """Merged view across every index shard (listings; ref: the
+        reference's sharded bucket listing merge, CLSRGWIssueBucketList
+        over shards)."""
+        out: dict[str, dict] = {}
+        for shard in range(self._nshards(bucket)):
+            try:
+                vals, _ = self.io.get_omap_vals(
+                    _index_obj(bucket, shard))
+            except RadosError:
+                continue
+            for k, v in vals.items():
+                out[k] = json.loads(v)
+        return out
+
+    def _index_entry(self, bucket: str, key: str,
+                     nshards: int | None = None) -> dict | None:
+        if nshards is None:
+            nshards = self._nshards(bucket)
+        shard = _shard_of(key, nshards)
+        vals = self.io.get_omap_vals_by_keys(
+            _index_obj(bucket, shard), [key])
+        return json.loads(vals[key]) if key in vals else None
 
     @staticmethod
     def _respond(h, status: int, body: bytes = b"",
@@ -161,6 +215,8 @@ class RGWGateway:
 
     @staticmethod
     def _read_body(h) -> bytes:
+        if hasattr(h, "_body"):      # cached by the auth gate
+            return h._body
         n = int(h.headers.get("Content-Length", 0))
         return h.rfile.read(n) if n else b""
 
@@ -195,10 +251,12 @@ class RGWGateway:
     def _bucket_op(self, h, method: str, bucket: str, q: dict) -> None:
         if method == "PUT":
             meta = json.dumps({"created": time.strftime(
-                "%Y-%m-%dT%H:%M:%S.000Z", time.gmtime())}).encode()
+                "%Y-%m-%dT%H:%M:%S.000Z", time.gmtime()),
+                "shards": self.index_shards}).encode()
             self.io.operate(BUCKETS_OBJ,
                             WriteOp().set_omap({bucket: meta}))
-            self.io.create(_index_obj(bucket))
+            for shard in range(self.index_shards):
+                self.io.create(_index_obj(bucket, shard))
             return self._respond(h, 200,
                                  headers={"Location": f"/{bucket}"})
         self._require_bucket(bucket)
@@ -209,11 +267,13 @@ class RGWGateway:
         if method == "DELETE":
             if self._index(bucket):
                 raise S3Error(409, "BucketNotEmpty", bucket)
+            nshards = self._nshards(bucket)
             self.io.remove_omap_keys(BUCKETS_OBJ, [bucket])
-            try:
-                self.io.remove(_index_obj(bucket))
-            except RadosError:
-                pass
+            for shard in range(nshards):
+                try:
+                    self.io.remove(_index_obj(bucket, shard))
+                except RadosError:
+                    pass
             return self._respond(h, 204)
         raise S3Error(405, "MethodNotAllowed", method)
 
@@ -246,7 +306,8 @@ class RGWGateway:
     # -- object level ----------------------------------------------------
     def _object_op(self, h, method: str, bucket: str, key: str,
                    q: dict) -> None:
-        self._require_bucket(bucket)
+        bmeta = self._require_bucket(bucket)
+        nshards = int(bmeta.get("shards", 1))
         if method == "POST" and "uploads" in q:
             return self._initiate_multipart(h, bucket, key)
         if method == "POST" and "uploadId" in q:
@@ -256,12 +317,13 @@ class RGWGateway:
             return self._upload_part(h, bucket, key, q)
         if method == "DELETE" and "uploadId" in q:
             return self._abort_multipart(h, bucket, key, q["uploadId"])
+        if method == "PUT" and "x-amz-copy-source" in h.headers:
+            return self._copy_object(h, bucket, key)
         if method == "PUT":
             return self._put_object(h, bucket, key)
-        idx = self._index(bucket)
-        if key not in idx:
+        meta = self._index_entry(bucket, key, nshards)
+        if meta is None:
             raise S3Error(404, "NoSuchKey", key)
-        meta = idx[key]
         if method == "HEAD":
             return self._respond(
                 h, 200, b"", "application/octet-stream",
@@ -277,7 +339,8 @@ class RGWGateway:
                 self.io.remove(_data_obj(bucket, key))
             except RadosError:
                 pass
-            self.io.remove_omap_keys(_index_obj(bucket), [key])
+            self.io.remove_omap_keys(
+                _index_obj(bucket, _shard_of(key, nshards)), [key])
             return self._respond(h, 204)
         raise S3Error(405, "MethodNotAllowed", method)
 
@@ -288,18 +351,39 @@ class RGWGateway:
         self._write_index(bucket, key, len(data), etag)
         self._respond(h, 200, headers={"ETag": f'"{etag}"'})
 
+    def _copy_object(self, h, bucket: str, key: str) -> None:
+        """Server-side copy (ref: RGWCopyObj; x-amz-copy-source)."""
+        src = unquote(h.headers["x-amz-copy-source"]).lstrip("/")
+        if "/" not in src:
+            raise S3Error(400, "InvalidArgument", src)
+        s_bucket, s_key = src.split("/", 1)
+        self._require_bucket(s_bucket)
+        s_meta = self._index_entry(s_bucket, s_key)
+        if s_meta is None:
+            raise S3Error(404, "NoSuchKey", s_key)
+        data = self.io.read(_data_obj(s_bucket, s_key))
+        etag = hashlib.md5(data).hexdigest()
+        self.io.write_full(_data_obj(bucket, key), data)
+        self._write_index(bucket, key, len(data), etag)
+        self._respond(h, 200, (
+            '<?xml version="1.0"?><CopyObjectResult>'
+            f"<ETag>&quot;{etag}&quot;</ETag>"
+            f"<LastModified>{s_meta['mtime']}</LastModified>"
+            "</CopyObjectResult>").encode())
+
     def _write_index(self, bucket: str, key: str, size: int,
                      etag: str) -> None:
         meta = {"size": size, "etag": etag,
                 "mtime": time.strftime("%Y-%m-%dT%H:%M:%S.000Z",
                                        time.gmtime())}
-        self.io.set_omap(_index_obj(bucket),
+        shard = _shard_of(key, self._nshards(bucket))
+        self.io.set_omap(_index_obj(bucket, shard),
                          {key: json.dumps(meta).encode()})
 
     # -- multipart (ref: rgw RGWInitMultipart/CompleteMultipart) ---------
     def _initiate_multipart(self, h, bucket: str, key: str) -> None:
         upload_id = uuid.uuid4().hex
-        self.io.set_omap(_index_obj(bucket), {
+        self.io.set_omap(self._upload_shard(bucket, upload_id), {
             f".upload.{upload_id}": json.dumps(
                 {"key": key, "parts": {}}).encode()})
         self._respond(h, 200, (
@@ -308,9 +392,14 @@ class RGWGateway:
             f"<UploadId>{upload_id}</UploadId>"
             "</InitiateMultipartUploadResult>").encode())
 
+    def _upload_shard(self, bucket: str, upload_id: str) -> str:
+        return _index_obj(bucket, _shard_of(f".upload.{upload_id}",
+                                            self._nshards(bucket)))
+
     def _upload_meta(self, bucket: str, upload_id: str) -> dict:
         vals = self.io.get_omap_vals_by_keys(
-            _index_obj(bucket), [f".upload.{upload_id}"])
+            self._upload_shard(bucket, upload_id),
+            [f".upload.{upload_id}"])
         if not vals:
             raise S3Error(404, "NoSuchUpload", upload_id)
         return json.loads(vals[f".upload.{upload_id}"])
@@ -324,7 +413,7 @@ class RGWGateway:
         part_obj = f".part.{upload_id}.{n}"
         self.io.write_full(part_obj, data)
         meta["parts"][str(n)] = {"size": len(data), "etag": etag}
-        self.io.set_omap(_index_obj(bucket), {
+        self.io.set_omap(self._upload_shard(bucket, upload_id), {
             f".upload.{upload_id}": json.dumps(meta).encode()})
         self._respond(h, 200, headers={"ETag": f'"{etag}"'})
 
@@ -371,7 +460,7 @@ class RGWGateway:
                 self.io.remove(f".part.{upload_id}.{n}")
             except RadosError:
                 pass
-        self.io.remove_omap_keys(_index_obj(bucket),
+        self.io.remove_omap_keys(self._upload_shard(bucket, upload_id),
                                  [f".upload.{upload_id}"])
 
 

@@ -1,0 +1,124 @@
+"""RGW SigV4 authentication + sharded bucket index + CopyObject
+(ref: src/rgw/rgw_auth_s3.cc; rgw bucket index shards; RGWCopyObj;
+VERDICT r2 #7)."""
+import http.client
+import json
+
+import pytest
+
+from ceph_tpu.auth import KeyRing
+from ceph_tpu.rgw import RGWGateway
+from ceph_tpu.rgw.auth import sign_request
+from ceph_tpu.testing import MiniCluster
+
+ACCESS = "client.s3user"
+
+
+@pytest.fixture(scope="module")
+def gw():
+    c = MiniCluster(n_osd=4, threaded=True)
+    c.wait_all_up()
+    kr = KeyRing.generate([ACCESS])
+    g = RGWGateway(c.rados(), port=0, keyring=kr, index_shards=4)
+    g.start()
+    yield c, g, kr
+    g.shutdown()
+    c.shutdown()
+
+
+def _req(g, kr, method, path, body=b"", sign=True, headers=None,
+         access=ACCESS, secret=None):
+    conn = http.client.HTTPConnection("127.0.0.1", g.port, timeout=30)
+    hdrs = dict(headers or {})
+    hdrs["host"] = f"127.0.0.1:{g.port}"
+    if sign:
+        hdrs = sign_request(method, path, hdrs, body, access,
+                            secret or kr.get(ACCESS))
+    conn.request(method, path, body, hdrs)
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp, data
+
+
+def test_unauthenticated_rejected(gw):
+    _c, g, kr = gw
+    resp, data = _req(g, kr, "PUT", "/b0", sign=False)
+    assert resp.status == 403
+    assert b"AccessDenied" in data
+    resp, _ = _req(g, kr, "GET", "/", sign=False)
+    assert resp.status == 403
+
+
+def test_bad_signature_and_unknown_key_rejected(gw):
+    _c, g, kr = gw
+    resp, data = _req(g, kr, "PUT", "/b0", secret="0" * 32)
+    assert resp.status == 403 and b"SignatureDoesNotMatch" in data
+    resp, data = _req(g, kr, "PUT", "/b0", access="client.ghost",
+                      secret="0" * 32)
+    assert resp.status == 403 and b"InvalidAccessKeyId" in data
+
+
+def test_signed_crud_roundtrip(gw):
+    _c, g, kr = gw
+    assert _req(g, kr, "PUT", "/auth-b")[0].status == 200
+    resp, _ = _req(g, kr, "PUT", "/auth-b/k1", b"payload-1")
+    assert resp.status == 200
+    resp, data = _req(g, kr, "GET", "/auth-b/k1")
+    assert resp.status == 200 and data == b"payload-1"
+    resp, _ = _req(g, kr, "HEAD", "/auth-b/k1")
+    assert resp.status == 200
+    assert resp.getheader("Content-Length") == "9"
+    resp, _ = _req(g, kr, "DELETE", "/auth-b/k1")
+    assert resp.status == 204
+
+
+def test_copy_object(gw):
+    _c, g, kr = gw
+    _req(g, kr, "PUT", "/src-b")
+    _req(g, kr, "PUT", "/dst-b")
+    _req(g, kr, "PUT", "/src-b/orig", b"copy me")
+    resp, data = _req(g, kr, "PUT", "/dst-b/dup",
+                      headers={"x-amz-copy-source": "/src-b/orig"})
+    assert resp.status == 200 and b"CopyObjectResult" in data
+    resp, data = _req(g, kr, "GET", "/dst-b/dup")
+    assert data == b"copy me"
+
+
+def test_sharded_index_lists_across_shards(gw):
+    """Keys spread over all 4 index shards; ListObjectsV2 merges and
+    paginates them in key order."""
+    from ceph_tpu.rgw.gateway import _index_obj, _shard_of
+    c, g, kr = gw
+    _req(g, kr, "PUT", "/wide")
+    n = 200
+    for i in range(n):
+        resp, _ = _req(g, kr, "PUT", f"/wide/obj{i:04d}",
+                       f"d{i}".encode())
+        assert resp.status == 200
+    # the index really is sharded: every shard object holds keys
+    shards_used = {_shard_of(f"obj{i:04d}", 4) for i in range(n)}
+    assert shards_used == {0, 1, 2, 3}
+    for s in range(4):
+        vals, _ = g.io.get_omap_vals(_index_obj("wide", s))
+        assert vals, f"shard {s} empty"
+    # paginated listing returns every key exactly once, sorted
+    got = []
+    token = ""
+    while True:
+        path = "/wide?list-type=2&max-keys=37"
+        if token:
+            path += f"&continuation-token={token}"
+        resp, data = _req(g, kr, "GET", path)
+        assert resp.status == 200
+        import re
+        keys = re.findall(r"<Key>([^<]+)</Key>", data.decode())
+        got.extend(keys)
+        m = re.search(r"<NextContinuationToken>([^<]+)<", data.decode())
+        if not m:
+            break
+        token = m.group(1)
+    assert got == [f"obj{i:04d}" for i in range(n)]
+    # per-object lookup routes straight to one shard
+    resp, data = _req(g, kr, "GET", "/wide/obj0123")
+    assert data == b"d123"

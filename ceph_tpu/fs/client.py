@@ -3,15 +3,25 @@
 (ref: src/client/Client.cc — path ops go to the MDS, file data goes
 straight to the data pool through the file layout's striping; size
 updates flow back to the MDS the way cap flushes carry size/mtime).
+
+Capability model (round 3, ref: Client.cc caps handling +
+src/mds/Locker.cc): open() requests caps from the MDS; CAP_EXCL lets
+the handle buffer its size (flushed on fsync/close/revoke), CAP_CACHE
+lets it cache read extents.  A revoke arriving over the session
+triggers flush + invalidate + ack off-thread; cap-less handles run
+write-through with grow-only size flushes so concurrent writers can't
+regress each other's extensions.
 """
 from __future__ import annotations
 
 import itertools
 import threading
+import time as _time
 
 from ..client import RadosError
-from ..msg.messages import MClientReply, MClientRequest
+from ..msg.messages import MClientCaps, MClientReply, MClientRequest
 from ..msg.messenger import Dispatcher, Message
+from .mds import CAP_CACHE, CAP_EXCL
 from ..osdc.striper import StripeLayout, Striper
 
 
@@ -29,7 +39,8 @@ def fs_data_obj(ino: int, objectno: int) -> str:
 
 class _MDSSession(Dispatcher):
     """Request/reply channel to the MDS riding the Rados client's
-    messenger (ref: Client::send_request / MetaSession)."""
+    messenger (ref: Client::send_request / MetaSession).  Also receives
+    MClientCaps revokes and routes them to the owning CephFS."""
 
     def __init__(self, rados, mds: str):
         self.ms = rados.objecter.ms
@@ -37,9 +48,16 @@ class _MDSSession(Dispatcher):
         self._tids = itertools.count(1)
         self._pending: dict[int, tuple[threading.Event, list]] = {}
         self._rados = rados
+        self.fs: "CephFS | None" = None
         self.ms.add_dispatcher(self)
 
     def ms_dispatch(self, msg: Message) -> bool:
+        if isinstance(msg, MClientCaps):
+            if self.fs is not None and msg.op == "revoke":
+                # flushing runs sync IO — never on the dispatch thread
+                threading.Thread(target=self.fs._handle_revoke,
+                                 args=(msg,), daemon=True).start()
+            return True
         if not isinstance(msg, MClientReply):
             return False
         entry = self._pending.pop(msg.tid, None)
@@ -78,15 +96,22 @@ class _MDSSession(Dispatcher):
 
 
 class FileHandle:
-    """Open file (ref: src/client/Fh.h)."""
+    """Open file (ref: src/client/Fh.h) with capability-driven caching
+    (ref: Client.cc caps: CAP_EXCL buffers size, CAP_CACHE caches read
+    extents; both surrendered on revoke)."""
 
-    def __init__(self, fs: "CephFS", path: str, rec: dict):
+    def __init__(self, fs: "CephFS", path: str, rec: dict,
+                 caps: int = 0):
         self.fs = fs
         self.path = path
         self.ino = rec["ino"]
         self.layout = StripeLayout(**rec["layout"])
         self.size = rec.get("size", 0)
+        self.caps = caps
+        self._dirty_size = False
+        self._rcache: dict[tuple[int, int], bytes] = {}
         self._io = fs.rados.open_ioctx(rec["pool"])
+        fs._register_handle(self)
 
     # -- data path (ref: Client::_write -> Striper + Objecter) ---------
     def write(self, offset: int, data: bytes) -> int:
@@ -100,17 +125,41 @@ class FileHandle:
                 offset=ext.offset))
         for f in futs:
             self._io._wait(f)
+        self._rcache.clear()
         if offset + len(data) > self.size:
             self.size = offset + len(data)
-            self.fs._session.call("setattr", {"path": self.path,
-                                              "size": self.size})
+            if self.caps & CAP_EXCL:
+                self._dirty_size = True      # flushed on fsync/revoke
+            else:
+                # write-through, grow-only: a stale size must never
+                # clip another writer's extension
+                self.fs._session.call("setattr", {
+                    "path": self.path, "size": self.size,
+                    "grow_only": True})
         return len(data)
 
+    def append(self, data: bytes) -> int:
+        """Append at the authoritative end: without CAP_EXCL the size
+        is re-fetched first (another writer may have extended)."""
+        if not self.caps & CAP_EXCL:
+            self.size = max(self.size,
+                            self.fs.stat(self.path).get("size", 0))
+        return self.write(self.size, data)
+
     def read(self, offset: int, length: int = 0) -> bytes:
+        if not self.caps & (CAP_EXCL | CAP_CACHE):
+            # no caps: another client may have extended the file
+            self.size = max(self.size,
+                            self.fs.stat(self.path).get("size", 0))
         if length == 0 or offset + length > self.size:
             length = max(0, self.size - offset)
         if length == 0:
             return b""
+        key = (offset, length)
+        if self.caps & (CAP_CACHE | CAP_EXCL):
+            hit = self._rcache.get(key)
+            if hit is not None:
+                return hit
         out = bytearray(length)
         pend = []
         for ext in Striper.file_to_extents(self.layout, offset,
@@ -127,14 +176,34 @@ class FileHandle:
                 buf = b""                        # sparse hole
             dst = ext.logical_offset - offset
             out[dst:dst + len(buf)] = buf
-        return bytes(out)
+        result = bytes(out)
+        if self.caps & (CAP_CACHE | CAP_EXCL):
+            self._rcache[key] = result
+        return result
+
+    def _surrender_caps(self) -> None:
+        """Revoke: flush dirty size, drop caches, run cap-less."""
+        if self._dirty_size:
+            self.fs._session.call("setattr", {
+                "path": self.path, "size": self.size,
+                "grow_only": True})
+            self._dirty_size = False
+        self._rcache.clear()
+        self.caps = 0
 
     def fsync(self) -> None:
         self.fs._session.call("setattr", {"path": self.path,
-                                          "size": self.size})
+                                          "size": self.size,
+                                          "grow_only": True})
+        self._dirty_size = False
 
     def close(self) -> None:
         self.fsync()
+        if self.fs._unregister_handle(self):
+            try:
+                self.fs._session.call("release", {"ino": self.ino})
+            except (CephFSError, TimeoutError):
+                pass
 
 
 class CephFS:
@@ -143,6 +212,41 @@ class CephFS:
     def __init__(self, rados, mds: str = "mds.0"):
         self.rados = rados
         self._session = _MDSSession(rados, mds)
+        self._session.fs = self
+        self._handles: dict[int, list] = {}      # ino -> [FileHandle]
+        self._hlock = threading.Lock()
+
+    # -- capability plumbing -------------------------------------------
+    def _register_handle(self, fh) -> None:
+        with self._hlock:
+            self._handles.setdefault(fh.ino, []).append(fh)
+
+    def _unregister_handle(self, fh) -> bool:
+        """Returns True when this was the client's LAST handle on the
+        ino — only then may the session's caps be released (an earlier
+        release would strand a sibling handle with client-side caps
+        the MDS no longer tracks)."""
+        with self._hlock:
+            lst = self._handles.get(fh.ino, [])
+            if fh in lst:
+                lst.remove(fh)
+            if not lst:
+                self._handles.pop(fh.ino, None)
+                return True
+            return False
+
+    def _handle_revoke(self, msg) -> None:
+        """MDS revoked our caps on an ino: flush + invalidate + ack
+        (runs off the dispatch thread)."""
+        with self._hlock:
+            handles = list(self._handles.get(msg.ino, []))
+        for fh in handles:
+            try:
+                fh._surrender_caps()
+            except (CephFSError, TimeoutError):
+                pass
+        self._session.ms.connect(self._session.mds).send_message(
+            MClientCaps(op="ack", ino=msg.ino))
 
     # -- namespace ------------------------------------------------------
     def mkdir(self, path: str) -> None:
@@ -178,16 +282,19 @@ class CephFS:
 
     def unlink(self, path: str) -> None:
         rec = self._session.call("unlink", {"path": path})
-        # purge data objects (ref: the reference defers this to the
-        # MDS PurgeQueue; the client-side purge keeps one moving part)
+        # purge data objects only when the last link died (ref: the
+        # reference defers this to the MDS PurgeQueue; nlink>0 keeps
+        # the inode's data alive for the remaining hardlinks)
         size = rec.get("size", 0)
-        if size:
+        if size and rec.get("purge", True):
             self._purge_data(rec, size)
 
     # -- files ----------------------------------------------------------
     def open(self, path: str, mode: str = "r",
-             layout: dict | None = None) -> FileHandle:
-        if "w" in mode or "a" in mode or "+" in mode:
+             layout: dict | None = None,
+             timeout: float = 10.0) -> FileHandle:
+        wants_write = "w" in mode or "a" in mode or "+" in mode
+        if wants_write:
             # 'w' carries O_TRUNC (POSIX); 'a'/'r+' keep existing bytes
             rec = self._session.call("create", {
                 "path": path, "layout": layout,
@@ -195,11 +302,27 @@ class CephFS:
             purge = rec.pop("purge_size", 0)
             if purge:
                 self._purge_data(rec, purge)
-        else:
-            rec = self.stat(path)
-            if rec["type"] != "f":
-                raise CephFSError("EISDIR", path)
-        return FileHandle(self, path, rec)
+        # capability request loop: EAGAIN while the MDS revokes
+        # conflicting caps (ref: Client waits out cap revocation)
+        deadline = _time.monotonic() + timeout
+        while True:
+            try:
+                out = self._session.call("open", {
+                    "path": path, "wants_write": wants_write})
+                break
+            except CephFSError as e:
+                if e.errno_name != "EAGAIN" or \
+                        _time.monotonic() > deadline:
+                    raise
+                _time.sleep(0.02)
+        rec, caps = out["rec"], out["caps"]
+        if rec["type"] != "f":
+            raise CephFSError("EISDIR", path)
+        return FileHandle(self, path, rec, caps=caps)
+
+    def link(self, src: str, dst: str) -> None:
+        """Hardlink (ref: libcephfs ceph_link)."""
+        self._session.call("link", {"src": src, "dst": dst})
 
     def _purge_data(self, rec: dict, size: int) -> None:
         layout = StripeLayout(**rec["layout"])

@@ -19,8 +19,25 @@ before the dirfrag update, and replayed on startup):
   objects into the data pool themselves (ref: file_layout_t +
   Striper), and report size growth via setattr like cap flushes.
 
-Single rank, synchronous ops, no client caps — the concurrency story
-is the mon-style "one dispatch at a time" lock.
+Single rank, one dispatch at a time.  Round 3 adds the Locker-lite
+concurrency model (ref: src/mds/Locker.cc + client caps,
+src/messages/MClientCaps.h):
+
+* clients OPEN files and are granted **capabilities**: CAP_CACHE (may
+  cache reads) and CAP_EXCL (may buffer writes and own the size);
+* a conflicting open triggers **revoke-on-conflict**: the MDS sends
+  MClientCaps revokes to the holders and answers the opener EAGAIN;
+  holders flush dirty size/caches, ack, and the retried open gets a
+  grant consistent with the surviving sharers (two writers -> nobody
+  caches, the reference's LOCK_MIX outcome);
+* caps are leases, not journaled — they die with the session like the
+  reference's session reconnect rebuild.
+
+Hardlinks use the reference's primary/remote dentry split
+(ref: CDentry::linkage_t): the first link migrates the embedded inode
+into the `mds.itable` omap (ino -> record, the anchor-table analogue)
+and both dentries become remote references carrying just the ino;
+nlink reaches 0 -> the itable entry dies and the client purges data.
 """
 from __future__ import annotations
 
@@ -30,17 +47,23 @@ import time
 
 from ..client import RadosError, WriteOp
 from ..common.log import dout
-from ..msg.messages import MClientReply, MClientRequest
+from ..msg.messages import MClientCaps, MClientReply, MClientRequest
 from ..msg.messenger import Dispatcher, Message, Messenger
 
 ROOT_INO = 1
 JOURNAL_OBJ = "mds.journal"
 META_OBJ = "mds.meta"
+ITABLE_OBJ = "mds.itable"
 #: applied_seq persists every N ops: the gap is the replay window
 APPLY_EVERY = 8
 
+# capability bits (reduced from src/include/ceph_fs.h CEPH_CAP_*)
+CAP_CACHE = 1          # may cache reads
+CAP_EXCL = 2           # may buffer writes; cached size is authoritative
+
 _ERRNO = {"ENOENT": -2, "EEXIST": -17, "ENOTDIR": -20, "EISDIR": -21,
-          "EINVAL": -22, "ENOTEMPTY": -39}
+          "EINVAL": -22, "ENOTEMPTY": -39, "EAGAIN": -11,
+          "EMLINK": -31}
 
 
 def dir_obj(ino: int) -> str:
@@ -73,6 +96,12 @@ class MDSDaemon(Dispatcher):
         self._seq = 0
         self._next_ino = ROOT_INO + 1
         self._ops_since_apply = 0
+        # capability leases (volatile; ref: Locker + session caps):
+        # ino -> {client: capbits}; open intents: ino -> {client: wants_write}
+        self._caps: dict[int, dict[str, int]] = {}
+        self._opens: dict[int, dict[str, bool]] = {}
+        self._pending_revokes: list[tuple[str, MClientCaps]] = []
+        self._revoking: dict[tuple[int, str], float] = {}
         self._mkfs_or_replay()
         self.ms = Messenger.create(network, self.name,
                                    threaded=threaded)
@@ -92,10 +121,11 @@ class MDSDaemon(Dispatcher):
         try:
             meta = self.meta.get_omap_vals(META_OBJ)[0]
         except RadosError:
-            # fresh fs: root dir + meta + empty journal
+            # fresh fs: root dir + meta + itable + empty journal
             self.meta.create(META_OBJ)
             self.meta.create(JOURNAL_OBJ)
             self.meta.create(dir_obj(ROOT_INO))
+            self.meta.create(ITABLE_OBJ)
             self.meta.set_omap(META_OBJ, {
                 "applied_seq": b"0", "next_ino": str(ROOT_INO + 1)
                 .encode()})
@@ -155,7 +185,10 @@ class MDSDaemon(Dispatcher):
                 except RadosError:
                     pass
             elif kind == "mkobj":
-                self.meta.create(obj)
+                try:
+                    self.meta.create(obj)
+                except RadosError:
+                    pass               # replay idempotency (EEXIST)
 
     def _persist_applied(self) -> None:
         self.meta.set_omap(META_OBJ, {
@@ -199,6 +232,94 @@ class MDSDaemon(Dispatcher):
         self._next_ino += 1
         return ino
 
+    # ------------------------------------------- hardlinks / itable
+    def _iget(self, ino: int) -> dict | None:
+        """itable record for a multiply-linked inode."""
+        try:
+            vals = self.meta.get_omap_vals_by_keys(ITABLE_OBJ,
+                                                   [str(ino)])
+        except RadosError:
+            return None
+        raw = vals.get(str(ino))
+        return json.loads(raw) if raw is not None else None
+
+    def _record_of(self, dent: dict) -> dict:
+        """Resolve a dentry to its inode record — remote dentries
+        (ref: CDentry remote linkage) indirect through the itable."""
+        if dent is not None and "remote" in dent:
+            rec = self._iget(dent["remote"])
+            if rec is None:
+                raise MDSError("ENOENT", f"ino {dent['remote']:x}")
+            return rec
+        return dent
+
+    def _update_record(self, parent: int, name: str, dent: dict,
+                       rec: dict, op: str) -> None:
+        """Persist an updated inode record where it lives: the itable
+        for remote dentries, the primary dentry otherwise."""
+        if "remote" in dent:
+            self._journal(op, [("set", ITABLE_OBJ,
+                                {str(dent["remote"]): json.dumps(rec)})])
+        else:
+            self._journal(op, [("set", dir_obj(parent),
+                                {name: json.dumps(rec)})])
+
+    # --------------------------------------------------- capabilities
+    #: unacked revoke grace before caps are force-dropped (the session
+    #: timeout analogue, ref: mds_session_autoclose)
+    REVOKE_GRACE = 5.0
+
+    def _queue_revoke(self, ino: int, clients) -> None:
+        now = time.monotonic()
+        for c in clients:
+            key = (ino, c)
+            started = self._revoking.setdefault(key, now)
+            if now - started > self.REVOKE_GRACE:
+                # client never acked (dead/hung): force-drop its caps
+                # and session so the opener can make progress
+                self._caps.get(ino, {}).pop(c, None)
+                self._opens.get(ino, {}).pop(c, None)
+                self._revoking.pop(key, None)
+                continue
+            self._pending_revokes.append((c, MClientCaps(
+                op="revoke", ino=ino,
+                caps=self._caps.get(ino, {}).get(c, 0))))
+
+    def _grant_caps(self, ino: int, client: str,
+                    wants_write: bool) -> int:
+        """Revoke-on-conflict grant (ref: Locker file lock states,
+        collapsed): raises EAGAIN after queueing revokes."""
+        other_caps = {c: b for c, b in self._caps.get(ino, {}).items()
+                      if c != client and b}
+        others = {c: w for c, w in self._opens.get(ino, {}).items()
+                  if c != client}
+        if wants_write:
+            if other_caps:
+                self._queue_revoke(ino, other_caps)
+                raise MDSError("EAGAIN", "caps being revoked")
+            caps = (CAP_EXCL | CAP_CACHE) if not others else 0
+        else:
+            excl = [c for c, b in other_caps.items() if b & CAP_EXCL]
+            if excl:
+                self._queue_revoke(ino, excl)
+                raise MDSError("EAGAIN", "caps being revoked")
+            caps = CAP_CACHE if not any(others.values()) else 0
+        self._opens.setdefault(ino, {})[client] = wants_write
+        if caps:
+            self._caps.setdefault(ino, {})[client] = caps
+        else:
+            self._caps.get(ino, {}).pop(client, None)
+        return caps
+
+    def handle_caps(self, msg: MClientCaps) -> None:
+        """Client returned caps (ack after flushing dirty state)."""
+        with self._lock:
+            if msg.op == "ack":
+                m = self._caps.get(msg.ino)
+                if m is not None:
+                    m.pop(msg.src, None)
+                self._revoking.pop((msg.ino, msg.src), None)
+
     # ------------------------------------------------------- operations
     def handle_op(self, op: str, args: dict):
         """Returns the reply payload; raises MDSError.
@@ -227,17 +348,18 @@ class MDSDaemon(Dispatcher):
         if dent is not None:
             if dent["type"] == "d":
                 raise MDSError("EISDIR", a["path"])
+            rec = self._record_of(dent)
             if not a.get("truncate"):
-                return dent                # open-existing ('r+'/'a')
+                return rec                 # open-existing ('r+'/'a')
             # O_TRUNC semantics (ref: Server::handle_client_openc +
             # inode truncate): size -> 0; the client purges the old
             # data objects, mirroring how unlink purges client-side
-            old_size = dent.get("size", 0)
-            dent["size"] = 0
-            dent["mtime"] = time.time()
-            self._journal("truncate", [
-                ("set", dir_obj(parent), {name: json.dumps(dent)})])
-            out = dict(dent)
+            old_size = rec.get("size", 0)
+            rec = dict(rec)
+            rec["size"] = 0
+            rec["mtime"] = time.time()
+            self._update_record(parent, name, dent, rec, "truncate")
+            out = dict(rec)
             out["purge_size"] = old_size
             return out
         ino = self._alloc_ino()
@@ -255,7 +377,61 @@ class MDSDaemon(Dispatcher):
         _parent, _name, dent = self._resolve(a["path"])
         if dent is None:
             raise MDSError("ENOENT", a["path"])
-        return dent
+        return self._record_of(dent)
+
+    def _op_open(self, a):
+        """Open with a capability request (ref: Server::handle_client_
+        open -> Locker issue).  EAGAIN while conflicting caps are being
+        revoked; the client retries."""
+        _parent, _name, dent = self._resolve(a["path"])
+        if dent is None:
+            raise MDSError("ENOENT", a["path"])
+        rec = self._record_of(dent)
+        if rec["type"] != "f":
+            raise MDSError("EISDIR", a["path"])
+        caps = self._grant_caps(rec["ino"], a["__client"],
+                                bool(a.get("wants_write")))
+        return {"rec": rec, "caps": caps}
+
+    def _op_release(self, a):
+        """Close: drop the session's caps + open intent
+        (ref: Locker::remove_client_cap)."""
+        ino = a["ino"]
+        self._caps.get(ino, {}).pop(a["__client"], None)
+        self._opens.get(ino, {}).pop(a["__client"], None)
+        return None
+
+    def _op_link(self, a):
+        """Hardlink (ref: Server::handle_client_link): the first link
+        migrates the embedded inode to the itable; both dentries become
+        remote references."""
+        sp, sname, sdent = self._resolve(a["src"])
+        if sdent is None:
+            raise MDSError("ENOENT", a["src"])
+        if self._record_of(sdent)["type"] == "d":
+            raise MDSError("EISDIR", a["src"])
+        dp, dname, ddent = self._resolve(a["dst"])
+        if not dname:
+            raise MDSError("EINVAL", a["dst"])
+        if ddent is not None:
+            raise MDSError("EEXIST", a["dst"])
+        if "remote" in sdent:
+            rec = self._iget(sdent["remote"])
+            rec["nlink"] = rec.get("nlink", 1) + 1
+            self._journal("link", [
+                ("set", ITABLE_OBJ, {str(rec["ino"]): json.dumps(rec)}),
+                ("set", dir_obj(dp),
+                 {dname: json.dumps({"type": "f",
+                                     "remote": rec["ino"]})})])
+            return rec
+        rec = dict(sdent)
+        rec["nlink"] = 2
+        remote = {"type": "f", "remote": rec["ino"]}
+        self._journal("link", [
+            ("set", ITABLE_OBJ, {str(rec["ino"]): json.dumps(rec)}),
+            ("set", dir_obj(sp), {sname: json.dumps(remote)}),
+            ("set", dir_obj(dp), {dname: json.dumps(remote)})])
+        return rec
 
     def _op_readdir(self, a):
         _parent, _name, dent = self._resolve(a["path"])
@@ -271,8 +447,31 @@ class MDSDaemon(Dispatcher):
             raise MDSError("ENOENT", a["path"])
         if dent["type"] == "d":
             raise MDSError("EISDIR", a["path"])
+        if "remote" in dent:
+            # hardlink: drop the reference; purge only at nlink 0
+            rec = self._iget(dent["remote"])
+            if rec is None:
+                self._journal("unlink", [("rm", dir_obj(parent),
+                                          [name])])
+                raise MDSError("ENOENT", a["path"])
+            rec["nlink"] = rec.get("nlink", 1) - 1
+            if rec["nlink"] <= 0:
+                self._journal("unlink", [
+                    ("rm", dir_obj(parent), [name]),
+                    ("rm", ITABLE_OBJ, [str(rec["ino"])])])
+                out = dict(rec)
+                out["purge"] = True
+                return out
+            self._journal("unlink", [
+                ("rm", dir_obj(parent), [name]),
+                ("set", ITABLE_OBJ, {str(rec["ino"]): json.dumps(rec)})])
+            out = dict(rec)
+            out["purge"] = False
+            return out
+        out = dict(dent)
+        out["purge"] = True
         self._journal("unlink", [("rm", dir_obj(parent), [name])])
-        return dent                      # client purges the data objs
+        return out                       # client purges the data objs
 
     def _op_rmdir(self, a):
         parent, name, dent = self._resolve(a["path"])
@@ -321,12 +520,18 @@ class MDSDaemon(Dispatcher):
         parent, name, dent = self._resolve(a["path"])
         if dent is None:
             raise MDSError("ENOENT", a["path"])
+        rec = self._record_of(dent)
         for k in ("size", "mtime"):
             if k in a:
-                dent[k] = a[k]
-        self._journal("setattr", [
-            ("set", dir_obj(parent), {name: json.dumps(dent)})])
-        return dent
+                if k == "size" and a.get("grow_only"):
+                    # cap-less writers flush sizes grow-only so a stale
+                    # flush can't regress another writer's extension
+                    # (ref: the size ordering Locker's xlock provides)
+                    rec[k] = max(rec.get(k, 0), a[k])
+                else:
+                    rec[k] = a[k]
+        self._update_record(parent, name, dent, rec, "setattr")
+        return rec
 
     def _op_statfs(self, a):
         def count(ino):
@@ -345,10 +550,15 @@ class MDSDaemon(Dispatcher):
 
     # --------------------------------------------------------- dispatch
     def ms_dispatch(self, msg: Message) -> bool:
+        if isinstance(msg, MClientCaps):
+            self.handle_caps(msg)
+            return True
         if not isinstance(msg, MClientRequest):
             return False
         try:
-            out = self.handle_op(msg.op, msg.args)
+            args = dict(msg.args)
+            args["__client"] = msg.src
+            out = self.handle_op(msg.op, args)
             reply = MClientReply(tid=msg.tid, result=0, out=out)
         except MDSError as e:
             reply = MClientReply(tid=msg.tid,
@@ -359,5 +569,11 @@ class MDSDaemon(Dispatcher):
                                  errno_name="EINVAL")
             dout("mds", 1).write("%s: bad request %s: %s", self.name,
                                  msg.op, e)
+        # drain cap revokes queued by the op AFTER the reply so the
+        # EAGAIN lands first (ref: Locker issues revokes async)
+        with self._lock:
+            revokes, self._pending_revokes = self._pending_revokes, []
         self.ms.connect(msg.src).send_message(reply)
+        for client, cap_msg in revokes:
+            self.ms.connect(client).send_message(cap_msg)
         return True

@@ -1,0 +1,164 @@
+"""CephFS capabilities + hardlinks + crash replay under concurrency
+(ref: src/mds/Locker.cc cap issue/revoke; CDentry remote linkage for
+hardlinks; MDLog replay — VERDICT r2 #8)."""
+import pytest
+
+from ceph_tpu.fs import CephFS, MDSDaemon
+from ceph_tpu.fs.client import CephFSError
+from ceph_tpu.fs.mds import CAP_CACHE, CAP_EXCL
+from ceph_tpu.testing import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def fscluster():
+    c = MiniCluster(n_osd=4, threaded=True)
+    c.wait_all_up()
+    mds = MDSDaemon(c.network, c.rados())
+    mds.init()
+    yield c, mds
+    mds.shutdown()
+    c.shutdown()
+
+
+def _fs(c):
+    return CephFS(c.rados())
+
+
+def test_single_client_gets_excl(fscluster):
+    c, _mds = fscluster
+    fs = _fs(c)
+    fs.mkdirs("/caps")
+    fh = fs.open("/caps/solo", "w")
+    assert fh.caps & CAP_EXCL and fh.caps & CAP_CACHE
+    fh.write(0, b"solo")
+    fh.close()
+
+
+def test_reader_revokes_writer_excl(fscluster):
+    """A second client's read-open revokes the writer's EXCL; the
+    writer's buffered size is flushed first, so the reader sees it."""
+    c, _mds = fscluster
+    fs_w, fs_r = _fs(c), _fs(c)
+    fs_w.mkdirs("/caps")
+    w = fs_w.open("/caps/shared", "w")
+    assert w.caps & CAP_EXCL
+    w.write(0, b"E" * 5000)      # size buffered under EXCL, not flushed
+    r = fs_r.open("/caps/shared", "r")
+    # the open interlock flushed the writer's dirty size
+    assert r.size == 5000
+    assert r.read(0) == b"E" * 5000
+    assert not (w.caps & CAP_EXCL)       # revoked
+    w.close()
+    r.close()
+
+
+def test_concurrent_writers_no_lost_update(fscluster):
+    """The round-2 failure mode: two writers appending — without caps
+    the second writer's cached size 0 overwrote the first's bytes.
+    With revoke-on-conflict + grow-only flushes both extents land."""
+    c, _mds = fscluster
+    fs_a, fs_b = _fs(c), _fs(c)
+    fs_a.mkdirs("/caps")
+    a = fs_a.open("/caps/both", "w")
+    a.write(0, b"A" * 1000)              # buffered under EXCL
+    b = fs_b.open("/caps/both", "a")     # conflict: revokes a's EXCL
+    assert b.size == 1000                # saw a's flushed size
+    b.append(b"B" * 1000)
+    # a appends again: cap-less now, re-fetches authoritative size
+    a.append(b"C" * 1000)
+    final = _fs(c).read_file("/caps/both")
+    assert final == b"A" * 1000 + b"B" * 1000 + b"C" * 1000
+    a.close()
+    b.close()
+
+
+def test_cache_invalidated_on_revoke(fscluster):
+    c, _mds = fscluster
+    fs_1, fs_2 = _fs(c), _fs(c)
+    fs_1.mkdirs("/caps")
+    fs_1.write_file("/caps/cached", b"v1-data")
+    h1 = fs_1.open("/caps/cached", "r")
+    assert h1.caps & CAP_CACHE
+    assert h1.read(0) == b"v1-data"
+    assert h1._rcache                    # cached
+    # another client writes: h1's CACHE is revoked, cache dropped
+    h2 = fs_2.open("/caps/cached", "r+")
+    h2.write(0, b"v2-DATA")
+    h2.fsync()
+    import time
+    deadline = time.monotonic() + 5
+    while h1.caps and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert h1.caps == 0 and not h1._rcache
+    assert h1.read(0) == b"v2-DATA"
+    h1.close()
+    h2.close()
+
+
+def test_hardlink_shares_data_until_last_unlink(fscluster):
+    c, _mds = fscluster
+    fs = _fs(c)
+    fs.mkdirs("/hl")
+    fs.write_file("/hl/one", b"linked-bytes")
+    fs.link("/hl/one", "/hl/two")
+    assert fs.read_file("/hl/two") == b"linked-bytes"
+    st1, st2 = fs.stat("/hl/one"), fs.stat("/hl/two")
+    assert st1["ino"] == st2["ino"]
+    assert st1.get("nlink") == 2
+    # writes through either name are visible through the other
+    fh = fs.open("/hl/two", "r+")
+    fh.write(0, b"LINKED")
+    fh.close()
+    assert fs.read_file("/hl/one")[:6] == b"LINKED"
+    # unlinking one name keeps the data alive
+    fs.unlink("/hl/one")
+    assert not fs.exists("/hl/one")
+    assert fs.read_file("/hl/two")[:6] == b"LINKED"
+    # last unlink purges
+    ino = st1["ino"]
+    fs.unlink("/hl/two")
+    io = fs.rados.open_ioctx("cephfs_data")
+    assert not [o for o in io.list_objects()
+                if o.startswith(f"{ino:x}.")]
+    # a second link then rename keeps resolution intact
+    fs.write_file("/hl/base", b"renamed-link")
+    fs.link("/hl/base", "/hl/alias")
+    fs.rename("/hl/alias", "/hl/alias2")
+    assert fs.read_file("/hl/alias2") == b"renamed-link"
+    with pytest.raises(CephFSError, match="EEXIST"):
+        fs.link("/hl/base", "/hl/alias2")
+
+
+def test_crash_replay_window_with_concurrent_clients():
+    """Hard-stop the MDS inside the applied_seq window (journaled,
+    dirfrags not checkpointed) with TWO clients mid-flight; the
+    restarted rank replays and both clients' namespaces converge
+    (ref: MDLog::replay; VERDICT r2 #8 crash inside the lazy window)."""
+    c = MiniCluster(n_osd=2, threaded=True)
+    try:
+        c.wait_all_up()
+        mds = MDSDaemon(c.network, c.rados())
+        mds.init()
+        fs_a, fs_b = CephFS(c.rados()), CephFS(c.rados())
+        fs_a.mkdirs("/w")
+        fs_a.write_file("/w/a", b"from-a")
+        fs_b.write_file("/w/b", b"from-b")
+        fs_b.link("/w/b", "/w/b2")       # itable op inside the window
+        # hard stop: no shutdown flush — applied_seq lags the journal
+        mds.ms.shutdown()
+        mds2 = MDSDaemon(c.network, c.rados())
+        mds2.init()
+        fs2 = CephFS(c.rados())
+        assert sorted(fs2.listdir("/w")) == ["a", "b", "b2"]
+        assert fs2.read_file("/w/a") == b"from-a"
+        assert fs2.read_file("/w/b2") == b"from-b"
+        assert fs2.stat("/w/b")["nlink"] == 2
+        # both clients keep working against the new rank
+        fs_a2, fs_b2 = CephFS(c.rados()), CephFS(c.rados())
+        ha = fs_a2.open("/w/a", "a")
+        ha.append(b"+more")
+        ha.close()
+        assert fs_b2.read_file("/w/a") == b"from-a+more"
+        mds2.shutdown()
+    finally:
+        c.shutdown()

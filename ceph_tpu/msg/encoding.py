@@ -184,6 +184,21 @@ def registered_types() -> dict[str, type]:
     return {n: i.cls for n, i in sorted(_by_name.items())}
 
 
+def ensure_registered() -> None:
+    """Import every module that registers wire structs (idempotent).
+    Decoders that touch PERSISTED data (LogDB WAL replay, dencoder)
+    call this first so decoding never depends on what the caller
+    happened to import — a BlueStore mount must be able to replay a
+    WAL containing EVersion/PG/... structs in a bare process."""
+    from ..crush import types as _ct          # noqa: F401
+    from ..crush import wrapper as _cw        # noqa: F401
+    from ..osd import osdmap as _om           # noqa: F401
+    from ..osd import pg_types as _pt         # noqa: F401
+    from ..osd import types as _ot            # noqa: F401
+    from ..store import objectstore as _os    # noqa: F401
+    from . import messages as _mm             # noqa: F401
+
+
 # -------------------------------------------------------------- encode
 
 def _encode_value(obj: Any, out: bytearray, depth: int) -> None:

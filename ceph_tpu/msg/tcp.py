@@ -156,11 +156,13 @@ class TcpMessenger:
             if sock is None and peer not in self.addr_map:
                 sock = self._learned.get(peer)
                 learned = sock is not None
+            fresh = False
             if sock is None:
                 sock = self._connect_peer(peer)
                 if sock is None:
                     self.handle_reset(peer)
                     return False
+                fresh = True
                 self._out[peer] = sock
                 self._spawn_reader(sock)
             try:
@@ -172,6 +174,25 @@ class TcpMessenger:
                     sock.close()
                 except OSError:
                     pass
+                # a cached socket may be stale (the peer restarted —
+                # e.g. an OSD process kill -9'd and revived on the same
+                # addr): reconnect once and resend before declaring the
+                # peer reset, or a mon's map push to a rebooted daemon
+                # is silently lost (ref: AsyncConnection reconnect)
+                if not fresh and peer in self.addr_map:
+                    sock = self._connect_peer(peer)
+                    if sock is not None:
+                        self._out[peer] = sock
+                        self._spawn_reader(sock)
+                        try:
+                            send_frame(sock, payload)
+                            return True
+                        except OSError:
+                            self._out.pop(peer, None)
+                            try:
+                                sock.close()
+                            except OSError:
+                                pass
         self.handle_reset(peer)
         return False
 

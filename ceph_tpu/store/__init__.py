@@ -2,13 +2,16 @@
 
 `ObjectStore` is the abstract transactional API (ObjectStore.h:66);
 `MemStore` is the in-memory implementation used by the OSD shards and
-tests (model: src/os/memstore/MemStore.cc); `JournaledStore` adds an
-on-disk write-ahead journal + snapshot (FileStore/FileJournal shape)
-for durable one-process-per-daemon deployments.
+tests (model: src/os/memstore/MemStore.cc); `BlueStore` is the
+block-file engine with KV metadata, at-rest checksums, deferred writes
+and compress-on-write (model: src/os/bluestore/) — the durable default
+for one-process-per-daemon deployments; `JournaledStore` is the legacy
+FileStore-shaped WAL+snapshot engine it retires.
 """
 from .objectstore import ObjectStore, Transaction, ObjectId, StoreError
 from .memstore import MemStore
 from .journaled import JournaledStore
+from .bluestore import BlueStore
 
 __all__ = ["ObjectStore", "Transaction", "ObjectId", "StoreError",
-           "MemStore", "JournaledStore"]
+           "MemStore", "JournaledStore", "BlueStore"]

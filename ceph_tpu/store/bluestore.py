@@ -1,0 +1,780 @@
+"""BlueStore-lite: block-file object store with KV metadata.
+
+The reference's storage engine re-built at framework scale
+(ref: src/os/bluestore/BlueStore.cc — txn entry `queue_transactions`
+:10873, `_txc_add_transaction` :10977; blob/extent onode model;
+allocators; checksums; compression; deferred writes; RocksDB metadata
+via src/kv/RocksDBStore.cc).  What it keeps and why:
+
+* **Data lives on a block file**, not RAM: objects map through a
+  BlueStore-style two-level reference — `lextents` (logical ranges ->
+  blob byte ranges) over immutable **blobs** (allocated unit runs with
+  a crc32c over the stored bytes and an optional compression alg).
+  Writes are COW: a new blob is written to FREE units and the lextent
+  map cut over in the KV commit, so a crash never tears visible data.
+* **Metadata in a KeyValueDB** (ceph_tpu.kv.LogDB = WAL + snapshot):
+  mount replays O(wal tail), never O(dataset) — the JournaledStore
+  failure mode this engine retires.
+* **Checksums at rest**: every blob carries crc32c(stored bytes),
+  verified on every read and by fsck; bitrot surfaces as EIO for the
+  scrub/repair machinery instead of silent corruption.
+* **Deferred small writes** (ref: bluestore deferred_write path): an
+  overwrite <= `deferred_max` inside one uncompressed single-ref blob
+  rides the KV WAL (data embedded) and is applied to the block file
+  after commit; mount re-applies pending entries (idempotent).
+* **Compress-on-write** finally consumes the compressor registry
+  (ref: src/compressor/ consumed by BlueStore): blobs >=
+  `comp_min_len` are compressed when the ratio pays, shrinking the
+  unit run.
+* **Allocator state is not persisted** — it is rebuilt at mount from
+  the blob map (the reference's NCB "allocation from onodes" recovery
+  model), eliminating allocator/metadata consistency bugs by design.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+from .. import compressor as comp_mod
+from ..common.crc32c import crc32c
+from ..common.options import global_config
+from ..kv import KeyValueDB, LogDB
+from .objectstore import (ObjectId, ObjectStore, StoreError, Transaction,
+                          OP_TOUCH, OP_WRITE, OP_ZERO, OP_TRUNCATE,
+                          OP_REMOVE, OP_SETATTRS, OP_RMATTR, OP_RMATTRS,
+                          OP_CLONE, OP_CLONE_RANGE, OP_MKCOLL, OP_RMCOLL,
+                          OP_COLL_MOVE_RENAME, OP_OMAP_CLEAR,
+                          OP_OMAP_SETKEYS, OP_OMAP_RMKEYS)
+
+# KV prefixes (ref: bluestore's rocksdb column prefixes PREFIX_OBJ etc.)
+P_SUPER = "S"
+P_COLL = "C"
+P_ONODE = "O"
+P_BLOB = "B"
+P_DEFER = "D"
+
+
+def _okey(cid: str, oid: ObjectId) -> str:
+    from ..msg import encoding as wire
+    return f"{cid}|{wire.encode(oid).hex()}"
+
+
+def _okey_oid(key: str) -> ObjectId:
+    from ..msg import encoding as wire
+    return wire.decode(bytes.fromhex(key.split("|", 1)[1]))
+
+
+class BlueStore(ObjectStore):
+    """dir/ layout: `block` (data file) + `kv/` (LogDB)."""
+
+    def __init__(self, path: str, min_alloc: int = 4096,
+                 deferred_max: int = 4096,
+                 compression: str = "none",
+                 comp_min_len: int = 32768):
+        self.path = path
+        self.min_alloc = min_alloc
+        self.deferred_max = deferred_max
+        self.compression = compression
+        self.comp_min_len = comp_min_len
+        self.mounted = False
+        self._lock = threading.RLock()
+        self._block = None
+        self.db: KeyValueDB | None = None
+        # in-memory metadata mirror (metadata only — data stays on disk)
+        self._colls: dict[str, dict[ObjectId, dict]] = {}
+        self._blobs: dict[int, dict] = {}
+        self._next_blob = 1
+        self._free: set[int] = set()          # free allocation units
+        self._units = 0                       # units provisioned so far
+        self._read_err_objs: set = set()
+
+    # ------------------------------------------------------- lifecycle
+    def mkfs(self) -> None:
+        os.makedirs(self.path, exist_ok=True)
+        open(os.path.join(self.path, "block"), "ab").close()
+
+    def mount(self) -> None:
+        os.makedirs(self.path, exist_ok=True)
+        self._block = open(os.path.join(self.path, "block"), "r+b") \
+            if os.path.exists(os.path.join(self.path, "block")) \
+            else open(os.path.join(self.path, "block"), "w+b")
+        self.db = LogDB(os.path.join(self.path, "kv"))
+        self._load()
+        self._replay_deferred()
+        self.mounted = True
+
+    def umount(self) -> None:
+        if self.db is not None:
+            self.db.close()
+            self.db = None
+        if self._block is not None:
+            self._block.close()
+            self._block = None
+        self.mounted = False
+
+    def _load(self) -> None:
+        """Rebuild the in-memory mirror + allocator from KV
+        (allocation recovered from the blob map, the NCB model)."""
+        self._colls = {}
+        for cid, meta in self.db.get_by_prefix(P_COLL).items():
+            self._colls[cid] = {}
+        for key, onode in self.db.get_by_prefix(P_ONODE).items():
+            cid = key.split("|", 1)[0]
+            self._colls.setdefault(cid, {})[_okey_oid(key)] = onode
+        self._blobs = {int(k): v for k, v in
+                       self.db.get_by_prefix(P_BLOB).items()}
+        self._next_blob = max(self._blobs, default=0) + 1
+        used = set()
+        top = 0
+        for b in self._blobs.values():
+            start, count = b["units"]
+            used.update(range(start, start + count))
+            top = max(top, start + count)
+        self._units = top
+        self._free = set(range(top)) - used
+
+    def _replay_deferred(self) -> None:
+        """Apply pending deferred writes (data was in the KV WAL;
+        idempotent re-apply, ref: bluestore deferred replay)."""
+        pending = self.db.get_by_prefix(P_DEFER)
+        if not pending:
+            return
+        txn = self.db.transaction()
+        for key, d in pending.items():
+            self._block.seek(d["off"])
+            self._block.write(bytes(d["data"]))
+            txn.rmkey(P_DEFER, key)
+        self._block.flush()
+        os.fsync(self._block.fileno())
+        self.db.submit_transaction(txn)
+
+    # ------------------------------------------------------- allocator
+    def _allocate(self, n_units: int) -> int:
+        """First-fit contiguous run; the block file grows on demand
+        (ref: BitmapAllocator — contiguity keeps blob reads one
+        seek)."""
+        if n_units <= 0:
+            raise StoreError("EINVAL", "zero allocation")
+        free = sorted(self._free)
+        run_start, run_len = None, 0
+        for u in free:
+            if run_start is not None and u == run_start + run_len:
+                run_len += 1
+            else:
+                run_start, run_len = u, 1
+            if run_len == n_units:
+                for x in range(run_start, run_start + n_units):
+                    self._free.discard(x)
+                return run_start
+        start = self._units
+        self._units += n_units
+        return start
+
+    def _free_blob(self, blob_id: int, txn) -> None:
+        b = self._blobs.pop(blob_id, None)
+        if b is None:
+            return
+        start, count = b["units"]
+        self._free.update(range(start, start + count))
+        txn.rmkey(P_BLOB, str(blob_id))
+
+    # ------------------------------------------------------ txn engine
+    def queue_transaction(self, txn: Transaction) -> None:
+        with self._lock:
+            if self.db is None:
+                raise StoreError("EIO", "store not mounted")
+            ctx = _TxnCtx(self)
+            try:
+                for op in txn.ops:
+                    self._apply(op, ctx)
+            except Exception:
+                ctx.abort()      # return allocated units to the pool
+                raise
+            ctx.commit()
+
+    def _apply(self, op, ctx: "_TxnCtx") -> None:
+        code = op[0]
+        if code == OP_MKCOLL:
+            _, cid, bits = op
+            if cid in ctx.colls_view():
+                raise StoreError("EEXIST", f"collection {cid}")
+            ctx.new_coll(cid, bits)
+            return
+        if code == OP_RMCOLL:
+            _, cid = op
+            if ctx.coll(cid):
+                raise StoreError("ENOTEMPTY", f"collection {cid}")
+            ctx.rm_coll(cid)
+            return
+        if code == OP_COLL_MOVE_RENAME:
+            _, oldcid, oldoid, cid, oid = op
+            src = ctx.coll(oldcid)
+            dst = ctx.coll(cid)
+            if oldoid not in src:
+                raise StoreError("ENOENT", f"{oldcid}/{oldoid}")
+            if oid in dst and not (cid == oldcid and oid == oldoid):
+                raise StoreError("EEXIST", f"{cid}/{oid}")
+            ctx.move(oldcid, oldoid, cid, oid)
+            return
+
+        cid, oid = op[1], op[2]
+        if code == OP_TOUCH:
+            ctx.onode(cid, oid, create=True)
+        elif code == OP_WRITE:
+            _, _, _, off, data = op
+            self._do_write(ctx, cid, oid, off, bytes(data))
+        elif code == OP_ZERO:
+            _, _, _, off, length = op
+            o = ctx.onode(cid, oid, create=True)
+            self._punch(ctx, o, off, length)
+            o["size"] = max(o["size"], off + length)
+        elif code == OP_TRUNCATE:
+            _, _, _, size = op
+            o = ctx.onode(cid, oid)
+            if size < o["size"]:
+                self._punch(ctx, o, size, o["size"] - size)
+            o["size"] = size
+        elif code == OP_REMOVE:
+            ctx.remove(cid, oid)
+        elif code == OP_SETATTRS:
+            _, _, _, attrs = op
+            o = ctx.onode(cid, oid, create=True)
+            o["attrs"].update(attrs)
+        elif code == OP_RMATTR:
+            _, _, _, name = op
+            ctx.onode(cid, oid)["attrs"].pop(name, None)
+        elif code == OP_RMATTRS:
+            ctx.onode(cid, oid)["attrs"].clear()
+        elif code == OP_CLONE:
+            _, _, _, noid = op
+            ctx.clone(cid, oid, noid)
+        elif code == OP_CLONE_RANGE:
+            _, _, _, noid, srcoff, length, dstoff = op
+            data = self._read_onode(ctx.onode(cid, oid), srcoff, length)
+            self._do_write(ctx, cid, noid, dstoff, data)
+        elif code == OP_OMAP_CLEAR:
+            ctx.onode(cid, oid)
+            ctx.omap_clear(cid, oid)
+        elif code == OP_OMAP_SETKEYS:
+            _, _, _, keys = op
+            ctx.onode(cid, oid, create=True)
+            ctx.omap_set(cid, oid, keys)
+        elif code == OP_OMAP_RMKEYS:
+            _, _, _, keys = op
+            ctx.onode(cid, oid)
+            ctx.omap_rm(cid, oid, keys)
+        else:
+            raise StoreError("EOPNOTSUPP", f"unknown op {code}")
+
+    # -------------------------------------------------------- write IO
+    def _do_write(self, ctx: "_TxnCtx", cid: str, oid: ObjectId,
+                  off: int, data: bytes) -> None:
+        if not data:
+            ctx.onode(cid, oid, create=True)
+            return
+        o = ctx.onode(cid, oid, create=True)
+        end = off + len(data)
+        # deferred small overwrite: entirely inside ONE uncompressed
+        # single-ref blob extent -> data rides the KV WAL, applied in
+        # place after commit (ref: bluestore deferred writes)
+        if len(data) <= self.deferred_max:
+            hit = self._deferred_target(o, off, len(data))
+            if hit is not None:
+                self._deferred_write(ctx, o, hit, off, data)
+                o["size"] = max(o["size"], end)
+                o["mtime"] = 0
+                return
+        self._punch(ctx, o, off, len(data))
+        blob_id = ctx.new_blob(data)
+        o["lextents"].append([off, len(data), blob_id, 0])
+        o["lextents"].sort()
+        o["size"] = max(o["size"], end)
+
+    def _deferred_target(self, o: dict, off: int, length: int):
+        """The lextent wholly containing [off, off+length) whose blob
+        can be patched in place, or None."""
+        for le in o["lextents"]:
+            loff, llen, blob_id, boff = le
+            if loff <= off and off + length <= loff + llen:
+                b = self._blobs_view().get(blob_id)
+                if b is not None and b.get("comp") is None and \
+                        b.get("refs", 1) == 1:
+                    return le
+            if loff > off:
+                break
+        return None
+
+    def _blobs_view(self) -> dict:
+        return self._blobs
+
+    def _deferred_write(self, ctx: "_TxnCtx", o: dict, le,
+                        off: int, data: bytes) -> None:
+        loff, llen, blob_id, boff = le
+        b = ctx.blob_mutable(blob_id)
+        delta = boff + (off - loff)
+        start, count = b["units"]
+        blob_base = start * self.min_alloc
+        abs_off = blob_base + delta
+        # new stored bytes -> new csum.  The read-merge must overlay
+        # deferred patches already queued in THIS txn (they are not on
+        # disk yet): two small writes to one blob in one transaction
+        # would otherwise produce a csum matching neither state.
+        stored = bytearray(self._read_stored(b))
+        for p_off, p_data in ctx._deferred:
+            rel = p_off - blob_base
+            if 0 <= rel < len(stored):
+                stored[rel:rel + len(p_data)] = p_data
+        stored[delta:delta + len(data)] = data
+        b["csum"] = crc32c(0, bytes(stored))
+        ctx.defer(abs_off, data)
+
+    def _punch(self, ctx: "_TxnCtx", o: dict, off: int,
+               length: int) -> None:
+        """Remove logical coverage of [off, off+length), splitting
+        boundary lextents; unreferenced blobs are freed."""
+        end = off + length
+        out = []
+        for le in o["lextents"]:
+            loff, llen, blob_id, boff = le
+            lend = loff + llen
+            if lend <= off or loff >= end:
+                out.append(le)
+                continue
+            if loff < off:          # head survives
+                out.append([loff, off - loff, blob_id, boff])
+            if lend > end:          # tail survives
+                out.append([end, lend - end, blob_id,
+                            boff + (end - loff)])
+        o["lextents"] = sorted(out)
+        ctx.gc_blobs(o)
+
+    # --------------------------------------------------------- read IO
+    def _read_stored(self, b: dict) -> bytes:
+        start, count = b["units"]
+        self._block.seek(start * self.min_alloc)
+        return self._block.read(b["stored"])
+
+    def _blob_raw(self, blob_id: int) -> bytes:
+        """Stored bytes -> raw bytes, csum-verified (every read passes
+        the at-rest checksum gate, ref: bluestore _verify_csum)."""
+        b = self._blobs.get(blob_id)
+        if b is None:
+            raise StoreError("EIO", f"missing blob {blob_id}")
+        stored = self._read_stored(b)
+        if crc32c(0, stored) != b["csum"]:
+            raise StoreError("EIO", f"blob {blob_id} checksum mismatch")
+        if b.get("comp") is not None:
+            return comp_mod.decompress(stored)
+        return stored
+
+    def _read_onode(self, o: dict, off: int, length: int) -> bytes:
+        if length == 0:
+            length = max(0, o["size"] - off)
+        out = bytearray(length)
+        for loff, llen, blob_id, boff in o["lextents"]:
+            lend = loff + llen
+            if lend <= off or loff >= off + length:
+                continue
+            raw = self._blob_raw(blob_id)
+            s = max(off, loff)
+            e = min(off + length, lend)
+            out[s - off:e - off] = raw[boff + (s - loff):
+                                       boff + (e - loff)]
+        return bytes(out[:max(0, min(length, o["size"] - off))])
+
+    # ----------------------------------------------- ObjectStore reads
+    def _obj(self, cid: str, oid: ObjectId) -> dict:
+        c = self._colls.get(cid)
+        if c is None:
+            raise StoreError("ENOENT", f"no collection {cid}")
+        o = c.get(oid)
+        if o is None:
+            raise StoreError("ENOENT", f"{cid}/{oid}")
+        return o
+
+    def read(self, cid: str, oid: ObjectId, off: int = 0,
+             length: int = 0) -> bytes:
+        with self._lock:
+            if ((cid, oid) in self._read_err_objs and
+                    global_config()["objectstore_debug_inject_read_err"]):
+                raise StoreError("EIO", f"injected read error {cid}/{oid}")
+            return self._read_onode(self._obj(cid, oid), off, length)
+
+    def stat(self, cid: str, oid: ObjectId) -> dict:
+        with self._lock:
+            return {"size": self._obj(cid, oid)["size"]}
+
+    def exists(self, cid: str, oid: ObjectId) -> bool:
+        with self._lock:
+            c = self._colls.get(cid)
+            return c is not None and oid in c
+
+    def getattr(self, cid: str, oid: ObjectId, name: str):
+        with self._lock:
+            o = self._obj(cid, oid)
+            if name not in o["attrs"]:
+                raise StoreError("ENODATA", f"{oid} xattr {name}")
+            return o["attrs"][name]
+
+    def getattrs(self, cid: str, oid: ObjectId) -> dict:
+        with self._lock:
+            return dict(self._obj(cid, oid)["attrs"])
+
+    def omap_get(self, cid: str, oid: ObjectId) -> dict[str, bytes]:
+        with self._lock:
+            self._obj(cid, oid)
+            return dict(self.db.get_by_prefix(
+                f"M{_okey(cid, oid)}"))
+
+    def list_collections(self) -> list[str]:
+        with self._lock:
+            return sorted(self._colls)
+
+    def collection_exists(self, cid: str) -> bool:
+        with self._lock:
+            return cid in self._colls
+
+    def collection_list(self, cid: str) -> list[ObjectId]:
+        with self._lock:
+            c = self._colls.get(cid)
+            if c is None:
+                raise StoreError("ENOENT", f"no collection {cid}")
+            return sorted(c)
+
+    def statfs(self) -> dict:
+        with self._lock:
+            total = global_config()["memstore_device_bytes"]
+            used = (self._units - len(self._free)) * self.min_alloc
+            return {"total": total, "used": used,
+                    "available": max(0, total - used)}
+
+    # --------------------------------------------------- fault hooks
+    def inject_read_err(self, cid: str, oid: ObjectId) -> None:
+        self._read_err_objs.add((cid, oid))
+
+    def clear_read_err(self, cid: str, oid: ObjectId) -> None:
+        self._read_err_objs.discard((cid, oid))
+
+    def corrupt_blob_bytes(self, cid: str, oid: ObjectId,
+                           payload: bytes = b"ROT") -> None:
+        """Test hook: flip stored bytes under an object's first blob
+        WITHOUT updating its csum — simulated bitrot that the read
+        path's checksum gate must catch."""
+        with self._lock:
+            o = self._obj(cid, oid)
+            if not o["lextents"]:
+                raise StoreError("ENOENT", "object has no data blobs")
+            blob_id = o["lextents"][0][2]
+            b = self._blobs[blob_id]
+            self._block.seek(b["units"][0] * self.min_alloc)
+            self._block.write(payload)
+            self._block.flush()
+
+    # --------------------------------------------------------- fsck
+    def fsck(self) -> list[str]:
+        """Verify every blob's at-rest checksum + onode references
+        (ref: BlueStore::fsck)."""
+        errors = []
+        with self._lock:
+            for cid, objs in self._colls.items():
+                for oid, o in objs.items():
+                    for loff, llen, blob_id, boff in o["lextents"]:
+                        b = self._blobs.get(blob_id)
+                        if b is None:
+                            errors.append(
+                                f"{cid}/{oid}: dangling blob {blob_id}")
+                            continue
+                        stored = self._read_stored(b)
+                        if crc32c(0, stored) != b["csum"]:
+                            errors.append(
+                                f"{cid}/{oid}: csum mismatch in blob "
+                                f"{blob_id}")
+        return errors
+
+
+class _TxnCtx:
+    """One queue_transaction: shadow-validated metadata mutations +
+    ordered block-file effects, committed atomically through the KV
+    (ref: BlueStore TransContext)."""
+
+    def __init__(self, store: BlueStore):
+        self.s = store
+        self.kv = store.db.transaction()
+        self._colls: dict[str, dict] = {}        # shadow collections
+        self._coll_meta: dict[str, dict | None] = {}
+        self._onodes: dict[tuple, dict] = {}     # shadow onodes
+        self._blob_shadow: dict[int, dict] = {}
+        self._new_blobs: list[tuple[int, bytes]] = []  # id, stored
+        self._deferred: list[tuple[int, bytes]] = []
+        self._freed: list[int] = []
+        self._omap_ops: list[tuple] = []
+        self._removed_onodes: set = set()
+        self._moved: list[tuple] = []
+
+    # -- shadow views ---------------------------------------------------
+    def colls_view(self):
+        view = set(self.s._colls) | set(
+            c for c, m in self._coll_meta.items() if m is not None)
+        view -= {c for c, m in self._coll_meta.items() if m is None}
+        return view
+
+    def new_coll(self, cid: str, bits: int) -> None:
+        self._coll_meta[cid] = {"bits": bits}
+        self._colls[cid] = {}
+
+    def rm_coll(self, cid: str) -> None:
+        self.coll(cid)          # existence + emptiness checked by caller
+        self._coll_meta[cid] = None
+        self._colls.pop(cid, None)
+
+    def coll(self, cid: str) -> dict:
+        if cid in self._colls:
+            return self._colls[cid]
+        if self._coll_meta.get(cid, "absent") is None or \
+                (cid not in self.s._colls and cid not in self._coll_meta):
+            raise StoreError("ENOENT", f"no collection {cid}")
+        c = dict(self.s._colls.get(cid, {}))
+        self._colls[cid] = c
+        return c
+
+    def onode(self, cid: str, oid: ObjectId, create: bool = False) -> dict:
+        key = (cid, oid)
+        if key in self._onodes:
+            return self._onodes[key]
+        c = self.coll(cid)
+        o = c.get(oid)
+        if o is None:
+            if not create:
+                raise StoreError("ENOENT", f"no object {oid}")
+            o = {"size": 0, "attrs": {}, "lextents": []}
+        else:
+            o = {"size": o["size"], "attrs": dict(o["attrs"]),
+                 "lextents": [list(le) for le in o["lextents"]]}
+        c[oid] = o
+        self._onodes[key] = o
+        self._removed_onodes.discard(key)
+        return o
+
+    def blob_mutable(self, blob_id: int) -> dict:
+        b = self._blob_shadow.get(blob_id)
+        if b is None:
+            b = dict(self.s._blobs[blob_id])
+            self._blob_shadow[blob_id] = b
+        return b
+
+    # -- effects --------------------------------------------------------
+    def new_blob(self, raw: bytes) -> int:
+        s = self.s
+        stored, comp = raw, None
+        if s.compression != "none" and len(raw) >= s.comp_min_len:
+            packed = comp_mod.compress(raw, s.compression)
+            if len(packed) < len(raw):
+                stored, comp = packed, s.compression
+        n_units = (len(stored) + s.min_alloc - 1) // s.min_alloc
+        start = s._allocate(n_units)
+        blob_id = s._next_blob
+        s._next_blob += 1
+        b = {"units": (start, n_units), "stored": len(stored),
+             "raw": len(raw), "csum": crc32c(0, stored),
+             "comp": comp, "refs": 1}
+        self._blob_shadow[blob_id] = b
+        self._new_blobs.append((blob_id, stored))
+        return blob_id
+
+    def defer(self, abs_off: int, data: bytes) -> None:
+        self._deferred.append((abs_off, data))
+
+    def gc_blobs(self, o: dict) -> None:
+        # blob refcounts: decrement when an onode stops referencing;
+        # resolved at commit over the final shadow state
+        pass
+
+    def remove(self, cid: str, oid: ObjectId) -> None:
+        c = self.coll(cid)
+        if oid not in c:
+            raise StoreError("ENOENT", f"{cid}/{oid}")
+        del c[oid]
+        self._onodes.pop((cid, oid), None)
+        self._removed_onodes.add((cid, oid))
+        self._omap_ops.append(("clear", cid, oid))
+
+    def clone(self, cid: str, oid: ObjectId, noid: ObjectId) -> None:
+        c = self.coll(cid)
+        if oid not in c:
+            raise StoreError("ENOENT", f"{cid}/{oid}")
+        src = c[oid]
+        dst = {"size": src["size"], "attrs": dict(src["attrs"]),
+               "lextents": [list(le) for le in src["lextents"]]}
+        # blob reference increments resolve in commit()'s symmetric
+        # lextent-count delta (an eager bump here would double-count)
+        c[noid] = dst
+        self._onodes[(cid, noid)] = dst
+        self._removed_onodes.discard((cid, noid))
+        # omap is cloned too (MemStore semantics)
+        self._omap_ops.append(("clone", cid, oid, noid))
+
+    def move(self, oldcid: str, oldoid: ObjectId, cid: str,
+             oid: ObjectId) -> None:
+        src = self.coll(oldcid)
+        dst = self.coll(cid)
+        o = src.pop(oldoid)
+        dst[oid] = o
+        self._onodes.pop((oldcid, oldoid), None)
+        self._onodes[(cid, oid)] = o
+        self._removed_onodes.add((oldcid, oldoid))
+        self._removed_onodes.discard((cid, oid))
+        self._omap_ops.append(("move", oldcid, oldoid, cid, oid))
+
+    def omap_set(self, cid, oid, keys) -> None:
+        self._omap_ops.append(("set", cid, oid, dict(keys)))
+
+    def omap_rm(self, cid, oid, keys) -> None:
+        self._omap_ops.append(("rm", cid, oid, list(keys)))
+
+    def omap_clear(self, cid, oid) -> None:
+        self._omap_ops.append(("clear", cid, oid))
+
+    # -- commit ---------------------------------------------------------
+    def abort(self) -> None:
+        """Undo txn-local allocator effects after a failed op: units
+        taken for new blobs go back to the free pool (the metadata
+        shadow is simply dropped)."""
+        s = self.s
+        for blob_id, _stored in self._new_blobs:
+            b = self._blob_shadow.get(blob_id)
+            if b is None:
+                continue
+            start, count = b["units"]
+            s._free.update(range(start, start + count))
+
+    def commit(self) -> None:
+        s = self.s
+        # Blob reference resolution.  `refs` counts LEXTENT references
+        # (a punch can split one lextent into two referencing the same
+        # blob, a clone copies a whole map), so the delta must be
+        # symmetric: splits INCREASE the count — a decrement-only
+        # formula would free blob A while its tail lextent still
+        # points at it (silent data loss once units are reused).
+        refcount_after: dict[int, int] = {}
+        touched = set(self._onodes) | self._removed_onodes
+        for (cid, oid) in touched:
+            c = self._colls.get(cid, {})
+            o = c.get(oid)
+            if o is None:
+                continue
+            for le in o["lextents"]:
+                refcount_after[le[2]] = refcount_after.get(le[2], 0) + 1
+        before: dict[int, int] = {}
+        for (cid, oid) in touched:
+            old = s._colls.get(cid, {}).get(oid)
+            if old is None:
+                continue
+            for le in old["lextents"]:
+                before[le[2]] = before.get(le[2], 0) + 1
+        new_ids = {bid for bid, _ in self._new_blobs}
+        for blob_id in set(before) | set(refcount_after) | new_ids:
+            # new blobs carry refs=1 for the lextent that created them
+            base = before.get(blob_id, 0) + \
+                (1 if blob_id in new_ids else 0)
+            delta = refcount_after.get(blob_id, 0) - base
+            if delta == 0:
+                continue
+            b = self._blob_shadow.get(blob_id) or \
+                dict(s._blobs.get(blob_id, {"refs": 0}))
+            b["refs"] = b.get("refs", 1) + delta
+            self._blob_shadow[blob_id] = b
+            if b["refs"] <= 0:
+                self._freed.append(blob_id)
+
+        # 1) block-file writes for new blobs (free units; crash before
+        #    the KV commit leaves unreferenced garbage, never torn data)
+        for blob_id, stored in self._new_blobs:
+            b = self._blob_shadow[blob_id]
+            s._block.seek(b["units"][0] * s.min_alloc)
+            s._block.write(stored)
+        if self._new_blobs:
+            s._block.flush()
+            os.fsync(s._block.fileno())
+
+        # 2) one atomic KV commit: onodes, blobs, colls, omap, deferred
+        for cid, meta in self._coll_meta.items():
+            if meta is None:
+                self.kv.rmkey(P_COLL, cid)
+            else:
+                self.kv.set(P_COLL, cid, meta)
+        for (cid, oid) in self._removed_onodes:
+            self.kv.rmkey(P_ONODE, _okey(cid, oid))
+        for (cid, oid), o in self._onodes.items():
+            self.kv.set(P_ONODE, _okey(cid, oid), o)
+        for blob_id in self._freed:
+            self._blob_shadow.pop(blob_id, None)
+            self.kv.rmkey(P_BLOB, str(blob_id))
+        for blob_id, b in self._blob_shadow.items():
+            self.kv.set(P_BLOB, str(blob_id), b)
+        self._commit_omap()
+        defer_keys = []
+        for i, (abs_off, data) in enumerate(self._deferred):
+            key = f"{abs_off}.{i}"
+            defer_keys.append(key)
+            self.kv.set(P_DEFER, key, {"off": abs_off, "data": data})
+        s.db.submit_transaction(self.kv)
+
+        # 3) apply deferred in place + clear the records
+        if self._deferred:
+            for abs_off, data in self._deferred:
+                s._block.seek(abs_off)
+                s._block.write(data)
+            s._block.flush()
+            os.fsync(s._block.fileno())
+            t2 = s.db.transaction()
+            for key in defer_keys:
+                t2.rmkey(P_DEFER, key)
+            s.db.submit_transaction(t2)
+
+        # 4) in-memory cutover + unit free
+        for cid, meta in self._coll_meta.items():
+            if meta is None:
+                s._colls.pop(cid, None)
+        for cid, objs in self._colls.items():
+            s._colls[cid] = objs
+        for blob_id, b in self._blob_shadow.items():
+            s._blobs[blob_id] = b
+        for blob_id in self._freed:
+            b = s._blobs.pop(blob_id, None)
+            if b is not None:
+                start, count = b["units"]
+                s._free.update(range(start, start + count))
+
+    def _commit_omap(self) -> None:
+        s = self.s
+        for op in self._omap_ops:
+            kind = op[0]
+            if kind == "set":
+                _, cid, oid, keys = op
+                pfx = f"M{_okey(cid, oid)}"
+                for k, v in keys.items():
+                    self.kv.set(pfx, k, bytes(v))
+            elif kind == "rm":
+                _, cid, oid, keys = op
+                pfx = f"M{_okey(cid, oid)}"
+                for k in keys:
+                    self.kv.rmkey(pfx, k)
+            elif kind == "clear":
+                _, cid, oid = op
+                self.kv.rmkeys_by_prefix(f"M{_okey(cid, oid)}")
+            elif kind == "clone":
+                _, cid, oid, noid = op
+                src = s.db.get_by_prefix(f"M{_okey(cid, oid)}")
+                # include keys set earlier in THIS txn
+                pfx = f"M{_okey(cid, noid)}"
+                self.kv.rmkeys_by_prefix(pfx)
+                for k, v in src.items():
+                    self.kv.set(pfx, k, v)
+            elif kind == "move":
+                _, oldcid, oldoid, cid, oid = op
+                oldpfx = f"M{_okey(oldcid, oldoid)}"
+                newpfx = f"M{_okey(cid, oid)}"
+                vals = s.db.get_by_prefix(oldpfx)
+                self.kv.rmkeys_by_prefix(oldpfx)
+                for k, v in vals.items():
+                    self.kv.set(newpfx, k, v)

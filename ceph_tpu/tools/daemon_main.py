@@ -63,8 +63,15 @@ def run_osd(args) -> int:
     mons = [f"mon.{r}" for r in mm.get("mon_ranks", [0])]
     store = None
     if args.data_dir:
-        from ..store import JournaledStore
-        store = JournaledStore(args.data_dir)
+        if getattr(args, "objectstore", "bluestore") == "journaled":
+            from ..store import JournaledStore
+            store = JournaledStore(args.data_dir)
+        else:
+            # the durable default (ref: bluestore as the OSD default;
+            # JournaledStore retired to an opt-in legacy engine)
+            from ..store import BlueStore
+            store = BlueStore(args.data_dir)
+            store.mkfs()
         store.mount()
     keyring = None
     if args.keyring:
@@ -134,8 +141,11 @@ def main(argv=None) -> int:
     po.add_argument("--id", type=int, required=True)
     po.add_argument("--monmap", required=True)
     po.add_argument("--data-dir", default="",
-                    help="durable store directory (JournaledStore); "
+                    help="durable store directory (BlueStore); "
                          "in-memory when omitted")
+    po.add_argument("--objectstore", default="bluestore",
+                    choices=["bluestore", "journaled"],
+                    help="durable engine (journaled = legacy)")
     po.add_argument("--asok", default="",
                     help="admin socket path (`ceph daemon` endpoint)")
     po.add_argument("--keyring", default="",

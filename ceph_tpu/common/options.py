@@ -130,6 +130,21 @@ OPTIONS: dict[str, Option] = {opt.name: opt for opt in [
     _o("osd_max_markdown_count", T.UINT, 5, L.DEV),
     _o("osd_recovery_max_active", T.UINT, 3, runtime=True,
        desc="concurrent recovery ops per OSD shard"),
+    # mClock op-class QoS (ref: options.cc osd_mclock_scheduler_*)
+    _o("osd_mclock_client_wgt", T.FLOAT, 10.0, L.ADVANCED,
+       desc="client op-class weight", runtime=True),
+    _o("osd_mclock_recovery_res", T.FLOAT, 20.0, L.ADVANCED,
+       desc="recovery reservation, ops/s", runtime=True),
+    _o("osd_mclock_recovery_wgt", T.FLOAT, 1.0, L.ADVANCED,
+       desc="recovery op-class weight", runtime=True),
+    _o("osd_mclock_recovery_lim", T.FLOAT, 200.0, L.ADVANCED,
+       desc="recovery limit, ops/s (0 = unlimited)", runtime=True),
+    _o("osd_mclock_scrub_wgt", T.FLOAT, 1.0, L.ADVANCED,
+       desc="scrub op-class weight", runtime=True),
+    _o("osd_mclock_scrub_lim", T.FLOAT, 100.0, L.ADVANCED,
+       desc="scrub limit, ops/s (0 = unlimited)", runtime=True),
+    _o("mon_target_pg_per_osd", T.UINT, 100, L.ADVANCED,
+       desc="pg_autoscaler target PG replicas per OSD", runtime=True),
     _o("osd_ec_batch_stripes", T.UINT, 64, L.ADVANCED,
        desc="stripes batched per TPU encode dispatch"),
     # monitor (ref: options.cc mon_* family)

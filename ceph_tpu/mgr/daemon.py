@@ -41,6 +41,9 @@ class MgrDaemon(Dispatcher, MonHunter):
         self._sync_cmds: dict = {}            # tid -> (Event, slot)
         self.prometheus = None
         self.failed_commands = 0
+        #: pg_autoscaler module (ref: pybind/mgr/pg_autoscaler);
+        #: enable with start_pg_autoscaler(), driven by autoscale_tick
+        self.pg_autoscaler = None
         self._lock = threading.RLock()
         self.ms = Messenger.create(network, self.name, threaded=threaded)
         self.ms.add_dispatcher(self)
@@ -102,6 +105,19 @@ class MgrDaemon(Dispatcher, MonHunter):
                 self._sync_cmds.pop(tid, None)
             raise TimeoutError(f"mon command {cmd.get('prefix')!r}")
         return slot["r"], slot["outs"], slot["outb"]
+
+    def start_pg_autoscaler(self, **kw):
+        from .pg_autoscaler import PGAutoscaler
+        self.pg_autoscaler = PGAutoscaler(self, **kw)
+        return self.pg_autoscaler
+
+    def autoscale_tick(self, pool_bytes: dict | None = None) -> int:
+        """One pg_autoscaler round (scheduled alongside the balancer
+        tick the way the reference's module serve loops both run)."""
+        if self.pg_autoscaler is None:
+            return 0
+        with self._lock:
+            return self.pg_autoscaler.tick(pool_bytes)
 
     def start_prometheus(self, port: int = 0):
         """Serve /metrics (ref: pybind/mgr/prometheus)."""

@@ -455,6 +455,14 @@ class OSDMonitor(PaxosService):
                 n = int(val)
                 if var == "pg_num" and n < pool.pg_num:
                     return -EPERM, "pg_num reduction not supported", None
+                if var == "pgp_num" and n > pool.pgp_num:
+                    # growing pgp_num reseeds every PG's placement; the
+                    # scan-based recovery has no backfill-from-history
+                    # machinery to chase relocated data, so reseeding
+                    # could orphan split objects (split keeps children
+                    # on the parent's seed precisely to avoid this)
+                    return -EPERM, ("pgp_num growth (placement reseed) "
+                                    "is not supported"), None
                 setattr(pool, var, n)
                 if var == "pg_num":
                     pool.pgp_num = min(pool.pgp_num, n)

@@ -30,7 +30,7 @@ from ..msg.messages import (ECSubRead, ECSubReadReply, ECSubWrite,
                             ScrubMapReply, ScrubMapRequest)
 from ..msg.mon_client import MonHunter
 from ..msg.messenger import Dispatcher, LocalNetwork, Message, Messenger
-from ..store import MemStore, StoreError
+from ..store import MemStore, StoreError, Transaction
 from . import mutations as mut
 from .mutations import MutationError
 from .ec_backend import ECBackend, ECPGShard
@@ -62,6 +62,7 @@ class _PGState:
         self.scan_pending: set[int] = set()
         self.peer_objects: dict[int, dict] = {}   # osd -> {oid: size}
         self.pull_pending: set[str] = set()
+        self.push_pending = 0      # mClock-queued stale-peer pushes
         self.ec_jobs_pending = 0   # in-flight EC recover_object jobs
         self.ec_jobs_failed = False
         self.recovery_gen = 0      # invalidates stale job callbacks
@@ -112,6 +113,7 @@ class OSDDaemon(Dispatcher, MonHunter):
         self.osdmap = OSDMap()
         self.pgs: dict[PG, _PGState] = {}
         self._ecs: dict[str, object] = {}     # profile name -> plugin
+        self._pool_pg_num: dict[int, int] = {}   # split detection
         # shared across backend rebuilds: stale sub-replies must never
         # alias a new op's tid
         import itertools
@@ -143,6 +145,23 @@ class OSDDaemon(Dispatcher, MonHunter):
         self._hb_handle = self.hbmap.add_worker(
             f"{self.name}.tick",
             grace=4 * global_config()["osd_heartbeat_interval"])
+        # mClock op-class QoS (ref: src/osd/mClockOpClassQueue.h):
+        # client ops execute inline and are ACCOUNTED; recovery/scrub
+        # work is queued and paced by the two-phase scheduler
+        from .op_queue import MClockQueue
+        cfg = global_config()
+        self.op_queue = MClockQueue()
+        self.op_queue.set_class("client",
+                                weight=cfg["osd_mclock_client_wgt"])
+        rec_lim = cfg["osd_mclock_recovery_lim"]
+        self.op_queue.set_class(
+            "recovery", reservation=cfg["osd_mclock_recovery_res"],
+            weight=cfg["osd_mclock_recovery_wgt"], limit=rec_lim,
+            burst=max(8.0, rec_lim / 4) if rec_lim > 0 else 64.0)
+        self.op_queue.set_class(
+            "scrub", weight=cfg["osd_mclock_scrub_wgt"],
+            limit=cfg["osd_mclock_scrub_lim"])
+        self._qos_timer: threading.Timer | None = None
         # op counters (ref: src/osd/osd_perf_counters.cc l_osd_op*);
         # multi-cluster harnesses pass their own collection so two
         # same-named daemons never commingle counts
@@ -177,6 +196,8 @@ class OSDDaemon(Dispatcher, MonHunter):
     def shutdown(self) -> None:
         if self.asok is not None:
             self.asok.shutdown()
+        if self._qos_timer is not None:
+            self._qos_timer.cancel()
         self.ms.shutdown()
 
     # -------------------------------------------------- admin socket
@@ -257,6 +278,10 @@ class OSDDaemon(Dispatcher, MonHunter):
             # PG lock — PrimaryLogPG::do_request holds pg->lock)
             with self._lock:
                 self.op_tracker.mark((msg.src, msg.tid), "dispatched")
+                # client ops run inline (latency IS the product); the
+                # QoS queue accounts them so recovery/scrub shares are
+                # computed against real client load
+                self.op_queue.account("client")
                 self._handle_client_op(msg)
             return True
         if isinstance(msg, ECSubWrite):
@@ -340,16 +365,15 @@ class OSDDaemon(Dispatcher, MonHunter):
             self._handle_scan_reply(msg)
             return True
         if isinstance(msg, PGPull):
-            shard = self._replicated_view(msg.pgid)
+            # recovery pushes ride the mClock queue: a storm of pulls
+            # drains at the recovery class's reservation/limit instead
+            # of flooding the wire ahead of client ops
             for oid in msg.oids:
-                if not shard.exists(oid):
-                    continue
-                data, attrs, omap, hdr = shard.push_payload(oid)
-                self.ms.connect(msg.src).send_message(PGPush(
-                    pgid=msg.pgid, oid=oid, data=data, size=len(data),
-                    version=shard.object_version(oid),
-                    attrs=attrs, omap=omap, omap_hdr=hdr,
-                    clones=shard.clone_payloads(oid)))
+                self.op_queue.enqueue(
+                    "recovery",
+                    lambda pgid=msg.pgid, src=msg.src, oid=oid:
+                        self._send_recovery_push(pgid, src, oid))
+            self._drain_op_queue()
             return True
         if isinstance(msg, PGPush):
             self._handle_push(msg)
@@ -443,10 +467,53 @@ class OSDDaemon(Dispatcher, MonHunter):
             self._ecs[profile_name] = ec
         return ec
 
+    def _split_pgs(self) -> None:
+        """PG splitting: when a pool's pg_num grows (pg_autoscaler or
+        operator), locally re-home objects whose placement seed now
+        folds to a child PG (ref: OSD.cc split handling /
+        PG::split_colls — the reference splits collections the same
+        way; cross-OSD placement then converges via normal peering/
+        recovery)."""
+        m = self.osdmap
+        for pool_id, pool in m.pools.items():
+            old = self._pool_pg_num.get(pool_id)
+            self._pool_pg_num[pool_id] = pool.pg_num
+            if old is None or pool.pg_num <= old:
+                continue
+            prefix = f"pg_{pool_id}."
+            for cid in list(self.store.list_collections()):
+                if not cid.startswith(prefix):
+                    continue
+                try:
+                    ps = int(cid[len(prefix):], 16)
+                except ValueError:
+                    continue
+                # one batched transaction per source collection: a
+                # per-object txn would fsync the KV WAL once per moved
+                # object on BlueStore
+                txn = Transaction()
+                made: set[str] = set()
+                for oid in list(self.store.collection_list(cid)):
+                    if oid.name == "pgmeta":
+                        continue
+                    raw = m.object_locator_to_pg(oid.name, pool_id)
+                    child = pool.raw_pg_to_pg(raw)
+                    if child.ps == ps:
+                        continue
+                    ccid = f"pg_{child}"
+                    if ccid not in made and \
+                            not self.store.collection_exists(ccid):
+                        txn.create_collection(ccid)
+                        made.add(ccid)
+                    txn.collection_move_rename(cid, oid, ccid, oid)
+                if not txn.empty():
+                    self.store.queue_transaction(txn)
+
     def _update_pgs(self) -> None:
         """Instantiate/refresh services for PGs mapped onto this OSD
         (ref: OSD.cc consume_map -> split/instantiate PGs)."""
         m = self.osdmap
+        self._split_pgs()
         seen: set[PG] = set()
         for pool_id, pool in m.pools.items():
             for ps in range(pool.pg_num):
@@ -525,6 +592,57 @@ class OSDDaemon(Dispatcher, MonHunter):
     # what each peer lacks (ref: PG peering -> PrimaryLogPG recovery/
     # backfill, collapsed to scan/pull/push; client ops get ESTALE and
     # retry while this runs).
+    # ----------------------------------------------------- QoS drain
+    def _send_recovery_push(self, pgid, src, oid) -> None:
+        try:
+            shard = self._replicated_view(pgid)
+        except (KeyError, AttributeError):
+            return
+        if not shard.exists(oid):
+            return
+        data, attrs, omap, hdr = shard.push_payload(oid)
+        self.ms.connect(src).send_message(PGPush(
+            pgid=pgid, oid=oid, data=data, size=len(data),
+            version=shard.object_version(oid),
+            attrs=attrs, omap=omap, omap_hdr=hdr,
+            clones=shard.clone_payloads(oid)))
+
+    def _drain_op_queue(self) -> None:
+        """Run every currently-eligible queued item; if a backlog
+        remains, arm a timer for the next eligibility instant
+        (ref: the dmclock scheduler's next-request clock)."""
+        while True:
+            item = self.op_queue.dequeue()
+            if item is None:
+                break
+            try:
+                item()
+            except Exception:
+                import traceback
+                dout("osd", 0).write("%s: queued op failed: %s",
+                                     self.name,
+                                     traceback.format_exc())
+        nxt = self.op_queue.next_eligible()
+        if nxt is None:
+            return
+        import time as _t
+        delay = max(0.01, nxt - _t.monotonic())
+        with self._lock:
+            if self._qos_timer is not None:
+                return            # one pending timer is enough
+            t = threading.Timer(delay, self._qos_timer_fired)
+            t.daemon = True
+            self._qos_timer = t
+            t.start()
+
+    def _qos_timer_fired(self) -> None:
+        # clear BEFORE draining: the drain must be able to arm the
+        # next timer (checking is_alive() here would see ourselves
+        # and wedge the paced backlog forever)
+        with self._lock:
+            self._qos_timer = None
+        self._drain_op_queue()
+
     def _start_recovery(self, pg: PG, st: _PGState) -> None:
         peers = [o for o in st.acting if o >= 0 and o != self.whoami]
         st.peer_objects = {}
@@ -689,9 +807,14 @@ class OSDDaemon(Dispatcher, MonHunter):
 
         for oid, targets, ver in jobs:
             # stamp rebuilt shards with the authoritative version (the
-            # rebuilt primary's pg_log cannot supply it)
-            b.recover_object(oid, targets, on_done,
-                             version=EVersion(*ver))
+            # rebuilt primary's pg_log cannot supply it); jobs ride the
+            # mClock recovery class so a storm is paced, not a flood
+            self.op_queue.enqueue(
+                "recovery",
+                lambda b=b, oid=oid, targets=targets, ver=ver:
+                    b.recover_object(oid, targets, on_done,
+                                     version=EVersion(*ver)))
+        self._drain_op_queue()
 
     def _push_ec_tombstones(self, pg: PG, st: _PGState, oid: str,
                             ver: tuple, targets: list[int]) -> None:
@@ -776,22 +899,49 @@ class OSDDaemon(Dispatcher, MonHunter):
                 theirs = tuple(objs[oid][0]) if oid in objs else (0, 0)
                 if theirs < my_ver:
                     stale.setdefault(oid, []).append(osd)
+        st.push_pending = sum(len(osds) for osds in stale.values())
+        if not st.push_pending:
+            st.recovering = False
+            dout("osd", 10).write("%s: pg %s recovered", self.name, pg)
+            self._drain_op_queue()
+            return
         for oid, osds in stale.items():
+            for osd in osds:
+                # primary -> stale-peer pushes ride the mClock recovery
+                # class: the backfill-storm side of recovery QoS.
+                # recovering stays True until the LAST queued push is
+                # actually sent — pgs_recovering()==0 must mean the
+                # replicas really received their data, not that an
+                # in-memory queue still holds it
+                self.op_queue.enqueue(
+                    "recovery",
+                    lambda pg=pg, st=st, oid=oid, osd=osd:
+                        self._push_to_peer(pg, st, oid, osd))
+        self._drain_op_queue()
+
+    def _push_to_peer(self, pg: PG, st: _PGState, oid: str,
+                      osd: int) -> None:
+        try:
+            mine = st.shard.inventory()
+            if oid not in mine:
+                return
             my_ver, whiteout = mine[oid]
             if whiteout:
                 data, attrs, omap, hdr = b"", {}, {}, b""
             else:
                 data, attrs, omap, hdr = st.shard.push_payload(oid)
-            clones = st.shard.clone_payloads(oid)
-            for osd in osds:
-                self.perf.inc("recovery_push")
-                self.ms.connect(f"osd.{osd}").send_message(PGPush(
-                    pgid=pg, oid=oid, data=data, size=len(data),
-                    version=my_ver, whiteout=whiteout,
-                    attrs=attrs, omap=omap, omap_hdr=hdr,
-                    clones=clones))
-        st.recovering = False
-        dout("osd", 10).write("%s: pg %s recovered", self.name, pg)
+            self.perf.inc("recovery_push")
+            self.ms.connect(f"osd.{osd}").send_message(PGPush(
+                pgid=pg, oid=oid, data=data, size=len(data),
+                version=my_ver, whiteout=whiteout,
+                attrs=attrs, omap=omap, omap_hdr=hdr,
+                clones=st.shard.clone_payloads(oid)))
+        finally:
+            st.push_pending -= 1
+            if st.push_pending <= 0 and st.recovering:
+                st.recovering = False
+                dout("osd", 10).write("%s: pg %s recovered",
+                                      self.name, pg)
 
     def pgs_recovering(self) -> int:
         return sum(1 for st in self.pgs.values() if st.recovering)
@@ -1019,6 +1169,7 @@ class OSDDaemon(Dispatcher, MonHunter):
         `now` may be simulated time for deterministic tests; stamps
         echo through PingReply so the clocks stay consistent."""
         import time as _time
+        self._drain_op_queue()      # paced recovery/scrub backlog
         now = _time.monotonic() if now is None else now
         self.hbmap.reset_timeout(self._hb_handle)
         grace = global_config()["osd_heartbeat_grace"]

@@ -1,0 +1,246 @@
+"""mClock op-class QoS + pg_autoscaler + PG splitting
+(ref: src/osd/mClockOpClassQueue.h + dmclock;
+src/pybind/mgr/pg_autoscaler/; OSD split handling — VERDICT r2 #10)."""
+import time
+
+import numpy as np
+import pytest
+
+from ceph_tpu.osd.op_queue import MClockQueue
+from ceph_tpu.testing import MiniCluster
+
+
+# --------------------------------------------------------- queue unit
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_limit_caps_class_rate():
+    clk = FakeClock()
+    q = MClockQueue(clock=clk)
+    q.set_class("recovery", reservation=0, weight=1, limit=10,
+                burst=5)
+    for i in range(50):
+        q.enqueue("recovery", i)
+    # burst drains immediately, then the limit gates
+    got = []
+    while (item := q.dequeue()) is not None:
+        got.append(item)
+    assert len(got) == 5                 # burst capacity
+    assert q.dequeue() is None
+    clk.t += 0.5                         # 0.5s -> 5 tokens (cap=burst)
+    more = []
+    while (item := q.dequeue()) is not None:
+        more.append(item)
+    assert len(more) == 5
+    # long-run rate == limit when drained continuously
+    total = 0
+    for _ in range(10):
+        clk.t += 0.1                     # 1 token per step
+        while q.dequeue() is not None:
+            total += 1
+    assert total == 10                   # 10 ops over 1s at lim=10
+    assert q.stats()["recovery"]["deferred"] > 0
+
+
+def test_reservation_guarantees_minimum():
+    """A reserved class makes its minimum rate even when a heavier
+    competitor is backlogged."""
+    clk = FakeClock()
+    q = MClockQueue(clock=clk)
+    q.set_class("heavy", weight=100, limit=0)
+    q.set_class("reserved", reservation=10, weight=0.001, limit=0,
+                burst=1000)
+    for i in range(1000):
+        q.enqueue("heavy", ("h", i))
+    for i in range(100):
+        q.enqueue("reserved", ("r", i))
+    clk.t += 2.0                       # 2s of reservation accrual
+    got = [q.dequeue() for _ in range(40)]
+    reserved = [g for g in got if g and g[0] == "r"]
+    # >= 10/s * 2s = 20 reserved items must have run
+    assert len(reserved) >= 20
+
+
+def test_weight_splits_excess():
+    clk = FakeClock()
+    q = MClockQueue(clock=clk)
+    q.set_class("a", weight=3)
+    q.set_class("b", weight=1)
+    for i in range(400):
+        q.enqueue("a", ("a", i))
+        q.enqueue("b", ("b", i))
+    got = [q.dequeue() for _ in range(200)]
+    a = sum(1 for g in got if g[0] == "a")
+    b = sum(1 for g in got if g[0] == "b")
+    assert a / max(b, 1) > 2.0           # ~3:1 split
+
+
+def test_account_consumes_share():
+    """Inline (client) ops advance the class tags so queued classes
+    see the real load."""
+    clk = FakeClock()
+    q = MClockQueue(clock=clk)
+    q.set_class("client", weight=10)
+    q.set_class("recovery", weight=1)
+    for _ in range(30):
+        q.account("client")
+    q.enqueue("recovery", "r0")
+    assert q.dequeue() == "r0"           # idle excess still flows
+
+
+# --------------------------------------- recovery storm, bounded impact
+
+def test_recovery_storm_client_latency_bounded():
+    """Kill + revive an OSD under many objects: recovery floods are
+    paced by the mClock queue while client IO keeps completing."""
+    from ceph_tpu.common.options import global_config
+    g = global_config()
+    old = (g["osd_mclock_recovery_lim"],)
+    g.set("osd_mclock_recovery_lim", 40.0)   # tight pacing, burst 10
+    c = MiniCluster(n_osd=4, threaded=True)
+    try:
+        c.wait_all_up()
+        r = c.rados()
+        # few PGs -> each PG's _finish_recovery enqueues a dense burst
+        # of pushes (deterministically larger than the token bucket)
+        r.pool_create("q", pg_num=4)
+        io = r.open_ioctx("q")
+        rng = np.random.default_rng(2)
+        for i in range(200):
+            io.write_full(f"s{i}", rng.integers(
+                0, 256, 4000, dtype=np.uint8).tobytes())
+        c.kill_osd(3)
+        r.mon_command({"prefix": "osd down", "ids": [3]})
+        r.mon_command({"prefix": "osd out", "ids": [3]})
+        for i in range(200, 240):        # writes while it is out
+            io.write_full(f"s{i}", b"x" * 2000)
+        c.revive_osd(3)                  # storm: osd.3 must backfill
+        r.mon_command({"prefix": "osd in", "ids": [3]})
+        # client IO during the storm: every op bounded + correct
+        lat = []
+        for i in range(30):
+            t0 = time.monotonic()
+            io.write_full(f"live{i}", b"y" * 1000)
+            assert io.read(f"live{i}") == b"y" * 1000
+            lat.append(time.monotonic() - t0)
+        assert max(lat) < 10.0, f"client latency spiked: {max(lat)}"
+        # recovery completes (ticks drain the paced queue)
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            c.tick()
+            if all(d.pgs_recovering() == 0 and len(d.op_queue) == 0
+                   for d in c.osds.values()):
+                break
+            time.sleep(0.2)
+        for i in range(240):
+            assert io.read(f"s{i}") is not None
+        # pacing engaged at some point: pushes were deferred (counter
+        # is cumulative, so this is safe to read after completion)
+        deferred = sum(
+            d.op_queue.stats()["recovery"]["deferred"]
+            for d in c.osds.values())
+        assert deferred > 0, \
+            "recovery pacing never engaged during the storm"
+    finally:
+        g.set("osd_mclock_recovery_lim", old[0])
+        c.shutdown()
+
+
+# ------------------------------------------- pg_autoscaler + splitting
+
+def test_pg_split_preserves_objects():
+    """Growing pg_num re-homes objects into child PGs (OSD-side
+    collection split) with no reads lost."""
+    c = MiniCluster(n_osd=4, threaded=True)
+    try:
+        c.wait_all_up()
+        r = c.rados()
+        r.pool_create("sp", pg_num=4)
+        io = r.open_ioctx("sp")
+        rng = np.random.default_rng(4)
+        objs = {f"o{i}": rng.integers(0, 256, 2000 + i,
+                                      dtype=np.uint8).tobytes()
+                for i in range(60)}
+        for k, v in objs.items():
+            io.write_full(k, v)
+        rc, outs, _ = r.mon_command({"prefix": "osd pool set",
+                                     "pool": "sp", "var": "pg_num",
+                                     "val": "16"})
+        assert rc == 0, outs
+        # pgp_num growth (placement reseed) is refused — split children
+        # must stay on the parent's seed or they could orphan data
+        rc2, outs2, _ = r.mon_command({"prefix": "osd pool set",
+                                       "pool": "sp", "var": "pgp_num",
+                                       "val": "16"})
+        assert rc2 < 0 and "not supported" in outs2
+        # wait for the map + split + re-peering to settle
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            c.tick()
+            if all(d.osdmap.pools.get(0) is not None and
+                   d.pgs_recovering() == 0
+                   for d in c.osds.values()):
+                try:
+                    if all(io.read(k) == v for k, v in objs.items()):
+                        break
+                except Exception:
+                    pass
+            time.sleep(0.2)
+        for k, v in objs.items():
+            assert io.read(k) == v, f"{k} lost across the split"
+    finally:
+        c.shutdown()
+
+
+def test_pg_autoscaler_grows_undersized_pool():
+    c = MiniCluster(n_osd=6, threaded=True)
+    try:
+        c.wait_all_up()
+        r = c.rados()
+        r.pool_create("tiny", pg_num=4)   # far below target
+        io = r.open_ioctx("tiny")
+        io.write_full("seed", b"z" * 1000)
+        mgr = c.start_mgr()
+        deadline = time.monotonic() + 30
+        while mgr.osdmap.epoch == 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        auto = mgr.start_pg_autoscaler()
+        sent = mgr.autoscale_tick()
+        assert sent >= 1
+        plan = auto.status()
+        tiny = next(p for p in plan if p["pool_name"] == "tiny")
+        assert tiny["would_adjust"] and tiny["target"] > 4
+        # the mon applied it and data survives the split
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            c.tick()
+            pool = c.mon.osdmap.pools.get(
+                r.pool_lookup("tiny"))
+            if pool is not None and pool.pg_num == tiny["target"]:
+                break
+            time.sleep(0.2)
+        pool = c.mon.osdmap.pools[r.pool_lookup("tiny")]
+        assert pool.pg_num == tiny["target"]
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            c.tick()
+            try:
+                if io.read("seed") == b"z" * 1000:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.2)
+        assert io.read("seed") == b"z" * 1000
+        # steady state: a second tick makes no further change
+        mgr.autoscale_tick()
+        t2 = next(p for p in auto.status()
+                  if p["pool_name"] == "tiny")
+        assert not t2["would_adjust"]
+    finally:
+        c.shutdown()

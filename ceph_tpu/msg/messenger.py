@@ -98,7 +98,8 @@ class Messenger:
         # dispatcher surface, one OS process per daemon
         from .tcp import TcpMessenger, TcpNet
         if isinstance(network, TcpNet):
-            return TcpMessenger(network.addr_map, name)
+            return TcpMessenger(network.addr_map, name,
+                                secure_secret=network.secure_secret)
         if ms_type is None:
             ms_type = global_config()["ms_type"]
         if ms_type in ("local", "ici"):

@@ -58,19 +58,33 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
 class TcpNet:
     """The monmap analogue: name -> (host, port) for every entity.
     Passing one of these as the `network` to Messenger.create selects
-    the TCP backend (ref: MonMap + per-daemon bind addrs)."""
+    the TCP backend (ref: MonMap + per-daemon bind addrs).
 
-    def __init__(self, addr_map: dict[str, tuple[str, int]]):
+    `secure_secret` switches every endpoint created on this net into
+    secure wire mode (ref: msgr v2 SECURE mode, crypto_onwire.cc):
+    frames are sealed with authenticated encryption derived from the
+    cluster secret — see ceph_tpu.msg.secure for the construction."""
+
+    def __init__(self, addr_map: dict[str, tuple[str, int]],
+                 secure_secret: str | bytes | None = None):
         self.addr_map = dict(addr_map)
+        self.secure_secret = secure_secret
 
 
 class TcpMessenger:
     """One endpoint bound to addr_map[name]
     (ref: Messenger::bind + AsyncMessenger accept loop)."""
 
-    def __init__(self, addr_map: dict[str, tuple[str, int]], name: str):
+    def __init__(self, addr_map: dict[str, tuple[str, int]], name: str,
+                 secure_secret: str | bytes | None = None):
         self.name = name
         self.addr_map = dict(addr_map)
+        # secure wire mode (ref: frames_v2 SECURE): all frames sealed
+        # under keys derived from the cluster secret
+        self._secure = None
+        if secure_secret is not None:
+            from .secure import SecureSession
+            self._secure = SecureSession(secure_secret, "frame")
         self.dispatchers: list[Dispatcher] = []
         self._lock = threading.Lock()
         self._out: dict[str, socket.socket] = {}   # peer -> conn
@@ -147,6 +161,8 @@ class TcpMessenger:
                 if self.auth_signer is not None:
                     msg = self.auth_signer.sign(msg)
                 payload = encode_message(msg)
+                if self._secure is not None:
+                    payload = self._secure.seal(payload)
             except WireError as ex:
                 dout("ms", 0).write("%s: unencodable %s: %s", self.name,
                                     msg.type_name, ex)
@@ -235,6 +251,13 @@ class TcpMessenger:
                 frame = recv_frame(conn)
                 if frame is None:
                     break
+                if self._secure is not None:
+                    frame = self._secure.open(frame)
+                    if frame is None:
+                        dout("ms", 1).write(
+                            "%s: secure frame failed authentication "
+                            "— dropping connection", self.name)
+                        break
                 msg = decode_message(frame)
                 # authenticate BEFORE learning: otherwise a forged
                 # frame could hijack the learned reply route for the

@@ -1,0 +1,146 @@
+"""Secure wire mode: sealed TCP frames (ref: msgr v2 SECURE mode,
+src/msg/async/crypto_onwire.cc — closing VERDICT r2 missing #9)."""
+import socket
+import struct
+import threading
+
+import pytest
+
+from ceph_tpu.msg.secure import SecureSession
+from ceph_tpu.msg.tcp import (TcpNet, pick_free_ports, recv_frame,
+                              send_frame)
+from ceph_tpu.msg.messenger import Dispatcher, Messenger
+
+
+def test_session_roundtrip_and_tamper():
+    s = SecureSession("s3cret", "frame")
+    for size in (0, 1, 100, 5000, 1 << 16):
+        pt = bytes(range(256)) * (size // 256) + b"x" * (size % 256)
+        blob = s.seal(pt)
+        assert blob != pt
+        assert s.open(blob) == pt
+        # every bit flip must fail authentication
+        bad = bytearray(blob)
+        bad[len(bad) // 2] ^= 1
+        assert s.open(bytes(bad)) is None
+    # wrong key never opens
+    other = SecureSession("wrong", "frame")
+    assert other.open(s.seal(b"secret data")) is None
+    # nonces differ: same plaintext -> different ciphertext
+    assert s.seal(b"same") != s.seal(b"same")
+
+
+def test_no_plaintext_on_the_wire():
+    """Sniff the raw socket bytes between two secure endpoints: the
+    payload marker must never appear in the clear."""
+    from ceph_tpu.msg.messages import OSDOp
+    ports = pick_free_ports(2)
+    addrs = {"osd.0": ("127.0.0.1", ports[0]),
+             "osd.1": ("127.0.0.1", ports[1])}
+    marker = b"TOP-SECRET-PAYLOAD-MARKER"
+    captured = {}
+    done = threading.Event()
+
+    # raw listener standing in for osd.1 (no decryption)
+    lsock = socket.socket()
+    lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lsock.bind(("127.0.0.1", ports[1]))
+    lsock.listen(1)
+
+    def sniff():
+        conn, _ = lsock.accept()
+        captured["frame"] = recv_frame(conn)
+        done.set()
+        conn.close()
+
+    threading.Thread(target=sniff, daemon=True).start()
+    net = TcpNet(addrs, secure_secret="cluster-key")
+    ms = Messenger.create(net, "osd.0")
+    ms.start()
+    assert ms.connect("osd.1").send_message(OSDOp(oid="o", op="write",
+                                                  data=marker))
+    assert done.wait(10)
+    assert marker not in captured["frame"]
+    ms.shutdown()
+    lsock.close()
+
+
+def test_secure_endpoints_interoperate_and_reject_plaintext():
+    from ceph_tpu.msg.messages import OSDOp
+    ports = pick_free_ports(2)
+    addrs = {"osd.0": ("127.0.0.1", ports[0]),
+             "osd.1": ("127.0.0.1", ports[1])}
+    net = TcpNet(addrs, secure_secret="cluster-key")
+    got = []
+    ev = threading.Event()
+
+    class D(Dispatcher):
+        def ms_dispatch(self, msg):
+            got.append(msg)
+            ev.set()
+            return True
+
+        def ms_handle_reset(self, peer):
+            pass
+
+    a = Messenger.create(net, "osd.0")
+    b = Messenger.create(net, "osd.1")
+    b.add_dispatcher(D())
+    a.add_dispatcher(D())
+    a.start()
+    b.start()
+    assert a.connect("osd.1").send_message(
+        OSDOp(oid="x", op="write", data=b"over the sealed wire"))
+    assert ev.wait(10)
+    assert got[0].data == b"over the sealed wire"
+    # a plaintext (or wrong-key) frame into a secure listener is
+    # dropped without dispatch
+    ev.clear()
+    got.clear()
+    from ceph_tpu.msg.encoding import encode_message
+    raw = socket.create_connection(addrs["osd.1"], timeout=5)
+    send_frame(raw, encode_message(OSDOp(oid="evil", op="write")))
+    assert not ev.wait(0.5)
+    assert not got
+    raw.close()
+    a.shutdown()
+    b.shutdown()
+
+
+def test_secure_cluster_io():
+    """Full mon+OSD cluster over sealed TCP frames, client included."""
+    import os
+    from ceph_tpu.client import Rados
+    from ceph_tpu.mon.monitor import Monitor, build_initial
+    from ceph_tpu.osd.daemon import OSDDaemon
+
+    names = ["mon.0", "osd.0", "osd.1", "osd.2"]
+    ports = pick_free_ports(len(names))
+    addrs = {n: ("127.0.0.1", p) for n, p in zip(names, ports)}
+    net = TcpNet(addrs, secure_secret="cluster-secret")
+    m, w = build_initial(3, osds_per_host=1)
+    mon = Monitor(net, rank=0, initial_map=m, initial_wrapper=w)
+    mon.init()
+    osds = [OSDDaemon(net, i, threaded=True) for i in range(3)]
+    for d in osds:
+        d.init()
+    r = Rados(net, name="client.960", op_timeout=15.0)
+    try:
+        r.connect(30.0)
+        import time
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 30:
+            if sum(1 for o in range(3)
+                   if r.objecter.osdmap.is_up(o)) == 3:
+                break
+            time.sleep(0.1)
+        r.pool_create("sec", pg_num=8)
+        io = r.open_ioctx("sec")
+        payload = os.urandom(100_000)
+        io.write_full("sealed", payload)
+        assert io.read("sealed") == payload
+    finally:
+        r.shutdown()
+        for d in osds:
+            d.shutdown()
+        mon.shutdown()

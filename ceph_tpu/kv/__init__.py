@@ -73,6 +73,10 @@ class KeyValueDB(abc.ABC):
     def iterator(self, prefix: str) -> Iterator[tuple[str, Any]]:
         """Sorted (key, value) pairs under a prefix."""
 
+    @abc.abstractmethod
+    def all_items(self) -> Iterator[tuple[tuple[str, str], Any]]:
+        """Every ((prefix, key), value) pair (whole-store loads)."""
+
     def close(self) -> None:
         pass
 
@@ -102,6 +106,10 @@ class MemDB(KeyValueDB):
 
     def iterator(self, prefix: str):
         return iter(sorted(self.get_by_prefix(prefix).items()))
+
+    def all_items(self):
+        with self._lock:
+            return list(self._data.items())
 
 
 def _apply(data: dict, ops) -> None:
@@ -208,6 +216,10 @@ class LogDB(KeyValueDB):
 
     def iterator(self, prefix: str):
         return iter(sorted(self.get_by_prefix(prefix).items()))
+
+    def all_items(self):
+        with self._lock:
+            return list(self._data.items())
 
     def wal_size(self) -> int:
         with self._lock:

@@ -1,0 +1,144 @@
+"""Durable mon store on the KeyValueDB engine (ref: MonitorDBStore on
+RocksDB, src/mon/MonitorDBStore.h — closing the 'mon store is ad-hoc'
+gap from VERDICT r2)."""
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from ceph_tpu.kv import LogDB
+from ceph_tpu.mon.store import MonitorStore, StoreTransaction
+
+
+def test_kv_backed_store_persists(tmp_path):
+    st = MonitorStore(LogDB(str(tmp_path / "mon")))
+    tx = StoreTransaction()
+    tx.put("osdmap", "last_committed", 7)
+    tx.put("osdmap", "full_7", b"blob")
+    tx.put("paxos", "3", b"v3")
+    st.apply_transaction(tx)
+    tx = StoreTransaction()
+    tx.erase_range("paxos", 0, 3)
+    st.apply_transaction(tx)
+    st.db.close()
+    st2 = MonitorStore(LogDB(str(tmp_path / "mon")))
+    assert st2.get("osdmap", "last_committed") == 7
+    assert st2.get("osdmap", "full_7") == b"blob"
+    assert st2.get("paxos", "3") == b"v3"
+    st2.db.close()
+
+
+def test_mon_resumes_from_kv_store(tmp_path):
+    """A mon constructed on a committed KV store resumes (no
+    bootstrap): pools and epochs survive the restart."""
+    from ceph_tpu.mon.monitor import Monitor, build_initial
+    from ceph_tpu.msg.messenger import LocalNetwork
+
+    net = LocalNetwork()
+    m, w = build_initial(3, osds_per_host=1)
+    store = MonitorStore(LogDB(str(tmp_path / "mon")))
+    mon = Monitor(net, rank=0, initial_map=m, initial_wrapper=w,
+                  store=store)
+    mon.init()
+    rc, outs, _ = mon.handle_command({
+        "prefix": "osd pool create", "pool": "persist", "pg_num": 8})
+    assert rc == 0, outs
+    epoch = mon.osdmap.epoch
+    mon.shutdown()
+    store.db.close()
+
+    net2 = LocalNetwork()
+    store2 = MonitorStore(LogDB(str(tmp_path / "mon")))
+    assert not store2.empty
+    mon2 = Monitor(net2, rank=0, store=store2)
+    mon2.init()
+    assert mon2.osdmap.epoch == epoch
+    assert "persist" in mon2.osdmap.pool_names.values()
+    mon2.shutdown()
+    store2.db.close()
+
+
+@pytest.mark.slow
+def test_multiprocess_mon_kill9_restart(tmp_path):
+    """SIGKILL the mon process and restart it on its KV data dir: the
+    cluster map (pools, epochs) survives and clients keep working."""
+    import json
+    from ceph_tpu.client import Rados
+    from ceph_tpu.msg.tcp import TcpNet, pick_free_ports
+
+    names = ["mon.0", "osd.0", "osd.1", "osd.2"]
+    ports = pick_free_ports(len(names))
+    addrs = {n: ["127.0.0.1", p] for n, p in zip(names, ports)}
+    mpath = tmp_path / "mm.json"
+    mpath.write_text(json.dumps(
+        {"addrs": addrs, "mon_ranks": [0], "n_osd": 3,
+         "osds_per_host": 1}))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.getcwd())
+
+    def start_mon():
+        return subprocess.Popen(
+            [sys.executable, "-m", "ceph_tpu.tools.daemon_main",
+             "mon", "--rank", "0", "--monmap", str(mpath),
+             "--data-dir", str(tmp_path / "mon0")], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+    procs = []
+    r = None
+    mon = start_mon()
+    try:
+        time.sleep(1.0)
+        for i in range(3):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "ceph_tpu.tools.daemon_main",
+                 "osd", "--id", str(i), "--monmap", str(mpath)],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT))
+        r = Rados(TcpNet({k: tuple(v) for k, v in addrs.items()}),
+                  name="client.980", op_timeout=10.0).connect(60.0)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if sum(1 for o in range(3)
+                   if r.objecter.osdmap.is_up(o)) == 3:
+                break
+            time.sleep(0.2)
+        r.pool_create("mp", pg_num=8)
+        io = r.open_ioctx("mp")
+        io.write_full("o", b"pre-crash")
+        mon.send_signal(signal.SIGKILL)
+        mon.wait(timeout=10)
+        mon = start_mon()
+        # the restarted mon must still know the pool: a fresh client
+        # learns the map from it and does IO
+        deadline = time.monotonic() + 60
+        ok = False
+        while time.monotonic() < deadline:
+            try:
+                r2 = Rados(TcpNet({k: tuple(v)
+                                   for k, v in addrs.items()}),
+                           name="client.981",
+                           op_timeout=8.0).connect(20.0)
+                io2 = r2.open_ioctx("mp")
+                if io2.read("o") == b"pre-crash":
+                    io2.write_full("o2", b"post-crash")
+                    ok = io2.read("o2") == b"post-crash"
+                    r2.shutdown()
+                    break
+                r2.shutdown()
+            except Exception:
+                pass
+            time.sleep(1.0)
+        assert ok, "cluster state lost across mon kill -9"
+    finally:
+        if r is not None:
+            r.shutdown()
+        for p in procs + [mon]:
+            p.terminate()
+        for p in procs + [mon]:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()

@@ -163,7 +163,8 @@ class RBD:
 
     def create(self, ioctx: IoCtx, name: str, size: int,
                order: int = RBD_DEFAULT_ORDER, stripe_unit: int = 0,
-               stripe_count: int = 1) -> None:
+               stripe_count: int = 1,
+               journaling: bool = False) -> None:
         if self._exists(ioctx, name):
             raise RBDError(17, f"image {name!r} exists")
         obj_size = 1 << order
@@ -173,6 +174,12 @@ class RBD:
         layout.validate()
         meta = {"size": size, "order": order, "stripe_unit": su,
                 "stripe_count": stripe_count}
+        if journaling:
+            # write-ahead mutation journal (ref: librbd journaling
+            # feature; consumed by ceph_tpu.rbd.mirror)
+            meta["journaling"] = True
+            from ..journal import Journaler
+            Journaler(ioctx, f"rbd.{name}", "master").create()
         ioctx.write_full(header_name(name), json.dumps(meta).encode())
 
     def remove(self, ioctx: IoCtx, name: str) -> None:
@@ -188,6 +195,8 @@ class RBD:
                 except RadosError:
                     pass
             img.object_map.remove()
+            if img._journal is not None:
+                img._journal.remove()
         finally:
             img.close()
         ioctx.remove(header_name(name))
@@ -270,6 +279,12 @@ class Image:
         self.snaps: dict[str, dict] = meta.get("snaps", {})
         self.parent: dict | None = meta.get("parent")
         self.meta_children: list = meta.get("children", [])
+        #: write-ahead mutation journal (ref: librbd journaling)
+        self.journaling = bool(meta.get("journaling"))
+        self._journal = None
+        if self.journaling:
+            from ..journal import Journaler
+            self._journal = Journaler(ioctx, f"rbd.{name}", "master")
         self._parent_image: "Image | None" = None
         self._snap_id: int | None = None
         if snapshot is not None:
@@ -432,6 +447,8 @@ class Image:
         self._check_open()
         self._check_writable()
         self._ensure_lock()
+        if self._journal is not None:
+            self._journal.append("resize", {"size": size})
         old_span = self._object_span()
         self.size = size
         new_span = self._object_span()
@@ -458,6 +475,8 @@ class Image:
             meta["parent"] = self.parent
         if self.meta_children:
             meta["children"] = self.meta_children
+        if self.journaling:
+            meta["journaling"] = True
         self.ioctx.write_full(header_name(self.name),
                               json.dumps(meta).encode())
 
@@ -468,6 +487,8 @@ class Image:
         self._ensure_lock()
         if snap_name in self.snaps:
             raise RBDError(17, f"snapshot {snap_name!r} exists")
+        if self._journal is not None:
+            self._journal.append("snap_create", {"name": snap_name})
         sid = self._wio.selfmanaged_snap_create()
         self.snaps[snap_name] = {"id": sid, "size": self.size}
         # fast-diff epoch: freeze the object map beside the snapshot,
@@ -487,6 +508,8 @@ class Image:
         if self.snaps[snap_name].get("protected"):
             raise RBDError(16, f"snapshot {snap_name!r} is protected")
         self._ensure_lock()
+        if self._journal is not None:
+            self._journal.append("snap_remove", {"name": snap_name})
         sid = self.snaps.pop(snap_name)["id"]
         self._wio.selfmanaged_snap_remove(sid)
         try:
@@ -518,6 +541,8 @@ class Image:
         self._refresh_header()
         if snap_name not in self.snaps:
             raise RBDError(2, f"snapshot {snap_name!r} not found")
+        if self._journal is not None:
+            self._journal.append("snap_protect", {"name": snap_name})
         self.snaps[snap_name]["protected"] = True
         self._save_meta()
 
@@ -531,6 +556,8 @@ class Image:
             raise RBDError(2, f"snapshot {snap_name!r} not found")
         if any(c[2] == snap_name for c in self.meta_children):
             raise RBDError(16, f"snapshot {snap_name!r} has clones")
+        if self._journal is not None:
+            self._journal.append("snap_unprotect", {"name": snap_name})
         self.snaps[snap_name].pop("protected", None)
         self._save_meta()
 
@@ -557,6 +584,8 @@ class Image:
         self._ensure_lock()
         if snap_name not in self.snaps:
             raise RBDError(2, f"snapshot {snap_name!r} not found")
+        if self._journal is not None:
+            self._journal.append("snap_rollback", {"name": snap_name})
         snap = self.snaps[snap_name]
         span = max(self._object_span(), self._span_for(snap["size"]))
         # fan the per-object rollbacks out like the write path: one
@@ -632,6 +661,11 @@ class Image:
         with self._iolock:
             self._ensure_lock()
             length = self._clip(offset, len(data))
+            if self._journal is not None and length:
+                # write-ahead: the event lands in the journal before
+                # the data objects (ref: librbd journaling ordering)
+                self._journal.append("write", {
+                    "off": offset, "data": bytes(data[:length])})
             obj_size = 1 << self.order
             over = self._overlap_span()
             futs = []
@@ -699,6 +733,9 @@ class Image:
         with self._iolock:
             self._ensure_lock()
             length = self._clip(offset, length)
+            if self._journal is not None and length:
+                self._journal.append("discard", {"off": offset,
+                                                 "len": length})
             obj_size = 1 << self.order
             over = self._overlap_span()
             for ext in Striper.file_to_extents(self.layout, offset,

@@ -469,17 +469,16 @@ def main(argv=None) -> int:
     import argparse
     ap = argparse.ArgumentParser(prog="ceph-tpu-rgw")
     ap.add_argument("--monmap", required=True)
+    ap.add_argument("--keyring", default="",
+                    help="keyring JSON (secure clusters / SigV4 auth)")
     ap.add_argument("--port", type=int, default=7480)
     ap.add_argument("--pool", default="rgw")
     a = ap.parse_args(argv)
-    import json as _json
     import os
     from ..client import Rados
-    from ..msg.tcp import TcpNet
-    with open(a.monmap) as f:
-        mm = _json.load(f)
-    addrs = {k: tuple(v) for k, v in mm["addrs"].items()}
-    r = Rados(TcpNet(addrs),
+    from ..tools.rados_cli import _net_from_monmap
+    net = _net_from_monmap(a.monmap, getattr(a, "keyring", ""))
+    r = Rados(net,
               name=f"client.rgw{os.getpid() % 10000}").connect()
     gw = RGWGateway(r, pool=a.pool, port=a.port)
     gw.start()

@@ -29,11 +29,29 @@ def load_monmap(path: str) -> dict:
     return mm
 
 
+
+def make_net(mm: dict, keyring) -> "TcpNet":
+    """TcpNet for this monmap; `"ms_secure_mode": true` in the monmap
+    switches every frame to sealed secure mode keyed by the keyring's
+    service secret (ref: msgr v2 secure mode; requires --keyring)."""
+    from ..msg.tcp import TcpNet
+    secret = None
+    if mm.get("ms_secure_mode"):
+        if keyring is None:
+            raise SystemExit("ms_secure_mode requires --keyring")
+        from ..auth import SERVICE_ENTITY
+        secret = keyring.get(SERVICE_ENTITY)
+        if secret is None:
+            # failing open to plaintext here would silently void the
+            # operator's secure-mode intent
+            raise SystemExit(
+                "ms_secure_mode: keyring has no service secret")
+    return TcpNet(mm["addrs"], secure_secret=secret)
+
 def run_mon(args) -> int:
     from ..mon.monitor import Monitor, build_initial
     from ..msg.tcp import TcpNet
     mm = load_monmap(args.monmap)
-    net = TcpNet(mm["addrs"])
     m, w = build_initial(mm.get("n_osd", 0),
                          osds_per_host=mm.get("osds_per_host", 1))
     ranks = mm.get("mon_ranks", [0])
@@ -41,7 +59,16 @@ def run_mon(args) -> int:
     if args.keyring:
         from ..auth import KeyRing
         keyring = KeyRing.load(args.keyring)
+    net = make_net(mm, keyring)
+    store = None
+    if args.data_dir:
+        # durable mon store on the KV engine (ref: MonitorDBStore on
+        # RocksDB): a restarted mon resumes from committed paxos state
+        from ..kv import LogDB
+        from ..mon.store import MonitorStore
+        store = MonitorStore(LogDB(args.data_dir))
     mon = Monitor(net, rank=args.rank, initial_map=m, initial_wrapper=w,
+                  store=store,
                   mon_ranks=ranks if len(ranks) > 1 else None,
                   keyring=keyring)
     mon.init()
@@ -59,7 +86,6 @@ def run_osd(args) -> int:
     from ..msg.tcp import TcpNet
     from ..osd.daemon import OSDDaemon
     mm = load_monmap(args.monmap)
-    net = TcpNet(mm["addrs"])
     mons = [f"mon.{r}" for r in mm.get("mon_ranks", [0])]
     store = None
     if args.data_dir:
@@ -77,6 +103,7 @@ def run_osd(args) -> int:
     if args.keyring:
         from ..auth import KeyRing
         keyring = KeyRing.load(args.keyring)
+    net = make_net(mm, keyring)
     d = OSDDaemon(net, args.id, mon=mons, store=store, keyring=keyring)
     d.init()
     if args.asok:
@@ -98,8 +125,12 @@ def run_mds(args) -> int:
     from ..fs.mds import MDSDaemon
     from ..msg.tcp import TcpNet
     mm = load_monmap(args.monmap)
-    net = TcpNet(mm["addrs"])
-    r = Rados(TcpNet(mm["addrs"]),
+    keyring = None
+    if getattr(args, "keyring", ""):
+        from ..auth import KeyRing
+        keyring = KeyRing.load(args.keyring)
+    net = make_net(mm, keyring)
+    r = Rados(make_net(mm, keyring),
               name=f"client.mds{os.getpid() % 10000}").connect()
     mds = MDSDaemon(net, r, rank=args.rank)
     mds.init()
@@ -133,6 +164,9 @@ def main(argv=None) -> int:
     pm = sub.add_parser("mon")
     pm.add_argument("--rank", type=int, default=0)
     pm.add_argument("--monmap", required=True)
+    pm.add_argument("--data-dir", default="",
+                    help="durable mon store directory (KV-backed); "
+                         "in-memory when omitted")
     pm.add_argument("--asok", default="",
                     help="admin socket path (`ceph daemon` endpoint)")
     pm.add_argument("--keyring", default="",
@@ -153,6 +187,8 @@ def main(argv=None) -> int:
     pd = sub.add_parser("mds")
     pd.add_argument("--rank", type=int, default=0)
     pd.add_argument("--monmap", required=True)
+    pd.add_argument("--keyring", default="",
+                    help="cephx keyring JSON (auth/secure clusters)")
     args = ap.parse_args(argv)
     return {"mon": run_mon, "osd": run_osd,
             "mds": run_mds}[args.role](args)

@@ -21,17 +21,32 @@ import sys
 import time
 
 
-def _connect(args):
+
+def _net_from_monmap(mm_path: str, keyring_path: str = ""):
+    """TcpNet honoring the monmap's ms_secure_mode (needs a keyring
+    with the service secret when secure)."""
     import json
-    from ..client import Rados
     from ..msg.tcp import TcpNet
-    with open(args.monmap) as f:
+    with open(mm_path) as f:
         mm = json.load(f)
     addrs = {k: tuple(v) for k, v in mm["addrs"].items()}
+    secret = None
+    if mm.get("ms_secure_mode"):
+        if not keyring_path:
+            raise SystemExit("secure cluster: pass --keyring")
+        from ..auth import SERVICE_ENTITY, KeyRing
+        secret = KeyRing.load(keyring_path).get(SERVICE_ENTITY)
+        if secret is None:
+            raise SystemExit("keyring has no service secret")
+    return TcpNet(addrs, secure_secret=secret)
+
+def _connect(args):
+    from ..client import Rados
     # ad-hoc client: not in the monmap — daemons answer over the
     # connections we open (learned-connection replies)
     name = f"client.{os.getpid() % 50000 + 10000}"
-    return Rados(TcpNet(addrs), name=name,
+    net = _net_from_monmap(args.monmap, getattr(args, "keyring", ""))
+    return Rados(net, name=name,
                  op_timeout=args.timeout).connect(args.timeout)
 
 
@@ -181,6 +196,8 @@ def main(argv=None, rados=None, out=None) -> int:
     ap = argparse.ArgumentParser(
         prog="rados", description="object store utility")
     ap.add_argument("--monmap", help="monmap JSON (TCP cluster)")
+    ap.add_argument("--keyring", default="",
+                    help="keyring JSON (secure-mode clusters)")
     ap.add_argument("--timeout", type=float, default=30.0)
     sub = ap.add_subparsers(dest="cmd", required=True)
     sub.add_parser("lspools")

@@ -55,12 +55,10 @@ def _split_spec(spec: str) -> tuple[str, str | None]:
 
 def _connect(args):
     from ..client import Rados
-    from ..msg.tcp import TcpNet
-    with open(args.monmap) as f:
-        mm = json.load(f)
-    addrs = {k: tuple(v) for k, v in mm["addrs"].items()}
+    from .rados_cli import _net_from_monmap
     name = f"client.{os.getpid() % 50000 + 10000}"
-    return Rados(TcpNet(addrs), name=name,
+    net = _net_from_monmap(args.monmap, getattr(args, "keyring", ""))
+    return Rados(net, name=name,
                  op_timeout=args.timeout).connect(args.timeout)
 
 
@@ -180,6 +178,8 @@ def main(argv=None, rados=None, out=None) -> int:
     out = out or sys.stdout
     ap = argparse.ArgumentParser(prog="rbd")
     ap.add_argument("--monmap", help="cluster monmap json")
+    ap.add_argument("--keyring", default="",
+                    help="keyring JSON (secure-mode clusters)")
     ap.add_argument("-p", "--pool", default="rbd")
     ap.add_argument("--timeout", type=float, default=30.0)
     sub = ap.add_subparsers(dest="cmd", required=True)

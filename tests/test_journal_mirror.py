@@ -1,0 +1,98 @@
+"""Journal library + rbd-mirror-lite (ref: src/journal/ Journaler/
+ObjectRecorder/JournalTrimmer; src/tools/rbd_mirror/ + librbd
+journaling — closing VERDICT r2 'journal lib: no')."""
+import numpy as np
+import pytest
+
+from ceph_tpu.journal import Journaler
+from ceph_tpu.rbd import RBD, Image
+from ceph_tpu.rbd.mirror import ImageMirror
+from ceph_tpu.testing import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(n_osd=4, threaded=True)
+    c.wait_all_up()
+    r = c.rados()
+    r.pool_create("primary", pg_num=8)
+    r.pool_create("backup", pg_num=8)
+    yield c
+    c.shutdown()
+
+
+def test_journal_append_replay_commit_trim(cluster):
+    io = cluster.rados().open_ioctx("primary")
+    j = Journaler(io, "t1", "master", object_size=256)
+    j.create()
+    j.register_client()
+    for i in range(20):
+        j.append("ev", {"n": i, "blob": b"x" * 50})
+    got = []
+    pos = j.replay(lambda tag, d: got.append((tag, d["n"])))
+    assert [n for _t, n in got] == list(range(20))
+    j.commit(pos)
+    # a second client replays independently from its own position
+    j2 = Journaler(io, "t1", "peer", object_size=256)
+    j2.register_client()
+    got2 = []
+    pos2 = j2.replay(lambda tag, d: got2.append(d["n"]))
+    assert got2 == list(range(20))
+    j2.commit(pos2)
+    # trim removes whole objects all clients passed
+    removed = j.trim()
+    assert removed > 0
+    # new entries continue after the trim
+    j.append("ev", {"n": 99, "blob": b""})
+    more = []
+    j.replay(lambda tag, d: more.append(d["n"]), from_pos=pos)
+    assert more == [99]
+    assert set(j.clients()) == {"master", "peer"}
+
+
+def test_journal_torn_tail(cluster):
+    from ceph_tpu.journal import data_obj
+    io = cluster.rados().open_ioctx("primary")
+    j = Journaler(io, "torn", "master")
+    j.create()
+    j.register_client()
+    j.append("ok", {"v": 1})
+    # simulate a crash mid-append: garbage after the valid frame
+    io.append(data_obj("torn", 0), b"\x00\x01\x02torn!")
+    got = []
+    j.replay(lambda t, d: got.append(d["v"]))
+    assert got == [1]
+
+
+def test_rbd_mirror_replicates_image(cluster):
+    r = cluster.rados()
+    src = r.open_ioctx("primary")
+    dst = r.open_ioctx("backup")
+    RBD().create(src, "vm", size=1 << 20, order=16, journaling=True)
+    img = Image(src, "vm")
+    rng = np.random.default_rng(6)
+    b1 = rng.integers(0, 256, 70_000, dtype=np.uint8).tobytes()
+    img.write(0, b1)
+    img.write(3 << 16, b"tail-block" * 100)
+    m = ImageMirror(src, dst, "vm")
+    applied = m.sync()
+    assert applied >= 2
+    rep = Image(dst, "vm")
+    assert rep.read(0, 70_000) == b1
+    assert rep.read(3 << 16, 1000) == (b"tail-block" * 100)[:1000]
+    rep.close()
+    # incremental: new writes + discard + snapshot flow on next sync
+    img.write(100, b"UPDATED")
+    img.discard(3 << 16, 1 << 16)
+    img.snap_create("s1")
+    assert m.sync() >= 3
+    rep = Image(dst, "vm")
+    assert rep.read(100, 7) == b"UPDATED"
+    assert rep.read(3 << 16, 100) == b"\0" * 100
+    assert [s["name"] for s in rep.snap_list()] == ["s1"]
+    rep.close()
+    # nothing new -> no-op sync
+    assert m.sync() == 0
+    img.snap_remove("s1")
+    assert m.sync() == 1
+    img.close()

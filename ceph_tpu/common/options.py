@@ -178,6 +178,10 @@ OPTIONS: dict[str, Option] = {opt.name: opt for opt in [
     # fault injection (ref: options.cc:774 heartbeat_inject_failure,
     # :3565 osd_debug_inject_dispatch_delay)
     _o("heartbeat_inject_failure", T.SECS, 0.0, L.DEV, runtime=True),
+    _o("lockdep", T.BOOL, False, L.DEV,
+       desc="lock-order cycle detection on instrumented locks; read "
+            "at lock construction, so set it before daemons start "
+            "(ref: src/common/lockdep.cc)"),
     _o("osd_debug_inject_dispatch_delay_probability", T.FLOAT, 0.0,
        L.DEV, min=0.0, max=1.0, runtime=True),
     _o("objectstore_debug_inject_read_err", T.BOOL, False, L.DEV,

@@ -105,10 +105,40 @@ def compress(data: bytes, alg: str = "zlib",
     return _MAGIC + bytes([len(tag)]) + tag + packed
 
 
-def decompress(blob: bytes) -> bytes:
-    if blob[:len(_MAGIC)] != _MAGIC:
+def decompress(blob: bytes, max_len: int | None = None) -> bytes:
+    """`max_len` caps the DECOMPRESSED size (decompression-bomb guard
+    for network input — the reference's frame layer bounds post-
+    decompression size the same way)."""
+    if len(blob) <= len(_MAGIC) or blob[:len(_MAGIC)] != _MAGIC:
         raise ValueError("not a compressed blob")
     n = blob[len(_MAGIC)]
     off = len(_MAGIC) + 1
     alg = blob[off:off + n].decode()
-    return registry.create(alg).decompress(blob[off + n:])
+    body = blob[off + n:]
+    if max_len is None:
+        return registry.create(alg).decompress(body)
+    return _decompress_capped(alg, body, max_len)
+
+
+def _decompress_capped(alg: str, body: bytes, max_len: int) -> bytes:
+    """Incremental decompression that refuses to inflate past
+    max_len (stdlib decompressobj max_length)."""
+    if alg == "none":
+        if len(body) > max_len:
+            raise ValueError("blob exceeds max_len")
+        return bytes(body)
+    import bz2
+    import lzma
+    import zlib
+    d = {"zlib": zlib.decompressobj,
+         "bz2": bz2.BZ2Decompressor,
+         "lzma": lzma.LZMADecompressor}.get(alg)
+    if d is None:
+        raise ValueError(f"unsupported compressor {alg!r}")
+    obj = d()
+    # request one byte past the cap: an oversize stream shows up as
+    # len(out) == max_len + 1 (the decompressor stops at max_length)
+    out = obj.decompress(body, max_len + 1)
+    if len(out) > max_len:
+        raise ValueError("decompressed size exceeds max_len")
+    return out

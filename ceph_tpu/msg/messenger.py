@@ -99,7 +99,9 @@ class Messenger:
         from .tcp import TcpMessenger, TcpNet
         if isinstance(network, TcpNet):
             return TcpMessenger(network.addr_map, name,
-                                secure_secret=network.secure_secret)
+                                secure_secret=network.secure_secret,
+                                compress=network.compress,
+                                compress_min=network.compress_min)
         if ms_type is None:
             ms_type = global_config()["ms_type"]
         if ms_type in ("local", "ici"):

@@ -66,9 +66,16 @@ class TcpNet:
     cluster secret — see ceph_tpu.msg.secure for the construction."""
 
     def __init__(self, addr_map: dict[str, tuple[str, int]],
-                 secure_secret: str | bytes | None = None):
+                 secure_secret: str | bytes | None = None,
+                 compress: str | None = None,
+                 compress_min: int = 4096):
         self.addr_map = dict(addr_map)
         self.secure_secret = secure_secret
+        #: on-wire compression (ref: msgr v2 compression negotiation,
+        #: ms_osd_compress_mode): frames above compress_min bytes are
+        #: compressed with the named registry algorithm
+        self.compress = compress
+        self.compress_min = compress_min
 
 
 class TcpMessenger:
@@ -76,7 +83,9 @@ class TcpMessenger:
     (ref: Messenger::bind + AsyncMessenger accept loop)."""
 
     def __init__(self, addr_map: dict[str, tuple[str, int]], name: str,
-                 secure_secret: str | bytes | None = None):
+                 secure_secret: str | bytes | None = None,
+                 compress: str | None = None,
+                 compress_min: int = 4096):
         self.name = name
         self.addr_map = dict(addr_map)
         # secure wire mode (ref: frames_v2 SECURE): all frames sealed
@@ -85,6 +94,17 @@ class TcpMessenger:
         if secure_secret is not None:
             from .secure import SecureSession
             self._secure = SecureSession(secure_secret, "frame")
+        # on-wire compression (ref: msgr v2 compression / the
+        # compressor registry the reference wires into the messenger).
+        # Layering matches the reference: compress, THEN seal —
+        # ciphertext doesn't compress.  BOTH endpoints must share the
+        # setting (it travels in the monmap via "ms_compress", like
+        # ms_secure_mode) — the flag byte is only present when on.
+        self._compress = compress
+        self._compress_min = compress_min
+        if compress is not None:
+            from ..compressor import registry as _creg
+            _creg.create(compress)     # fail fast on unknown algs
         self.dispatchers: list[Dispatcher] = []
         self._lock = threading.Lock()
         self._out: dict[str, socket.socket] = {}   # peer -> conn
@@ -161,6 +181,13 @@ class TcpMessenger:
                 if self.auth_signer is not None:
                     msg = self.auth_signer.sign(msg)
                 payload = encode_message(msg)
+                if self._compress is not None:
+                    if len(payload) >= self._compress_min:
+                        from .. import compressor
+                        payload = b"\x01" + compressor.compress(
+                            payload, self._compress)
+                    else:
+                        payload = b"\x00" + payload
                 if self._secure is not None:
                     payload = self._secure.seal(payload)
             except WireError as ex:
@@ -258,6 +285,23 @@ class TcpMessenger:
                             "%s: secure frame failed authentication "
                             "— dropping connection", self.name)
                         break
+                if self._compress is not None:
+                    if not frame:
+                        break
+                    if frame[0] == 1:
+                        from .. import compressor
+                        try:
+                            # cap post-decompression size: a small
+                            # frame must not inflate into an OOM bomb
+                            frame = compressor.decompress(
+                                frame[1:], max_len=MAX_FRAME)
+                        except Exception as ex:
+                            dout("ms", 1).write(
+                                "%s: bad compressed frame: %s — "
+                                "dropping connection", self.name, ex)
+                            break
+                    else:
+                        frame = frame[1:]
                 msg = decode_message(frame)
                 # authenticate BEFORE learning: otherwise a forged
                 # frame could hijack the learned reply route for the

@@ -118,7 +118,8 @@ class OSDDaemon(Dispatcher, MonHunter):
         # alias a new op's tid
         import itertools
         self._tid_gen = itertools.count(1)
-        self._lock = threading.RLock()
+        from ..common.lockdep import make_lock
+        self._lock = make_lock(f"{self.name}.daemon")
         # heartbeat state (ref: OSD.cc heartbeat_* family)
         self._hb_last: dict[int, float] = {}   # peer -> last reply time
         self._hb_first: dict[int, float] = {}  # peer -> first ping time

@@ -385,7 +385,11 @@ class ECBackend:
         # a map change must not restart tids or a stale sub-reply could
         # alias a new op
         self._tid_gen = tid_gen
-        self._lock = threading.RLock()
+        from ..common.lockdep import make_lock
+        # name carries the daemon identity: several OSDs share one
+        # process in tests, and lockdep must see osd.0's and osd.1's
+        # backends for one PG as DIFFERENT locks
+        self._lock = make_lock(f"osd.{whoami}.ecbackend.{pgid}")
         # the three-queue pipeline (ref: ECBackend.h waiting_state/
         # waiting_reads/waiting_commit)
         self.waiting_state: list[_Write] = []

@@ -46,7 +46,8 @@ def make_net(mm: dict, keyring) -> "TcpNet":
             # operator's secure-mode intent
             raise SystemExit(
                 "ms_secure_mode: keyring has no service secret")
-    return TcpNet(mm["addrs"], secure_secret=secret)
+    return TcpNet(mm["addrs"], secure_secret=secret,
+                  compress=mm.get("ms_compress"))
 
 def run_mon(args) -> int:
     from ..mon.monitor import Monitor, build_initial

@@ -38,7 +38,8 @@ def _net_from_monmap(mm_path: str, keyring_path: str = ""):
         secret = KeyRing.load(keyring_path).get(SERVICE_ENTITY)
         if secret is None:
             raise SystemExit("keyring has no service secret")
-    return TcpNet(addrs, secure_secret=secret)
+    return TcpNet(addrs, secure_secret=secret,
+                  compress=mm.get("ms_compress"))
 
 def _connect(args):
     from ..client import Rados

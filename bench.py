@@ -37,7 +37,9 @@ K, M = 8, 4
 OBJECT_SIZE = 1 << 20            # 1 MiB
 CHUNK = OBJECT_SIZE // K         # 131072
 STRIPES = 256                    # objects per dispatch
-REPS = 50                        # scan-chained unique reps per measurement
+REPS = 100                       # scan-chained unique reps per measurement
+#                                  (longer chains average out the axon
+#                                  tunnel's run-to-run timing noise)
 
 
 def measure_cpu_avx2(mat: np.ndarray, data_rows: list) -> float | None:

@@ -48,10 +48,12 @@ DAEMON_PREFIXES = frozenset({"osd", "mon", "mds", "mgr", SERVICE_ENTITY})
 
 #: message types a *client*-class ticket may send to daemons
 #: (ref: the effect of default client caps: client ops + mon
-#: subscriptions/commands + mds requests; daemon-internal traffic
-#: like RepOpWrite/ECSubWrite/MMap/MOSDFailure is daemon-only)
+#: subscriptions/commands + mds requests + cap-release acks, which
+#: travel client->mds as MClientCaps; daemon-internal traffic like
+#: RepOpWrite/ECSubWrite/MMap/MOSDFailure is daemon-only)
 CLIENT_ALLOWED = frozenset({
-    "OSDOp", "MMonSubscribe", "MMonCommand", "MClientRequest"})
+    "OSDOp", "MMonSubscribe", "MMonCommand", "MClientRequest",
+    "MClientCaps"})
 
 #: replay-window size: how far behind the highest-seen signing seq a
 #: message may arrive before it is considered stale (tolerates
@@ -107,6 +109,25 @@ class KeyRing:
         """A daemon's keyring: its own key + the service secret."""
         return KeyRing({e: self.keys[e] for e in
                         (*entities, SERVICE_ENTITY) if e in self.keys})
+
+
+def attach_cephx(ms, entity: str, keyring: "KeyRing",
+                 verifier: bool = True) -> None:
+    """Wire a messenger for cephx: self-minted signer (daemons hold
+    the service secret — the reference's rotating service keys) plus,
+    for daemon endpoints, an inbound verifier.  `verifier=False` is
+    for a daemon's embedded *client* messenger (e.g. the MDS's RADOS
+    client), which signs as the daemon but must not gate inbound
+    replies.  One place for the gate so mon/OSD/MDS cannot drift, and
+    a keyring missing the service secret fails loud here instead of
+    deep inside _mac."""
+    svc = keyring.get(SERVICE_ENTITY)
+    if svc is None:
+        raise ValueError(
+            f"cephx for {entity}: keyring has no service secret")
+    ms.auth_signer = CephxClient.self_mint(entity, svc)
+    if verifier:
+        ms.auth_verifier = CephxVerifier(svc)
 
 
 def _derive_session_key(secret: str, nonce: str, challenge: str) -> str:
@@ -319,12 +340,25 @@ class CephxVerifier:
         # entity-class gate: a client-class ticket cannot send
         # daemon-internal traffic (RepOpWrite/ECSubWrite/MMap/
         # MOSDFailure/paxos...) even with a valid signature
-        if ticket.get("cls", "client") == "client" and \
-                msg.type_name not in CLIENT_ALLOWED:
-            dout("auth", 1).write(
-                "cephx: client-class %s may not send %s",
-                ticket.get("entity"), msg.type_name)
-            return False
+        if ticket.get("cls", "client") == "client":
+            if msg.type_name not in CLIENT_ALLOWED:
+                dout("auth", 1).write(
+                    "cephx: client-class %s may not send %s",
+                    ticket.get("entity"), msg.type_name)
+                return False
+            # identity binding: a client ticket speaks only for its own
+            # entity — services authorize state changes (cap releases,
+            # ops) by msg.src, and src is MAC-covered, so without this
+            # check any authenticated client could stamp another
+            # client's name and e.g. forge its MClientCaps release.
+            # Daemon-class is exempt: every service-secret holder can
+            # mint any daemon ticket anyway (and the MDS's embedded
+            # RADOS client legitimately signs as its daemon identity).
+            if ticket.get("entity") != getattr(msg, "src", None):
+                dout("auth", 1).write(
+                    "cephx: ticket for %s on message from %s",
+                    ticket.get("entity"), getattr(msg, "src", None))
+                return False
         seq = auth.get("seq", 0)
         want = _mac(ticket["session_key"],
                     _canon(msg) + b"|seq=%d" % seq)

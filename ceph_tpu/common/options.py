@@ -175,6 +175,9 @@ OPTIONS: dict[str, Option] = {opt.name: opt for opt in [
     # object store
     _o("memstore_device_bytes", T.SIZE, 1 << 30, L.ADVANCED,
        desc="capacity reported by MemStore statfs"),
+    _o("bluestore_device_bytes", T.SIZE, 0, L.ADVANCED,
+       desc="provisioned capacity reported by BlueStore statfs; 0 = "
+            "grow with the block file (never report used > total)"),
     # fault injection (ref: options.cc:774 heartbeat_inject_failure,
     # :3565 osd_debug_inject_dispatch_delay)
     _o("heartbeat_inject_failure", T.SECS, 0.0, L.DEV, runtime=True),

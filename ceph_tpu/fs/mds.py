@@ -82,7 +82,7 @@ class MDSDaemon(Dispatcher):
     def __init__(self, network, rados, rank: int = 0,
                  metadata_pool: str = "cephfs_metadata",
                  data_pool: str = "cephfs_data",
-                 threaded: bool = True):
+                 threaded: bool = True, keyring=None):
         self.name = f"mds.{rank}"
         self.rados = rados
         for pool in (metadata_pool, data_pool):
@@ -105,6 +105,13 @@ class MDSDaemon(Dispatcher):
         self._mkfs_or_replay()
         self.ms = Messenger.create(network, self.name,
                                    threaded=threaded)
+        if keyring is not None:
+            # like the OSD: the MDS holds the service secret, mints its
+            # ticket locally, and gates inbound client traffic — an
+            # auth-enabled cluster must not leave the metadata server
+            # as the one unauthenticated daemon (advisor r3 medium)
+            from ..auth import attach_cephx
+            attach_cephx(self.ms, self.name, keyring)
         self.ms.add_dispatcher(self)
 
     def init(self) -> None:

@@ -89,12 +89,9 @@ class Monitor(Dispatcher):
         # (ref: AuthMonitor + CephxServiceHandler)
         self.cephx = None
         if keyring is not None:
-            from ..auth import (SERVICE_ENTITY, CephxClient,
-                                CephxServer, CephxVerifier)
+            from ..auth import CephxServer, attach_cephx
             self.cephx = CephxServer(keyring)
-            svc = keyring.get(SERVICE_ENTITY)
-            self.ms.auth_verifier = CephxVerifier(svc)
-            self.ms.auth_signer = CephxClient.self_mint(self.name, svc)
+            attach_cephx(self.ms, self.name, keyring)
         self.ms.add_dispatcher(self)
         # osdmap subscribers: entity -> next epoch they need
         self._subs: dict[str, int] = {}

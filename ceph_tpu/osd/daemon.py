@@ -176,13 +176,8 @@ class OSDDaemon(Dispatcher, MonHunter):
             self.perf.add_u64_counter(key)
         self.ms = Messenger.create(network, self.name, threaded=threaded)
         if keyring is not None:
-            # daemons hold the service secret (the reference's rotating
-            # service keys), so their tickets mint locally; inbound
-            # traffic must carry a valid ticket + signature
-            from ..auth import SERVICE_ENTITY, CephxClient, CephxVerifier
-            svc = keyring.get(SERVICE_ENTITY)
-            self.ms.auth_signer = CephxClient.self_mint(self.name, svc)
-            self.ms.auth_verifier = CephxVerifier(svc)
+            from ..auth import attach_cephx
+            attach_cephx(self.ms, self.name, keyring)
         self.ms.add_dispatcher(self)
 
     # ------------------------------------------------------------ setup

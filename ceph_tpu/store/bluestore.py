@@ -278,7 +278,7 @@ class BlueStore(ObjectStore):
         # single-ref blob extent -> data rides the KV WAL, applied in
         # place after commit (ref: bluestore deferred writes)
         if len(data) <= self.deferred_max:
-            hit = self._deferred_target(o, off, len(data))
+            hit = self._deferred_target(ctx, o, off, len(data))
             if hit is not None:
                 self._deferred_write(ctx, o, hit, off, data)
                 o["size"] = max(o["size"], end)
@@ -290,7 +290,8 @@ class BlueStore(ObjectStore):
         o["lextents"].sort()
         o["size"] = max(o["size"], end)
 
-    def _deferred_target(self, o: dict, off: int, length: int):
+    def _deferred_target(self, ctx: "_TxnCtx", o: dict, off: int,
+                         length: int):
         """The lextent wholly containing [off, off+length) whose blob
         can be patched in place, or None."""
         for le in o["lextents"]:
@@ -298,11 +299,33 @@ class BlueStore(ObjectStore):
             if loff <= off and off + length <= loff + llen:
                 b = self._blobs_view().get(blob_id)
                 if b is not None and b.get("comp") is None and \
-                        b.get("refs", 1) == 1:
+                        self._pending_refs(ctx, blob_id, b) == 1:
                     return le
             if loff > off:
                 break
         return None
+
+    def _pending_refs(self, ctx: "_TxnCtx", blob_id: int, b: dict) -> int:
+        """Effective lextent-reference count of `blob_id` at this point
+        in the transaction.  Committed `refs` is only resolved at
+        commit, so a clone EARLIER IN THE SAME TXN shares the blob
+        while refs still reads 1 — an in-place deferred patch would
+        then mutate the bytes the clone shares (silent snapshot
+        corruption).  Adjust committed refs by the txn shadow: for
+        every onode touched by this txn, subtract its committed lextent
+        references and add its shadow ones."""
+        refs = b.get("refs", 1)
+        touched = set(ctx._onodes) | ctx._removed_onodes
+        for (cid, oid) in touched:
+            old = self._colls.get(cid, {}).get(oid)
+            if old is not None:
+                refs -= sum(1 for le in old["lextents"]
+                            if le[2] == blob_id)
+            cur = ctx._colls.get(cid, {}).get(oid)
+            if cur is not None:
+                refs += sum(1 for le in cur["lextents"]
+                            if le[2] == blob_id)
+        return refs
 
     def _blobs_view(self) -> dict:
         return self._blobs
@@ -442,9 +465,17 @@ class BlueStore(ObjectStore):
             return sorted(c)
 
     def statfs(self) -> dict:
+        """Capacity from the configured device size, or — when
+        unprovisioned (0) — from the grow-on-demand block file, never
+        the MemStore RAM constant (advisor: used must not exceed total
+        or capacity logic like pg_autoscaler sees fictional headroom)."""
         with self._lock:
-            total = global_config()["memstore_device_bytes"]
             used = (self._units - len(self._free)) * self.min_alloc
+            total = global_config()["bluestore_device_bytes"]
+            if total <= 0:
+                total = max(self._units * self.min_alloc,
+                            global_config()["memstore_device_bytes"])
+            total = max(total, used)
             return {"total": total, "used": used,
                     "available": max(0, total - used)}
 

@@ -132,8 +132,17 @@ def run_mds(args) -> int:
         keyring = KeyRing.load(args.keyring)
     net = make_net(mm, keyring)
     r = Rados(make_net(mm, keyring),
-              name=f"client.mds{os.getpid() % 10000}").connect()
-    mds = MDSDaemon(net, r, rank=args.rank)
+              name=f"client.mds{os.getpid() % 10000}")
+    if keyring is not None:
+        # the MDS's embedded RADOS client signs as the daemon itself:
+        # it holds the service secret, so it self-mints (a wire
+        # handshake would fail — the mon has no key for the ephemeral
+        # client name)
+        from ..auth import attach_cephx
+        attach_cephx(r.objecter.ms, f"mds.{args.rank}", keyring,
+                     verifier=False)
+    r.connect()
+    mds = MDSDaemon(net, r, rank=args.rank, keyring=keyring)
     mds.init()
     print(f"mds.{args.rank}: serving on "
           f"{mm['addrs'][f'mds.{args.rank}']}", flush=True)

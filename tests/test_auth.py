@@ -194,3 +194,80 @@ def test_cephx_cluster_io():
         anon.shutdown()
     finally:
         c.shutdown()
+
+def test_cephx_mds_gate():
+    """Advisor r3 (medium): in an auth cluster the MDS must verify
+    inbound traffic like mon/OSD do — and the client->mds MClientCaps
+    release ack must be client-allowed or cap revocation wedges."""
+    import time
+
+    from ceph_tpu.fs import CephFS, MDSDaemon
+    from ceph_tpu.fs.client import CephFSError
+    from ceph_tpu.fs.mds import CAP_EXCL
+    from ceph_tpu.msg.messages import MClientRequest
+    from ceph_tpu.msg.messenger import Messenger
+    c = MiniCluster(n_osd=2, threaded=True, auth="cephx")
+    mds = None
+    try:
+        c.wait_all_up()
+        mds = MDSDaemon(c.network, c.rados(), keyring=c.keyring)
+        mds.init()
+        assert mds.ms.auth_verifier is not None
+        fs_w, fs_r = CephFS(c.rados()), CephFS(c.rados())
+        fs_w.mkdirs("/sec")
+        w = fs_w.open("/sec/f", "w")
+        assert w.caps & CAP_EXCL
+        w.write(0, b"X" * 2048)          # size buffered under EXCL
+        # the reader's open forces a revoke; the writer's release ack
+        # travels client->mds as a signed MClientCaps
+        r = fs_r.open("/sec/f", "r")
+        assert r.size == 2048            # proves the flush+ack landed
+        assert not (w.caps & CAP_EXCL)
+        w.close()
+        r.close()
+        # an unauthenticated endpoint gets silently dropped
+        rogue = Messenger.create(c.network, "client.rogue",
+                                 threaded=True)
+        got = []
+
+        class _Sink:
+            def ms_dispatch(self, msg):
+                got.append(msg)
+                return True
+
+        rogue.add_dispatcher(_Sink())
+        rogue.start()
+        rogue.connect("mds.0").send_message(
+            MClientRequest(tid=1, op="mkdir",
+                           args={"path": "/evil", "mode": 0o755}))
+        time.sleep(0.5)
+        assert not got, "unauthenticated mds request must get no reply"
+        assert not CephFS(c.rados()).exists("/evil")
+        rogue.shutdown()
+    finally:
+        if mds is not None:
+            mds.shutdown()
+        c.shutdown()
+
+def test_client_ticket_bound_to_src():
+    """A client-class ticket speaks only for its own entity: services
+    authorize by msg.src, so a valid ticket stamped with another
+    client's name must not verify (cap-release forgery)."""
+    from ceph_tpu.msg.messages import MClientCaps
+    kr = KeyRing.generate(["client.a", "client.victim"])
+    server = CephxServer(kr)
+    ver = CephxVerifier(kr.get(SERVICE_ENTITY))
+    atk = CephxClient("client.a", kr.get("client.a"))
+    assert atk.ingest_reply(server.handle_request(atk.build_request()))
+    forged = atk.sign(_stamp(MClientCaps(op="ack", ino=7),
+                             "client.victim"))
+    assert not ver.verify(forged)
+    legit = atk.sign(_stamp(MClientCaps(op="ack", ino=7),
+                            "client.a", 2))
+    assert ver.verify(legit)
+    # daemon-class stays exempt: the MDS's embedded RADOS client
+    # legitimately signs as its daemon identity from a client-named
+    # messenger (and every service-secret holder could mint any
+    # daemon ticket anyway)
+    mdsc = CephxClient.self_mint("mds.0", kr.get(SERVICE_ENTITY))
+    assert ver.verify(mdsc.sign(_stamp(Message(), "client.mds123")))

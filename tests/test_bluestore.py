@@ -430,3 +430,70 @@ def test_failed_txn_returns_units(tmp_path):
     assert bs._units - len(bs._free) == used_before, \
         "failed transactions leaked allocator units"
     bs.umount()
+
+def test_clone_then_deferred_write_same_txn(tmp_path):
+    """Advisor r3 (high): a clone earlier in the SAME txn shares the
+    blob while committed refs still read 1 — a small deferred write
+    must NOT patch the shared blob in place (silent snapshot
+    corruption).  This is exactly the snapshot-COW txn
+    replicated_backend builds: clone for the snap, then the overwrite."""
+    bs = mk(tmp_path, deferred_max=4096)
+    bs.queue_transaction(Transaction().create_collection("c"))
+    base = bytes(range(256)) * 16           # 4 KiB blob, uncompressed
+    bs.queue_transaction(Transaction().write("c", O("h"), 0, base))
+    bs.queue_transaction(
+        Transaction()
+        .clone("c", O("h"), O("h.snap"))
+        .write("c", O("h"), 0, b"X" * 512))   # <= deferred_max
+    assert bs.read("c", O("h.snap")) == base, \
+        "snapshot clone must keep pre-write bytes"
+    assert bs.read("c", O("h"))[:512] == b"X" * 512
+    assert bs.read("c", O("h"))[512:] == base[512:]
+    assert bs.fsck() == []
+    # survives remount: the head's write was COW'd to a new blob
+    bs.umount()
+    bs2 = BlueStore(str(tmp_path / "bs"), min_alloc=512)
+    bs2.mount()
+    assert bs2.read("c", O("h.snap")) == base
+    assert bs2.read("c", O("h"))[:512] == b"X" * 512
+    bs2.umount()
+
+
+def test_deferred_after_clone_removed_same_txn(tmp_path):
+    """Counter-case: clone then REMOVE the clone in the same txn — the
+    blob is single-ref again, deferral is legal and must still produce
+    a consistent csum."""
+    bs = mk(tmp_path, deferred_max=4096)
+    bs.queue_transaction(Transaction().create_collection("c"))
+    base = bytes(range(256)) * 16
+    bs.queue_transaction(Transaction().write("c", O("h"), 0, base))
+    bs.queue_transaction(
+        Transaction()
+        .clone("c", O("h"), O("tmp"))
+        .remove("c", O("tmp"))
+        .write("c", O("h"), 0, b"Y" * 256))
+    assert bs.read("c", O("h"))[:256] == b"Y" * 256
+    assert bs.read("c", O("h"))[256:] == base[256:]
+    assert bs.fsck() == []
+
+
+def test_statfs_disk_backed_capacity(tmp_path):
+    """Advisor r3 (low): a disk-backed store must never report
+    used > total from the MemStore RAM constant."""
+    from ceph_tpu.common.options import global_config
+    bs = mk(tmp_path)
+    bs.queue_transaction(Transaction().create_collection("c"))
+    bs.queue_transaction(Transaction().write("c", O("big"), 0,
+                                             b"z" * (1 << 16)))
+    st = bs.statfs()
+    assert st["used"] <= st["total"]
+    assert st["available"] == st["total"] - st["used"]
+    # provisioned size wins when configured
+    global_config().set("bluestore_device_bytes", 1 << 20)
+    try:
+        st = bs.statfs()
+        assert st["total"] == 1 << 20
+        assert st["used"] <= st["total"]
+    finally:
+        global_config().set("bluestore_device_bytes", 0)
+    bs.umount()

@@ -178,6 +178,17 @@ OPTIONS: dict[str, Option] = {opt.name: opt for opt in [
     _o("bluestore_device_bytes", T.SIZE, 0, L.ADVANCED,
        desc="provisioned capacity reported by BlueStore statfs; 0 = "
             "grow with the block file (never report used > total)"),
+    # peering / recovery / backfill (ref: options.cc osd_min_pg_log_
+    # entries, osd_max_pg_log_entries, osd_max_backfills,
+    # osd_backfill_scan_max)
+    _o("osd_min_pg_log_entries", T.UINT, 250, L.ADVANCED, runtime=True,
+       desc="entries kept after a pg log trim"),
+    _o("osd_max_pg_log_entries", T.UINT, 500, L.ADVANCED, runtime=True,
+       desc="log length that triggers a trim"),
+    _o("osd_max_backfills", T.UINT, 1, L.ADVANCED, runtime=True,
+       desc="concurrent backfills an OSD serves (local or remote)"),
+    _o("osd_backfill_scan_max", T.UINT, 512, L.ADVANCED, runtime=True,
+       desc="objects per ranged backfill scan chunk"),
     # fault injection (ref: options.cc:774 heartbeat_inject_failure,
     # :3565 osd_debug_inject_dispatch_delay)
     _o("heartbeat_inject_failure", T.SECS, 0.0, L.DEV, runtime=True),

@@ -12,12 +12,12 @@ current pg_num is off by the threshold factor 3).  Reduced faithfully:
   reference's hysteresis) — and only upward: the framework supports
   splitting (OSD-side collection split, daemon._split_pgs) but not
   merging, matching pg_num reduction being refused by the mon;
-* applies `osd pool set pg_num` ONLY — pgp_num stays, so children
-  keep the parent's placement seed and split data remains co-resident
-  with its parent collections (the reference likewise splits with
-  pg_num first; growing pgp_num reseeds placement, which requires the
-  backfill machinery this framework's scan-based recovery does not
-  model — the mon refuses pgp_num growth for the same reason).
+* `osd pool set pg_num` first (cheap local collection split keeping
+  children on the parent's placement seed), then the NEXT tick grows
+  pgp_num to match — the placement reseed whose data movement the
+  peering statechart chases via prior-interval queries + reservation-
+  throttled backfill (osd/peering.py; the reference likewise splits
+  with pg_num first and walks pgp_num up afterwards).
 """
 from __future__ import annotations
 
@@ -74,13 +74,35 @@ class PGAutoscaler:
 
     # ----------------------------------------------------------- apply
     def tick(self, pool_bytes: dict[int, int] | None = None) -> int:
-        """Plan + apply (ref: _maybe_adjust).  Returns commands sent."""
+        """Plan + apply (ref: _maybe_adjust).  Returns commands sent.
+
+        pgp_num follows pg_num one step behind (ref: the reference's
+        gradual pgp_num increase honoring the misplaced-ratio target):
+        the tick after a split, placement reseeds and the peering
+        statechart's prior-interval backfill migrates the split data;
+        the step-behind cadence keeps split (cheap, local) and reseed
+        (data movement, reservation-throttled) in separate epochs."""
         osdmap = self.mgr.osdmap
         if osdmap.epoch == 0:
             return 0
         self.last_plan = self.plan(osdmap, pool_bytes)
         sent = 0
         for p in self.last_plan:
+            pool = osdmap.pools.get(p["pool_id"])
+            if pool is not None and pool.pgp_num < pool.pg_num and \
+                    pool.is_replicated():
+                # EC pools keep children on the parent's seed: their
+                # recovery has no prior-interval backfill to chase a
+                # reseed (the mon refuses it too)
+                dout("mgr", 1).write(
+                    "pg_autoscaler: pool %s pgp_num %d -> %d (reseed)",
+                    p["pool_name"], pool.pgp_num, pool.pg_num)
+                self.mgr._command({"prefix": "osd pool set",
+                                   "pool": p["pool_name"],
+                                   "var": "pgp_num",
+                                   "val": str(pool.pg_num)})
+                sent += 1
+                continue
             if not p["would_adjust"]:
                 continue
             dout("mgr", 1).write(

@@ -32,7 +32,8 @@ from ..msg.messages import (MAuthRequest, MConfig, MMap, MMonCommand,
                             MMonCommandAck,
                             MMonElection, MMonForward, MMonLease,
                             MMonLeaseAck, MMonSubscribe, MOSDBoot,
-                            MOSDFailure, MPaxosAccept, MPaxosBegin,
+                            MOSDFailure, MOSDPGTemp, MPaxosAccept,
+                            MPaxosBegin,
                             MPaxosCommit, MPaxosStoreSync,
                             MPaxosSyncReq, MPGStats)
 from ..msg.messenger import Dispatcher, LocalNetwork, Message, Messenger
@@ -267,6 +268,11 @@ class Monitor(Dispatcher):
                 if self._relay_if_peon(msg):
                     return True
                 self._handle_failure(msg)
+                return True
+            if isinstance(msg, MOSDPGTemp):
+                if self._relay_if_peon(msg):
+                    return True
+                self._handle_pg_temp(msg)
                 return True
             if isinstance(msg, MPGStats):
                 self.pgmap.ingest(OSDStatReport(
@@ -697,6 +703,37 @@ class Monitor(Dispatcher):
         need = global_config()["mon_osd_min_down_reporters"]
         if len(reports) >= need:
             self._mark_down(target)
+
+    def _handle_pg_temp(self, msg: MOSDPGTemp) -> None:
+        """pg_temp request from a peering primary (ref:
+        OSDMonitor::prepare_pgtemp): pin the PG's acting set to the
+        data holders while the up set backfills; an empty list clears
+        the override when the backfill finishes."""
+        def stage():
+            m = self.osdmap
+            pg = msg.pgid
+            if pg is None or pg.pool not in m.pools or \
+                    pg.ps >= m.pools[pg.pool].pg_num:
+                return (1, "", None)
+            want = [o for o in msg.osds
+                    if 0 <= o < m.max_osd and m.is_up(o)]
+            if msg.osds and not want:
+                # a PIN whose members are all momentarily down must
+                # not degenerate into a clear of the live override
+                return (1, "", None)
+            inc = self.osdmon.pending_inc
+            cur = inc.new_pg_temp.get(pg, m.pg_temp.get(pg, []))
+            if want == list(cur):
+                return (1, "", None)       # no-op, no proposal
+            if not want and pg not in m.pg_temp and \
+                    pg not in inc.new_pg_temp:
+                return (1, "", None)       # clearing nothing
+            inc.new_pg_temp[pg] = want
+            dout("mon", 4).write("%s: pg_temp %s -> %s (from osd.%d)",
+                                 self.name, pg, want, msg.from_osd)
+            return (0, "", None)
+
+        self._submit_change(stage)
 
     def _mark_down_pgmap(self, osd: int) -> None:
         """Drop a downed OSD's stat report: its capacity must leave the

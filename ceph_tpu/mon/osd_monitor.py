@@ -455,14 +455,24 @@ class OSDMonitor(PaxosService):
                 n = int(val)
                 if var == "pg_num" and n < pool.pg_num:
                     return -EPERM, "pg_num reduction not supported", None
-                if var == "pgp_num" and n > pool.pgp_num:
-                    # growing pgp_num reseeds every PG's placement; the
-                    # scan-based recovery has no backfill-from-history
-                    # machinery to chase relocated data, so reseeding
-                    # could orphan split objects (split keeps children
-                    # on the parent's seed precisely to avoid this)
-                    return -EPERM, ("pgp_num growth (placement reseed) "
-                                    "is not supported"), None
+                if var == "pgp_num":
+                    if n > pool.pg_num:
+                        return -EINVAL, \
+                            "pgp_num must not exceed pg_num", None
+                    if n < pool.pgp_num:
+                        return -EPERM, \
+                            "pgp_num reduction not supported", None
+                    if n > pool.pgp_num and pool.is_erasure():
+                        # EC recovery reconciles the acting set's
+                        # shard inventories only — no prior-interval
+                        # queries, so a reseed would orphan split
+                        # data on the old placement
+                        return -EPERM, ("pgp_num growth on erasure "
+                                        "pools is not supported"), None
+                    # replicated growth reseeds split PGs' placement;
+                    # the peering statechart's prior-interval queries
+                    # + backfill chase the relocated data
+                    # (osd/peering.py)
                 setattr(pool, var, n)
                 if var == "pg_num":
                     pool.pgp_num = min(pool.pgp_num, n)

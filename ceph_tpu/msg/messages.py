@@ -104,19 +104,141 @@ class RepOpReply(Message):
 class PGScan(Message):
     """Primary asks a peer for its object inventory after an acting
     change (the peering/backfill scan,
-    ref: src/messages/MOSDPGScan.h / PG::scan_range)."""
+    ref: src/messages/MOSDPGScan.h / PG::scan_range).
+
+    v2 appends the ranged-walk fields: with `ranged` set the peer
+    returns only its objects in (begin, end] (PG::scan_range's
+    interval window), so a backfill walk never materializes a big
+    PG's whole inventory in one message."""
     pgid: Any = None
     ec: bool = False       # scanner's pool type: build only that view
+    # --- v2: ranged backfill walk ---
+    ranged: bool = False
+    begin: str = ""        # exclusive lower bound ("" = start)
+    end: str = ""          # inclusive upper bound ("" = unbounded)
 
 
 @dataclass
 class PGScanReply(Message):
+    """v2 appends the ranged-walk echo fields so the primary can match
+    a chunk reply to its cursor position."""
     pgid: Any = None
     from_osd: int = -1
     #: oid -> ((epoch, version), whiteout) — the recovery inventory
     objects: dict = field(default_factory=dict)
     #: EC pools: oid -> [shard indexes present in the peer's store]
     ec_shards: dict = field(default_factory=dict)
+    # --- v2: ranged backfill walk ---
+    ranged: bool = False
+    begin: str = ""
+    end: str = ""
+
+
+# ------------------------------------------------------------- peering
+# The phase-machine message family (ref: PG peering,
+# src/osd/PG.h:2085-2195 state chart; messages src/messages/MOSDPGQuery.h,
+# MOSDPGNotify.h, MOSDPGLog.h, MBackfillReserve.h, MOSDPGRemove.h,
+# MOSDPGTemp.h).
+
+
+@dataclass
+class PGQuery(Message):
+    """Primary asks a (possibly prior-interval) peer for its pg_info
+    (GetInfo phase, ref: src/messages/MOSDPGQuery.h)."""
+    pgid: Any = None
+    epoch: int = 0
+
+
+@dataclass
+class PGNotify(Message):
+    """pg_info, two roles (ref: src/messages/MOSDPGNotify.h carrying
+    pg_info_t): the GetInfo reply, answered from the persisted shard
+    log even when the peer has no live PG state; and — with `stray`
+    set — the unsolicited stray self-notify (an OSD holding data for
+    a PG it is no longer mapped to announces itself to the current
+    primary, which answers PGRemove once clean, or re-peers if the
+    stray holds newer history)."""
+    pgid: Any = None
+    from_osd: int = -1
+    epoch: int = 0
+    last_update: Any = None      # EVersion head of the shard's log
+    log_tail: Any = None         # EVersion tail
+    have_data: bool = False      # store collection is non-empty
+    n_objects: int = 0
+    stray: bool = False          # unsolicited self-notify leg
+
+
+@dataclass
+class PGLogReq(Message):
+    """GetLog: primary asks the authoritative peer for its log
+    (ref: MOSDPGQuery with query_t::LOG)."""
+    pgid: Any = None
+    since: Any = None            # EVersion: send entries > since
+    epoch: int = 0               # staleness guard
+    full: bool = False           # wholesale adoption (primary backfill)
+
+
+@dataclass
+class PGLogPush(Message):
+    """A log segment + bounds, both directions (ref:
+    src/messages/MOSDPGLog.h): auth peer -> primary as the GetLog
+    reply, primary -> replica during GetMissing/activation (the
+    replica merges it and answers PGMissingReply)."""
+    pgid: Any = None
+    from_osd: int = -1
+    entries: list = field(default_factory=list)   # PGLogEntry, ascending
+    head: Any = None             # sender's log head (EVersion)
+    tail: Any = None             # sender's log tail
+    to_primary: bool = False     # True = GetLog reply leg
+    activate: bool = False       # primary->replica: compute missing
+    full: bool = False           # wholesale adoption leg
+    epoch: int = 0
+
+
+@dataclass
+class PGMissingReply(Message):
+    """Replica's missing set after merging the primary's log
+    (GetMissing phase; ref: pg_missing_t exchanged via MOSDPGLog)."""
+    pgid: Any = None
+    from_osd: int = -1
+    #: oid -> (epoch, version) needed
+    missing: dict = field(default_factory=dict)
+    epoch: int = 0
+    #: the replica could not merge (its log raced a trim): the primary
+    #: reclassifies it as a backfill target
+    no_overlap: bool = False
+
+
+@dataclass
+class BackfillReserve(Message):
+    """Backfill reservation handshake (ref:
+    src/messages/MBackfillReserve.h REQUEST/GRANT/REJECT_TOOFULL/
+    RELEASE): a target only serves `osd_max_backfills` concurrent
+    backfills; rejected primaries retry on the tick."""
+    pgid: Any = None
+    from_osd: int = -1
+    op: str = "request"          # request|grant|reject|release
+
+
+@dataclass
+class PGRemove(Message):
+    """Primary tells a stray (an OSD holding this PG's data but no
+    longer in the acting/up set) to delete its copy after the PG goes
+    clean (ref: src/messages/MOSDPGRemove.h)."""
+    pgid: Any = None
+    epoch: int = 0
+
+
+@dataclass
+class MOSDPGTemp(Message):
+    """OSD asks the mon for a pg_temp override (ref:
+    src/messages/MOSDPGTemp.h): a freshly-mapped primary with no data
+    keeps the old acting set serving while it backfills; empty `osds`
+    clears the override when the backfill finishes."""
+    pgid: Any = None
+    from_osd: int = -1
+    epoch: int = 0
+    osds: list = field(default_factory=list)
 
 
 @dataclass
@@ -167,6 +289,12 @@ class PGPush(Message):
     #: snapshot history rides along:
     #: {snap_seq, items: [{snap, covers, data, attrs, omap}]}
     clones: dict = field(default_factory=dict)
+    # --- v2 ---
+    #: backfill walk payload: the primary's interval is absolutely
+    #: authoritative — apply regardless of the target's local version
+    #: (a divergent survivor past trimmed history can carry a NEWER
+    #: version that the force guard would wrongly keep)
+    backfill: bool = False
 
 
 # ---------------------------------------------------------------- client
@@ -451,6 +579,9 @@ class PingReply(Message):
 #: per-type (version, compat) overrides — bump when appending fields
 _VERSIONS: dict[str, tuple[int, int]] = {
     "ECSubWrite": (2, 1),       # v2: ICI-fabric fields appended
+    "PGScan": (2, 1),           # v2: ranged backfill walk
+    "PGScanReply": (2, 1),      # v2: ranged/begin/end echo fields
+    "PGPush": (2, 1),           # v2: authoritative backfill flag
 }
 
 

@@ -21,12 +21,14 @@ from ..common.heartbeat_map import HeartbeatMap
 from ..common.log import dout
 from ..common.options import global_config
 from ..ec import registry as ec_registry
-from ..msg.messages import (ECSubRead, ECSubReadReply, ECSubWrite,
-                            ECSubWriteReply, MConfig, MMap, MOSDBoot,
-                            MMonSubscribe, MOSDFailure, MPGStats,
-                            MWatchNotify, OSDOp, OSDOpReply, PGPull,
-                            PGPush, PGScan, PGScanReply, Ping,
-                            PingReply, RepOpReply, RepOpWrite,
+from ..msg.messages import (BackfillReserve, ECSubRead, ECSubReadReply,
+                            ECSubWrite, ECSubWriteReply, MConfig, MMap,
+                            MOSDBoot, MMonSubscribe, MOSDFailure,
+                            MOSDPGTemp, MPGStats, MWatchNotify, OSDOp,
+                            OSDOpReply, PGLogPush, PGLogReq,
+                            PGMissingReply, PGNotify, PGPull, PGPush,
+                            PGQuery, PGRemove, PGScan, PGScanReply,
+                            Ping, PingReply, RepOpReply, RepOpWrite,
                             ScrubMapReply, ScrubMapRequest)
 from ..msg.mon_client import MonHunter
 from ..msg.messenger import Dispatcher, LocalNetwork, Message, Messenger
@@ -56,8 +58,11 @@ class _PGState:
         self.backend = None        # primary-only
         self.acting: list[int] = []
         self.acting_primary = -1
-        # replicated recovery state (primary only; ref: PG peering ->
-        # recovery/backfill, simplified to scan/pull/push)
+        self.up: list[int] = []
+        # replicated peering statechart (primary only, osd/peering.py);
+        # EC pools keep the scan-based fields below
+        self.peering = None        # PGPeering | None
+        self.backfilling = False
         self.recovering = False
         self.scan_pending: set[int] = set()
         self.peer_objects: dict[int, dict] = {}   # osd -> {oid: size}
@@ -112,6 +117,10 @@ class OSDDaemon(Dispatcher, MonHunter):
             self.store.mount()
         self.osdmap = OSDMap()
         self.pgs: dict[PG, _PGState] = {}
+        # previous interval's acting sets (prior-set source for
+        # peering; see _prior_acting_for)
+        self._acting_hist: dict[PG, list[int]] = {}
+        self._acting_hist_pgnum: dict[int, int] = {}
         self._ecs: dict[str, object] = {}     # profile name -> plugin
         self._pool_pg_num: dict[int, int] = {}   # split detection
         # shared across backend rebuilds: stale sub-replies must never
@@ -129,6 +138,17 @@ class OSDDaemon(Dispatcher, MonHunter):
         #: (a "hung" osd — the heartbeat_inject_failure analogue,
         #: ref: src/common/options.cc:774)
         self.inject_heartbeat_mute = False
+        # backfill reservations (ref: the AsyncReserver pair in OSD.h:
+        # local_reserver + remote_reserver, both osd_max_backfills
+        # wide).  Requests past capacity QUEUE and are granted as
+        # slots free — the reference's AsyncReserver model, so
+        # saturation never needs a timer-driven retry
+        self._local_backfills: set = set()          # PGs we drive
+        self._remote_backfills: set = set()         # (pg, primary osd)
+        self._local_waitq: list = []                # PGs awaiting a slot
+        self._remote_waitq: list = []               # (key, reply addr)
+        #: cached stray self-notifies: pg -> (PGNotify, primary osd)
+        self._stray_notifies: dict = {}
         # in-flight notifies: notify_id -> state
         # (ref: src/osd/Watch.cc Notify)
         self._notifies: dict[int, dict] = {}
@@ -352,13 +372,93 @@ class OSDDaemon(Dispatcher, MonHunter):
                     ec_shards=ec_store_inventory(self.store,
                                                  pg_cid(msg.pgid)))
             else:
+                inv = self._replicated_view(msg.pgid).inventory()
+                if msg.ranged:
+                    inv = {o: v for o, v in inv.items()
+                           if o > msg.begin and
+                           (msg.end == "" or o <= msg.end)}
                 reply = PGScanReply(
-                    pgid=msg.pgid, from_osd=self.whoami,
-                    objects=self._replicated_view(msg.pgid).inventory())
+                    pgid=msg.pgid, from_osd=self.whoami, objects=inv,
+                    ranged=msg.ranged, begin=msg.begin, end=msg.end)
             self.ms.connect(msg.src).send_message(reply)
             return True
         if isinstance(msg, PGScanReply):
-            self._handle_scan_reply(msg)
+            with self._lock:
+                st = self.pgs.get(msg.pgid)
+                pr = st.peering if st is not None else None
+                if pr is not None:
+                    if msg.ranged:
+                        pr.on_backfill_scan(msg)
+                    else:
+                        pr.on_primary_backfill_scan(msg)
+                else:
+                    self._handle_scan_reply(msg)
+            return True
+        if isinstance(msg, PGQuery):
+            # pg_info from the durable shard log — answerable even
+            # with no live PG state (GetInfo queries reach
+            # prior-interval holders and map-lagging peers).  Under
+            # the daemon lock: the log is concurrently mutated by
+            # applies and splits on other threads.
+            with self._lock:
+                shard = self._replicated_view(msg.pgid)
+                head, tail = shard.log_info()
+                inv = shard.inventory()
+            self.ms.connect(msg.src).send_message(PGNotify(
+                pgid=msg.pgid, from_osd=self.whoami, epoch=msg.epoch,
+                last_update=head, log_tail=tail,
+                have_data=bool(inv), n_objects=len(inv)))
+            return True
+        if isinstance(msg, PGNotify):
+            with self._lock:
+                if msg.stray:
+                    self._handle_stray_notify(msg)
+                else:
+                    st = self.pgs.get(msg.pgid)
+                    if st is not None and st.peering is not None:
+                        st.peering.on_info(msg)
+            return True
+        if isinstance(msg, PGLogReq):
+            with self._lock:     # log mutates under applies/splits
+                shard = self._replicated_view(msg.pgid)
+                head, tail = shard.log_info()
+                since = msg.since if msg.since is not None else tail
+                if msg.full:
+                    entries, rtail = list(shard.pg_log.log.entries), \
+                        tail
+                else:
+                    entries = [e for e in shard.pg_log.log.entries
+                               if e.version > since]
+                    # the advertised tail must not claim history the
+                    # segment doesn't carry
+                    rtail = max(tail, since)
+            self.ms.connect(msg.src).send_message(PGLogPush(
+                pgid=msg.pgid, from_osd=self.whoami, entries=entries,
+                head=head, tail=rtail, to_primary=True,
+                full=msg.full, epoch=msg.epoch))
+            return True
+        if isinstance(msg, PGLogPush):
+            with self._lock:
+                if msg.to_primary:
+                    st = self.pgs.get(msg.pgid)
+                    if st is not None and st.peering is not None:
+                        st.peering.on_auth_log(msg)
+                elif msg.activate:
+                    self._replica_merge_log(msg)
+            return True
+        if isinstance(msg, PGMissingReply):
+            with self._lock:
+                st = self.pgs.get(msg.pgid)
+                if st is not None and st.peering is not None:
+                    st.peering.on_missing(msg)
+            return True
+        if isinstance(msg, BackfillReserve):
+            with self._lock:
+                self._handle_backfill_reserve(msg)
+            return True
+        if isinstance(msg, PGRemove):
+            with self._lock:
+                self._handle_pg_remove(msg)
             return True
         if isinstance(msg, PGPull):
             # recovery pushes ride the mClock queue: a storm of pulls
@@ -449,6 +549,18 @@ class OSDDaemon(Dispatcher, MonHunter):
                     self._hb_first.pop(o, None)
                     self._hb_last.pop(o, None)
                     self._hb_reported.discard(o)
+            # reclaim remote backfill slots whose requesting primary
+            # died — an explicit release will never come, and at
+            # osd_max_backfills=1 a leaked slot wedges every future
+            # backfill through this target
+            dead = [k for k in self._remote_backfills
+                    if not self.osdmap.is_up(k[1])]
+            for k in dead:
+                self._remote_backfills.discard(k)
+            self._remote_waitq = [(k, s) for k, s in self._remote_waitq
+                                  if self.osdmap.is_up(k[1])]
+            if dead:
+                self._grant_queued_reservations()
             self._update_pgs()
 
     def _ec_plugin(self, profile_name: str):
@@ -476,6 +588,7 @@ class OSDDaemon(Dispatcher, MonHunter):
             self._pool_pg_num[pool_id] = pool.pg_num
             if old is None or pool.pg_num <= old:
                 continue
+            replicated = pool.type != POOL_TYPE_ERASURE
             prefix = f"pg_{pool_id}."
             for cid in list(self.store.list_collections()):
                 if not cid.startswith(prefix):
@@ -489,6 +602,7 @@ class OSDDaemon(Dispatcher, MonHunter):
                 # object on BlueStore
                 txn = Transaction()
                 made: set[str] = set()
+                moved_to: dict[str, str] = {}     # oid -> child cid
                 for oid in list(self.store.collection_list(cid)):
                     if oid.name == "pgmeta":
                         continue
@@ -502,26 +616,103 @@ class OSDDaemon(Dispatcher, MonHunter):
                         txn.create_collection(ccid)
                         made.add(ccid)
                     txn.collection_move_rename(cid, oid, ccid, oid)
+                    moved_to[oid.name] = ccid
+                if replicated and moved_to:
+                    self._split_pg_log(PG(pool_id, ps), txn, moved_to)
                 if not txn.empty():
                     self.store.queue_transaction(txn)
 
+    def _prior_acting_for(self, pg: PG) -> list[int]:
+        """The previous interval's acting set for `pg` from the
+        acting-set cache the last _update_pgs pass recorded — the
+        PastIntervals-lite prior set (ref: PeeringState::build_prior).
+        The cache (not the pre-ingest OSDMap object) is authoritative
+        because OSDMap.ingest mutates in place on the incremental
+        path.  A split child folds back to its parent's seed; a
+        pgp_num reseed resolves under the cached old interval, which
+        is exactly where the data still lives."""
+        hit = self._acting_hist.get(pg)
+        if hit is not None:
+            return list(hit)
+        old_pg_num = self._acting_hist_pgnum.get(pg.pool, 0)
+        if old_pg_num <= 0 or pg.ps < old_pg_num:
+            return []
+        from .types import cbits, ceph_stable_mod
+        mask = (1 << cbits(old_pg_num - 1)) - 1
+        parent = PG(pg.pool, ceph_stable_mod(pg.ps, old_pg_num, mask))
+        return list(self._acting_hist.get(parent, []))
+
+    def _split_pg_log(self, parent: PG, txn: Transaction,
+                      moved_to: dict[str, str]) -> None:
+        """Split the parent's durable pg_log along with its objects
+        (ref: PG::split_into splitting the log): each child gets the
+        entries of the objects it received plus the parent's tail, so
+        every acting member computes identical child log bounds and
+        peering sees real history instead of empty logs."""
+        from ..msg import encoding as wire
+        from .replicated_backend import (PGMETA, _TAIL_KEY, _log_key,
+                                         ReplicatedPGShard)
+        st = self.pgs.get(parent)
+        if st is not None and isinstance(st.shard, ReplicatedPGShard):
+            shard = st.shard
+        else:
+            shard = ReplicatedPGShard(parent, self.store, create=False)
+        log = shard.pg_log.log
+        if not log.entries and log.tail == log.head:
+            return
+        by_child: dict[str, list] = {}
+        keep = []
+        for e in log.entries:
+            ccid = moved_to.get(e.soid)
+            if ccid is None:
+                keep.append(e)
+            else:
+                by_child.setdefault(ccid, []).append(e)
+        for ccid, entries in by_child.items():
+            txn.touch(ccid, PGMETA)
+            txn.omap_setkeys(ccid, PGMETA, dict(
+                {_log_key(e.version): wire.encode(e) for e in entries},
+                **{_TAIL_KEY: wire.encode(log.tail)}))
+        # children that received objects but no log entries still need
+        # the tail marker so their info reflects the parent's history
+        for ccid in set(moved_to.values()) - set(by_child):
+            txn.touch(ccid, PGMETA)
+            txn.omap_setkeys(ccid, PGMETA,
+                             {_TAIL_KEY: wire.encode(log.tail)})
+        if len(keep) != len(log.entries):
+            gone = [e for e in log.entries if e.soid in moved_to]
+            txn.omap_rmkeys(f"pg_{parent}", PGMETA,
+                            [_log_key(e.version) for e in gone])
+            log.entries = keep
+            log.index()
+
     def _update_pgs(self) -> None:
         """Instantiate/refresh services for PGs mapped onto this OSD
-        (ref: OSD.cc consume_map -> split/instantiate PGs)."""
+        (ref: OSD.cc consume_map -> split/instantiate PGs).  For
+        replicated pools membership includes the UP set: an up-but-not-
+        acting OSD is a backfill target that must hold live PG state to
+        receive pushes and cursor-gated writes (ref: the backfill
+        peers' PG instances)."""
         m = self.osdmap
         self._split_pgs()
         seen: set[PG] = set()
+        acting_now: dict[PG, list[int]] = {}
         for pool_id, pool in m.pools.items():
+            replicated = pool.type != POOL_TYPE_ERASURE
             for ps in range(pool.pg_num):
                 pg = PG(pool_id, ps)
                 up, up_p, acting, acting_p = m.pg_to_up_acting_osds(pg)
                 acting = [-1 if o == CRUSH_ITEM_NONE else o
                           for o in acting]
-                if self.whoami not in acting:
+                up = [-1 if o == CRUSH_ITEM_NONE else o for o in up]
+                acting_now[pg] = [o for o in acting if o >= 0]
+                if self.whoami not in acting and not (
+                        replicated and self.whoami in up):
                     continue
                 seen.add(pg)
                 st = self.pgs.get(pg)
                 if st is not None and st.acting == acting and \
+                        st.up == up and \
                         st.acting_primary == acting_p and \
                         (st.backend is None) == (acting_p != self.whoami):
                     if st.backend is not None:
@@ -531,20 +722,31 @@ class OSDDaemon(Dispatcher, MonHunter):
                             st.backend.pool_snaps = dict(pool.snaps)
                             st.backend.pool_removed_snaps = \
                                 set(pool.removed_snaps)
-                        if st.recovering:
-                            # a scanned/pulled-from peer may have died:
-                            # restart the (idempotent) recovery against
-                            # the live acting set so it can't wedge
+                        if st.peering is not None:
+                            # same interval: unwedge phases waiting on
+                            # peers that died with this map
+                            st.peering.on_map_advance()
+                        elif st.recovering:
+                            # EC legacy path: a scanned/pulled-from
+                            # peer may have died; restart idempotently
                             self._start_recovery(pg, st)
                     continue
                 old = self.pgs.get(pg)
-                if old is not None and old.backend is not None:
-                    # acting change: abort queued ops so clients see
-                    # failures and retry, instead of hanging
-                    old.backend.fail_in_flight()
+                prior: list[int] = []
+                if old is not None:
+                    prior = [o for o in old.acting if o >= 0]
+                    if old.peering is not None:
+                        old.peering.abort()
+                    if old.backend is not None:
+                        # acting change: abort queued ops so clients
+                        # see failures and retry, instead of hanging
+                        old.backend.fail_in_flight()
+                else:
+                    prior = self._prior_acting_for(pg)
                 st = _PGState()
                 st.acting = acting
                 st.acting_primary = acting_p
+                st.up = up
                 if pool.type == POOL_TYPE_ERASURE:
                     ec = self._ec_plugin(pool.erasure_code_profile
                                          or "default")
@@ -566,21 +768,38 @@ class OSDDaemon(Dispatcher, MonHunter):
                     if acting_p == self.whoami:
                         st.backend = ReplicatedBackend(
                             pg, self.whoami, acting, st.shard,
-                            send=self._make_send(pg), epoch=m.epoch,
+                            send=self._make_send_osd(), epoch=m.epoch,
                             tid_gen=self._tid_gen)
                         st.backend.pool_snap_seq = pool.snap_seq
                         st.backend.pool_snaps = dict(pool.snaps)
                         st.backend.pool_removed_snaps = \
                             set(pool.removed_snaps)
                 self.pgs[pg] = st
-                if st.backend is not None:
-                    # new primary or acting change: re-peer (empty
-                    # peers answer instantly, so initial pool creation
-                    # converges in one scan round-trip)
+                if st.backend is None:
+                    continue
+                if replicated:
+                    # new interval: run the peering statechart
+                    from .peering import PGPeering
+                    st.peering = PGPeering(self, pg, st,
+                                           prior_acting=prior)
+                    st.peering.start()
+                else:
+                    # EC pools: inventory-scan recovery
                     self._start_recovery(pg, st)
         for pg in list(self.pgs):
             if pg not in seen:
-                del self.pgs[pg]
+                st = self.pgs.pop(pg)
+                if st.peering is not None:
+                    st.peering.abort()
+                if st.backend is not None:
+                    st.backend.fail_in_flight()
+        # record this interval's acting sets for the NEXT map's
+        # prior-set queries (OSDMap.ingest mutates in place, so the
+        # map object itself can't serve as history)
+        self._acting_hist = acting_now
+        self._acting_hist_pgnum = {pid: p.pg_num
+                                   for pid, p in m.pools.items()}
+        self._notify_strays()
 
     # -------------------------------------------------------- recovery
     # Simplified replicated peering: on an acting change the primary
@@ -842,16 +1061,23 @@ class OSDDaemon(Dispatcher, MonHunter):
                     force: bool = False, attrs: dict | None = None,
                     omap: dict | None = None,
                     omap_hdr: bytes = b"",
-                    clones: dict | None = None) -> None:
+                    clones: dict | None = None,
+                    backfill: bool = False) -> None:
         """Full-object overwrite, but never let an older version clobber
         newer local data (pushes can race regular writes).  `force`
-        (scrub repair) overwrites a same-version corrupted copy."""
+        (scrub repair) overwrites a same-version corrupted copy;
+        `backfill` applies unconditionally — the walking primary's
+        interval is authoritative even over a divergent local copy
+        whose version reads newer (pre-trim history from a dead
+        interval), and the cursor gating guarantees no client write
+        for this object can race the push."""
         ver = tuple(version) if version else (0, 0)
         inv = shard.inventory().get(oid)
-        if inv is not None and not force and inv[0] >= ver:
-            return
-        if inv is not None and force and inv[0] > ver:
-            return
+        if not backfill:
+            if inv is not None and not force and inv[0] >= ver:
+                return
+            if inv is not None and force and inv[0] > ver:
+                return
         if whiteout:
             shard.apply_write(oid, 0, b"", True, EVersion(*ver), [])
             shard.apply_clone_payloads(oid, clones or {})
@@ -871,19 +1097,30 @@ class OSDDaemon(Dispatcher, MonHunter):
         shard.apply_clone_payloads(oid, clones or {})
 
     def _handle_push(self, msg: PGPush) -> None:
-        st = self.pgs.get(msg.pgid)
-        if st is None or not isinstance(st.shard, ReplicatedPGShard):
-            # a delayed push for a PG we no longer own must not write
-            # into the store (it would be reported by a later scan)
-            return
-        self._apply_push(st.shard, msg.oid, msg.data, msg.version,
-                         msg.whiteout, force=msg.force,
-                         attrs=msg.attrs, omap=msg.omap,
-                         omap_hdr=msg.omap_hdr, clones=msg.clones)
-        if st.recovering and msg.oid in st.pull_pending:
-            st.pull_pending.discard(msg.oid)
-            if not st.pull_pending and not st.scan_pending:
-                self._finish_recovery(msg.pgid, st)
+        with self._lock:
+            st = self.pgs.get(msg.pgid)
+            if st is None or not isinstance(st.shard,
+                                            ReplicatedPGShard):
+                # a delayed push for a PG we no longer own must not
+                # write into the store (a later scan would report it)
+                return
+            self._apply_push(st.shard, msg.oid, msg.data, msg.version,
+                             msg.whiteout, force=msg.force,
+                             attrs=msg.attrs, omap=msg.omap,
+                             omap_hdr=msg.omap_hdr, clones=msg.clones,
+                             backfill=msg.backfill)
+            if msg.version:
+                # clear any missing-set entry this push satisfied (the
+                # replica side of recovery bookkeeping)
+                st.shard.pg_log.recover_got(
+                    msg.oid, EVersion(*tuple(msg.version)))
+            if st.peering is not None:
+                st.peering.on_pull_done(msg.oid)
+                return
+            if st.recovering and msg.oid in st.pull_pending:
+                st.pull_pending.discard(msg.oid)
+                if not st.pull_pending and not st.scan_pending:
+                    self._finish_recovery(msg.pgid, st)
 
     def _finish_recovery(self, pg: PG, st: _PGState) -> None:
         mine = st.shard.inventory()
@@ -940,7 +1177,266 @@ class OSDDaemon(Dispatcher, MonHunter):
                                       self.name, pg)
 
     def pgs_recovering(self) -> int:
-        return sum(1 for st in self.pgs.values() if st.recovering)
+        return sum(1 for st in self.pgs.values()
+                   if st.recovering or st.backfilling)
+
+    # ------------------------------------------- peering statechart glue
+    def _replica_merge_log(self, msg: PGLogPush) -> None:
+        """Replica side of GetMissing: merge the primary's
+        authoritative log (our own divergent entries resolved by the
+        five-case machinery, store effects via the rollbacker), then
+        report what we now know we lack
+        (ref: PG::merge_log on MOSDPGLog + the activate missing
+        exchange)."""
+        from .peering import StoreRollbacker
+        from .pg_log import IndexedLog
+        from .pg_types import ZERO_VERSION
+        st = self.pgs.get(msg.pgid)
+        if st is not None and isinstance(st.shard, ReplicatedPGShard):
+            shard = st.shard
+        else:
+            # map lag: we may not know we're acting yet; the merge is
+            # durable so the eventual PG state re-loads it
+            shard = ReplicatedPGShard(msg.pgid, self.store)
+        head = msg.head if msg.head is not None else ZERO_VERSION
+        tail = msg.tail if msg.tail is not None else ZERO_VERSION
+        if msg.full:
+            # wholesale adoption closing a backfill: the walk already
+            # made the store match the primary's interval, so the log
+            # simply replaces ours (no overlap requirement)
+            shard.pg_log.log = IndexedLog(list(msg.entries), head=head,
+                                          tail=tail)
+            shard.pg_log.log.can_rollback_to = head
+            shard.pg_log.missing.items.clear()
+            shard.persist_log()
+            self.ms.connect(msg.src).send_message(PGMissingReply(
+                pgid=msg.pgid, from_osd=self.whoami, epoch=msg.epoch))
+            return
+        olog = IndexedLog(list(msg.entries), head=head, tail=tail)
+        try:
+            shard.pg_log.merge_log(olog, StoreRollbacker(shard))
+        except ValueError:
+            self.ms.connect(msg.src).send_message(PGMissingReply(
+                pgid=msg.pgid, from_osd=self.whoami, epoch=msg.epoch,
+                no_overlap=True))
+            return
+        shard.persist_log()
+        missing = {oid: (it.need.epoch, it.need.version)
+                   for oid, it in shard.pg_log.missing.items.items()}
+        self.ms.connect(msg.src).send_message(PGMissingReply(
+            pgid=msg.pgid, from_osd=self.whoami, epoch=msg.epoch,
+            missing=missing))
+
+    def _handle_backfill_reserve(self, msg: BackfillReserve) -> None:
+        """Both ends of the reservation handshake (ref:
+        MBackfillReserve + the AsyncReserver pair: requests past
+        capacity queue and are granted as slots free).  Local and
+        remote pools are INDEPENDENT — an OSD can drive one backfill
+        while serving another; a combined pool deadlocks the moment
+        every primary holds local waiting on a saturated remote."""
+        key = (msg.pgid, msg.from_osd)
+        if msg.op == "request":
+            limit = global_config()["osd_max_backfills"]
+            if key in self._remote_backfills or \
+                    len(self._remote_backfills) < limit:
+                self._remote_backfills.add(key)
+                if not self.ms.connect(msg.src).send_message(
+                        BackfillReserve(pgid=msg.pgid,
+                                        from_osd=self.whoami,
+                                        op="grant")):
+                    self._remote_backfills.discard(key)
+            elif (key, msg.src) not in self._remote_waitq:
+                self._remote_waitq.append((key, msg.src))
+            return
+        if msg.op == "release":
+            self._remote_backfills.discard(key)
+            self._remote_waitq = [(k, s) for k, s in self._remote_waitq
+                                  if k != key]
+            self._grant_queued_reservations()
+            return
+        st = self.pgs.get(msg.pgid)         # grant | reject
+        pr = st.peering if st is not None else None
+        consumed = pr.on_reserve(msg) if pr is not None \
+            else msg.op != "grant"
+        if not consumed:
+            # a grant nobody can use (this round was superseded):
+            # hand the slot back or it leaks on the target
+            self.ms.connect(msg.src).send_message(BackfillReserve(
+                pgid=msg.pgid, from_osd=self.whoami, op="release"))
+
+    def _grant_queued_reservations(self) -> None:
+        """Capacity freed: grant queued remote requests, then wake
+        queued local backfills (FIFO within each class)."""
+        limit = global_config()["osd_max_backfills"]
+        while self._remote_waitq and len(self._remote_backfills) < limit:
+            key, src = self._remote_waitq.pop(0)
+            self._remote_backfills.add(key)
+            if not self.ms.connect(src).send_message(BackfillReserve(
+                    pgid=key[0], from_osd=self.whoami, op="grant")):
+                self._remote_backfills.discard(key)   # requester died
+        while self._local_waitq and len(self._local_backfills) < limit:
+            pg = self._local_waitq.pop(0)
+            st = self.pgs.get(pg)
+            if st is None or st.peering is None:
+                continue
+            self._local_backfills.add(pg)
+            st.peering.local_granted()
+
+    def reserve_local_backfill(self, pg: PG) -> bool:
+        """True = slot taken now; False = queued, the peering's
+        local_granted() fires when capacity frees."""
+        if pg in self._local_backfills:
+            return True
+        limit = global_config()["osd_max_backfills"]
+        if len(self._local_backfills) >= limit:
+            if pg not in self._local_waitq:
+                self._local_waitq.append(pg)
+            return False
+        self._local_backfills.add(pg)
+        return True
+
+    def release_local_backfill(self, pg: PG) -> None:
+        self._local_backfills.discard(pg)
+        if pg in self._local_waitq:
+            self._local_waitq.remove(pg)
+        self._grant_queued_reservations()
+
+    def request_pg_temp(self, pg: PG, osds: list[int]) -> None:
+        """Ask the mon to pin this PG's acting set (ref:
+        src/messages/MOSDPGTemp.h; OSDMonitor::prepare_pgtemp)."""
+        self.ms.connect(self.mon).send_message(MOSDPGTemp(
+            pgid=pg, from_osd=self.whoami, epoch=self.osdmap.epoch,
+            osds=list(osds)))
+
+    def clear_pg_temp(self, pg: PG) -> None:
+        self.ms.connect(self.mon).send_message(MOSDPGTemp(
+            pgid=pg, from_osd=self.whoami, epoch=self.osdmap.epoch,
+            osds=[]))
+
+    def _push_object(self, pg: PG, st: _PGState, oid: str, osd: int,
+                     backfill: bool = False) -> None:
+        """One recovery/backfill push (no legacy push_pending
+        bookkeeping — the peering statechart tracks its own)."""
+        mine = st.shard.inventory()
+        if oid not in mine:
+            return
+        my_ver, whiteout = mine[oid]
+        if whiteout:
+            data, attrs, omap, hdr = b"", {}, {}, b""
+        else:
+            data, attrs, omap, hdr = st.shard.push_payload(oid)
+        self.perf.inc("recovery_push")
+        self.ms.connect(f"osd.{osd}").send_message(PGPush(
+            pgid=pg, oid=oid, data=data, size=len(data),
+            version=my_ver, whiteout=whiteout, backfill=backfill,
+            attrs=attrs, omap=omap, omap_hdr=hdr,
+            clones=st.shard.clone_payloads(oid)))
+
+    def _push_whiteout(self, pg: PG, oid: str, osd: int,
+                       over_version) -> None:
+        """Authoritative delete for a backfill target's stray object
+        (divergent leftover the walking primary does not know)."""
+        e, v = tuple(over_version)
+        self.ms.connect(f"osd.{osd}").send_message(PGPush(
+            pgid=pg, oid=oid, data=b"", size=0,
+            version=(e, v + 1), whiteout=True, backfill=True))
+
+    def _handle_stray_notify(self, msg: PGNotify) -> None:
+        """A stray announced itself (ref: the stray-notify ->
+        purge_strays flow in PeeringState::activate/Clean).  If the
+        stray holds history we went clean WITHOUT (multi-interval
+        churn the one-interval prior set missed), re-peer including
+        it; otherwise tell it to delete its copy."""
+        from .peering import CLEAN, PGPeering, _ev
+        st = self.pgs.get(msg.pgid)
+        if st is None or st.backend is None or \
+                st.acting_primary != self.whoami:
+            return
+        pr = st.peering
+        if pr is None or pr.phase != CLEAN or st.recovering or \
+                st.backfilling:
+            return        # busy: the stray re-notifies on its tick
+        head, _tail = st.shard.log_info()
+        if _ev(msg.last_update) > head:
+            dout("osd", 1).write(
+                "%s: stray osd.%d has newer history for pg %s "
+                "(%s > %s): re-peering", self.name, msg.from_osd,
+                msg.pgid, msg.last_update, head)
+            st.peering = PGPeering(self, msg.pgid, st,
+                                   prior_acting=[msg.from_osd])
+            st.peering.start()
+            return
+        self.ms.connect(msg.src).send_message(PGRemove(
+            pgid=msg.pgid, epoch=self.osdmap.epoch))
+
+    def _notify_strays(self, rebuild: bool = True) -> None:
+        """Announce every replicated PG collection we hold but are no
+        longer mapped to (up OR acting) to its current primary — the
+        stray side of the purge flow.  The candidate scan (store walk
+        + CRUSH + log decode) runs only on map ingest; ticks re-send
+        the cached notifies so a primary that was mid-peering on the
+        first one hears from us again.  Strays get no writes, so the
+        cached info cannot go stale; PGRemove drops the cache entry."""
+        if rebuild:
+            self._stray_notifies = {}
+            m = self.osdmap
+            for cid in self.store.list_collections():
+                if not cid.startswith("pg_") or "." not in cid:
+                    continue
+                try:
+                    pool_part, ps_part = cid[3:].split(".", 1)
+                    pg = PG(int(pool_part), int(ps_part, 16))
+                except ValueError:
+                    continue
+                pool = m.pools.get(pg.pool)
+                if pool is None or pg.ps >= pool.pg_num or \
+                        pool.type == POOL_TYPE_ERASURE:
+                    continue
+                if pg in self.pgs:
+                    continue
+                up, _, acting, ap = m.pg_to_up_acting_osds(pg)
+                if self.whoami in list(up) + list(acting) or ap < 0 \
+                        or ap >= CRUSH_ITEM_NONE:
+                    continue
+                if not any(o.name != "pgmeta"
+                           for o in self.store.collection_list(cid)):
+                    continue
+                shard = self._replicated_view(pg)
+                head, tail = shard.log_info()
+                inv = shard.inventory()
+                self._stray_notifies[pg] = PGNotify(
+                    pgid=pg, from_osd=self.whoami, epoch=m.epoch,
+                    last_update=head, log_tail=tail,
+                    have_data=bool(inv), n_objects=len(inv),
+                    stray=True), ap
+        for pg, (note, ap) in list(self._stray_notifies.items()):
+            self.ms.connect(f"osd.{ap}").send_message(note)
+
+    def _handle_pg_remove(self, msg: PGRemove) -> None:
+        """Delete a stray PG copy (ref: MOSDPGRemove ->
+        PG::_delete_some).  Refused while our own map still places the
+        PG on us — a lagging primary must not void live data."""
+        m = self.osdmap
+        pool = m.pools.get(msg.pgid.pool)
+        if pool is not None:
+            up, _, acting, _ = m.pg_to_up_acting_osds(msg.pgid)
+            if self.whoami in list(up) + list(acting):
+                return
+        st = self.pgs.pop(msg.pgid, None)
+        if st is not None and st.backend is not None:
+            st.backend.fail_in_flight()
+        self._stray_notifies.pop(msg.pgid, None)
+        from .ec_backend import pg_cid
+        cid = pg_cid(msg.pgid)
+        if not self.store.collection_exists(cid):
+            return
+        txn = Transaction()
+        for soid in self.store.collection_list(cid):
+            txn.remove(cid, soid)
+        txn.remove_collection(cid)
+        self.store.queue_transaction(txn)
+        dout("osd", 4).write("%s: removed stray pg %s", self.name,
+                             msg.pgid)
 
     # ------------------------------------------------------------ scrub
     # Primary-driven deep scrub (ref: src/osd/scrubber/pg_scrubber.cc:
@@ -1147,6 +1643,16 @@ class OSDDaemon(Dispatcher, MonHunter):
             return self.ms.connect(f"osd.{osd}").send_message(payload)
         return send
 
+    def _make_send_osd(self):
+        """OSD-id addressed send (replicated backends: the fan-out may
+        include up-but-not-acting backfill targets, which have no
+        acting shard index)."""
+        def send(osd: int, payload) -> bool:
+            if osd < 0:
+                return False
+            return self.ms.connect(f"osd.{osd}").send_message(payload)
+        return send
+
     # ------------------------------------------------------ heartbeats
     def heartbeat_peers(self) -> set[int]:
         """OSDs sharing PGs with this one (ref: OSD.cc
@@ -1156,6 +1662,7 @@ class OSDDaemon(Dispatcher, MonHunter):
         with self._lock:
             for st in self.pgs.values():
                 peers.update(o for o in st.acting if o >= 0)
+                peers.update(o for o in st.up if o >= 0)
         peers.discard(self.whoami)
         return peers
 
@@ -1168,6 +1675,14 @@ class OSDDaemon(Dispatcher, MonHunter):
         self._drain_op_queue()      # paced recovery/scrub backlog
         now = _time.monotonic() if now is None else now
         self.hbmap.reset_timeout(self._hb_handle)
+        # peering retry hooks (backfill reservation backoff) + stray
+        # re-notify (a primary that was mid-peering on our first
+        # notify hears from us again)
+        with self._lock:
+            for st in self.pgs.values():
+                if st.peering is not None:
+                    st.peering.tick(now)
+            self._notify_strays(rebuild=False)
         grace = global_config()["osd_heartbeat_grace"]
         # clock-domain sanity: if our own ticks stopped for more than a
         # grace (or time went backwards — e.g. a test switching between
@@ -1247,16 +1762,18 @@ class OSDDaemon(Dispatcher, MonHunter):
             state = ["active"]
             if st.recovering:
                 state.append("recovering")
+            if st.backfilling:
+                state.append("backfilling")
             if alive < width:
                 state.append("degraded")
-            elif not st.recovering:
+            elif not st.recovering and not st.backfilling:
                 state.append("clean")
             if st.scrub is not None:
                 state.append("scrubbing")
             objs = st.shard.objects()
             nbytes = sum(st.shard.object_size(o) for o in objs)
             order = ["active", "clean", "degraded", "recovering",
-                     "scrubbing"]
+                     "backfilling", "scrubbing"]
             pg_stats[str(pg)] = {
                 "state": "+".join(sorted(state, key=order.index)),
                 "num_objects": len(objs), "bytes": nbytes,

@@ -22,8 +22,28 @@ from .pg_log import PGLog
 from .pg_types import DELETE, EVersion, MODIFY, PGLogEntry, ZERO_VERSION
 
 
+#: pgmeta omap key prefix for persisted log entries; the key embeds the
+#: zero-padded (epoch, version) so lexicographic omap order IS log
+#: order (ref: PGLog.cc write_log_and_missing — log entries are rocksdb
+#: keys under the pgmeta object the same way)
+_LOG_KEY = "l.{:010d}.{:012d}"
+_TAIL_KEY = "t"           # persisted log tail marker (EVersion)
+
+PGMETA = ObjectId("pgmeta")
+
+
+def _log_key(v) -> str:
+    return _LOG_KEY.format(v.epoch, v.version)
+
+
 class ReplicatedPGShard:
-    """Per-OSD service for one replicated PG (primary or replica)."""
+    """Per-OSD service for one replicated PG (primary or replica).
+
+    The shard's pg_log is durable: every apply writes its entries into
+    the pgmeta object's omap in the SAME store transaction as the data
+    (ref: PGLog::write_log_and_missing riding the op's txn), so a
+    restarted OSD re-peers from real log bounds instead of an empty
+    log that would force a full backfill."""
 
     def __init__(self, pgid, store, create: bool = True):
         self.pgid = pgid
@@ -33,6 +53,69 @@ class ReplicatedPGShard:
         if create and not store.collection_exists(self.cid):
             store.queue_transaction(
                 Transaction().create_collection(self.cid))
+        self._load_log()
+
+    # -- durable log ---------------------------------------------------
+    def _load_log(self) -> None:
+        from ..msg import encoding as wire
+        if not self.store.collection_exists(self.cid) or \
+                not self.store.exists(self.cid, PGMETA):
+            return
+        omap = self.store.omap_get(self.cid, PGMETA)
+        entries = [wire.decode(v) for k, v in sorted(omap.items())
+                   if k.startswith("l.")]
+        if not entries and _TAIL_KEY not in omap:
+            return
+        tail = wire.decode(omap[_TAIL_KEY]) if _TAIL_KEY in omap \
+            else ZERO_VERSION
+        from .pg_log import IndexedLog
+        head = entries[-1].version if entries else tail
+        self.pg_log = PGLog(IndexedLog(entries, head=head, tail=tail))
+
+    def _log_txn_ops(self, txn: Transaction, new_entries: list) -> list:
+        """Append `new_entries` to the durable log inside `txn`, and
+        trim when past osd_max_pg_log_entries (down to
+        osd_min_pg_log_entries, ref: PG::calc_trim_to).  Returns the
+        entries to drop from the in-memory log AFTER the txn commits
+        (a failed txn must not trim memory ahead of disk)."""
+        from ..common.options import global_config
+        from ..msg import encoding as wire
+        txn.touch(self.cid, PGMETA)
+        txn.omap_setkeys(self.cid, PGMETA,
+                         {_log_key(e.version): wire.encode(e)
+                          for e in new_entries})
+        cfg = global_config()
+        total = len(self.pg_log.log) + len(new_entries)
+        dropped: list = []
+        if total > cfg["osd_max_pg_log_entries"]:
+            drop = total - cfg["osd_min_pg_log_entries"]
+            dropped = self.pg_log.log.entries[:drop]
+            if dropped:
+                txn.omap_rmkeys(self.cid, PGMETA,
+                                [_log_key(e.version) for e in dropped])
+                txn.omap_setkeys(self.cid, PGMETA, {
+                    _TAIL_KEY: wire.encode(dropped[-1].version)})
+        return dropped
+
+    def persist_log(self) -> None:
+        """Rewrite the whole durable log (after a peering merge_log,
+        where entries were rewound/replaced, not appended)."""
+        from ..msg import encoding as wire
+        txn = Transaction()
+        if not self.store.collection_exists(self.cid):
+            txn.create_collection(self.cid)
+        txn.touch(self.cid, PGMETA)
+        txn.omap_clear(self.cid, PGMETA)
+        txn.omap_setkeys(self.cid, PGMETA, dict(
+            {_log_key(e.version): wire.encode(e)
+             for e in self.pg_log.log.entries},
+            **{_TAIL_KEY: wire.encode(self.pg_log.log.tail)}))
+        self.store.queue_transaction(txn)
+
+    def log_info(self) -> tuple:
+        """(last_update, log_tail) — the pg_info_t core the peering
+        GetInfo phase exchanges."""
+        return self.pg_log.log.head, self.pg_log.log.tail
 
     # -- local apply (both roles; ref: ReplicatedBackend.cc:1148) ------
     # Deletes leave a zero-length *whiteout* carrying the delete's
@@ -83,11 +166,16 @@ class ReplicatedPGShard:
                 txn.setattr(self.cid, soid, OI_ATTR,
                             {"size": size, "version": version,
                              "snap_seq": new_seq, "clones": clones})
+            new_entries = [e for e in log_entries
+                           if e.version > self.pg_log.log.head]
+            dropped = self._log_txn_ops(txn, new_entries) \
+                if new_entries else []
             if not txn.empty():
                 self.store.queue_transaction(txn)
-            for e in log_entries:
-                if e.version > self.pg_log.log.head:
-                    self.pg_log.append(e)
+            if dropped:
+                self.pg_log.log.trim_to(dropped[-1].version)
+            for e in new_entries:
+                self.pg_log.append(e)
             return True
         except StoreError as err:
             dout("osd", 0).write("%s replicated apply failed: %s",
@@ -454,9 +542,17 @@ class ReplicatedBackend:
         self.whoami = whoami
         self.acting = list(acting)
         self.local_shard = local_shard
-        self.send = send
+        self.send = send                 # send(osd_id, msg) -> bool
         self.epoch = epoch
-        self.last_version = ZERO_VERSION
+        # version continuity across primary changes: resume AFTER the
+        # durable log head, or a rebuilt primary in the same epoch
+        # would re-issue versions its log already holds
+        self.last_version = local_shard.pg_log.log.head
+        #: backfill targets' write-gating cursors (osd -> last_backfill
+        #: oid; the entry is REMOVED once the walk completes — ref: the
+        #: last_backfill gating in PrimaryLogPG::issue_repop): ops fan
+        #: out to a target only for objects the walk already copied
+        self.backfill_peers: dict[int, str] = {}
         self._tid = 0
         self._tid_gen = tid_gen    # see ECBackend: no tid reuse across
         self._lock = threading.RLock()      # backend rebuilds
@@ -482,8 +578,9 @@ class ReplicatedBackend:
             op.on_all_commit(False)
 
     def _next_version(self) -> EVersion:
-        self.last_version = EVersion(self.epoch,
-                                     self.last_version.version + 1)
+        self.last_version = EVersion(
+            max(self.epoch, self.last_version.epoch),
+            self.last_version.version + 1)
         return self.last_version
 
     def _resolve_muts(self, oid: str, muts: list) -> list:
@@ -568,21 +665,34 @@ class ReplicatedBackend:
             muts = self._resolve_muts(oid, muts)
             seq, snaps = self._snap_context(snapc)
             clone_snap, covers = self._cow_decision(oid, seq, snaps)
+            prior = EVersion(*self.local_shard.object_version(oid))
             entry = PGLogEntry(DELETE if mut.is_delete(muts) else MODIFY,
-                               oid, version)
+                               oid, version, prior_version=prior)
             ok = self.local_shard.apply_mutations(
                 oid, muts, version, [entry], clone_snap=clone_snap,
                 clone_covers=covers, snap_seq=seq)
             if not ok:
                 on_all_commit(False)
                 return tid
-            replicas = [i for i, o in enumerate(self.acting)
+            replicas = [o for o in self.acting
                         if o >= 0 and o != self.whoami]
-            if not replicas:
+            for o in self.backfill_peers:
+                if o not in replicas and o != self.whoami:
+                    replicas.append(o)
+            targets = []
+            for o in replicas:
+                cursor = self.backfill_peers.get(o)
+                if cursor is not None and oid > cursor:
+                    # past the target's last_backfill: the walk copies
+                    # this object later, already carrying this write
+                    # (ref: last_backfill gating in issue_repop)
+                    continue
+                targets.append(o)
+            if not targets:
                 on_all_commit(True)
                 return tid
             op = _RepWrite(tid=tid, on_all_commit=on_all_commit,
-                           pending=set(replicas))
+                           pending=set(targets))
             self.in_flight[tid] = op
             from ..common.tracing import child_of
             msg = RepOpWrite(pgid=self.pgid, tid=tid, oid=oid,
@@ -591,10 +701,10 @@ class ReplicatedBackend:
                              clone_snap=clone_snap,
                              clone_covers=covers or [],
                              snap_seq=seq, trace=child_of(trace))
-            for s in replicas:
-                if not self.send(s, msg):
-                    op.failed.add(s)
-                    op.pending.discard(s)
+            for o in targets:
+                if not self.send(o, msg):
+                    op.failed.add(o)
+                    op.pending.discard(o)
             self._maybe_done(op)
             return tid
 
@@ -603,11 +713,10 @@ class ReplicatedBackend:
             op = self.in_flight.get(m.tid)
             if op is None:
                 return
-            for idx, osd in enumerate(self.acting):
-                if osd == m.from_osd and idx in op.pending:
-                    op.pending.discard(idx)
-                    if not m.committed:
-                        op.failed.add(idx)
+            if m.from_osd in op.pending:
+                op.pending.discard(m.from_osd)
+                if not m.committed:
+                    op.failed.add(m.from_osd)
             self._maybe_done(op)
 
     def _maybe_done(self, op: _RepWrite) -> None:

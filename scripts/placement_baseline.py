@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""CPU placement baseline: the reference CRUSH C core mapping the
+BASELINE scale (1M PGs x 10k OSDs straw2) single-threaded.
+
+Builds the same osdmaptool --createsimple topology as
+scripts/placement_bench.py inside the compiled reference core
+(/tmp/crush_oracle/libcrush_oracle.so — scripts/build_crush_oracle.sh)
+and times `crush_do_rule` over every PG in one C-side loop
+(`oracle_map_bulk`), so no Python/ctypes per-call overhead taints the
+number (ref: src/tools/osdmaptool.cc --test-map-pgs driving
+src/crush/mapper.c:900 on one core; the reference threads the same
+loop via ParallelPGMapper, src/osd/OSDMapMapping.h:18).
+
+Prints one JSON line: {"baseline_mappings_per_s": ...}.  Run with
+--update-bench to fold the number into PLACEMENT_BENCH.json as
+`baseline_mappings_per_s` + `vs_baseline`.
+"""
+import argparse
+import ctypes
+import json
+import pathlib
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from ceph_tpu.crush.types import (CRUSH_BUCKET_STRAW2,  # noqa: E402
+                                  CRUSH_RULE_CHOOSELEAF_FIRSTN,
+                                  CRUSH_RULE_EMIT, CRUSH_RULE_TAKE)
+
+ORACLE_SO = "/tmp/crush_oracle/libcrush_oracle.so"
+#: jewel tunables, matching CrushMap.set_tunables_profile("jewel")
+JEWEL = (0, 0, 50, 1, 1, 1)
+
+
+def build_oracle(n_osd: int, osds_per_host: int = 20):
+    lib = ctypes.CDLL(ORACLE_SO)
+    lib.oracle_create.restype = ctypes.c_void_p
+    lib.oracle_add_bucket.restype = ctypes.c_int
+    lib.oracle_add_rule.restype = ctypes.c_int
+    lib.oracle_map_bulk.restype = ctypes.c_longlong
+    h = ctypes.c_void_p(lib.oracle_create())
+    lib.oracle_set_tunables(h, *[ctypes.c_int(v) for v in JEWEL])
+
+    def add_bucket(alg, type_, items, weights):
+        n = len(items)
+        ia = (ctypes.c_int * n)(*items)
+        wa = (ctypes.c_int * n)(*weights)
+        return lib.oracle_add_bucket(h, alg, type_, n, ia, wa, 0)
+
+    # mirror OSDMap.build_simple: hosts of `osds_per_host`, one root
+    host_ids = []
+    for base in range(0, n_osd, osds_per_host):
+        items = list(range(base, min(base + osds_per_host, n_osd)))
+        host_ids.append(add_bucket(CRUSH_BUCKET_STRAW2, 1, items,
+                                   [0x10000] * len(items)))
+    hw = [0x10000 * osds_per_host] * len(host_ids)
+    root = add_bucket(CRUSH_BUCKET_STRAW2, 10, host_ids, hw)
+    steps = [(CRUSH_RULE_TAKE, root, 0),
+             (CRUSH_RULE_CHOOSELEAF_FIRSTN, 0, 1),
+             (CRUSH_RULE_EMIT, 0, 0)]
+    n = len(steps)
+    ops = (ctypes.c_int * n)(*[s[0] for s in steps])
+    a1 = (ctypes.c_int * n)(*[s[1] for s in steps])
+    a2 = (ctypes.c_int * n)(*[s[2] for s in steps])
+    ruleno = lib.oracle_add_rule(h, n, ops, a1, a2)
+    lib.oracle_finalize(h)
+    return lib, h, ruleno
+
+
+def run(n_osd: int, pg_num: int, size: int = 3,
+        verify_sample: int = 64) -> dict:
+    from ceph_tpu.osd.types import PGPool
+    pool = PGPool(pg_num=pg_num, pgp_num=pg_num, size=size)
+    pss = np.arange(pg_num, dtype=np.int64)
+    pps = pool.raw_pg_to_pps_batch(pss, 0).astype(np.int32)
+
+    lib, h, ruleno = build_oracle(n_osd)
+    weights = (ctypes.c_uint * n_osd)(*([0x10000] * n_osd))
+    xs = pps.ctypes.data_as(ctypes.POINTER(ctypes.c_int))
+
+    # warm pass on a slice (page in the map), then the timed full loop
+    lib.oracle_map_bulk(h, ruleno, xs, min(4096, pg_num), size,
+                        weights, n_osd, None)
+    t0 = time.perf_counter()
+    acc = lib.oracle_map_bulk(h, ruleno, xs, pg_num, size, weights,
+                              n_osd, None)
+    dt = time.perf_counter() - t0
+
+    # cross-check a sample against the framework's scalar engine
+    # (itself fixture-validated against this very C core)
+    from ceph_tpu.osd.osdmap import OSDMap
+    m = OSDMap()
+    m.build_simple(n_osd, osds_per_host=20, pg_pool=pool)
+    from ceph_tpu.crush import mapper as scalar
+    rng = np.random.default_rng(0)
+    out = np.empty(verify_sample * size, dtype=np.int32)
+    idx = rng.choice(pg_num, size=verify_sample, replace=False)
+    sample_xs = pps[idx].astype(np.int32).copy()
+    lib.oracle_map_bulk(
+        h, ruleno,
+        sample_xs.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        verify_sample, size, weights, n_osd,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int)))
+    pyrule = m.crush.find_rule(m.pools[0].crush_rule, pool.type, size)
+    for i, ps in enumerate(idx):
+        want = scalar.do_rule(m.crush, pyrule, int(pps[ps]), size,
+                              m.osd_weight)
+        got = [int(o) for o in out[i * size:(i + 1) * size]][:len(want)]
+        assert got == list(want), (ps, got, want)
+
+    return {
+        "baseline_mappings_per_s": round(pg_num / dt, 1),
+        "seconds": round(dt, 3),
+        "n_osd": n_osd, "pg_num": pg_num, "size": size,
+        "checksum": int(acc),
+        "engine": "reference crush C core, 1 thread (-O2)",
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n-osd", type=int, default=10_000)
+    ap.add_argument("--pg-num", type=int, default=1 << 20)
+    ap.add_argument("--update-bench", action="store_true",
+                    help="fold baseline + vs_baseline into "
+                         "PLACEMENT_BENCH.json")
+    a = ap.parse_args()
+    out = run(a.n_osd, a.pg_num)
+    print(json.dumps(out))
+    if a.update_bench:
+        root = pathlib.Path(__file__).resolve().parent.parent
+        path = root / "PLACEMENT_BENCH.json"
+        rec = json.loads(path.read_text())
+        rec["detail"]["baseline_mappings_per_s"] = \
+            out["baseline_mappings_per_s"]
+        rec["detail"]["baseline_engine"] = out["engine"]
+        rec["vs_baseline"] = round(
+            rec["value"] / out["baseline_mappings_per_s"], 3)
+        path.write_text(json.dumps(rec) + "\n")
+
+
+if __name__ == "__main__":
+    main()

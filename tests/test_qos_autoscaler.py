@@ -173,12 +173,11 @@ def test_pg_split_preserves_objects():
                                      "pool": "sp", "var": "pg_num",
                                      "val": "16"})
         assert rc == 0, outs
-        # pgp_num growth (placement reseed) is refused — split children
-        # must stay on the parent's seed or they could orphan data
+        # pgp_num beyond pg_num stays invalid
         rc2, outs2, _ = r.mon_command({"prefix": "osd pool set",
                                        "pool": "sp", "var": "pgp_num",
-                                       "val": "16"})
-        assert rc2 < 0 and "not supported" in outs2
+                                       "val": "32"})
+        assert rc2 < 0
         # wait for the map + split + re-peering to settle
         deadline = time.monotonic() + 60
         while time.monotonic() < deadline:
@@ -194,6 +193,29 @@ def test_pg_split_preserves_objects():
             time.sleep(0.2)
         for k, v in objs.items():
             assert io.read(k) == v, f"{k} lost across the split"
+        # pgp_num growth (placement reseed) is now a supported
+        # operation: the peering statechart's prior-interval queries +
+        # backfill chase the relocated data (VERDICT r3 #1)
+        rc3, outs3, _ = r.mon_command({"prefix": "osd pool set",
+                                       "pool": "sp", "var": "pgp_num",
+                                       "val": "16"})
+        assert rc3 == 0, outs3
+        deadline = time.monotonic() + 90
+        settled = False
+        while time.monotonic() < deadline and not settled:
+            c.tick()
+            if all(d.osdmap.pools.get(0) is not None and
+                   d.osdmap.pools[0].pgp_num == 16 and
+                   d.pgs_recovering() == 0
+                   for d in c.osds.values()):
+                try:
+                    settled = all(io.read(k) == v
+                                  for k, v in objs.items())
+                except Exception:
+                    settled = False
+            time.sleep(0.2)
+        for k, v in objs.items():
+            assert io.read(k) == v, f"{k} lost across the reseed"
     finally:
         c.shutdown()
 

@@ -57,6 +57,8 @@ class _MDSSession(Dispatcher):
                 # flushing runs sync IO — never on the dispatch thread
                 threading.Thread(target=self.fs._handle_revoke,
                                  args=(msg,), daemon=True).start()
+            elif self.fs is not None and msg.op == "snapc":
+                self.fs._handle_snapc(msg)
             return True
         if not isinstance(msg, MClientReply):
             return False
@@ -108,13 +110,26 @@ class FileHandle:
         self.layout = StripeLayout(**rec["layout"])
         self.size = rec.get("size", 0)
         self.caps = caps
+        #: non-None = a `.snap` path handle: reads at that snapid,
+        #: writes EROFS (ref: the snapdir is read-only)
+        self.snapid = rec.get("snapid")
         self._dirty_size = False
         self._rcache: dict[tuple[int, int], bytes] = {}
         self._io = fs.rados.open_ioctx(rec["pool"])
+        # writes under a snapped realm carry its snap context so the
+        # OSD COWs pre-snap state (ref: SnapRealm::get_snap_context
+        # feeding every data op)
+        self.set_snapc(rec.get("snapc"))
         fs._register_handle(self)
+
+    def set_snapc(self, snapc: dict | None) -> None:
+        if snapc:
+            self._io.set_write_snapc(snapc["seq"], snapc["snaps"])
 
     # -- data path (ref: Client::_write -> Striper + Objecter) ---------
     def write(self, offset: int, data: bytes) -> int:
+        if self.snapid is not None:
+            raise CephFSError("EROFS", self.path)
         futs = []
         for ext in Striper.file_to_extents(self.layout, offset,
                                            len(data)):
@@ -147,8 +162,10 @@ class FileHandle:
         return self.write(self.size, data)
 
     def read(self, offset: int, length: int = 0) -> bytes:
-        if not self.caps & (CAP_EXCL | CAP_CACHE):
+        if self.snapid is None and \
+                not self.caps & (CAP_EXCL | CAP_CACHE):
             # no caps: another client may have extended the file
+            # (snap handles never refresh: the record is frozen)
             self.size = max(self.size,
                             self.fs.stat(self.path).get("size", 0))
         if length == 0 or offset + length > self.size:
@@ -166,7 +183,8 @@ class FileHandle:
                                            length):
             pend.append((ext, self._io.aio_read(
                 fs_data_obj(self.ino, ext.objectno),
-                length=ext.length, offset=ext.offset)))
+                length=ext.length, offset=ext.offset,
+                snapid=self.snapid)))
         for ext, fut in pend:
             try:
                 buf = self._io._wait(fut).data
@@ -192,6 +210,8 @@ class FileHandle:
         self.caps = 0
 
     def fsync(self) -> None:
+        if self.snapid is not None:
+            return
         self.fs._session.call("setattr", {"path": self.path,
                                           "size": self.size,
                                           "grow_only": True})
@@ -247,6 +267,15 @@ class CephFS:
                 pass
         self._session.ms.connect(self._session.mds).send_message(
             MClientCaps(op="ack", ino=msg.ino))
+
+    def _handle_snapc(self, msg) -> None:
+        """mksnap widened the realm's snap context: every open handle
+        on the ino switches its write snapc so the OSD COWs pre-snap
+        state (ref: the SnapRealm update broadcast)."""
+        with self._hlock:
+            handles = list(self._handles.get(msg.ino, []))
+        for fh in handles:
+            fh.set_snapc(msg.snapc)
 
     # -- namespace ------------------------------------------------------
     def mkdir(self, path: str) -> None:
@@ -304,17 +333,9 @@ class CephFS:
                 self._purge_data(rec, purge)
         # capability request loop: EAGAIN while the MDS revokes
         # conflicting caps (ref: Client waits out cap revocation)
-        deadline = _time.monotonic() + timeout
-        while True:
-            try:
-                out = self._session.call("open", {
-                    "path": path, "wants_write": wants_write})
-                break
-            except CephFSError as e:
-                if e.errno_name != "EAGAIN" or \
-                        _time.monotonic() > deadline:
-                    raise
-                _time.sleep(0.02)
+        out = self._retry_eagain(
+            lambda: self._session.call("open", {
+                "path": path, "wants_write": wants_write}), timeout)
         rec, caps = out["rec"], out["caps"]
         if rec["type"] != "f":
             raise CephFSError("EISDIR", path)
@@ -324,9 +345,46 @@ class CephFS:
         """Hardlink (ref: libcephfs ceph_link)."""
         self._session.call("link", {"src": src, "dst": dst})
 
+    def _retry_eagain(self, fn, timeout: float):
+        """EAGAIN retry loop: the MDS answers EAGAIN while revoking
+        caps out from under the op; the client waits it out (ref:
+        Client's cap-wait)."""
+        deadline = _time.monotonic() + timeout
+        while True:
+            try:
+                return fn()
+            except CephFSError as e:
+                if e.errno_name != "EAGAIN" or \
+                        _time.monotonic() >= deadline:
+                    raise
+                _time.sleep(0.02)
+
+    # -- snapshots (ref: libcephfs ceph_mksnap/ceph_rmsnap) -------------
+    def mksnap(self, path: str, name: str,
+               timeout: float = 10.0) -> int:
+        """Snapshot a directory realm; `<path>/.snap/<name>` serves
+        the frozen namespace + data.  Retries while the MDS flushes
+        EXCL holders under the realm (their buffered sizes must land
+        before the dirfrags freeze)."""
+        return self._retry_eagain(
+            lambda: self._session.call("mksnap", {"path": path,
+                                                  "name": name}),
+            timeout)["id"]
+
+    def rmsnap(self, path: str, name: str) -> None:
+        self._session.call("rmsnap", {"path": path, "name": name})
+
+    def lssnap(self, path: str) -> dict[str, dict]:
+        return self._session.call("lssnap", {"path": path})
+
     def _purge_data(self, rec: dict, size: int) -> None:
         layout = StripeLayout(**rec["layout"])
         io = self.rados.open_ioctx(rec["pool"])
+        if rec.get("snapc"):
+            # deleting under a snapped realm: the OSD must COW the
+            # head into a clone so `.snap` reads survive the unlink
+            io.set_write_snapc(rec["snapc"]["seq"],
+                               rec["snapc"]["snaps"])
         objnos = {e.objectno for e in
                   Striper.file_to_extents(layout, 0, size)}
         for objno in sorted(objnos):

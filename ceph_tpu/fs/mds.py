@@ -54,6 +54,9 @@ ROOT_INO = 1
 JOURNAL_OBJ = "mds.journal"
 META_OBJ = "mds.meta"
 ITABLE_OBJ = "mds.itable"
+#: realm table (ref: src/mds/SnapServer.cc's snap table): omap key =
+#: realm dir ino -> {name: {"id": snapid, "stamp": t}}
+SNAPTABLE_OBJ = "mds.snaptable"
 #: applied_seq persists every N ops: the gap is the replay window
 APPLY_EVERY = 8
 
@@ -62,8 +65,15 @@ CAP_CACHE = 1          # may cache reads
 CAP_EXCL = 2           # may buffer writes; cached size is authoritative
 
 _ERRNO = {"ENOENT": -2, "EEXIST": -17, "ENOTDIR": -20, "EISDIR": -21,
+          "EROFS": -30,
           "EINVAL": -22, "ENOTEMPTY": -39, "EAGAIN": -11,
           "EMLINK": -31}
+
+
+def snap_dir_obj(snapid: int, ino: int) -> str:
+    """Snapped dirfrag: the realm's namespace as captured at mksnap
+    (ref: the snapped CDentry versions a SnapRealm preserves)."""
+    return f"mds.snapdir.{snapid}.{ino:x}"
 
 
 def dir_obj(ino: int) -> str:
@@ -100,6 +110,7 @@ class MDSDaemon(Dispatcher):
         # ino -> {client: capbits}; open intents: ino -> {client: wants_write}
         self._caps: dict[int, dict[str, int]] = {}
         self._opens: dict[int, dict[str, bool]] = {}
+        self._chain: list[int] = [ROOT_INO]   # last-resolve dir chain
         self._pending_revokes: list[tuple[str, MClientCaps]] = []
         self._revoking: dict[tuple[int, str], float] = {}
         self._mkfs_or_replay()
@@ -216,23 +227,222 @@ class MDSDaemon(Dispatcher):
             raise MDSError("ENOENT", f"dir ino {ino:x}")
         return {k: json.loads(v) for k, v in vals.items()}
 
+    def _readdir_at(self, ino: int, snapid: int | None) -> dict:
+        """Directory listing now, or as captured at `snapid` (the
+        snapped dirfrag written by mksnap)."""
+        if snapid is None:
+            return self._readdir(ino)
+        try:
+            vals, _ = self.meta.get_omap_vals(snap_dir_obj(snapid,
+                                                           ino))
+        except RadosError:
+            return {}        # dir did not exist at the snap
+        return {k: json.loads(v) for k, v in vals.items()}
+
     def _resolve(self, path: str) -> tuple[int, str, dict | None]:
-        """path -> (parent ino, final name, dentry|None).
-        (ref: MDCache::path_traverse)."""
+        """path -> (parent ino, final name, dentry|None)
+        (ref: MDCache::path_traverse).  Understands `.snap/<name>`
+        components (ref: SnapRealm's snapdir traversal): past one, the
+        walk continues through the snapped dirfrags and the final
+        dentry carries "snapid".  Side effect: self._chain holds the
+        traversed directory-ino chain (root..parent) for snap-context
+        resolution — handle_op serializes under the daemon lock."""
         parts = [p for p in path.strip("/").split("/") if p]
+        self._chain = [ROOT_INO]
         if not parts:
             return 0, "", {"ino": ROOT_INO, "type": "d"}
         ino = ROOT_INO
-        for i, comp in enumerate(parts[:-1]):
-            ents = self._readdir(ino)
+        snapid = None
+        i = 0
+        while i < len(parts):
+            comp = parts[i]
+            is_last = i == len(parts) - 1
+            if comp == ".snap":
+                if snapid is not None:
+                    raise MDSError("EINVAL", ".snap inside .snap")
+                if is_last:
+                    # the snapdir pseudo-directory itself
+                    return ino, ".snap", {"ino": ino,
+                                          "type": "snapdir"}
+                name = parts[i + 1]
+                snaps = self._snaps_of(ino)
+                if name not in snaps:
+                    raise MDSError("ENOENT", f".snap/{name}")
+                snapid = snaps[name]["id"]
+                if i + 1 == len(parts) - 1:
+                    # the snap root: the realm dir at that snap
+                    return ino, name, {"ino": ino, "type": "d",
+                                       "snapid": snapid}
+                i += 2
+                continue
+            ents = self._readdir_at(ino, snapid)
+            if is_last:
+                d = ents.get(comp)
+                if d is not None and snapid is not None:
+                    d = dict(d)
+                    d["snapid"] = snapid
+                return ino, comp, d
             d = ents.get(comp)
             if d is None:
                 raise MDSError("ENOENT", "/".join(parts[:i + 1]))
             if d["type"] != "d":
                 raise MDSError("ENOTDIR", comp)
             ino = d["ino"]
-        ents = self._readdir(ino)
-        return ino, parts[-1], ents.get(parts[-1])
+            self._chain.append(ino)
+            i += 1
+        raise MDSError("EINVAL", path)     # unreachable
+
+    # ------------------------------------------------------- snaprealms
+    def _snaps_of(self, ino: int) -> dict[str, dict]:
+        """Realm snaps of a directory ino (ref: SnapRealm::srnode)."""
+        try:
+            vals = self.meta.get_omap_vals_by_keys(SNAPTABLE_OBJ,
+                                                   [str(ino)])
+        except RadosError:
+            return {}
+        raw = vals.get(str(ino))
+        return json.loads(raw) if raw is not None else {}
+
+    def _snapc_for_chain(self, chain: list[int]) -> dict | None:
+        """The snap context a file under this directory chain writes
+        with (ref: SnapRealm::get_snap_context — the union of every
+        ancestor realm's snapids; self-managed, so it exists only in
+        the client's snapc, the librbd model)."""
+        ids: set[int] = set()
+        for ino in chain:
+            for ent in self._snaps_of(ino).values():
+                ids.add(ent["id"])
+        if not ids:
+            return None
+        return {"seq": max(ids), "snaps": sorted(ids, reverse=True)}
+
+    def _walk_realm(self, realm: int) -> list[tuple[int, dict, list]]:
+        """Subtree walk from the realm dir: [(dir ino, entries with
+        remote dentries materialized, chain-below-realm)].  Remote
+        (hardlink) dentries are resolved NOW so the snapped dirfrag
+        freezes the inode state at snap time."""
+        out = []
+        stack = [(realm, [realm])]
+        while stack:
+            ino, chain = stack.pop()
+            ents = {}
+            for name, d in self._readdir(ino).items():
+                if "remote" in d:
+                    rec = self._iget(d["remote"])
+                    ents[name] = dict(rec) if rec is not None else d
+                else:
+                    ents[name] = d
+                if d.get("type") == "d":
+                    stack.append((d["ino"], chain + [d["ino"]]))
+            out.append((ino, ents, chain))
+        return out
+
+    def _alloc_snapid(self) -> int:
+        """Allocate a self-managed snapid on the data pool (ref:
+        SnapServer's table; riding the pool's self-managed allocator
+        keeps removed-snap bookkeeping on the OSD path)."""
+        return self.rados.open_ioctx(self.data_pool) \
+            .selfmanaged_snap_create()
+
+    def _op_mksnap(self, a):
+        """Create a realm snapshot (ref: Server::handle_client_mksnap
+        + SnapRealm COW).  EAGAIN while EXCL holders under the realm
+        still buffer sizes — the client retries after the revokes
+        flush them, so the snapped dirfrags capture true sizes."""
+        _p, _n, dent = self._resolve(a["path"])
+        if dent is None:
+            raise MDSError("ENOENT", a["path"])
+        if dent.get("snapid") is not None or dent["type"] != "d":
+            raise MDSError("EINVAL", a["path"])
+        name = a.get("name", "")
+        if not name or "/" in name or name == ".snap":
+            raise MDSError("EINVAL", f"snap name {name!r}")
+        realm = dent["ino"]
+        realm_chain = self._chain + [realm]
+        snaps = self._snaps_of(realm)
+        if name in snaps:
+            raise MDSError("EEXIST", name)
+        walk = self._walk_realm(realm)
+        # flush gate: any EXCL holder's buffered size would be frozen
+        # stale into the snap
+        excl = []
+        for _ino, ents, _chain in walk:
+            for d in ents.values():
+                if d.get("type") != "f":
+                    continue
+                holders = [c for c, b in
+                           self._caps.get(d["ino"], {}).items()
+                           if b & CAP_EXCL]
+                if holders:
+                    excl.append((d["ino"], holders))
+        if excl:
+            for ino, holders in excl:
+                self._queue_revoke(ino, holders)
+            raise MDSError("EAGAIN", "flushing EXCL holders")
+        snapid = self._alloc_snapid()
+        snaps = dict(snaps)
+        snaps[name] = {"id": snapid, "stamp": time.time(),
+                       "dirs": [ino for ino, _e, _c in walk]}
+        deltas = [("set", SNAPTABLE_OBJ, {str(realm):
+                                          json.dumps(snaps)})]
+        for ino, ents, _chain in walk:
+            obj = snap_dir_obj(snapid, ino)
+            deltas.append(("mkobj", obj))
+            if ents:
+                deltas.append(("set", obj,
+                               {k: json.dumps(v)
+                                for k, v in ents.items()}))
+        self._journal("mksnap", deltas)
+        # push the widened snap context to every open handle under the
+        # realm (ref: the SnapRealm update broadcast): without it their
+        # next write carries the old snapc and the OSD never COWs for
+        # this snap
+        prefix = realm_chain[:-1]
+        for ino, ents, chain in walk:
+            snapc = None          # one computation per directory
+            for d in ents.values():
+                if d.get("type") != "f" or \
+                        d["ino"] not in self._opens:
+                    continue
+                if snapc is None:
+                    snapc = self._snapc_for_chain(prefix + chain)
+                for client in self._opens[d["ino"]]:
+                    self._pending_revokes.append((client, MClientCaps(
+                        op="snapc", ino=d["ino"], snapc=snapc)))
+        return {"id": snapid, "name": name}
+
+    def _op_rmsnap(self, a):
+        """(ref: Server::handle_client_rmsnap; the snapid joins the
+        pool's removed set so OSD snap contexts stop carrying it)."""
+        _p, _n, dent = self._resolve(a["path"])
+        if dent is None:
+            raise MDSError("ENOENT", a["path"])
+        if dent.get("snapid") is not None or dent["type"] != "d":
+            raise MDSError("EINVAL", a["path"])
+        realm = dent["ino"]
+        snaps = dict(self._snaps_of(realm))
+        ent = snaps.pop(a.get("name", ""), None)
+        if ent is None:
+            raise MDSError("ENOENT", a.get("name", ""))
+        deltas = [("set", SNAPTABLE_OBJ,
+                   {str(realm): json.dumps(snaps)})]
+        for ino in ent.get("dirs", []):
+            deltas.append(("rmobj", snap_dir_obj(ent["id"], ino)))
+        self._journal("rmsnap", deltas)
+        try:
+            self.rados.open_ioctx(self.data_pool) \
+                .selfmanaged_snap_remove(ent["id"])
+        except RadosError:
+            pass      # snapid leak on failure: ids are never reused
+        return None
+
+    def _op_lssnap(self, a):
+        _p, _n, dent = self._resolve(a["path"])
+        if dent is None:
+            raise MDSError("ENOENT", a["path"])
+        if dent["type"] not in ("d", "snapdir"):
+            raise MDSError("ENOTDIR", a["path"])
+        return self._snaps_of(dent["ino"])
 
     def _alloc_ino(self) -> int:
         ino = self._next_ino
@@ -328,11 +538,30 @@ class MDSDaemon(Dispatcher):
                 self._revoking.pop((msg.ino, msg.src), None)
 
     # ------------------------------------------------------- operations
+    #: ops allowed to traverse `.snap` paths — everything else on a
+    #: snapshot path is EROFS (ref: the snapdir is read-only)
+    _SNAP_RO_OPS = frozenset({"lookup", "open", "readdir", "statfs",
+                              "lssnap", "release"})
+
     def handle_op(self, op: str, args: dict):
         """Returns the reply payload; raises MDSError.
         (ref: Server::dispatch_client_request op switch)."""
         with self._lock:
+            if op not in self._SNAP_RO_OPS and any(
+                    ".snap" in str(args.get(k, "")).split("/")
+                    for k in ("path", "src", "dst")):
+                raise MDSError("EROFS", "snapshots are read-only")
             return getattr(self, f"_op_{op}")(args)
+
+    def _with_snapc(self, rec: dict) -> dict:
+        """Attach the write snap context for the just-resolved path's
+        realm chain (consumed by the client's data ioctx)."""
+        snapc = self._snapc_for_chain(self._chain)
+        if snapc is None:
+            return rec
+        rec = dict(rec)
+        rec["snapc"] = snapc
+        return rec
 
     def _op_mkdir(self, a):
         parent, name, dent = self._resolve(a["path"])
@@ -357,7 +586,8 @@ class MDSDaemon(Dispatcher):
                 raise MDSError("EISDIR", a["path"])
             rec = self._record_of(dent)
             if not a.get("truncate"):
-                return rec                 # open-existing ('r+'/'a')
+                # open-existing ('r+'/'a')
+                return self._with_snapc(rec)
             # O_TRUNC semantics (ref: Server::handle_client_openc +
             # inode truncate): size -> 0; the client purges the old
             # data objects, mirroring how unlink purges client-side
@@ -366,7 +596,7 @@ class MDSDaemon(Dispatcher):
             rec["size"] = 0
             rec["mtime"] = time.time()
             self._update_record(parent, name, dent, rec, "truncate")
-            out = dict(rec)
+            out = self._with_snapc(dict(rec))
             out["purge_size"] = old_size
             return out
         ino = self._alloc_ino()
@@ -378,27 +608,36 @@ class MDSDaemon(Dispatcher):
                "pool": self.data_pool}
         self._journal("create", [
             ("set", dir_obj(parent), {name: json.dumps(rec)})])
-        return rec
+        return self._with_snapc(rec)
 
     def _op_lookup(self, a):
         _parent, _name, dent = self._resolve(a["path"])
         if dent is None:
             raise MDSError("ENOENT", a["path"])
-        return self._record_of(dent)
+        if dent.get("snapid") is not None:
+            return dent        # frozen snap record, size at snap time
+        return self._with_snapc(self._record_of(dent))
 
     def _op_open(self, a):
         """Open with a capability request (ref: Server::handle_client_
         open -> Locker issue).  EAGAIN while conflicting caps are being
-        revoked; the client retries."""
+        revoked; the client retries.  Snapshot paths open read-only
+        with no caps — the record itself is frozen."""
         _parent, _name, dent = self._resolve(a["path"])
         if dent is None:
             raise MDSError("ENOENT", a["path"])
+        if dent.get("snapid") is not None:
+            if a.get("wants_write"):
+                raise MDSError("EROFS", a["path"])
+            if dent["type"] != "f":
+                raise MDSError("EISDIR", a["path"])
+            return {"rec": dent, "caps": 0}
         rec = self._record_of(dent)
         if rec["type"] != "f":
             raise MDSError("EISDIR", a["path"])
         caps = self._grant_caps(rec["ino"], a["__client"],
                                 bool(a.get("wants_write")))
-        return {"rec": rec, "caps": caps}
+        return {"rec": self._with_snapc(rec), "caps": caps}
 
     def _op_release(self, a):
         """Close: drop the session's caps + open intent
@@ -444,9 +683,14 @@ class MDSDaemon(Dispatcher):
         _parent, _name, dent = self._resolve(a["path"])
         if dent is None:
             raise MDSError("ENOENT", a["path"])
+        if dent["type"] == "snapdir":
+            # `ls dir/.snap`: the realm's snapshots as directories
+            return {n: {"ino": dent["ino"], "type": "d",
+                        "snapid": s["id"]}
+                    for n, s in self._snaps_of(dent["ino"]).items()}
         if dent["type"] != "d":
             raise MDSError("ENOTDIR", a["path"])
-        return self._readdir(dent["ino"])
+        return self._readdir_at(dent["ino"], dent.get("snapid"))
 
     def _op_unlink(self, a):
         parent, name, dent = self._resolve(a["path"])
@@ -466,16 +710,19 @@ class MDSDaemon(Dispatcher):
                 self._journal("unlink", [
                     ("rm", dir_obj(parent), [name]),
                     ("rm", ITABLE_OBJ, [str(rec["ino"])])])
-                out = dict(rec)
+                out = self._with_snapc(dict(rec))
                 out["purge"] = True
                 return out
             self._journal("unlink", [
                 ("rm", dir_obj(parent), [name]),
                 ("set", ITABLE_OBJ, {str(rec["ino"]): json.dumps(rec)})])
-            out = dict(rec)
+            out = self._with_snapc(dict(rec))
             out["purge"] = False
             return out
-        out = dict(dent)
+        # the purge travels with the realm's snapc: under a snapped
+        # realm the OSD-side delete COWs the head into a clone first,
+        # so `.snap` reads keep serving the file's frozen state
+        out = self._with_snapc(dict(dent))
         out["purge"] = True
         self._journal("unlink", [("rm", dir_obj(parent), [name])])
         return out                       # client purges the data objs

@@ -397,6 +397,10 @@ class MClientCaps(Message):
     seq: int = 0
     size: int = -1                   # flushed size (-1 = clean)
     mtime: float = 0.0
+    # --- v2 ---
+    #: op="snapc": the realm's widened write snap context pushed to
+    #: open handles after a mksnap (ref: SnapRealm update broadcast)
+    snapc: Any = None
 
 
 @dataclass
@@ -582,6 +586,7 @@ _VERSIONS: dict[str, tuple[int, int]] = {
     "PGScan": (2, 1),           # v2: ranged backfill walk
     "PGScanReply": (2, 1),      # v2: ranged/begin/end echo fields
     "PGPush": (2, 1),           # v2: authoritative backfill flag
+    "MClientCaps": (2, 1),      # v2: snapc broadcast leg
 }
 
 

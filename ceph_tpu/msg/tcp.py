@@ -88,12 +88,13 @@ class TcpMessenger:
                  compress_min: int = 4096):
         self.name = name
         self.addr_map = dict(addr_map)
-        # secure wire mode (ref: frames_v2 SECURE): all frames sealed
-        # under keys derived from the cluster secret
-        self._secure = None
-        if secure_secret is not None:
-            from .secure import SecureSession
-            self._secure = SecureSession(secure_secret, "frame")
+        # secure wire mode (ref: frames_v2 SECURE): every CONNECTION
+        # runs its own KEX and seals under per-session, per-direction
+        # keys (msg/secure.py SecureConn; VERDICT r3 #4 — one captured
+        # or compromised session no longer decrypts any other)
+        self._secure_secret = secure_secret
+        #: socket -> SecureConn session state
+        self._sessions: dict = {}
         # on-wire compression (ref: msgr v2 compression / the
         # compressor registry the reference wires into the messenger).
         # Layering matches the reference: compress, THEN seal —
@@ -158,6 +159,7 @@ class TcpMessenger:
         with self._lock:
             socks = list(self._out.values())
             self._out.clear()
+            self._sessions.clear()
         for s in socks:
             try:
                 s.close()
@@ -170,6 +172,61 @@ class TcpMessenger:
                 pass
 
     # -- send ------------------------------------------------------------
+    def _secure_handshake(self, sock) -> object | None:
+        """Initiator side of the per-connection KEX: send our share,
+        the reader thread ingests the responder's and signals ready.
+        Returns the established SecureConn or None."""
+        from .secure import SecureConn
+        sc = SecureConn(self._secure_secret, initiator=True)
+        self._sessions[sock] = sc
+        try:
+            send_frame(sock, sc.kex_frame())
+        except OSError:
+            return None
+        return sc
+
+    def _wait_session(self, sock) -> bool:
+        """Wait for the socket's KEX to complete with the messenger
+        lock RELEASED — a hung peer's handshake must not stall sends
+        to every other peer.  Caller holds self._lock."""
+        sc = self._sessions.get(sock)
+        if sc is None:
+            return False
+        if sc.established:
+            return True
+        self._lock.release()
+        try:
+            return sc.ready.wait(5.0)
+        finally:
+            self._lock.acquire()
+
+    def _seal_for(self, sock, payload: bytes) -> bytes | None:
+        """Seal under the socket's established session; None = no
+        session, or an INITIATOR-side connection due for rekey
+        (rotation is initiator-driven: a responder forcing it on a
+        learned socket would drop the in-flight reply with no way to
+        reconnect to a listener-less client)."""
+        sc = self._sessions.get(sock)
+        if sc is None or not sc.established:
+            return None
+        from .secure import REKEY_FRAMES
+        if sc.initiator and sc.send_ctr >= REKEY_FRAMES:
+            return None          # rotate: reconnect runs a fresh KEX
+        return sc.seal(payload)
+
+    def _send_sealed(self, sock, payload: bytes) -> None:
+        """One framing contract for every send path: seal when secure
+        (waiting out a pending KEX first), raise OSError on failure."""
+        if self._secure_secret is not None:
+            if not self._wait_session(sock):
+                raise OSError("secure session unavailable")
+            sealed = self._seal_for(sock, payload)
+            if sealed is None:
+                raise OSError("secure session unavailable")
+            send_frame(sock, sealed)
+        else:
+            send_frame(sock, payload)
+
     def _send(self, peer: str, msg: Message) -> bool:
         import dataclasses
         with self._lock:
@@ -188,8 +245,6 @@ class TcpMessenger:
                             payload, self._compress)
                     else:
                         payload = b"\x00" + payload
-                if self._secure is not None:
-                    payload = self._secure.seal(payload)
             except WireError as ex:
                 dout("ms", 0).write("%s: unencodable %s: %s", self.name,
                                     msg.type_name, ex)
@@ -207,31 +262,38 @@ class TcpMessenger:
                     return False
                 fresh = True
                 self._out[peer] = sock
+                if self._secure_secret is not None:
+                    self._secure_handshake(sock)
                 self._spawn_reader(sock)
             try:
-                send_frame(sock, payload)
+                self._send_sealed(sock, payload)
                 return True
             except OSError:
                 (self._learned if learned else self._out).pop(peer, None)
+                self._sessions.pop(sock, None)
                 try:
                     sock.close()
                 except OSError:
                     pass
                 # a cached socket may be stale (the peer restarted —
                 # e.g. an OSD process kill -9'd and revived on the same
-                # addr): reconnect once and resend before declaring the
-                # peer reset, or a mon's map push to a rebooted daemon
-                # is silently lost (ref: AsyncConnection reconnect)
+                # addr — or its secure session is due for rotation):
+                # reconnect once and resend before declaring the peer
+                # reset, or a mon's map push to a rebooted daemon is
+                # silently lost (ref: AsyncConnection reconnect)
                 if not fresh and peer in self.addr_map:
                     sock = self._connect_peer(peer)
                     if sock is not None:
                         self._out[peer] = sock
+                        if self._secure_secret is not None:
+                            self._secure_handshake(sock)
                         self._spawn_reader(sock)
                         try:
-                            send_frame(sock, payload)
+                            self._send_sealed(sock, payload)
                             return True
                         except OSError:
                             self._out.pop(peer, None)
+                            self._sessions.pop(sock, None)
                             try:
                                 sock.close()
                             except OSError:
@@ -259,6 +321,10 @@ class TcpMessenger:
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if self._secure_secret is not None:
+                from .secure import SecureConn
+                self._sessions[conn] = SecureConn(self._secure_secret,
+                                                  initiator=False)
             self._spawn_reader(conn, learn=True)
 
     def _spawn_reader(self, conn: socket.socket,
@@ -273,13 +339,27 @@ class TcpMessenger:
 
     def _read_loop(self, conn: socket.socket, learn: bool) -> None:
         peer = None
+        sc = self._sessions.get(conn)
         try:
             while self._running:
                 frame = recv_frame(conn)
                 if frame is None:
                     break
-                if self._secure is not None:
-                    frame = self._secure.open(frame)
+                if self._secure_secret is not None:
+                    if sc is None:
+                        break
+                    if not sc.established:
+                        # handshake leg: ingest the peer's KEX; the
+                        # responder answers with its own share
+                        if not sc.ingest_kex(frame):
+                            dout("ms", 1).write(
+                                "%s: bad KEX frame — dropping "
+                                "connection", self.name)
+                            break
+                        if not sc.initiator:
+                            send_frame(conn, sc.kex_frame())
+                        continue
+                    frame = sc.open(frame)
                     if frame is None:
                         dout("ms", 1).write(
                             "%s: secure frame failed authentication "
@@ -328,6 +408,7 @@ class TcpMessenger:
                 conn.close()
             except OSError:
                 pass
+            self._sessions.pop(conn, None)
             if peer is not None:
                 with self._lock:
                     if self._learned.get(peer) is conn:

@@ -41,6 +41,15 @@ def signing_key(secret: str, date: str, region: str,
     return _hmac(k, "aws4_request")
 
 
+def _parse_amz_date(s: str) -> float:
+    """X-Amz-Date/x-amz-date -> epoch seconds; SigV4Error on junk."""
+    try:
+        return _time.mktime(_time.strptime(s, "%Y%m%dT%H%M%SZ")) \
+            - _time.timezone
+    except ValueError:
+        raise SigV4Error("AccessDenied", "malformed amz date")
+
+
 def canonical_query(query: str) -> str:
     """Sort the wire query pairs.  The wire form is already
     percent-encoded by the client (and that exact form was signed), so
@@ -99,12 +108,7 @@ def verify(method: str, path: str, headers, body: bytes,
     amz_date_hdr = headers.get("x-amz-date", "")
     if not amz_date_hdr or amz_date_hdr[:8] != a["date"]:
         raise SigV4Error("AccessDenied", "x-amz-date/scope mismatch")
-    try:
-        when = _time.mktime(_time.strptime(amz_date_hdr,
-                                           "%Y%m%dT%H%M%SZ")) \
-            - _time.timezone
-    except ValueError:
-        raise SigV4Error("AccessDenied", "malformed x-amz-date")
+    when = _parse_amz_date(amz_date_hdr)
     if abs(_time.time() - when) > MAX_SKEW:
         raise SigV4Error("RequestTimeTooSkewed", amz_date_hdr)
     u = urlparse(path)
@@ -138,6 +142,91 @@ def verify(method: str, path: str, headers, body: bytes,
     if not hmac.compare_digest(want, a["signature"]):
         raise SigV4Error("SignatureDoesNotMatch")
     return a["access_key"]
+
+
+def verify_presigned(method: str, path: str, headers,
+                     lookup_secret) -> str:
+    """Query-string SigV4 (presigned URL) verification (ref:
+    src/rgw/rgw_auth_s3.h's query-string path; the AWS
+    `X-Amz-Signature` scheme): the signature, credential scope and
+    expiry all ride the query, the payload is UNSIGNED-PAYLOAD, and
+    only the listed headers (normally just `host`) are signed."""
+    u = urlparse(path)
+    q: dict[str, str] = {}
+    for part in u.query.split("&"):
+        if "=" in part:
+            k, v = part.split("=", 1)
+            q[k] = v
+    from urllib.parse import unquote
+    if unquote(q.get("X-Amz-Algorithm", "")) != ALGORITHM:
+        raise SigV4Error("InvalidArgument", "unsupported algorithm")
+    cred = unquote(q.get("X-Amz-Credential", "")).split("/")
+    if len(cred) != 5 or cred[4] != "aws4_request":
+        raise SigV4Error("InvalidArgument", "malformed credential")
+    access_key, date, region, service = cred[:4]
+    secret = lookup_secret(access_key)
+    if secret is None:
+        raise SigV4Error("InvalidAccessKeyId", access_key)
+    amz_date = unquote(q.get("X-Amz-Date", ""))
+    if amz_date[:8] != date:
+        raise SigV4Error("AccessDenied", "date/scope mismatch")
+    when = _parse_amz_date(amz_date)
+    try:
+        expires = min(int(q.get("X-Amz-Expires", "300")), 7 * 86400)
+    except ValueError:
+        raise SigV4Error("AccessDenied", "malformed X-Amz-Expires")
+    now = _time.time()
+    if now > when + expires:
+        raise SigV4Error("AccessDenied", "request has expired")
+    if when > now + MAX_SKEW:
+        raise SigV4Error("RequestTimeTooSkewed", amz_date)
+    signed = unquote(q.get("X-Amz-SignedHeaders", "host")).split(";")
+    canon_headers = ""
+    for name in signed:
+        v = headers.get(name, "")
+        canon_headers += f"{name}:{' '.join(str(v).split())}\n"
+    # canonical query: every pair as received EXCEPT the signature
+    cq = canonical_query("&".join(
+        part for part in u.query.split("&")
+        if not part.startswith("X-Amz-Signature=")))
+    canonical = "\n".join([method, u.path or "/", cq, canon_headers,
+                           ";".join(signed), UNSIGNED])
+    scope = f"{date}/{region}/{service}/aws4_request"
+    sts = "\n".join([ALGORITHM, amz_date, scope,
+                     hashlib.sha256(canonical.encode()).hexdigest()])
+    key = signing_key(secret, date, region, service)
+    want = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
+    if not hmac.compare_digest(want, q.get("X-Amz-Signature", "")):
+        raise SigV4Error("SignatureDoesNotMatch")
+    return access_key
+
+
+def presign(method: str, path: str, host: str, access_key: str,
+            secret: str, expires: int = 300, region: str = "default",
+            amz_date: str | None = None) -> str:
+    """Generate a presigned URL path+query (the boto3
+    generate_presigned_url analogue for tests and in-tree clients)."""
+    from urllib.parse import quote
+    amz_date = amz_date or _time.strftime("%Y%m%dT%H%M%SZ",
+                                          _time.gmtime())
+    date = amz_date[:8]
+    scope = f"{date}/{region}/s3/aws4_request"
+    params = {
+        "X-Amz-Algorithm": ALGORITHM,
+        "X-Amz-Credential": quote(f"{access_key}/{scope}", safe=""),
+        "X-Amz-Date": amz_date,
+        "X-Amz-Expires": str(expires),
+        "X-Amz-SignedHeaders": "host",
+    }
+    pairs = sorted(params.items())
+    cq = "&".join(f"{k}={v}" for k, v in pairs)
+    canonical = "\n".join([method, path, cq, f"host:{host}\n", "host",
+                           UNSIGNED])
+    sts = "\n".join([ALGORITHM, amz_date, scope,
+                     hashlib.sha256(canonical.encode()).hexdigest()])
+    key = signing_key(secret, date, region)
+    sig = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
+    return f"{path}?{cq}&X-Amz-Signature={sig}"
 
 
 def sign_request(method: str, path: str, headers: dict, body: bytes,

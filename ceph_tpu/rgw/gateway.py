@@ -21,10 +21,22 @@ surface:
   Object, CopyObject (x-amz-copy-source), ListObjectsV2 (prefix +
   max-keys + continuation), multipart initiate/upload-part/complete/
   abort.  XML shapes follow S3 close enough for scripted clients.
+* **Object versioning** (ref: rgw versioned buckets): per-bucket
+  Enabled/Suspended state; versioned PUTs stack version records on
+  the index entry with data at `<bucket>/<key>@<vid>`; DELETE inserts
+  a delete marker; GET/HEAD honor `versionId`; GET `?versions` lists
+  the stack; the pre-versioning object becomes the S3 "null" version.
+* **Bucket lifecycle** (ref: src/rgw/rgw_lc.cc): Put/Get/Delete
+  lifecycle configuration (Expiration.Days +
+  NoncurrentVersionExpiration.NoncurrentDays per prefix rule);
+  `lc_tick()` applies expirations — delete markers for current
+  versions, outright removal for noncurrent ones.
 
 **Auth**: with a keyring, every request must carry a valid AWS SigV4
 signature whose access key is a cephx entity (ref: src/rgw/
-rgw_auth_s3.cc); without one the gateway is anonymous (test mode).
+rgw_auth_s3.cc) — either the Authorization header or the query-string
+presigned-URL form (X-Amz-Signature, ref: rgw_auth_s3.h); without a
+keyring the gateway is anonymous (test mode).
 """
 from __future__ import annotations
 
@@ -39,7 +51,8 @@ from xml.etree import ElementTree as ET
 from xml.sax.saxutils import escape
 
 from ..client import RadosError, WriteOp
-from .auth import SigV4Error, verify as sigv4_verify
+from .auth import (SigV4Error, verify as sigv4_verify,
+                   verify_presigned as presigned_verify)
 
 #: omap object holding the bucket registry (name -> creation meta)
 BUCKETS_OBJ = ".rgw.buckets.list"
@@ -106,9 +119,15 @@ class RGWGateway:
                     self._body = body
                     if gw.keyring is not None:
                         try:
-                            self.s3_user = sigv4_verify(
-                                method, self.path, self.headers, body,
-                                gw.keyring.get)
+                            if "X-Amz-Signature" in self.path:
+                                # query-string auth: presigned URL
+                                self.s3_user = presigned_verify(
+                                    method, self.path, self.headers,
+                                    gw.keyring.get)
+                            else:
+                                self.s3_user = sigv4_verify(
+                                    method, self.path, self.headers,
+                                    body, gw.keyring.get)
                         except SigV4Error as e:
                             raise S3Error(403, e.code, str(e))
                     gw._route(self, method)
@@ -146,6 +165,11 @@ class RGWGateway:
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self.httpd.server_address[1]
         self._thread: threading.Thread | None = None
+        #: serializes version-stack read-modify-writes — the HTTP
+        #: server is threaded, and an unlocked RMW would lose a
+        #: concurrent PUT's version record (the cls_rgw index
+        #: transaction's job in the reference)
+        self._vlock = threading.Lock()
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> None:
@@ -247,9 +271,22 @@ class RGWGateway:
             f"<Buckets>{ents}</Buckets>"
             "</ListAllMyBucketsResult>").encode())
 
+    def _update_bucket_meta(self, bucket: str, meta: dict) -> None:
+        self.io.operate(BUCKETS_OBJ, WriteOp().set_omap(
+            {bucket: json.dumps(meta).encode()}))
+
     # -- bucket level ----------------------------------------------------
     def _bucket_op(self, h, method: str, bucket: str, q: dict) -> None:
+        if "versioning" in q:
+            return self._versioning_op(h, method, bucket)
+        if "lifecycle" in q:
+            return self._lifecycle_op(h, method, bucket)
         if method == "PUT":
+            if bucket in self._buckets():
+                # idempotent re-create must NOT rebuild the meta —
+                # that would silently wipe versioning/lifecycle state
+                return self._respond(h, 200,
+                                     headers={"Location": f"/{bucket}"})
             meta = json.dumps({"created": time.strftime(
                 "%Y-%m-%dT%H:%M:%S.000Z", time.gmtime()),
                 "shards": self.index_shards}).encode()
@@ -263,6 +300,8 @@ class RGWGateway:
         if method in ("GET", "HEAD"):
             if method == "HEAD":
                 return self._respond(h, 200)
+            if "versions" in q:
+                return self._list_versions(h, bucket, q)
             return self._list_objects(h, bucket, q)
         if method == "DELETE":
             if self._index(bucket):
@@ -277,6 +316,203 @@ class RGWGateway:
             return self._respond(h, 204)
         raise S3Error(405, "MethodNotAllowed", method)
 
+    # -- versioning (ref: rgw versioned buckets; S3 PutBucketVersioning)
+    def _versioning_op(self, h, method: str, bucket: str) -> None:
+        meta = self._require_bucket(bucket)
+        if method == "GET":
+            status = meta.get("versioning", "")
+            inner = f"<Status>{status}</Status>" if status else ""
+            return self._respond(h, 200, (
+                '<?xml version="1.0"?><VersioningConfiguration>'
+                f"{inner}</VersioningConfiguration>").encode())
+        if method != "PUT":
+            raise S3Error(405, "MethodNotAllowed", method)
+        try:
+            root = ET.fromstring(self._read_body(h))
+            status = next((el.text for el in root.iter()
+                           if el.tag.endswith("Status")), None)
+        except ET.ParseError:
+            raise S3Error(400, "MalformedXML")
+        if status not in ("Enabled", "Suspended"):
+            raise S3Error(400, "IllegalVersioningConfigurationException",
+                          str(status))
+        meta["versioning"] = status
+        self._update_bucket_meta(bucket, meta)
+        self._respond(h, 200)
+
+    def _versioning_of(self, bmeta: dict) -> str:
+        return bmeta.get("versioning", "")
+
+    def _list_versions(self, h, bucket: str, q: dict) -> None:
+        """GET ?versions (ref: RGWListBucketVersions)."""
+        prefix = q.get("prefix", "")
+        idx = self._index(bucket)
+        ents = []
+        for key in sorted(k for k in idx if k.startswith(prefix)
+                          and not k.startswith(".upload.")):
+            versions = idx[key].get("versions")
+            if versions is None:
+                versions = [{"vid": "null",
+                             "size": idx[key]["size"],
+                             "etag": idx[key]["etag"],
+                             "mtime": idx[key]["mtime"], "dm": False}]
+            for i, v in enumerate(versions):
+                latest = str(i == 0).lower()
+                if v.get("dm"):
+                    ents.append(
+                        f"<DeleteMarker><Key>{escape(key)}</Key>"
+                        f"<VersionId>{v['vid']}</VersionId>"
+                        f"<IsLatest>{latest}</IsLatest>"
+                        f"<LastModified>{v['mtime']}</LastModified>"
+                        "</DeleteMarker>")
+                else:
+                    ents.append(
+                        f"<Version><Key>{escape(key)}</Key>"
+                        f"<VersionId>{v['vid']}</VersionId>"
+                        f"<IsLatest>{latest}</IsLatest>"
+                        f"<Size>{v['size']}</Size>"
+                        f"<ETag>&quot;{v['etag']}&quot;</ETag>"
+                        f"<LastModified>{v['mtime']}</LastModified>"
+                        "</Version>")
+        self._respond(h, 200, (
+            '<?xml version="1.0"?><ListVersionsResult>'
+            f"<Name>{escape(bucket)}</Name>"
+            f"{''.join(ents)}</ListVersionsResult>").encode())
+
+    # -- lifecycle (ref: src/rgw/rgw_lc.cc; S3 PutBucketLifecycle) ------
+    def _lifecycle_op(self, h, method: str, bucket: str) -> None:
+        meta = self._require_bucket(bucket)
+        if method == "GET":
+            rules = meta.get("lifecycle")
+            if not rules:
+                raise S3Error(404, "NoSuchLifecycleConfiguration")
+            ents = []
+            for r in rules:
+                exp = (f"<Expiration><Days>{r['days']}</Days>"
+                       "</Expiration>") if r.get("days") else ""
+                nce = (f"<NoncurrentVersionExpiration><NoncurrentDays>"
+                       f"{r['noncurrent_days']}</NoncurrentDays>"
+                       "</NoncurrentVersionExpiration>") \
+                    if r.get("noncurrent_days") else ""
+                ents.append(
+                    f"<Rule><ID>{escape(r['id'])}</ID>"
+                    f"<Prefix>{escape(r['prefix'])}</Prefix>"
+                    f"<Status>{r['status']}</Status>{exp}{nce}</Rule>")
+            return self._respond(h, 200, (
+                '<?xml version="1.0"?><LifecycleConfiguration>'
+                f"{''.join(ents)}</LifecycleConfiguration>").encode())
+        if method == "DELETE":
+            meta.pop("lifecycle", None)
+            self._update_bucket_meta(bucket, meta)
+            return self._respond(h, 204)
+        if method != "PUT":
+            raise S3Error(405, "MethodNotAllowed", method)
+        try:
+            root = ET.fromstring(self._read_body(h))
+        except ET.ParseError:
+            raise S3Error(400, "MalformedXML")
+        rules = []
+        for rule in root.iter():
+            if not rule.tag.endswith("Rule"):
+                continue
+            r = {"id": "", "prefix": "", "status": "Enabled",
+                 "days": 0, "noncurrent_days": 0}
+            for el in rule.iter():
+                tag = el.tag.rsplit("}", 1)[-1]
+                if tag == "ID":
+                    r["id"] = el.text or ""
+                elif tag == "Prefix":
+                    r["prefix"] = el.text or ""
+                elif tag == "Status":
+                    r["status"] = el.text or "Enabled"
+                elif tag in ("Days", "NoncurrentDays"):
+                    try:
+                        n = int(el.text or 0)
+                    except ValueError:
+                        raise S3Error(400, "MalformedXML",
+                                      f"bad {tag}: {el.text}")
+                    r["days" if tag == "Days"
+                      else "noncurrent_days"] = n
+            if not r["days"] and not r["noncurrent_days"]:
+                raise S3Error(400, "MalformedXML",
+                              "rule needs an expiration")
+            rules.append(r)
+        meta["lifecycle"] = rules
+        self._update_bucket_meta(bucket, meta)
+        self._respond(h, 200)
+
+    @staticmethod
+    def _parse_mtime(s: str) -> float:
+        try:
+            return time.mktime(time.strptime(
+                s, "%Y-%m-%dT%H:%M:%S.000Z")) - time.timezone
+        except ValueError:
+            return 0.0
+
+    def lc_tick(self, now: float | None = None) -> int:
+        """One lifecycle pass (ref: RGWLC::process — the reference
+        runs it from a worker; here the gateway's maintenance tick or
+        the caller drives it).  Returns expirations performed.
+        Expiring the CURRENT version of a versioned object inserts a
+        delete marker (S3 semantics); noncurrent expiration removes
+        old versions outright."""
+        now = time.time() if now is None else now
+        acted = 0
+        for bucket, meta in self._buckets().items():
+            rules = [r for r in meta.get("lifecycle", [])
+                     if r.get("status") == "Enabled"]
+            if not rules:
+                continue
+            versioned = bool(self._versioning_of(meta))
+            idx = self._index(bucket)
+            for key, ent in idx.items():
+                if key.startswith(".upload."):
+                    continue
+                acted_on_key = False
+                with self._vlock:
+                    for r in rules:
+                        if acted_on_key:
+                            # one action per key per tick: a second
+                            # matching rule would act on a stale
+                            # snapshot (stacked delete markers)
+                            break
+                        if not key.startswith(r["prefix"]):
+                            continue
+                        if r.get("days"):
+                            age = now - self._parse_mtime(
+                                ent.get("mtime", ""))
+                            latest_dm = bool((ent.get("versions") or
+                                              [{}])[0].get("dm"))
+                            if age > r["days"] * 86400 and \
+                                    not latest_dm:
+                                if versioned or ent.get("versions"):
+                                    self._insert_delete_marker(bucket,
+                                                               key)
+                                else:
+                                    self._delete_unversioned(bucket,
+                                                             key)
+                                acted += 1
+                                acted_on_key = True
+                                continue
+                        if r.get("noncurrent_days") and \
+                                ent.get("versions"):
+                            keep, dropped = [], 0
+                            for i, v in enumerate(ent["versions"]):
+                                age = now - self._parse_mtime(
+                                    v["mtime"])
+                                if i > 0 and age > \
+                                        r["noncurrent_days"] * 86400:
+                                    self._remove_version_data(v)
+                                    dropped += 1
+                                else:
+                                    keep.append(v)
+                            if dropped:
+                                acted += dropped
+                                acted_on_key = True
+                                self._store_versions(bucket, key,
+                                                     keep)
+        return acted
+
     def _list_objects(self, h, bucket: str, q: dict) -> None:
         """ListObjectsV2 (ref: RGWListBucket)."""
         prefix = q.get("prefix", "")
@@ -285,7 +521,8 @@ class RGWGateway:
         idx = self._index(bucket)
         keys = sorted(k for k in idx
                       if k.startswith(prefix) and k > token
-                      and not k.startswith(".upload."))
+                      and not k.startswith(".upload.")
+                      and not idx[k].get("dm"))   # delete markers hide
         page, truncated = keys[:max_keys], len(keys) > max_keys
         ents = "".join(
             f"<Contents><Key>{escape(k)}</Key>"
@@ -318,40 +555,205 @@ class RGWGateway:
         if method == "DELETE" and "uploadId" in q:
             return self._abort_multipart(h, bucket, key, q["uploadId"])
         if method == "PUT" and "x-amz-copy-source" in h.headers:
-            return self._copy_object(h, bucket, key)
+            return self._copy_object(h, bucket, key, bmeta)
         if method == "PUT":
-            return self._put_object(h, bucket, key)
+            return self._put_object(h, bucket, key, bmeta)
         meta = self._index_entry(bucket, key, nshards)
         if meta is None:
             raise S3Error(404, "NoSuchKey", key)
-        if method == "HEAD":
-            return self._respond(
-                h, 200, b"", "application/octet-stream",
-                {"ETag": f'"{meta["etag"]}"',
-                 "Content-Length": str(meta["size"])})
-        if method == "GET":
-            data = self.io.read(_data_obj(bucket, key))
+        want_vid = q.get("versionId", "")
+        if method in ("HEAD", "GET"):
+            v = self._select_version(meta, want_vid, key)
+            if method == "HEAD":
+                return self._respond(
+                    h, 200, b"", "application/octet-stream",
+                    {"ETag": f'"{v["etag"]}"',
+                     "Content-Length": str(v["size"]),
+                     "x-amz-version-id": v.get("vid", "null")})
+            data = self.io.read(v.get("obj") or _data_obj(bucket, key))
             return self._respond(h, 200, data,
                                  "application/octet-stream",
-                                 {"ETag": f'"{meta["etag"]}"'})
+                                 {"ETag": f'"{v["etag"]}"',
+                                  "x-amz-version-id":
+                                      v.get("vid", "null")})
         if method == "DELETE":
-            try:
-                self.io.remove(_data_obj(bucket, key))
-            except RadosError:
-                pass
-            self.io.remove_omap_keys(
-                _index_obj(bucket, _shard_of(key, nshards)), [key])
-            return self._respond(h, 204)
+            return self._delete_object(h, bucket, key, bmeta, meta,
+                                       want_vid)
         raise S3Error(405, "MethodNotAllowed", method)
 
-    def _put_object(self, h, bucket: str, key: str) -> None:
+    def _select_version(self, meta: dict, vid: str, key: str) -> dict:
+        """The version a read serves: the newest live one, or the
+        explicitly requested versionId (ref: rgw versioned read
+        resolution)."""
+        versions = meta.get("versions")
+        if versions is None:
+            if vid and vid != "null":
+                raise S3Error(404, "NoSuchVersion", vid)
+            return meta
+        if vid:
+            for v in versions:
+                if v["vid"] == vid:
+                    if v.get("dm"):
+                        raise S3Error(405, "MethodNotAllowed",
+                                      "delete marker")
+                    return v
+            raise S3Error(404, "NoSuchVersion", vid)
+        if versions[0].get("dm"):
+            raise S3Error(404, "NoSuchKey", key)
+        return versions[0]
+
+    def _store_versions(self, bucket: str, key: str,
+                        versions: list,
+                        nshards: int | None = None) -> None:
+        shard = _shard_of(key, nshards if nshards is not None
+                          else self._nshards(bucket))
+        if not versions:
+            self.io.remove_omap_keys(_index_obj(bucket, shard), [key])
+            return
+        head = versions[0]
+        meta = {"versions": versions, "size": head.get("size", 0),
+                "etag": head.get("etag", ""), "mtime": head["mtime"],
+                "dm": bool(head.get("dm"))}
+        self.io.set_omap(_index_obj(bucket, shard),
+                         {key: json.dumps(meta).encode()})
+
+    def _now_str(self) -> str:
+        return time.strftime("%Y-%m-%dT%H:%M:%S.000Z", time.gmtime())
+
+    def _versions_of(self, bucket: str, key: str,
+                     nshards: int | None = None) -> list:
+        """Existing version list; a pre-versioning plain entry folds
+        into the S3 'null' version (ref: null version semantics)."""
+        ent = self._index_entry(bucket, key, nshards)
+        if ent is None:
+            return []
+        if ent.get("versions") is not None:
+            return ent["versions"]
+        return [{"vid": "null", "size": ent["size"],
+                 "etag": ent["etag"], "mtime": ent["mtime"],
+                 "dm": False, "obj": _data_obj(bucket, key)}]
+
+    def _remove_version_data(self, v: dict) -> None:
+        if v.get("dm") or not v.get("obj"):
+            return
+        try:
+            self.io.remove(v["obj"])
+        except RadosError:
+            pass
+
+    def _insert_delete_marker(self, bucket: str, key: str,
+                              vid: str | None = None) -> str:
+        versions = self._versions_of(bucket, key)
+        vid = vid or uuid.uuid4().hex
+        versions.insert(0, {"vid": vid, "size": 0, "etag": "",
+                            "mtime": self._now_str(), "dm": True,
+                            "obj": None})
+        self._store_versions(bucket, key, versions)
+        return vid
+
+    def _delete_unversioned(self, bucket: str, key: str) -> None:
+        try:
+            self.io.remove(_data_obj(bucket, key))
+        except RadosError:
+            pass
+        self.io.remove_omap_keys(
+            _index_obj(bucket, _shard_of(key, self._nshards(bucket))),
+            [key])
+
+    def _delete_object(self, h, bucket: str, key: str, bmeta: dict,
+                       meta: dict, want_vid: str) -> None:
+        """Versioned deletes (ref: rgw delete marker flow): no
+        versionId = insert a delete marker (Enabled) or replace the
+        null version with one (Suspended); an explicit versionId
+        removes that version outright."""
+        versioning = self._versioning_of(bmeta)
+        with self._vlock:
+            if want_vid:
+                versions = self._versions_of(bucket, key)
+                keep = []
+                for v in versions:
+                    if v["vid"] == want_vid:
+                        self._remove_version_data(v)
+                    else:
+                        keep.append(v)
+                if len(keep) == len(versions):
+                    raise S3Error(404, "NoSuchVersion", want_vid)
+                if not keep and meta.get("versions") is None:
+                    self._delete_unversioned(bucket, key)
+                else:
+                    self._store_versions(bucket, key, keep)
+                return self._respond(h, 204, headers={
+                    "x-amz-version-id": want_vid})
+            if not versioning and meta.get("versions") is None:
+                self._delete_unversioned(bucket, key)
+                return self._respond(h, 204)
+            if versioning == "Suspended":
+                # the null version is replaced by a null delete marker
+                keep = []
+                for v in self._versions_of(bucket, key):
+                    if v["vid"] == "null":
+                        self._remove_version_data(v)
+                    else:
+                        keep.append(v)
+                self._store_versions(bucket, key, keep)
+                vid = self._insert_delete_marker(bucket, key,
+                                                 vid="null")
+            else:
+                vid = self._insert_delete_marker(bucket, key)
+        self._respond(h, 204, headers={"x-amz-delete-marker": "true",
+                                       "x-amz-version-id": vid})
+
+    def _store_object(self, bucket: str, key: str, data: bytes,
+                      etag: str, bmeta: dict | None = None) -> str | None:
+        """Write object data + index honoring the bucket's versioning
+        state; returns the new version id (None = unversioned bucket).
+        The version-stack read-modify-write runs under _vlock — a
+        concurrent PUT on the same key must not lose a version."""
+        bmeta = bmeta if bmeta is not None \
+            else self._require_bucket(bucket)
+        versioning = self._versioning_of(bmeta)
+        nshards = int(bmeta.get("shards", 1))
+        with self._vlock:
+            if versioning == "Enabled":
+                vid = uuid.uuid4().hex
+                obj = f"{bucket}/{key}@{vid}"
+                self.io.write_full(obj, data)
+                versions = self._versions_of(bucket, key, nshards)
+                versions.insert(0, {"vid": vid, "size": len(data),
+                                    "etag": etag,
+                                    "mtime": self._now_str(),
+                                    "dm": False, "obj": obj})
+                self._store_versions(bucket, key, versions, nshards)
+                return vid
+            if versioning == "Suspended":
+                # overwrite the null version in place
+                obj = _data_obj(bucket, key)
+                self.io.write_full(obj, data)
+                versions = [v for v in
+                            self._versions_of(bucket, key, nshards)
+                            if v["vid"] != "null"]
+                versions.insert(0, {"vid": "null", "size": len(data),
+                                    "etag": etag,
+                                    "mtime": self._now_str(),
+                                    "dm": False, "obj": obj})
+                self._store_versions(bucket, key, versions, nshards)
+                return "null"
+            self.io.write_full(_data_obj(bucket, key), data)
+            self._write_index(bucket, key, len(data), etag)
+            return None
+
+    def _put_object(self, h, bucket: str, key: str,
+                    bmeta: dict | None = None) -> None:
         data = self._read_body(h)
         etag = hashlib.md5(data).hexdigest()
-        self.io.write_full(_data_obj(bucket, key), data)
-        self._write_index(bucket, key, len(data), etag)
-        self._respond(h, 200, headers={"ETag": f'"{etag}"'})
+        vid = self._store_object(bucket, key, data, etag, bmeta)
+        hdrs = {"ETag": f'"{etag}"'}
+        if vid is not None:
+            hdrs["x-amz-version-id"] = vid
+        self._respond(h, 200, headers=hdrs)
 
-    def _copy_object(self, h, bucket: str, key: str) -> None:
+    def _copy_object(self, h, bucket: str, key: str,
+                     bmeta: dict | None = None) -> None:
         """Server-side copy (ref: RGWCopyObj; x-amz-copy-source)."""
         src = unquote(h.headers["x-amz-copy-source"]).lstrip("/")
         if "/" not in src:
@@ -361,10 +763,11 @@ class RGWGateway:
         s_meta = self._index_entry(s_bucket, s_key)
         if s_meta is None:
             raise S3Error(404, "NoSuchKey", s_key)
-        data = self.io.read(_data_obj(s_bucket, s_key))
+        sv = self._select_version(s_meta, "", s_key)
+        data = self.io.read(sv.get("obj") or _data_obj(s_bucket,
+                                                       s_key))
         etag = hashlib.md5(data).hexdigest()
-        self.io.write_full(_data_obj(bucket, key), data)
-        self._write_index(bucket, key, len(data), etag)
+        self._store_object(bucket, key, data, etag, bmeta)
         self._respond(h, 200, (
             '<?xml version="1.0"?><CopyObjectResult>'
             f"<ETag>&quot;{etag}&quot;</ETag>"
@@ -439,8 +842,7 @@ class RGWGateway:
         etag = hashlib.md5(
             b"".join(bytes.fromhex(e) for e in etags)).hexdigest() \
             + f"-{len(wanted)}"
-        self.io.write_full(_data_obj(bucket, key), bytes(blob))
-        self._write_index(bucket, key, len(blob), etag)
+        self._store_object(bucket, key, bytes(blob), etag)
         self._cleanup_upload(bucket, upload_id, meta)
         self._respond(h, 200, (
             '<?xml version="1.0"?><CompleteMultipartUploadResult>'

@@ -189,6 +189,16 @@ OPTIONS: dict[str, Option] = {opt.name: opt for opt in [
        desc="concurrent backfills an OSD serves (local or remote)"),
     _o("osd_backfill_scan_max", T.UINT, 512, L.ADVANCED, runtime=True,
        desc="objects per ranged backfill scan chunk"),
+    # client-side object cache (ref: options.cc client_oc*, rbd_cache*)
+    _o("client_oc", T.BOOL, True, L.ADVANCED,
+       desc="cephfs write-back object cache under CAP_EXCL/CAP_CACHE"),
+    _o("client_oc_size", T.SIZE, 32 << 20, L.ADVANCED),
+    _o("client_oc_max_dirty", T.SIZE, 8 << 20, L.ADVANCED),
+    _o("rbd_cache", T.BOOL, True, L.ADVANCED,
+       desc="librbd write-back object cache (flushed on lock "
+            "release, snap create, close)"),
+    _o("rbd_cache_size", T.SIZE, 32 << 20, L.ADVANCED),
+    _o("rbd_cache_max_dirty", T.SIZE, 8 << 20, L.ADVANCED),
     # fault injection (ref: options.cc:774 heartbeat_inject_failure,
     # :3565 osd_debug_inject_dispatch_delay)
     _o("heartbeat_inject_failure", T.SECS, 0.0, L.DEV, runtime=True),

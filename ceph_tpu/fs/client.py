@@ -120,16 +120,55 @@ class FileHandle:
         # OSD COWs pre-snap state (ref: SnapRealm::get_snap_context
         # feeding every data op)
         self.set_snapc(rec.get("snapc"))
+        # write-back object cache (ref: ObjectCacher mounted by
+        # Client.cc; the caps ARE its coherence protocol: CAP_EXCL
+        # buffers writes, CAP_CACHE serves cached reads, revocation
+        # flushes + invalidates).  Shared PER INODE across this
+        # client's handles — per-handle caches would lose each
+        # other's page updates at flush time.
+        self._oc = None
+        self._oc_io = None
+        from ..common.options import global_config
+        if global_config()["client_oc"] and self.snapid is None:
+            self._oc, self._oc_io = fs._get_cache(
+                self.ino, rec["pool"],
+                page=min(self.layout.stripe_unit, 1 << 16))
+            if rec.get("snapc"):
+                self._oc_io.set_write_snapc(rec["snapc"]["seq"],
+                                            rec["snapc"]["snaps"])
         fs._register_handle(self)
 
     def set_snapc(self, snapc: dict | None) -> None:
         if snapc:
+            oc = getattr(self, "_oc", None)
+            if oc is not None:
+                # buffered writes predate the new snap context: they
+                # must flush under the OLD one or the OSD won't COW
+                # them into the snapshot they logically belong to
+                oc.flush()
             self._io.set_write_snapc(snapc["seq"], snapc["snaps"])
+            if getattr(self, "_oc_io", None) is not None:
+                self._oc_io.set_write_snapc(snapc["seq"],
+                                            snapc["snaps"])
 
     # -- data path (ref: Client::_write -> Striper + Objecter) ---------
     def write(self, offset: int, data: bytes) -> int:
         if self.snapid is not None:
             raise CephFSError("EROFS", self.path)
+        if self._oc is not None and self.caps & CAP_EXCL:
+            # EXCL grants write buffering: data lands in the cache and
+            # reaches RADOS on fsync/close/revoke (ref: Fw-cap
+            # buffered writes through ObjectCacher)
+            for ext in Striper.file_to_extents(self.layout, offset,
+                                               len(data)):
+                buf = data[ext.logical_offset - offset:
+                           ext.logical_offset - offset + ext.length]
+                self._oc.write(fs_data_obj(self.ino, ext.objectno),
+                               ext.offset, buf)
+            if offset + len(data) > self.size:
+                self.size = offset + len(data)
+                self._dirty_size = True
+            return len(data)
         futs = []
         for ext in Striper.file_to_extents(self.layout, offset,
                                            len(data)):
@@ -141,6 +180,10 @@ class FileHandle:
         for f in futs:
             self._io._wait(f)
         self._rcache.clear()
+        if self._oc is not None:
+            # a CACHE-only handle may have cached reads: the direct
+            # write just went around them (read-your-own-write)
+            self._oc.invalidate()
         if offset + len(data) > self.size:
             self.size = offset + len(data)
             if self.caps & CAP_EXCL:
@@ -172,6 +215,18 @@ class FileHandle:
             length = max(0, self.size - offset)
         if length == 0:
             return b""
+        if self._oc is not None and \
+                self.caps & (CAP_CACHE | CAP_EXCL):
+            # cached read path (ref: CAP_CACHE through ObjectCacher)
+            out = bytearray(length)
+            for ext in Striper.file_to_extents(self.layout, offset,
+                                               length):
+                buf = self._oc.read(
+                    fs_data_obj(self.ino, ext.objectno),
+                    ext.offset, ext.length)
+                dst = ext.logical_offset - offset
+                out[dst:dst + len(buf)] = buf
+            return bytes(out[:length])
         key = (offset, length)
         if self.caps & (CAP_CACHE | CAP_EXCL):
             hit = self._rcache.get(key)
@@ -200,18 +255,27 @@ class FileHandle:
         return result
 
     def _surrender_caps(self) -> None:
-        """Revoke: flush dirty size, drop caches, run cap-less."""
+        """Revoke: flush dirty DATA first, then the dirty size, drop
+        caches, run cap-less (ref: the flush ordering cap revocation
+        imposes on ObjectCacher — data must land before the metadata
+        that advertises it)."""
+        if self._oc is not None:
+            self._oc.flush()
         if self._dirty_size:
             self.fs._session.call("setattr", {
                 "path": self.path, "size": self.size,
                 "grow_only": True})
             self._dirty_size = False
+        if self._oc is not None:
+            self._oc.invalidate()
         self._rcache.clear()
         self.caps = 0
 
     def fsync(self) -> None:
         if self.snapid is not None:
             return
+        if self._oc is not None:
+            self._oc.flush()
         self.fs._session.call("setattr", {"path": self.path,
                                           "size": self.size,
                                           "grow_only": True})
@@ -219,6 +283,9 @@ class FileHandle:
 
     def close(self) -> None:
         self.fsync()
+        if self._oc is not None:
+            self.fs._put_cache(self.ino)
+            self._oc = None
         if self.fs._unregister_handle(self):
             try:
                 self.fs._session.call("release", {"ino": self.ino})
@@ -234,7 +301,53 @@ class CephFS:
         self._session = _MDSSession(rados, mds)
         self._session.fs = self
         self._handles: dict[int, list] = {}      # ino -> [FileHandle]
+        #: per-inode shared ObjectCacher: ino -> (cacher, io, refs)
+        #: (ref: Client.cc mounts ONE ObjectCacher per inode)
+        self._caches: dict[int, tuple] = {}
         self._hlock = threading.Lock()
+
+    def _get_cache(self, ino: int, pool: str, page: int):
+        from ..common.options import global_config
+        from ..osdc.object_cacher import ObjectCacher
+        with self._hlock:
+            ent = self._caches.get(ino)
+            if ent is not None:
+                oc, io, refs = ent
+                self._caches[ino] = (oc, io, refs + 1)
+                return oc, io
+            io = self.rados.open_ioctx(pool)
+
+            def _read(oid, off, length, _io=io):
+                try:
+                    return _io.read(oid, length=length, offset=off)
+                except RadosError as ex:
+                    if ex.errno_name != "ENOENT":
+                        raise
+                    return b""              # sparse hole
+
+            def _write(oid, off, data, _io=io):
+                _io._wait(_io.aio_write(oid, data, offset=off))
+
+            cfg = global_config()
+            oc = ObjectCacher(_read, _write,
+                              max_dirty=cfg["client_oc_max_dirty"],
+                              max_size=cfg["client_oc_size"],
+                              page=page)
+            self._caches[ino] = (oc, io, 1)
+            return oc, io
+
+    def _put_cache(self, ino: int) -> None:
+        with self._hlock:
+            ent = self._caches.get(ino)
+            if ent is None:
+                return
+            oc, io, refs = ent
+            if refs > 1:
+                self._caches[ino] = (oc, io, refs - 1)
+                return
+            del self._caches[ino]
+        oc.flush()
+        oc.invalidate()
 
     # -- capability plumbing -------------------------------------------
     def _register_handle(self, fh) -> None:

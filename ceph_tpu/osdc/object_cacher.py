@@ -1,0 +1,260 @@
+"""ObjectCacher: a write-back object extent cache shared by librbd
+images and CephFS file handles (ref: src/osdc/ObjectCacher.{h,cc} —
+the BufferHead extent cache both libraries mount on top of the
+Objecter; VERDICT r3 #6).
+
+Model (page-granular BufferHeads):
+
+* Each cached object holds fixed-size **pages** (default 64 KiB) with
+  a valid set and a dirty set.  A partial-page write to an uncached
+  page write-allocates: the page is first read from the backing store
+  (read-modify-write), so flushing always writes fully-valid pages —
+  flushing a partially-known page would overwrite backing bytes that
+  were never cached.
+* **Write-back**: writes land in pages and return; `flush()` pushes
+  dirty pages (consecutive runs coalesced into one backing write) in
+  object order.  Exceeding `max_dirty` triggers an inline flush of
+  the oldest dirty object (the reference's dirty/tx throttle).
+* **Bounded memory**: an LRU across objects; past `max_size`, clean
+  pages of the least-recently-used objects are evicted (dirty pages
+  flush first).
+* **Coherence contract**: single writer per object range — exactly
+  what the callers' concurrency machinery guarantees (librbd's
+  exclusive lock, CephFS's CAP_EXCL/CAP_CACHE capabilities).  Cap
+  revocation / lock release MUST `flush()` + `invalidate()` (the
+  flush-ordering obligation ObjectCacher places on its users).
+
+The backing store is abstracted as two callables, so the same cacher
+serves rbd (object reads with parent fall-through + copyup writes)
+and cephfs (striped file objects):
+
+    read_fn(oid, off, length) -> bytes   # short/empty = sparse zeros
+    write_fn(oid, off, data)  -> None
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Callable
+
+
+class _CachedObject:
+    __slots__ = ("pages", "valid", "dirty")
+
+    def __init__(self):
+        self.pages: dict[int, bytearray] = {}
+        self.valid: set[int] = set()
+        self.dirty: set[int] = set()
+
+
+class ObjectCacher:
+    def __init__(self, read_fn: Callable, write_fn: Callable,
+                 max_dirty: int = 8 << 20, max_size: int = 32 << 20,
+                 page: int = 1 << 16):
+        self._read = read_fn
+        self._write = write_fn
+        self.max_dirty = max_dirty
+        self.max_size = max_size
+        self.page = page
+        self._objs: "OrderedDict[str, _CachedObject]" = OrderedDict()
+        self._lock = threading.RLock()
+        # O(1) accounting: page counts maintained at every transition
+        # (a per-write full scan would sit on the hot path)
+        self._n_pages = 0
+        self._n_dirty = 0
+        self.stats = {"hit": 0, "miss": 0, "flush_writes": 0,
+                      "write_back": 0, "evicted_pages": 0}
+
+    # -- accounting -----------------------------------------------------
+    def dirty_bytes(self) -> int:
+        return self._n_dirty * self.page
+
+    def cached_bytes(self) -> int:
+        return self._n_pages * self.page
+
+    # -- internals ------------------------------------------------------
+    def _obj(self, oid: str) -> _CachedObject:
+        o = self._objs.get(oid)
+        if o is None:
+            o = self._objs[oid] = _CachedObject()
+        self._objs.move_to_end(oid)          # LRU touch
+        return o
+
+    def _install(self, o: _CachedObject, p: int,
+                 buf: bytearray) -> None:
+        if p not in o.valid:
+            self._n_pages += 1
+        o.pages[p] = buf
+        o.valid.add(p)
+
+    def _fill_page(self, oid: str, o: _CachedObject, p: int) -> None:
+        """Write-allocate: fetch the page so a later flush writes only
+        fully-valid bytes (short backing reads zero-fill = sparse)."""
+        if p in o.valid:
+            return
+        data = self._read(oid, p * self.page, self.page) or b""
+        buf = bytearray(self.page)
+        buf[:len(data)] = data
+        self._install(o, p, buf)
+
+    def _fill_span(self, oid: str, o: _CachedObject,
+                   pages: list[int]) -> None:
+        """Cold-read fill: ONE backing read spanning the whole missing
+        window, sliced into pages — per-page reads would serialize a
+        cold object read into dozens of round-trips (the aio fan-out
+        the uncached path had).  Already-valid pages (possibly dirty)
+        are never overwritten."""
+        missing = [p for p in pages if p not in o.valid]
+        if not missing:
+            return
+        lo, hi = min(missing), max(missing)
+        data = self._read(oid, lo * self.page,
+                          (hi - lo + 1) * self.page) or b""
+        for p in range(lo, hi + 1):
+            if p in o.valid:
+                continue
+            base = (p - lo) * self.page
+            buf = bytearray(self.page)
+            chunk = data[base:base + self.page]
+            buf[:len(chunk)] = chunk
+            self._install(o, p, buf)
+
+    def _page_range(self, off: int, length: int):
+        return range(off // self.page,
+                     (off + length - 1) // self.page + 1)
+
+    # -- data path ------------------------------------------------------
+    def read(self, oid: str, off: int, length: int) -> bytes:
+        if length <= 0:
+            return b""
+        with self._lock:
+            o = self._obj(oid)
+            pages = list(self._page_range(off, length))
+            if all(p in o.valid for p in pages):
+                self.stats["hit"] += 1
+            else:
+                self.stats["miss"] += 1
+                self._fill_span(oid, o, pages)
+            out = bytearray()
+            for p in pages:
+                out += o.pages[p]
+            base = off - pages[0] * self.page
+            self._maybe_evict()
+            return bytes(out[base:base + length])
+
+    def write(self, oid: str, off: int, data: bytes) -> None:
+        if not data:
+            return
+        with self._lock:
+            o = self._obj(oid)
+            self.stats["write_back"] += 1
+            pos = 0
+            for p in self._page_range(off, len(data)):
+                p_start = p * self.page
+                lo = max(off, p_start) - p_start
+                hi = min(off + len(data), p_start + self.page) - p_start
+                if lo > 0 or hi < self.page:
+                    self._fill_page(oid, o, p)     # partial page: RMW
+                elif p not in o.valid:
+                    self._install(o, p, bytearray(self.page))
+                o.pages[p][lo:hi] = data[pos:pos + (hi - lo)]
+                pos += hi - lo
+                if p not in o.dirty:
+                    o.dirty.add(p)
+                    self._n_dirty += 1
+            if self.dirty_bytes() > self.max_dirty:
+                self._flush_oldest_dirty()
+            self._maybe_evict()
+
+    def discard(self, oid: str, off: int, length: int) -> None:
+        """Drop cached pages fully inside [off, off+len) and zero the
+        overlap of boundary pages (the caller zeroed the backing)."""
+        with self._lock:
+            o = self._objs.get(oid)
+            if o is None:
+                return
+            for p in list(self._page_range(off, length)):
+                p_start = p * self.page
+                lo = max(off, p_start) - p_start
+                hi = min(off + length, p_start + self.page) - p_start
+                if lo == 0 and hi == self.page:
+                    if p in o.valid:
+                        self._n_pages -= 1
+                    if p in o.dirty:
+                        self._n_dirty -= 1
+                    o.pages.pop(p, None)
+                    o.valid.discard(p)
+                    o.dirty.discard(p)
+                elif p in o.valid:
+                    o.pages[p][lo:hi] = b"\0" * (hi - lo)
+
+    # -- flush / invalidate ---------------------------------------------
+    def _flush_obj(self, oid: str, o: _CachedObject) -> int:
+        wrote = 0
+        run: list[int] = []
+        for p in sorted(o.dirty) + [None]:
+            if run and (p is None or p != run[-1] + 1):
+                start = run[0] * self.page
+                blob = b"".join(bytes(o.pages[q]) for q in run)
+                self._write(oid, start, blob)
+                self.stats["flush_writes"] += 1
+                wrote += len(blob)
+                run = []
+            if p is not None:
+                run.append(p)
+        self._n_dirty -= len(o.dirty)
+        o.dirty.clear()
+        return wrote
+
+    def flush(self, oid: str | None = None) -> int:
+        """Push dirty pages to the backing store; returns bytes
+        written.  MUST run before a cap/lock is surrendered."""
+        with self._lock:
+            items = [(oid, self._objs[oid])] if oid is not None and \
+                oid in self._objs else \
+                ([] if oid is not None else list(self._objs.items()))
+            return sum(self._flush_obj(k, o) for k, o in items
+                       if o.dirty)
+
+    def _flush_oldest_dirty(self) -> None:
+        for oid, o in self._objs.items():      # LRU order
+            if o.dirty:
+                self._flush_obj(oid, o)
+                return
+
+    def invalidate(self, oid: str | None = None,
+                   discard_dirty: bool = False) -> None:
+        """Drop cached state.  Dirty pages are flushed first unless
+        the caller explicitly discards them (rollback/resize paths)."""
+        with self._lock:
+            oids = [oid] if oid is not None else list(self._objs)
+            for k in oids:
+                o = self._objs.get(k)
+                if o is None:
+                    continue
+                if o.dirty and not discard_dirty:
+                    self._flush_obj(k, o)
+                self._n_pages -= len(o.valid)
+                self._n_dirty -= len(o.dirty)
+                del self._objs[k]
+
+    def _maybe_evict(self) -> None:
+        """LRU eviction of clean pages once past max_size."""
+        while self.cached_bytes() > self.max_size:
+            for oid, o in self._objs.items():
+                clean = [p for p in o.valid if p not in o.dirty]
+                if clean:
+                    for p in clean:
+                        o.pages.pop(p, None)
+                        o.valid.discard(p)
+                        self._n_pages -= 1
+                        self.stats["evicted_pages"] += 1
+                    if not o.pages:
+                        del self._objs[oid]
+                    break
+            else:
+                # everything is dirty: flush the oldest, then retry
+                before = self.dirty_bytes()
+                self._flush_oldest_dirty()
+                if self.dirty_bytes() >= before:
+                    return                      # cannot make progress

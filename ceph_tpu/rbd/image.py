@@ -279,6 +279,9 @@ class Image:
         self.snaps: dict[str, dict] = meta.get("snaps", {})
         self.parent: dict | None = meta.get("parent")
         self.meta_children: list = meta.get("children", [])
+        #: mirror state (ref: librbd mirror image info): None = not
+        #: mirrored; else {"primary": bool, "epochs": [promotion ids]}
+        self.mirror: dict | None = meta.get("mirror")
         #: write-ahead mutation journal (ref: librbd journaling)
         self.journaling = bool(meta.get("journaling"))
         self._journal = None
@@ -307,6 +310,60 @@ class Image:
         # demand for diffs)
         self.object_map = ObjectMap(self._wio, name,
                                     self._object_span())
+        # write-back object cache (ref: librbd's ObjectCacher mount,
+        # rbd_cache*): head IO only — snapshot opens read frozen state
+        # and bypass it.  The exclusive lock is the coherence protocol:
+        # release flushes + invalidates.
+        self._oc = None
+        from ..common.options import global_config
+        if global_config()["rbd_cache"] and self._snap_id is None:
+            from ..osdc.object_cacher import ObjectCacher
+            cfg = global_config()
+            self._oc = ObjectCacher(
+                self._oc_read, self._oc_write,
+                max_dirty=cfg["rbd_cache_max_dirty"],
+                max_size=cfg["rbd_cache_size"],
+                page=min(1 << self.order, 1 << 16))
+
+    # -- object cache backing (oid = str(objectno)) ---------------------
+    def _oc_read(self, oid: str, off: int, length: int) -> bytes:
+        """Head object read with clone parent fall-through (the same
+        resolution Image.read performs per extent)."""
+        objno = int(oid)
+        try:
+            return self.ioctx.read(data_name(self.name, objno),
+                                   length=length, offset=off)
+        except RadosError as ex:
+            if ex.errno_name != "ENOENT":
+                raise
+        parent = self._parent()
+        if parent is not None and self.parent is not None:
+            p_off = objno * (1 << self.order) + off
+            p_len = min(length, self.parent["overlap"] - p_off)
+            if p_len > 0:
+                return parent.read(p_off, p_len)
+        return b""
+
+    def _oc_write(self, oid: str, off: int, data: bytes) -> None:
+        """Backing write at flush time: copyup for parent-backed
+        partial overwrites + object-map existence, exactly like the
+        uncached write path."""
+        objno = int(oid)
+        partial = not (off == 0 and len(data) == 1 << self.order)
+        if partial and objno < self._overlap_span() and \
+                self.object_map.get(objno) == ObjectMap.NONEXISTENT:
+            self._copyup(objno)
+        self._wio._wait(self._wio.aio_write(
+            data_name(self.name, objno), data, offset=off))
+        self.object_map.set(objno, ObjectMap.EXISTS, flush=False)
+
+    def flush(self) -> None:
+        """Flush the write-back cache (ref: rbd_flush): dirty data
+        reaches RADOS and the object map is persisted."""
+        if self._oc is not None:
+            with self._iolock:
+                self._oc.flush()
+                self.object_map.flush()
 
     # -- exclusive lock (ref: src/librbd/exclusive_lock/) --------------
     @property
@@ -376,6 +433,14 @@ class Image:
         with self._iolock:
             if not self._lock_owned:
                 return
+            # the lock is the cache-coherence protocol: dirty data
+            # must land and cached state drop BEFORE another client
+            # can take the lock (ref: pre-release flush in
+            # librbd's exclusive_lock PreReleaseRequest)
+            if self._oc is not None:
+                self._oc.flush()
+                self.object_map.flush()
+                self._oc.invalidate()
             try:
                 self.ioctx.exec(header_name(self.name), "lock",
                                 "unlock", {
@@ -447,6 +512,11 @@ class Image:
         self._check_open()
         self._check_writable()
         self._ensure_lock()
+        if self._oc is not None:
+            # flush, then drop: shrink removes backing objects the
+            # cache may still shadow
+            self.flush()
+            self._oc.invalidate()
         if self._journal is not None:
             self._journal.append("resize", {"size": size})
         old_span = self._object_span()
@@ -477,6 +547,8 @@ class Image:
             meta["children"] = self.meta_children
         if self.journaling:
             meta["journaling"] = True
+        if self.mirror is not None:
+            meta["mirror"] = self.mirror
         self.ioctx.write_full(header_name(self.name),
                               json.dumps(meta).encode())
 
@@ -487,6 +559,9 @@ class Image:
         self._ensure_lock()
         if snap_name in self.snaps:
             raise RBDError(17, f"snapshot {snap_name!r} exists")
+        # dirty cached data belongs BEFORE the snapshot point
+        # (ref: librbd flushes the ObjectCacher ahead of snap_create)
+        self.flush()
         if self._journal is not None:
             self._journal.append("snap_create", {"name": snap_name})
         sid = self._wio.selfmanaged_snap_create()
@@ -584,6 +659,9 @@ class Image:
         self._ensure_lock()
         if snap_name not in self.snaps:
             raise RBDError(2, f"snapshot {snap_name!r} not found")
+        if self._oc is not None:
+            # post-snap dirty data is exactly what rollback discards
+            self._oc.invalidate(discard_dirty=True)
         if self._journal is not None:
             self._journal.append("snap_rollback", {"name": snap_name})
         snap = self.snaps[snap_name]
@@ -618,6 +696,13 @@ class Image:
     def _check_writable(self) -> None:
         if self._snap_id is not None:
             raise RBDError(30, "image is open read-only at a snapshot")
+        if self.mirror is not None and \
+                not self.mirror.get("primary", True) and \
+                not getattr(self, "_replaying", False):
+            # a demoted mirror image refuses local writes — only the
+            # primary's journal replayer may mutate it (ref: librbd's
+            # non-primary write gate; the replayer sets _replaying)
+            raise RBDError(30, "image is non-primary (demoted)")
 
     # -- IO ------------------------------------------------------------
     def _check_open(self) -> None:
@@ -666,6 +751,16 @@ class Image:
                 # the data objects (ref: librbd journaling ordering)
                 self._journal.append("write", {
                     "off": offset, "data": bytes(data[:length])})
+            if self._oc is not None:
+                # write-back: pages buffer in the cache; copyup +
+                # object-map existence happen at flush in _oc_write
+                for ext in Striper.file_to_extents(self.layout,
+                                                   offset, length):
+                    buf = data[ext.logical_offset - offset:
+                               ext.logical_offset - offset
+                               + ext.length]
+                    self._oc.write(str(ext.objectno), ext.offset, buf)
+                return length
             obj_size = 1 << self.order
             over = self._overlap_span()
             futs = []
@@ -695,6 +790,15 @@ class Image:
         (ref: io/ImageReadRequest parent read-from)."""
         self._check_open()
         length = self._clip(offset, length)
+        if self._oc is not None and self._snap_id is None:
+            out = bytearray(length)
+            for ext in Striper.file_to_extents(self.layout, offset,
+                                               length):
+                buf = self._oc.read(str(ext.objectno), ext.offset,
+                                    ext.length)
+                base = ext.logical_offset - offset
+                out[base:base + len(buf)] = buf
+            return bytes(out)
         out = bytearray(length)
         pend = []
         for ext in Striper.file_to_extents(self.layout, offset, length):
@@ -733,6 +837,16 @@ class Image:
         with self._iolock:
             self._ensure_lock()
             length = self._clip(offset, length)
+            if self._oc is not None:
+                # flush dirty state, then drop exactly the discarded
+                # extents — the backing removes/zeros below must not
+                # be shadowed by cached pages, and the rest of the
+                # cache stays warm
+                self._oc.flush()
+                for ext in Striper.file_to_extents(self.layout,
+                                                   offset, length):
+                    self._oc.discard(str(ext.objectno), ext.offset,
+                                     ext.length)
             if self._journal is not None and length:
                 self._journal.append("discard", {"off": offset,
                                                  "len": length})
@@ -767,6 +881,7 @@ class Image:
         """Provisioned bytes from the object map — no data-object scan
         (ref: rbd du fast-diff path)."""
         self._check_open()
+        self.flush()        # cached writes count once they exist
         obj_size = 1 << self.order
         used = 0
         for objno in self.object_map.existing():
@@ -778,6 +893,8 @@ class Image:
         straight from the object maps (ref: diff_iterate with
         whole_object=true + fast-diff)."""
         self._check_open()
+        if self._snap_id is None:
+            self.flush()    # cached writes must reach the object map
         obj_size = 1 << self.order
         if snap_name is None:
             base = None
@@ -821,6 +938,7 @@ class Image:
     def close(self) -> None:
         if not self._open:
             return
+        self.flush()
         self.release_lock()
         if self._watch_cookie is not None:
             try:

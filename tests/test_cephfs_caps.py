@@ -80,7 +80,9 @@ def test_cache_invalidated_on_revoke(fscluster):
     h1 = fs_1.open("/caps/cached", "r")
     assert h1.caps & CAP_CACHE
     assert h1.read(0) == b"v1-data"
-    assert h1._rcache                    # cached
+    # cached (ObjectCacher when enabled, legacy rcache otherwise)
+    assert (h1._oc is not None and h1._oc.cached_bytes() > 0) or \
+        h1._rcache
     # another client writes: h1's CACHE is revoked, cache dropped
     h2 = fs_2.open("/caps/cached", "r+")
     h2.write(0, b"v2-DATA")
@@ -90,6 +92,7 @@ def test_cache_invalidated_on_revoke(fscluster):
     while h1.caps and time.monotonic() < deadline:
         time.sleep(0.02)
     assert h1.caps == 0 and not h1._rcache
+    assert h1._oc is None or h1._oc.cached_bytes() == 0
     assert h1.read(0) == b"v2-DATA"
     h1.close()
     h2.close()

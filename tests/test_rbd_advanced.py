@@ -76,6 +76,7 @@ def test_object_map_tracks_existence_and_du(cluster):
     img = Image(io, "mapped")
     img.write(0, b"z" * 100)                    # object 0
     img.write(3 << ORDER, b"z" * (1 << ORDER))  # object 3, full
+    img.flush()     # write-back cache: the map materializes at flush
     assert img.object_map.get(0) == ObjectMap.EXISTS
     assert img.object_map.get(1) == ObjectMap.NONEXISTENT
     assert img.object_map.get(3) == ObjectMap.EXISTS

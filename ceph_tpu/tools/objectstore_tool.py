@@ -1,0 +1,201 @@
+"""ceph-objectstore-tool: offline surgery on a stopped OSD's store
+(ref: src/tools/ceph_objectstore_tool.cc; VERDICT r3 #7).
+
+Operates directly on the data directory of a DOWN OSD (BlueStore
+layout: `block` + `kv/`):
+
+    --op list                     list PG collections (or objects
+                                  with --pgid)
+    --op info    --pgid P         object count + durable log bounds
+    --op fsck                     BlueStore checksum/reference fsck
+    --op export  --pgid P --file F   serialize the whole PG: objects
+                                  (data, attrs, omap, snap clones) +
+                                  the pgmeta omap (durable pg_log)
+    --op import  --file F         restore an exported PG into this
+                                  (possibly different) OSD's store —
+                                  the disk-swap / PG-rescue flow the
+                                  reference tool exists for
+    --op remove  --pgid P         delete a PG collection outright
+
+The export blob uses the typed wire codec, so it round-trips the
+exact ObjectIds (snap clones included) and the pg_log omap that
+peering reads — an imported PG peers from real history instead of
+backfilling."""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from ..msg import encoding as wire
+from ..osd.types import PG
+from ..store import BlueStore, ObjectId, StoreError, Transaction
+
+EXPORT_VERSION = 1
+
+
+def _open_store(path: str) -> BlueStore:
+    st = BlueStore(path)
+    st.mount()
+    return st
+
+
+def _parse_pgid(s: str) -> PG:
+    pool, ps = s.split(".", 1)
+    return PG(int(pool), int(ps, 16))
+
+
+def _pg_cid(pg: PG) -> str:
+    from ..osd.ec_backend import pg_cid
+    return pg_cid(pg)
+
+
+def list_pgs(store) -> list[str]:
+    out = []
+    for cid in store.list_collections():
+        if cid.startswith("pg_"):
+            out.append(cid[3:])
+    return sorted(out)
+
+
+def list_objects(store, pg: PG) -> list[str]:
+    cid = _pg_cid(pg)
+    if not store.collection_exists(cid):
+        raise StoreError("ENOENT", f"pg {pg}")
+    return [repr(o) for o in sorted(store.collection_list(cid),
+                                    key=lambda o: (o.name, o.snap))]
+
+
+def pg_info(store, pg: PG) -> dict:
+    from ..osd.replicated_backend import ReplicatedPGShard
+    cid = _pg_cid(pg)
+    if not store.collection_exists(cid):
+        raise StoreError("ENOENT", f"pg {pg}")
+    shard = ReplicatedPGShard(pg, store, create=False)
+    head, tail = shard.log_info()
+    objs = [o for o in store.collection_list(cid)
+            if o.name != "pgmeta"]
+    return {"pgid": str(pg), "objects": len(objs),
+            "log_head": str(head), "log_tail": str(tail),
+            "log_entries": len(shard.pg_log.log)}
+
+
+def export_pg(store, pg: PG) -> bytes:
+    """Serialize a whole PG — every object (head + snap clones) with
+    data/attrs/omap, plus pgmeta's omap (the durable pg_log)."""
+    cid = _pg_cid(pg)
+    if not store.collection_exists(cid):
+        raise StoreError("ENOENT", f"pg {pg}")
+    objects = []
+    for oid in sorted(store.collection_list(cid),
+                      key=lambda o: (o.name, o.snap)):
+        objects.append({
+            "oid": oid,
+            "data": bytes(store.read(cid, oid, 0, 0)),
+            "attrs": dict(store.getattrs(cid, oid)),
+            "omap": dict(store.omap_get(cid, oid)),
+        })
+    return wire.encode({"version": EXPORT_VERSION, "pgid": pg,
+                        "objects": objects})
+
+
+def import_pg(store, blob: bytes, force: bool = False) -> PG:
+    """Restore an exported PG.  Refuses to clobber an existing
+    collection unless forced (ref: the tool's same guard)."""
+    rec = wire.decode(blob)
+    if not isinstance(rec, dict) or \
+            rec.get("version") != EXPORT_VERSION:
+        raise StoreError("EINVAL", "not a PG export blob")
+    pg = rec["pgid"]
+    cid = _pg_cid(pg)
+    if store.collection_exists(cid):
+        if not force:
+            raise StoreError("EEXIST", f"pg {pg} already present "
+                                       "(--force to overwrite)")
+        txn = Transaction()
+        for oid in store.collection_list(cid):
+            txn.remove(cid, oid)
+        txn.remove_collection(cid)
+        store.queue_transaction(txn)
+    txn = Transaction()
+    txn.create_collection(cid)
+    for ent in rec["objects"]:
+        oid = ent["oid"]
+        txn.touch(cid, oid)
+        if ent["data"]:
+            txn.write(cid, oid, 0, ent["data"])
+        if ent["attrs"]:
+            txn.setattrs(cid, oid, ent["attrs"])
+        if ent["omap"]:
+            txn.omap_setkeys(cid, oid, ent["omap"])
+    store.queue_transaction(txn)
+    return pg
+
+
+def remove_pg(store, pg: PG) -> int:
+    cid = _pg_cid(pg)
+    if not store.collection_exists(cid):
+        raise StoreError("ENOENT", f"pg {pg}")
+    objs = store.collection_list(cid)
+    txn = Transaction()
+    for oid in objs:
+        txn.remove(cid, oid)
+    txn.remove_collection(cid)
+    store.queue_transaction(txn)
+    return len(objs)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="ceph-tpu-objectstore-tool")
+    ap.add_argument("--data-path", required=True,
+                    help="the STOPPED OSD's store directory")
+    ap.add_argument("--op", required=True,
+                    choices=["list", "info", "fsck", "export",
+                             "import", "remove"])
+    ap.add_argument("--pgid", default="",
+                    help="pg id as <pool>.<ps-hex>")
+    ap.add_argument("--file", default="", help="export/import blob")
+    ap.add_argument("--force", action="store_true")
+    ap.add_argument("--repair", action="store_true",
+                    help="(fsck) placeholder — errors are reported; "
+                         "repair rides scrub in a live cluster")
+    a = ap.parse_args(argv)
+    store = _open_store(a.data_path)
+    try:
+        if a.op == "list":
+            if a.pgid:
+                for line in list_objects(store, _parse_pgid(a.pgid)):
+                    print(line)
+            else:
+                for p in list_pgs(store):
+                    print(p)
+        elif a.op == "info":
+            import json
+            print(json.dumps(pg_info(store, _parse_pgid(a.pgid))))
+        elif a.op == "fsck":
+            errors = store.fsck()
+            for e in errors:
+                print(e)
+            print(f"fsck: {len(errors)} error(s)")
+            return 1 if errors else 0
+        elif a.op == "export":
+            blob = export_pg(store, _parse_pgid(a.pgid))
+            with open(a.file, "wb") as f:
+                f.write(blob)
+            print(f"exported {a.pgid}: {len(blob)} bytes")
+        elif a.op == "import":
+            with open(a.file, "rb") as f:
+                pg = import_pg(store, f.read(), force=a.force)
+            print(f"imported {pg}")
+        elif a.op == "remove":
+            n = remove_pg(store, _parse_pgid(a.pgid))
+            print(f"removed {a.pgid}: {n} object(s)")
+        return 0
+    except StoreError as ex:
+        print(f"error: {ex}", file=sys.stderr)
+        return 1
+    finally:
+        store.umount()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

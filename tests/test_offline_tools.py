@@ -1,0 +1,190 @@
+"""Offline surgery tools: objectstore-tool + monstore-tool
+(VERDICT r3 #7; ref: src/tools/ceph_objectstore_tool.cc,
+src/tools/ceph_monstore_tool.cc)."""
+import json
+import time
+
+import pytest
+
+from ceph_tpu.osd.types import PG
+from ceph_tpu.store import BlueStore
+from ceph_tpu.testing import MiniCluster
+from ceph_tpu.tools import monstore_tool, objectstore_tool
+
+
+def _mk_store(tmp_path, name):
+    st = BlueStore(str(tmp_path / name))
+    st.mkfs()
+    st.mount()
+    return st
+
+
+def test_objectstore_tool_cli_roundtrip(tmp_path):
+    """list/info/fsck/export/import/remove against a bare store."""
+    from ceph_tpu.osd.replicated_backend import ReplicatedPGShard
+    from ceph_tpu.osd.pg_types import EVersion, MODIFY, PGLogEntry
+    st = _mk_store(tmp_path, "osd0")
+    pg = PG(3, 0xb)
+    shard = ReplicatedPGShard(pg, st)
+    for i in range(5):
+        e = PGLogEntry(MODIFY, f"obj{i}", EVersion(2, i + 1))
+        shard.apply_mutations(f"obj{i}", [], EVersion(2, i + 1), [e])
+        st_data = f"payload-{i}".encode() * 10
+        shard.apply_write(f"obj{i}", 0, st_data, False,
+                          EVersion(2, i + 1), [])
+    st.umount()
+
+    # CLI: list + info + fsck
+    assert objectstore_tool.main(
+        ["--data-path", str(tmp_path / "osd0"), "--op", "list"]) == 0
+    assert objectstore_tool.main(
+        ["--data-path", str(tmp_path / "osd0"), "--op", "fsck"]) == 0
+    st = _mk_store(tmp_path, "osd0")
+    info = objectstore_tool.pg_info(st, pg)
+    assert info["objects"] == 5
+    assert info["log_entries"] == 5
+
+    # export -> import into a different store
+    blob = objectstore_tool.export_pg(st, pg)
+    st.umount()
+    st2 = _mk_store(tmp_path, "osd1")
+    got = objectstore_tool.import_pg(st2, blob)
+    assert got == pg
+    # double import refused without --force
+    with pytest.raises(Exception):
+        objectstore_tool.import_pg(st2, blob)
+    objectstore_tool.import_pg(st2, blob, force=True)
+    info2 = objectstore_tool.pg_info(st2, pg)
+    assert info2["objects"] == info["objects"]
+    assert info2["log_head"] == info["log_head"]
+    from ceph_tpu.osd.replicated_backend import ReplicatedPGShard as R
+    sh2 = R(pg, st2, create=False)
+    assert sh2.read("obj3") == b"payload-3" * 10
+    # remove
+    assert objectstore_tool.remove_pg(st2, pg) == 6  # 5 objs + pgmeta
+    st2.umount()
+
+
+def test_pg_export_import_rescues_killed_osd(tmp_path):
+    """The VERDICT criterion: export a PG from a killed OSD's store,
+    import it into a fresh one, revive — the cluster peers from the
+    imported history and every object reads back."""
+    import numpy as np
+    c = MiniCluster(n_osd=3, threaded=True)
+    try:
+        c.wait_all_up()
+        # move every OSD onto disk-backed BlueStore
+        for i in range(3):
+            c.kill_osd(i)
+            st = _mk_store(tmp_path, f"osd{i}")
+            c._stores[i] = st
+            c.revive_osd(i)
+        c.wait_all_up()
+        r = c.rados()
+        r.pool_create("surgery", pg_num=2)
+        io = r.open_ioctx("surgery")
+        rng = np.random.default_rng(5)
+        objs = {f"s{i}": rng.integers(0, 256, 1024,
+                                      dtype=np.uint8).tobytes()
+                for i in range(24)}
+        for k, v in objs.items():
+            io.write_full(k, v)
+        victim = 1
+        c.kill_osd(victim)
+        r.mon_command({"prefix": "osd down", "ids": [victim]})
+        # offline surgery: every PG the dead OSD held moves to a
+        # brand-new store (the disk-swap flow)
+        old = c._stores[victim]
+        fresh = _mk_store(tmp_path, "osd-fresh")
+        moved = 0
+        for pgs in objectstore_tool.list_pgs(old):
+            pool_s, ps_s = pgs.split(".")
+            pg = PG(int(pool_s), int(ps_s, 16))
+            blob = objectstore_tool.export_pg(old, pg)
+            objectstore_tool.import_pg(fresh, blob)
+            moved += 1
+        assert moved >= 1
+        old.umount()
+        c._stores[victim] = fresh
+        c.revive_osd(victim)
+        deadline = time.monotonic() + 60
+        ok = False
+        while time.monotonic() < deadline and not ok:
+            c.tick()
+            if all(d.pgs_recovering() == 0 for d in c.osds.values()):
+                try:
+                    ok = all(io.read(k) == v for k, v in objs.items())
+                except Exception:
+                    ok = False
+            time.sleep(0.1)
+        assert ok, "cluster never returned to clean after import"
+        # the revived OSD serves from the imported collections
+        d = c.osds[victim]
+        assert any(cid.startswith("pg_") for cid in
+                   d.store.list_collections())
+    finally:
+        c.shutdown()
+
+
+def test_monstore_tool_dump_and_rebuild(tmp_path):
+    """dump / show-versions / get-osdmap / rebuild on a real durable
+    mon store."""
+    from ceph_tpu.kv import LogDB
+    from ceph_tpu.mon.monitor import Monitor, build_initial
+    from ceph_tpu.mon.store import MonitorStore
+    from ceph_tpu.msg.messenger import LocalNetwork
+    mon_dir = str(tmp_path / "mon0")
+    net = LocalNetwork()
+    m0, w = build_initial(3)
+    mon = Monitor(net, initial_map=m0, initial_wrapper=w,
+                  store=MonitorStore(LogDB(mon_dir)), threaded=False)
+    mon.init()
+    rc, outs, _ = mon.handle_command({"prefix": "osd pool create",
+                                      "pool": "p1", "pg_num": 8})
+    assert rc == 0, outs
+    rc, _, _ = mon.handle_command({"prefix": "osd pool create",
+                                   "pool": "p2", "pg_num": 4})
+    assert rc == 0
+    mon.shutdown()
+
+    store = monstore_tool._load(mon_dir)
+    lines = monstore_tool.dump(store)
+    assert any("osdmap" in ln for ln in lines)
+    vers = monstore_tool.show_versions(store)
+    assert "paxos" in vers or "osdmap" in vers
+    summary = monstore_tool.get_osdmap(store)
+    assert summary["epoch"] >= 3
+    assert len(summary["pools"]) == 2
+    store.db.close()
+
+    # rebuild into a fresh dir; a mon boots from it with same state
+    out_dir = str(tmp_path / "mon0-rebuilt")
+    n = monstore_tool.rebuild(mon_dir, out_dir)
+    assert n > 0
+    mon2 = Monitor(net, initial_map=build_initial(3)[0],
+                   initial_wrapper=build_initial(3)[1],
+                   store=MonitorStore(LogDB(out_dir)), threaded=False)
+    mon2.init()
+    assert len(mon2.osdmap.pools) == 2
+    assert mon2.osdmap.epoch >= 3
+    mon2.shutdown()
+
+
+def test_monstore_cli(tmp_path):
+    from ceph_tpu.kv import LogDB
+    from ceph_tpu.mon.monitor import Monitor, build_initial
+    from ceph_tpu.mon.store import MonitorStore
+    from ceph_tpu.msg.messenger import LocalNetwork
+    mon_dir = str(tmp_path / "monc")
+    m0, w = build_initial(2)
+    mon = Monitor(LocalNetwork(), initial_map=m0, initial_wrapper=w,
+                  store=MonitorStore(LogDB(mon_dir)), threaded=False)
+    mon.init()
+    mon.handle_command({"prefix": "osd pool create", "pool": "x",
+                        "pg_num": 4})
+    mon.shutdown()
+    assert monstore_tool.main([mon_dir, "dump"]) == 0
+    assert monstore_tool.main([mon_dir, "show-versions"]) == 0
+    assert monstore_tool.main([mon_dir, "get-osdmap"]) == 0
+    out = str(tmp_path / "monc2")
+    assert monstore_tool.main([mon_dir, "rebuild", "--out", out]) == 0

@@ -195,6 +195,7 @@ def ensure_registered() -> None:
     from ..osd import osdmap as _om           # noqa: F401
     from ..osd import pg_types as _pt         # noqa: F401
     from ..osd import types as _ot            # noqa: F401
+    from ..store import memstore as _ms       # noqa: F401
     from ..store import objectstore as _os    # noqa: F401
     from . import messages as _mm             # noqa: F401
 

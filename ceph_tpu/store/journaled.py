@@ -3,8 +3,8 @@
 The FileStore+FileJournal shape (ref: src/os/filestore/FileJournal.cc —
 every transaction appended to a journal before ack; src/os/filestore/
 FileStore.cc mount replay): the working set lives in memory like
-MemStore, every committed transaction is framed (length + crc32c +
-pickle) and fsync'd to `<dir>/journal.wal`, and mount() restores the
+MemStore, every committed transaction is framed (length + crc32c + the typed
+wire codec) and fsync'd to `<dir>/journal.wal`, and mount() restores the
 last snapshot then replays the journal.  umount() (or `compact()`)
 rewrites a snapshot and truncates the journal, bounding replay time.
 
@@ -15,8 +15,9 @@ PG collections intact.
 from __future__ import annotations
 
 import os
-import pickle
 import struct
+
+from ..msg import encoding as wire
 
 from ..common.crc32c import crc32c
 from ..common.log import dout
@@ -27,7 +28,7 @@ _HDR = struct.Struct("<II")      # length, crc32c
 
 
 class JournaledStore(MemStore):
-    SNAPSHOT = "snapshot.pkl"
+    SNAPSHOT = "snapshot.bin"
     JOURNAL = "journal.wal"
 
     def __init__(self, path: str):
@@ -50,16 +51,33 @@ class JournaledStore(MemStore):
         super().mkfs()
         self._seq = 0
         with open(self._snap_path, "wb") as f:
-            pickle.dump((self.colls, self._seq), f)
+            f.write(wire.encode((self.colls, self._seq)))
         open(self._wal_path, "wb").close()
 
     def mount(self) -> None:
         """Restore snapshot + replay the journal
         (ref: FileStore::mount -> journal replay)."""
+        legacy = os.path.join(self.path, "snapshot.pkl")
+        if not os.path.exists(self._snap_path) and \
+                os.path.exists(legacy):
+            # a pre-typed-codec store: refuse rather than silently
+            # mkfs-wipe it (and deliberately never load pickle)
+            from .objectstore import StoreError
+            raise StoreError(
+                "EINVAL",
+                f"{self.path}: legacy pickle-format JournaledStore — "
+                "migrate by re-importing its PGs (objectstore-tool) "
+                "or recover from replicas")
         if not os.path.exists(self._snap_path):
             self.mkfs()
         with open(self._snap_path, "rb") as f:
-            self.colls, self._seq = pickle.load(f)
+            self.colls, self._seq = wire.decode(f.read())
+        # the codec returns immutable bytes; object data must stay a
+        # mutable bytearray for in-place writes
+        for objs in self.colls.values():
+            for o in objs.values():
+                if not isinstance(o.data, bytearray):
+                    o.data = bytearray(o.data)
         replayed = 0
         if os.path.exists(self._wal_path):
             with open(self._wal_path, "rb") as f:
@@ -75,7 +93,7 @@ class JournaledStore(MemStore):
                             "%s: journal tail torn after %d txns",
                             self.path, replayed)
                         break     # torn tail from a crash: stop here
-                    seq, ops = pickle.loads(blob)
+                    seq, ops = wire.decode(blob)
                     if seq <= self._seq:
                         continue  # already in the snapshot (a crash
                                   # between snapshot publish and WAL
@@ -106,7 +124,7 @@ class JournaledStore(MemStore):
             self._wal = None
         tmp = self._snap_path + ".tmp"
         with open(tmp, "wb") as f:
-            pickle.dump((self.colls, self._seq), f)
+            f.write(wire.encode((self.colls, self._seq)))
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._snap_path)
@@ -119,9 +137,12 @@ class JournaledStore(MemStore):
         # cannot journal in a different order than they applied; a
         # crash between the two loses only this unacked txn
         with self._lock:
+            # encode BEFORE the in-memory apply: an unencodable
+            # payload must fail the whole txn, not leave applied-but-
+            # unjournaled state that a remount silently rolls back
+            blob = wire.encode((self._seq + 1, txn.ops))
             super().queue_transaction(txn)
             self._seq += 1
-            blob = pickle.dumps((self._seq, txn.ops))
             frame = _HDR.pack(
                 len(blob),
                 crc32c(0xFFFFFFFF, blob) & 0xFFFFFFFF) + blob

@@ -42,6 +42,13 @@ class _Object:
         return o
 
 
+# wire registration: the JournaledStore snapshot/WAL serializes whole
+# collections through the typed codec (no pickle anywhere near disk)
+from ..msg.encoding import register_struct as _reg  # noqa: E402
+
+_reg(_Object, version=1, compat=1, fields=("data", "xattr", "omap"))
+
+
 class MemStore(ObjectStore):
     def __init__(self, path: str = "mem"):
         self.path = path

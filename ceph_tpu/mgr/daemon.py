@@ -44,6 +44,9 @@ class MgrDaemon(Dispatcher, MonHunter):
         #: pg_autoscaler module (ref: pybind/mgr/pg_autoscaler);
         #: enable with start_pg_autoscaler(), driven by autoscale_tick
         self.pg_autoscaler = None
+        #: progress module (ref: pybind/mgr/progress); enable with
+        #: start_progress(), driven by progress_tick
+        self.progress = None
         self._lock = threading.RLock()
         self.ms = Messenger.create(network, self.name, threaded=threaded)
         self.ms.add_dispatcher(self)
@@ -119,11 +122,26 @@ class MgrDaemon(Dispatcher, MonHunter):
         with self._lock:
             return self.pg_autoscaler.tick(pool_bytes)
 
+    def start_progress(self):
+        """Track long-running operations (ref: pybind/mgr/progress)."""
+        from .progress import ProgressModule
+        self.progress = ProgressModule(self)
+        return self.progress
+
+    def progress_tick(self) -> int:
+        if self.progress is None:
+            return 0
+        return self.progress.tick()
+
     def start_prometheus(self, port: int = 0):
-        """Serve /metrics (ref: pybind/mgr/prometheus)."""
+        """Serve /metrics (ref: pybind/mgr/prometheus).  Exports
+        progress events too when the progress module is running."""
         from .prometheus import PrometheusExporter
-        self.prometheus = PrometheusExporter(self.mon_command,
-                                             port=port)
+        # late-bound: progress may start before OR after the exporter
+        self.prometheus = PrometheusExporter(
+            self.mon_command, port=port,
+            progress_ls=lambda: (self.progress.ls()
+                                 if self.progress is not None else []))
         self.prometheus.start()
         return self.prometheus
 

@@ -43,8 +43,12 @@ class PrometheusExporter:
     (rc, outs, outb)`): a Rados handle or a Monitor both qualify."""
 
     def __init__(self, mon_command, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, progress_ls=None):
         self._cmd = mon_command
+        #: optional callable returning the mgr progress module's
+        #: event list (ref: the progress metrics the reference's
+        #: prometheus module exports)
+        self._progress_ls = progress_ls
         exporter = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -131,9 +135,11 @@ class PrometheusExporter:
         rc, _, perf = self._cmd({"prefix": "osd perf dump"})
         if rc == 0:
             emitted: set[str] = set()
+            totals: dict[str, float] = {}
             for daemon, counters in sorted(perf.items()):
                 for key, val in sorted(counters.items()):
-                    if isinstance(val, dict):   # long-run averages
+                    is_avg = isinstance(val, dict)
+                    if is_avg:                  # long-run averages
                         val = val.get("avg", 0.0)
                     elif isinstance(val, list):  # histograms
                         continue
@@ -143,4 +149,22 @@ class PrometheusExporter:
                         b.metric(name, f"per-daemon counter {key}",
                                  "counter")
                     b.sample(name, val, {"daemon": daemon})
+                    if not is_avg:
+                        # averages don't sum: a cluster-wide
+                        # "sum of averages" is meaningless
+                        totals[key] = totals.get(key, 0.0) \
+                            + float(val)
+            # cluster-wide aggregation across every reporting daemon
+            # (ref: the DaemonServer-side counter aggregation)
+            for key, val in sorted(totals.items()):
+                name = f"ceph_cluster_{key}"
+                b.metric(name, f"cluster-wide sum of {key}", "counter")
+                b.sample(name, val)
+
+        if self._progress_ls is not None:
+            b.metric("ceph_progress_event",
+                     "long-running event completion ratio")
+            for ev in self._progress_ls():
+                b.sample("ceph_progress_event", ev["progress"],
+                         {"id": ev["id"], "message": ev["message"]})
         return b.render()

@@ -98,16 +98,33 @@ def promote(ioctx, name: str, force: bool = False) -> str:
     if m.get("primary"):
         return m["epochs"][-1] if m["epochs"] else ""
     if not force:
-        j = Journaler(ioctx, journal_id(name), "promote-check")
-        if j.exists():
-            head = _head_pos(j)
-            # any registered client not at the head = not caught up
-            for cid, c in j.clients().items():
-                if tuple(c.get("pos") or (0, 0)) < head:
-                    raise RBDError(16, "journal not fully replayed "
-                                       "(use force to promote anyway)")
+        # clean promotion requires an orderly handoff, provable one of
+        # two ways (ref: the demotion-tag ownership check in librbd's
+        # promote):
+        #  * we are a sync TARGET that replayed from a demoted source
+        #    (`src_demoted` recorded by the draining sync); or
+        #  * we are the just-demoted image itself (failover abort) and
+        #    our OWN journal is fully consumed by every registered
+        #    client — nothing of ours can be lost.
+        # Residual limit of the single-cluster view: if the remote
+        # side was force-promoted AFTER our last sync, this flag is
+        # stale — the next sync's split-brain gate catches the
+        # divergence, but dual primaries exist until then.
+        ok = bool(m.get("src_demoted"))
+        if not ok:
+            j = Journaler(ioctx, journal_id(name), "promote-check")
+            if j.exists():
+                clients = j.clients()
+                head = _head_pos(j)
+                ok = bool(clients) and all(
+                    tuple(c.get("pos") or (0, 0)) >= head
+                    for c in clients.values())
+        if not ok:
+            raise RBDError(16, "source not demoted/drained — demote "
+                               "the primary and sync first (or force)")
     epoch = uuid.uuid4().hex
     m["primary"] = True
+    m.pop("src_demoted", None)
     m.setdefault("epochs", []).append(epoch)
     if force:
         m["force_promoted"] = True
@@ -225,12 +242,16 @@ class ImageMirror:
         self.journaler.commit(pos)
         self.journaler.trim()
         # adopt the primary's promotion chain: the secondary's state
-        # records every handoff it has replicated through
+        # records every handoff it has replicated through.  A sync
+        # that drained a DEMOTED source marks the orderly-handoff
+        # gate clean promotion checks.
         if src_img.mirror is not None:
             dmeta = _load_meta(self.dst, self.name)
             dmeta["mirror"] = {
                 "primary": False,
-                "epochs": list(src_img.mirror.get("epochs", []))}
+                "epochs": list(src_img.mirror.get("epochs", [])),
+                "src_demoted":
+                    not src_img.mirror.get("primary", True)}
             _store_meta(self.dst, self.name, dmeta)
         return applied
 
@@ -265,6 +286,7 @@ class ImageMirror:
                         16, "refusing to resync a PRIMARY image — "
                             "reverse the mirror direction")
                 span = old._object_span()
+                snap_ids = [s["id"] for s in old.snaps.values()]
                 old.close()
                 for objno in range(span):
                     try:
@@ -274,6 +296,16 @@ class ImageMirror:
                 j = Journaler(self.dst, journal_id(self.name), "rs")
                 if j.exists():
                     j.remove()
+                # stale object maps would mark objects the rebuilt
+                # image does not have (phantom du/fast-diff extents)
+                from .image import object_map_name
+                for om in ([object_map_name(self.name)] +
+                           [object_map_name(self.name, s)
+                            for s in snap_ids]):
+                    try:
+                        self.dst.remove(om)
+                    except Exception:
+                        pass
                 try:
                     self.dst.remove(header_name(self.name))
                 except Exception:

@@ -192,3 +192,66 @@ def test_mirror_orderly_failback(cluster):
     a.close()
     assert not mirror_state(ioa, name)["primary"]
     assert mirror_state(iob, name)["primary"]
+
+def test_clean_promote_requires_drained_demotion(cluster):
+    """promote(force=False) refuses until a post-demotion sync drained
+    the old primary — undrained writes must not be silently lost."""
+    from ceph_tpu.rbd.image import RBDError
+    from ceph_tpu.rbd.mirror import (ImageMirror, demote,
+                                     mirror_enable, promote)
+    r = cluster.rados()
+    ioa = r.open_ioctx("primary")
+    iob = r.open_ioctx("backup")
+    name = "drain-vm"
+    RBD().create(ioa, name, size=1 << 19, order=16, journaling=True)
+    mirror_enable(ioa, name)
+    a = Image(ioa, name)
+    a.write(0, b"synced")
+    a.close()
+    m = ImageMirror(ioa, iob, name)
+    m.sync()
+    # demote WITHOUT draining the last write
+    a = Image(ioa, name)
+    a.write(100, b"undrained")
+    a.close()
+    demote(ioa, name)
+    with pytest.raises(RBDError) as ei:
+        promote(iob, name, force=False)
+    assert "demoted/drained" in str(ei.value) or \
+        ei.value.errno == 16
+    # drain, then the clean promote succeeds
+    m.sync()
+    promote(iob, name, force=False)
+    b = Image(iob, name)
+    assert b.read(100, 9) == b"undrained"
+    b.close()
+
+def test_failover_abort_repromotes_drained_old_primary(cluster):
+    """A demoted image whose own journal is fully consumed may cleanly
+    re-promote (aborted handoff) — but NOT while undrained."""
+    from ceph_tpu.rbd.image import RBDError
+    from ceph_tpu.rbd.mirror import (ImageMirror, demote,
+                                     mirror_enable, promote)
+    r = cluster.rados()
+    ioa = r.open_ioctx("primary")
+    iob = r.open_ioctx("backup")
+    name = "abort-vm"
+    RBD().create(ioa, name, size=1 << 19, order=16, journaling=True)
+    mirror_enable(ioa, name)
+    a = Image(ioa, name)
+    a.write(0, b"payload")
+    a.close()
+    m = ImageMirror(ioa, iob, name)
+    m.sync()
+    demote(ioa, name)
+    # drained: the same image re-promotes without force
+    promote(ioa, name, force=False)
+    a = Image(ioa, name)
+    a.write(32, b"more")       # primary again, writable
+    a.close()
+    # demote with an UNdrained tail: re-promote refused
+    demote(ioa, name)
+    with pytest.raises(RBDError):
+        promote(ioa, name, force=False)
+    m.sync()
+    promote(ioa, name, force=False)

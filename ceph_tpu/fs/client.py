@@ -70,7 +70,27 @@ class _MDSSession(Dispatcher):
         ev.set()
         return True
 
+    #: hop bound for cross-rank forwards (a pin cycle cannot form, but
+    #: a racing migration could bounce once or twice)
+    MAX_FORWARDS = 4
+
     def call(self, op: str, args: dict, timeout: float = 30.0):
+        import time
+        deadline = time.monotonic() + timeout
+        target = self.mds
+        for _hop in range(self.MAX_FORWARDS):
+            rep = self._call_one(target, op, args, deadline)
+            if rep.forward is not None and rep.forward >= 0:
+                # another rank owns this subtree (ref: MDS forward)
+                target = f"mds.{rep.forward}"
+                continue
+            if rep.result < 0:
+                raise CephFSError(rep.errno_name or "EIO", op)
+            return rep.out
+        raise CephFSError("EMLINK", f"mds forward loop for {op}")
+
+    def _call_one(self, target: str, op: str, args: dict,
+                  deadline: float):
         import time
         tid = next(self._tids)
         ev, slot = threading.Event(), []
@@ -80,21 +100,18 @@ class _MDSSession(Dispatcher):
         # request is never re-sent — a lost reply must not replay a
         # non-idempotent op (ref: Client request resend is gated on
         # session state the same way)
-        deadline = time.monotonic() + timeout
         msg = MClientRequest(tid=tid, op=op, args=args)
-        while not self.ms.connect(self.mds).send_message(msg):
+        while not self.ms.connect(target).send_message(msg):
             if time.monotonic() >= deadline:
                 self._pending.pop(tid, None)
-                raise TimeoutError(f"mds {self.mds} unreachable")
+                raise TimeoutError(f"mds {target} unreachable")
             time.sleep(0.25)
-        if not self._rados.objecter.wait_sync(ev.is_set, timeout,
-                                              ev=ev):
+        if not self._rados.objecter.wait_sync(
+                ev.is_set, max(0.1, deadline - time.monotonic()),
+                ev=ev):
             self._pending.pop(tid, None)
             raise TimeoutError(f"mds op {op} timed out")
-        rep = slot[0]
-        if rep.result < 0:
-            raise CephFSError(rep.errno_name or "EIO", op)
-        return rep.out
+        return slot[0]
 
 
 class FileHandle:
@@ -103,9 +120,10 @@ class FileHandle:
     extents; both surrendered on revoke)."""
 
     def __init__(self, fs: "CephFS", path: str, rec: dict,
-                 caps: int = 0):
+                 caps: int = 0, wants_write: bool = False):
         self.fs = fs
         self.path = path
+        self.wants_write = wants_write
         self.ino = rec["ino"]
         self.layout = StripeLayout(**rec["layout"])
         self.size = rec.get("size", 0)
@@ -288,7 +306,10 @@ class FileHandle:
             self._oc = None
         if self.fs._unregister_handle(self):
             try:
-                self.fs._session.call("release", {"ino": self.ino})
+                # path included so the release routes to the rank
+                # that actually tracks this handle's caps
+                self.fs._session.call("release", {
+                    "ino": self.ino, "path": self.path})
             except (CephFSError, TimeoutError):
                 pass
 
@@ -378,8 +399,23 @@ class CephFS:
                 fh._surrender_caps()
             except (CephFSError, TimeoutError):
                 pass
-        self._session.ms.connect(self._session.mds).send_message(
-            MClientCaps(op="ack", ino=msg.ino))
+        # ack the RANK THAT REVOKED (after a subtree migration that
+        # is not necessarily our default session rank)
+        self._session.ms.connect(msg.src or self._session.mds) \
+            .send_message(MClientCaps(op="ack", ino=msg.ino))
+        # re-register surviving handles' open intents with whichever
+        # rank now owns the path — without this a subtree migration
+        # would let the new authority grant conflicting EXCL over our
+        # live write-through handles
+        for fh in handles:
+            if fh.snapid is not None:
+                continue             # snap handles hold no caps
+            try:
+                self._session.call("reopen", {
+                    "path": fh.path,
+                    "wants_write": fh.wants_write})
+            except (CephFSError, TimeoutError):
+                pass
 
     def _handle_snapc(self, msg) -> None:
         """mksnap widened the realm's snap context: every open handle
@@ -452,7 +488,8 @@ class CephFS:
         rec, caps = out["rec"], out["caps"]
         if rec["type"] != "f":
             raise CephFSError("EISDIR", path)
-        return FileHandle(self, path, rec, caps=caps)
+        return FileHandle(self, path, rec, caps=caps,
+                          wants_write=wants_write)
 
     def link(self, src: str, dst: str) -> None:
         """Hardlink (ref: libcephfs ceph_link)."""
@@ -471,6 +508,16 @@ class CephFS:
                         _time.monotonic() >= deadline:
                     raise
                 _time.sleep(0.02)
+
+    # -- multi-MDS subtree pinning (ref: setfattr ceph.dir.pin) ---------
+    def set_pin(self, path: str, rank: int) -> None:
+        """Pin a directory subtree to an MDS rank; its current
+        authority migrates serving + cap ownership over."""
+        self._session.call("set_pin", {"path": path, "rank": rank})
+
+    def get_pins(self) -> dict[str, int]:
+        return {k: int(v) for k, v in
+                self._session.call("get_pins", {}).items()}
 
     # -- snapshots (ref: libcephfs ceph_mksnap/ceph_rmsnap) -------------
     def mksnap(self, path: str, name: str,

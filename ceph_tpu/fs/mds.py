@@ -57,6 +57,13 @@ ITABLE_OBJ = "mds.itable"
 #: realm table (ref: src/mds/SnapServer.cc's snap table): omap key =
 #: realm dir ino -> {name: {"id": snapid, "stamp": t}}
 SNAPTABLE_OBJ = "mds.snaptable"
+#: subtree authority table (ref: the subtree map MDSRank/Migrator
+#: maintain + the ceph.dir.pin export pin): omap key = normalized
+#: directory path -> owning rank; longest prefix wins, "/" -> 0
+SUBTREE_OBJ = "mds.subtrees"
+#: per-rank inode-number spaces (ref: each rank's InoTable range):
+#: ino = (rank << INO_RANK_SHIFT) | n, so allocations never collide
+INO_RANK_SHIFT = 48
 #: applied_seq persists every N ops: the gap is the replay window
 APPLY_EVERY = 8
 
@@ -65,9 +72,18 @@ CAP_CACHE = 1          # may cache reads
 CAP_EXCL = 2           # may buffer writes; cached size is authoritative
 
 _ERRNO = {"ENOENT": -2, "EEXIST": -17, "ENOTDIR": -20, "EISDIR": -21,
-          "EROFS": -30,
+          "EROFS": -30, "EXDEV": -18,
           "EINVAL": -22, "ENOTEMPTY": -39, "EAGAIN": -11,
           "EMLINK": -31}
+
+
+class MDSForward(Exception):
+    """Request belongs to another rank's subtree (ref: the
+    MDS_OP forward the reference sends when it is not auth)."""
+
+    def __init__(self, rank: int):
+        self.rank = rank
+        super().__init__(f"forward to mds.{rank}")
 
 
 def snap_dir_obj(snapid: int, ino: int) -> str:
@@ -87,33 +103,76 @@ class MDSError(Exception):
 
 
 class MDSDaemon(Dispatcher):
-    """mds.<rank> — rank 0 only (ref: src/mds/MDSDaemon.cc)."""
+    """mds.<rank> (ref: src/mds/MDSDaemon.cc + MDSRank).  Multiple
+    ranks serve one filesystem: each rank is authoritative for the
+    subtrees pinned to it (SUBTREE_OBJ, default everything -> rank 0),
+    forwards requests outside its subtrees, journals to its own
+    per-rank journal, and allocates inos from its own range.
+    `set_pin` migrates a subtree's authority (the Migrator's export,
+    collapsed: metadata already lives in shared RADOS omaps, so only
+    serving authority and cap ownership move)."""
 
     def __init__(self, network, rados, rank: int = 0,
                  metadata_pool: str = "cephfs_metadata",
                  data_pool: str = "cephfs_data",
                  threaded: bool = True, keyring=None):
         self.name = f"mds.{rank}"
+        self.rank = rank
         self.rados = rados
         for pool in (metadata_pool, data_pool):
             try:
                 rados.pool_lookup(pool)
             except RadosError:
-                rados.pool_create(pool, pg_num=32)
+                try:
+                    rados.pool_create(pool, pg_num=32)
+                except RadosError:
+                    # raced another booting rank to the create: wait
+                    # for the winner's pool to reach our map
+                    end = time.monotonic() + 30
+                    while True:
+                        try:
+                            rados.pool_lookup(pool)
+                            break
+                        except RadosError:
+                            if time.monotonic() >= end:
+                                raise
+                            time.sleep(0.2)
         self.meta = rados.open_ioctx(metadata_pool)
         self.data_pool = data_pool
+        # per-rank journal + meta keys (rank 0 keeps the legacy names)
+        self._journal_obj = JOURNAL_OBJ if rank == 0 \
+            else f"{JOURNAL_OBJ}.{rank}"
+        self._k_applied = "applied_seq" if rank == 0 \
+            else f"applied_seq.{rank}"
+        self._k_next_ino = "next_ino" if rank == 0 \
+            else f"next_ino.{rank}"
+        self._ino_base = rank << INO_RANK_SHIFT
         self._lock = threading.RLock()
         self._seq = 0
-        self._next_ino = ROOT_INO + 1
+        self._next_ino = self._ino_base + ROOT_INO + 1
         self._ops_since_apply = 0
         # capability leases (volatile; ref: Locker + session caps):
         # ino -> {client: capbits}; open intents: ino -> {client: wants_write}
         self._caps: dict[int, dict[str, int]] = {}
         self._opens: dict[int, dict[str, bool]] = {}
         self._chain: list[int] = [ROOT_INO]   # last-resolve dir chain
+        self._subtree_cache: dict | None = None
+        self._subtree_cache_at = 0.0
         self._pending_revokes: list[tuple[str, MClientCaps]] = []
         self._revoking: dict[tuple[int, str], float] = {}
         self._mkfs_or_replay()
+        # subtree-table invalidation channel: set_pin on any rank
+        # notifies every MDS to drop its cached pin table
+        try:
+            self.meta.create(SUBTREE_OBJ)
+        except RadosError:
+            pass
+        self._subtree_watch = None
+        try:
+            self._subtree_watch = self.meta.watch(SUBTREE_OBJ,
+                                                  self._subtree_notify)
+        except RadosError:
+            pass          # TTL refresh covers a failed watch
         self.ms = Messenger.create(network, self.name,
                                    threaded=threaded)
         if keyring is not None:
@@ -131,6 +190,12 @@ class MDSDaemon(Dispatcher):
     def shutdown(self) -> None:
         with self._lock:
             self._persist_applied()
+        if self._subtree_watch is not None:
+            try:
+                self.meta.unwatch(SUBTREE_OBJ, self._subtree_watch)
+            except Exception:
+                pass
+            self._subtree_watch = None
         self.ms.shutdown()
 
     # ------------------------------------------------------ journal/WAL
@@ -140,19 +205,36 @@ class MDSDaemon(Dispatcher):
             meta = self.meta.get_omap_vals(META_OBJ)[0]
         except RadosError:
             # fresh fs: root dir + meta + itable + empty journal
-            self.meta.create(META_OBJ)
-            self.meta.create(JOURNAL_OBJ)
-            self.meta.create(dir_obj(ROOT_INO))
-            self.meta.create(ITABLE_OBJ)
-            self.meta.set_omap(META_OBJ, {
-                "applied_seq": b"0", "next_ino": str(ROOT_INO + 1)
-                .encode()})
-            return
-        applied = int(meta.get("applied_seq", b"0"))
-        self._seq = applied          # stay monotonic across journal trims
-        self._next_ino = int(meta.get("next_ino", b"2"))
+            # (exclusive create arbitrates racing first-boot ranks:
+            # the loser re-reads the winner's state)
+            try:
+                self.meta.create(META_OBJ, exclusive=True)
+            except RadosError:
+                meta = self.meta.get_omap_vals(META_OBJ)[0]
+            else:
+                for obj in (self._journal_obj, dir_obj(ROOT_INO),
+                            ITABLE_OBJ):
+                    try:
+                        self.meta.create(obj)
+                    except RadosError:
+                        pass
+                self.meta.set_omap(META_OBJ, {
+                    self._k_applied: b"0",
+                    self._k_next_ino:
+                        str(self._ino_base + ROOT_INO + 1).encode()})
+                return
         try:
-            raw = self.meta.read(JOURNAL_OBJ)
+            self.meta.create(self._journal_obj)   # first boot of rank
+        except RadosError:
+            pass
+        applied = int(meta.get(self._k_applied, b"0"))
+        self._seq = applied          # stay monotonic across journal trims
+        self._next_ino = max(
+            self._ino_base + ROOT_INO + 1,
+            int(meta.get(self._k_next_ino,
+                         str(self._ino_base + ROOT_INO + 1).encode())))
+        try:
+            raw = self.meta.read(self._journal_obj)
         except RadosError:
             raw = b""
         replayed = 0
@@ -179,7 +261,7 @@ class MDSDaemon(Dispatcher):
         line = json.dumps({"seq": self._seq, "op": op,
                            "next_ino": self._next_ino,
                            "deltas": deltas}) + "\n"
-        self.meta.append(JOURNAL_OBJ, line.encode())
+        self.meta.append(self._journal_obj, line.encode())
         self._apply_deltas(deltas)
         self._ops_since_apply += 1
         if self._ops_since_apply >= APPLY_EVERY:
@@ -210,14 +292,14 @@ class MDSDaemon(Dispatcher):
 
     def _persist_applied(self) -> None:
         self.meta.set_omap(META_OBJ, {
-            "applied_seq": str(self._seq).encode(),
-            "next_ino": str(self._next_ino).encode()})
+            self._k_applied: str(self._seq).encode(),
+            self._k_next_ino: str(self._next_ino).encode()})
         self._ops_since_apply = 0
         # Runtime trim (ref: MDLog::trim): everything <= applied_seq is
         # fully applied, so the journal can be emptied.  Ordering
         # matters — applied_seq persists first; a crash in between just
         # replays already-applied idempotent deltas.
-        self.meta.write_full(JOURNAL_OBJ, b"")
+        self.meta.write_full(self._journal_obj, b"")
 
     # ------------------------------------------------------- name space
     def _readdir(self, ino: int) -> dict[str, dict]:
@@ -537,6 +619,133 @@ class MDSDaemon(Dispatcher):
                     m.pop(msg.src, None)
                 self._revoking.pop((msg.ino, msg.src), None)
 
+    # --------------------------------------------- subtree authority
+    @staticmethod
+    def _norm(path: str) -> str:
+        return "/" + "/".join(p for p in path.strip("/").split("/")
+                              if p)
+
+    #: staleness bound when the invalidation notify was missed
+    _SUBTREE_TTL = 2.0
+
+    def _subtrees(self) -> dict[str, int]:
+        """The pin table, cached in memory (the reference keeps the
+        subtree map resident) and invalidated by set_pin's notify on
+        SUBTREE_OBJ — a per-op omap read would sit on every metadata
+        op's hot path."""
+        now = time.monotonic()
+        cached = self._subtree_cache
+        if cached is not None and \
+                now - self._subtree_cache_at < self._SUBTREE_TTL:
+            return cached
+        try:
+            vals, _ = self.meta.get_omap_vals(SUBTREE_OBJ)
+            table = {k: int(v) for k, v in vals.items()}
+        except RadosError:
+            table = {}
+        self._subtree_cache = table
+        self._subtree_cache_at = now
+        return table
+
+    def _subtree_notify(self, notify_id=None, notifier=None,
+                        payload=None):
+        """Watch callback: a peer's set_pin changed the table."""
+        self._subtree_cache = None
+        return {"rank": self.rank}
+
+    def _authority(self, path: str) -> int:
+        """Owning rank by longest-prefix match (ref: the subtree map;
+        everything defaults to rank 0)."""
+        path = self._norm(path)
+        best, rank = "", 0
+        for prefix, r in self._subtrees().items():
+            if (path == prefix or
+                    path.startswith(prefix.rstrip("/") + "/")) and \
+                    len(prefix) > len(best):
+                best, rank = prefix, r
+        return rank
+
+    #: ops served by whichever rank receives them (no path to route)
+    _LOCAL_OPS = frozenset({"statfs"})
+
+    def _route(self, op: str, a: dict) -> None:
+        """Forward requests outside our subtrees (ref: the reference
+        MDS forwarding non-auth requests via the mdsmap)."""
+        if op in self._LOCAL_OPS:
+            return
+        if op == "set_pin" and a.get("force"):
+            # admin repair hatch: a subtree pinned to a dead or
+            # nonexistent rank is otherwise unreachable — any live
+            # rank may override the table
+            return
+        path = a.get("path") or a.get("src")
+        if path is None:
+            return
+        auth = self._authority(path)
+        dst = a.get("dst")
+        if dst is not None and self._authority(dst) != auth:
+            # cross-rank rename/link would need the reference's slave
+            # request machinery
+            raise MDSError("EXDEV", "paths belong to different ranks")
+        if auth != self.rank:
+            raise MDSForward(auth)
+
+    def _op_reopen(self, a):
+        """Re-register an open intent after a cap surrender (the
+        client's half of a subtree migration: the NEW authority must
+        know the handle exists or it would grant a later opener
+        conflicting EXCL over live write-through traffic)."""
+        _parent, _name, dent = self._resolve(a["path"])
+        if dent is None:
+            raise MDSError("ENOENT", a["path"])
+        rec = self._record_of(dent)
+        self._opens.setdefault(rec["ino"], {})[a["__client"]] = \
+            bool(a.get("wants_write"))
+        return None
+
+    def _op_set_pin(self, a):
+        """Migrate a subtree's authority (ref: Migrator export +
+        `setfattr ceph.dir.pin`): journal the new pin, then evict our
+        caps/open state under it — clients re-acquire through the new
+        rank on their next forwarded op."""
+        _p, _n, dent = self._resolve(a["path"])
+        if dent is None:
+            raise MDSError("ENOENT", a["path"])
+        if dent["type"] != "d" or dent.get("snapid") is not None:
+            raise MDSError("ENOTDIR", a["path"])
+        target = int(a["rank"])
+        if target < 0:
+            raise MDSError("EINVAL", f"rank {target}")
+        path = self._norm(a["path"])
+        self._journal("set_pin", [
+            ("mkobj", SUBTREE_OBJ),
+            ("set", SUBTREE_OBJ, {path: str(target)})])
+        # clean handoff: nothing of ours left unflushed for the new
+        # authority to miss
+        self._persist_applied()
+        self._subtree_cache = None
+        try:
+            # synchronous invalidation: peers drop their cached table
+            # before this reply releases the client to the new rank
+            self.meta.notify(SUBTREE_OBJ, {"op": "repin"})
+        except RadosError:
+            pass
+        if target != self.rank:
+            for _ino, ents, _chain in self._walk_realm(dent["ino"]):
+                for d in ents.values():
+                    if d.get("type") != "f":
+                        continue
+                    ino = d["ino"]
+                    holders = list(self._caps.get(ino, {}))
+                    if holders:
+                        self._queue_revoke(ino, holders)
+                    self._caps.pop(ino, None)
+                    self._opens.pop(ino, None)
+        return {"path": path, "rank": target}
+
+    def _op_get_pins(self, a):
+        return self._subtrees()
+
     # ------------------------------------------------------- operations
     #: ops allowed to traverse `.snap` paths — everything else on a
     #: snapshot path is EROFS (ref: the snapdir is read-only)
@@ -544,9 +753,10 @@ class MDSDaemon(Dispatcher):
                               "lssnap", "release"})
 
     def handle_op(self, op: str, args: dict):
-        """Returns the reply payload; raises MDSError.
+        """Returns the reply payload; raises MDSError/MDSForward.
         (ref: Server::dispatch_client_request op switch)."""
         with self._lock:
+            self._route(op, args)
             if op not in self._SNAP_RO_OPS and any(
                     ".snap" in str(args.get(k, "")).split("/")
                     for k in ("path", "src", "dst")):
@@ -643,8 +853,12 @@ class MDSDaemon(Dispatcher):
         """Close: drop the session's caps + open intent
         (ref: Locker::remove_client_cap)."""
         ino = a["ino"]
-        self._caps.get(ino, {}).pop(a["__client"], None)
-        self._opens.get(ino, {}).pop(a["__client"], None)
+        for table in (self._caps, self._opens):
+            ent = table.get(ino)
+            if ent is not None:
+                ent.pop(a["__client"], None)
+                if not ent:
+                    del table[ino]
         return None
 
     def _op_link(self, a):
@@ -814,6 +1028,9 @@ class MDSDaemon(Dispatcher):
             args["__client"] = msg.src
             out = self.handle_op(msg.op, args)
             reply = MClientReply(tid=msg.tid, result=0, out=out)
+        except MDSForward as f:
+            reply = MClientReply(tid=msg.tid, result=0,
+                                 forward=f.rank)
         except MDSError as e:
             reply = MClientReply(tid=msg.tid,
                                  result=_ERRNO.get(e.errno_name, -22),

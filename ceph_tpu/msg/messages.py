@@ -382,6 +382,10 @@ class MClientReply(Message):
     result: int = 0
     errno_name: str = ""
     out: Any = None
+    # --- v2 ---
+    #: >= 0: the request belongs to another rank's subtree — retry
+    #: there (ref: the MDS forward/mdsmap redirection)
+    forward: int = -1
 
 
 @dataclass
@@ -587,6 +591,7 @@ _VERSIONS: dict[str, tuple[int, int]] = {
     "PGScanReply": (2, 1),      # v2: ranged/begin/end echo fields
     "PGPush": (2, 1),           # v2: authoritative backfill flag
     "MClientCaps": (2, 1),      # v2: snapc broadcast leg
+    "MClientReply": (2, 1),     # v2: cross-rank forward
 }
 
 

@@ -1,0 +1,251 @@
+"""Multi-MDS: ranks + subtree authority + migration (closing VERDICT
+r3 missing #3; ref: src/mds/MDSRank, src/mds/Migrator.cc, the
+ceph.dir.pin export pin, MDS request forwarding)."""
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.fs import CephFS, MDSDaemon
+from ceph_tpu.fs.client import CephFSError
+from ceph_tpu.fs.mds import INO_RANK_SHIFT
+from ceph_tpu.testing import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(n_osd=4, threaded=True)
+    c.wait_all_up()
+    mds0 = MDSDaemon(c.network, c.rados(), rank=0)
+    mds0.init()
+    mds1 = MDSDaemon(c.network, c.rados(), rank=1)
+    mds1.init()
+    yield c, mds0, mds1
+    mds1.shutdown()
+    mds0.shutdown()
+    c.shutdown()
+
+
+def _fs(c):
+    return CephFS(c.rados())
+
+
+def test_pinned_subtree_served_by_other_rank(cluster):
+    c, mds0, mds1 = cluster
+    fs = _fs(c)
+    fs.mkdirs("/tenant-a")
+    fs.mkdirs("/tenant-b")
+    fs.set_pin("/tenant-b", 1)
+    assert fs.get_pins().get("/tenant-b") == 1
+    # ops under the pin transparently forward to rank 1 and work
+    fs.write_file("/tenant-b/file", b"served by rank one")
+    assert fs.read_file("/tenant-b/file") == b"served by rank one"
+    # rank 1 (not rank 0) granted the caps for the pinned file
+    ino = fs.stat("/tenant-b/file")["ino"]
+    fh = fs.open("/tenant-b/file", "w")
+    assert ino in mds1._caps or ino in mds1._opens
+    assert ino not in mds0._caps
+    fh.close()
+    # the unpinned tree stays on rank 0
+    fs.write_file("/tenant-a/file", b"served by rank zero")
+    ino0 = fs.stat("/tenant-a/file")["ino"]
+    fh0 = fs.open("/tenant-a/file", "r")
+    assert ino0 in mds0._opens
+    assert ino0 not in mds1._opens
+    fh0.close()
+
+
+def test_ino_spaces_disjoint(cluster):
+    """Each rank allocates inos from its own range (the InoTable
+    partition), so concurrent creates never collide."""
+    c, _m0, _m1 = cluster
+    fs = _fs(c)
+    fs.mkdirs("/inos-r0")
+    fs.mkdirs("/inos-r1")
+    fs.set_pin("/inos-r1", 1)
+    inos = set()
+    for i in range(8):
+        fs.write_file(f"/inos-r0/f{i}", b"x")
+        fs.write_file(f"/inos-r1/f{i}", b"y")
+        inos.add(fs.stat(f"/inos-r0/f{i}")["ino"])
+        inos.add(fs.stat(f"/inos-r1/f{i}")["ino"])
+    assert len(inos) == 16
+    r1_inos = {fs.stat(f"/inos-r1/f{i}")["ino"] for i in range(8)}
+    assert all(ino >> INO_RANK_SHIFT == 1 for ino in r1_inos)
+
+
+def test_migration_revokes_live_handles(cluster):
+    """Re-pinning a subtree migrates authority out from under open
+    handles: their caps are revoked (flushing buffered state) and
+    subsequent ops route to the new rank."""
+    c, mds0, mds1 = cluster
+    fs = _fs(c)
+    fs.mkdirs("/moving")
+    fh = fs.open("/moving/live", "w")
+    fh.write(0, b"A" * 3000)          # size buffered under EXCL
+    fs.set_pin("/moving", 1)
+    # the revoke lands asynchronously: wait for the surrender
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and fh.caps:
+        time.sleep(0.05)
+    assert fh.caps == 0, "migration never revoked the handle"
+    # the flushed size is visible through the NEW authority
+    st = fs.stat("/moving/live")
+    assert st["size"] == 3000
+    fh.write(3000, b"B" * 100)        # cap-less write-through works
+    fh.close()
+    assert fs.read_file("/moving/live") == b"A" * 3000 + b"B" * 100
+    ino = st["ino"]
+    assert ino not in mds0._caps and ino not in mds0._opens
+    # migrate BACK under concurrent readers
+    fs.set_pin("/moving", 0)
+    assert fs.read_file("/moving/live")[:4] == b"AAAA"
+
+
+def test_cross_rank_rename_refused(cluster):
+    c, _m0, _m1 = cluster
+    fs = _fs(c)
+    fs.mkdirs("/xr-a")
+    fs.mkdirs("/xr-b")
+    fs.set_pin("/xr-b", 1)
+    fs.write_file("/xr-a/f", b"data")
+    with pytest.raises(CephFSError) as ei:
+        fs.rename("/xr-a/f", "/xr-b/f")
+    assert ei.value.errno_name == "EXDEV"
+    # same-rank renames still fine on both ranks
+    fs.rename("/xr-a/f", "/xr-a/g")
+    fs.write_file("/xr-b/h", b"hb")
+    fs.rename("/xr-b/h", "/xr-b/h2")
+    assert fs.read_file("/xr-b/h2") == b"hb"
+
+
+def test_concurrent_clients_across_ranks(cluster):
+    """Two ranks serve disjoint subtrees under concurrent writers
+    with no lost updates."""
+    c, _m0, _m1 = cluster
+    fs = _fs(c)
+    fs.mkdirs("/par-r0")
+    fs.mkdirs("/par-r1")
+    fs.set_pin("/par-r1", 1)
+    errors: list = []
+
+    def worker(base, idx):
+        try:
+            wfs = _fs(c)
+            for i in range(10):
+                wfs.write_file(f"{base}/w{idx}-{i}",
+                               (f"{base}:{idx}:{i}").encode())
+        except Exception as ex:       # noqa: BLE001
+            errors.append(ex)
+
+    threads = [threading.Thread(target=worker, args=(b, i),
+                                daemon=True)
+               for b in ("/par-r0", "/par-r1") for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors, errors
+    for b in ("/par-r0", "/par-r1"):
+        for idx in range(2):
+            for i in range(10):
+                assert fs.read_file(f"{b}/w{idx}-{i}") == \
+                    (f"{b}:{idx}:{i}").encode()
+
+
+def test_rank_crash_replay_isolated():
+    """Each rank journals independently: a crashed rank replays its
+    own journal without touching the other's state."""
+    c = MiniCluster(n_osd=2, threaded=True)
+    try:
+        c.wait_all_up()
+        mds0 = MDSDaemon(c.network, c.rados(), rank=0)
+        mds0.init()
+        mds1 = MDSDaemon(c.network, c.rados(), rank=1)
+        mds1.init()
+        fs = _fs(c)
+        fs.mkdirs("/keep")
+        fs.mkdirs("/crashy")
+        fs.set_pin("/crashy", 1)
+        fs.write_file("/keep/a", b"rank0 data")
+        fs.write_file("/crashy/b", b"rank1 data")
+        # hard-stop rank 1 (no graceful flush), revive it
+        mds1.ms.shutdown()
+        mds1b = MDSDaemon(c.network, c.rados(), rank=1)
+        mds1b.init()
+        fs2 = _fs(c)
+        assert fs2.read_file("/crashy/b") == b"rank1 data"
+        assert fs2.read_file("/keep/a") == b"rank0 data"
+        fs2.write_file("/crashy/c", b"post-replay")
+        assert fs2.read_file("/crashy/c") == b"post-replay"
+        mds1b.shutdown()
+        mds0.shutdown()
+    finally:
+        c.shutdown()
+
+def test_migration_preserves_open_intents(cluster):
+    """After a migration, the new rank knows about surviving handles:
+    a second client's open must NOT get EXCL over a live writer."""
+    from ceph_tpu.fs.mds import CAP_EXCL
+    c, _m0, mds1 = cluster
+    fs_w, fs_r = _fs(c), _fs(c)
+    fs_w.mkdirs("/intent")
+    w = fs_w.open("/intent/f", "w")
+    w.write(0, b"writer data")
+    fs_w.set_pin("/intent", 1)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and w.caps:
+        time.sleep(0.05)
+    assert w.caps == 0
+    ino = fs_w.stat("/intent/f")["ino"]
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and ino not in mds1._opens:
+        time.sleep(0.05)
+    assert ino in mds1._opens, "open intent never re-registered"
+    # second client's open sees the conflict: no EXCL granted
+    r = fs_r.open("/intent/f", "r")
+    assert not (r.caps & CAP_EXCL)
+    w.write(100, b"still-writing")
+    assert fs_r.read_file("/intent/f")[:11] == b"writer data"
+    w.close()
+    r.close()
+    fs_w.set_pin("/intent", 0)
+
+
+def test_release_routes_to_owning_rank(cluster):
+    """close() of a handle on a pinned subtree clears the owning
+    rank's cap/open state (a mis-routed release would wedge future
+    EXCL grants)."""
+    from ceph_tpu.fs.mds import CAP_EXCL
+    c, _m0, mds1 = cluster
+    fs = _fs(c)
+    fs.mkdirs("/rel")
+    fs.set_pin("/rel", 1)
+    fh = fs.open("/rel/f", "w")
+    ino = fh.ino
+    assert ino in mds1._opens
+    fh.write(0, b"x")
+    fh.close()
+    assert ino not in mds1._opens, "release never reached rank 1"
+    # a fresh open still gets EXCL (no stale-intent downgrade)
+    fh2 = fs.open("/rel/f", "w")
+    assert fh2.caps & CAP_EXCL
+    fh2.close()
+    fs.set_pin("/rel", 0)
+
+
+def test_force_repin_rescues_bad_pin(cluster):
+    """Pinning to a nonexistent rank is repairable: set_pin(force=True)
+    through any live rank overrides the table."""
+    c, _m0, _m1 = cluster
+    fs = _fs(c)
+    fs.mkdirs("/bricked")
+    fs.write_file("/bricked/f", b"data")
+    fs.set_pin("/bricked", 7)           # rank 7 does not exist
+    with pytest.raises((CephFSError, TimeoutError)):
+        fs._session.call("lookup", {"path": "/bricked/f"},
+                         timeout=2.0)
+    # repair through rank 0 with force
+    fs._session.call("set_pin", {"path": "/bricked", "rank": 0,
+                                 "force": True})
+    assert fs.read_file("/bricked/f") == b"data"

@@ -119,7 +119,9 @@ class RGWGateway:
                     self._body = body
                     if gw.keyring is not None:
                         try:
-                            if "X-Amz-Signature" in self.path:
+                            presigned = "X-Amz-Signature" in parse_qs(
+                                urlparse(self.path).query)
+                            if presigned:
                                 # query-string auth: presigned URL
                                 self.s3_user = presigned_verify(
                                     method, self.path, self.headers,

@@ -49,16 +49,52 @@ class _MDSSession(Dispatcher):
         self._pending: dict[int, tuple[threading.Event, list]] = {}
         self._rados = rados
         self.fs: "CephFS | None" = None
+        # cap messages (revoke/snapc) run sync RADOS IO whose replies
+        # ride the dispatch thread, so they must be offloaded — but
+        # ordered PER INO, not a thread per message: two snapc
+        # broadcasts applied out of order would install a stale snap
+        # context permanently.  Per-ino queues keep that invariant
+        # without letting one wedged revoke (30s MDS call timeout)
+        # head-of-line-block every other file's snapc delivery.
+        self._capqs: dict[int, list] = {}
+        self._capq_lock = threading.Lock()
         self.ms.add_dispatcher(self)
+
+    def _cap_drain(self, ino: int) -> None:
+        from ..common.log import dout
+        while True:
+            with self._capq_lock:
+                q = self._capqs.get(ino)
+                if not q:
+                    self._capqs.pop(ino, None)
+                    return
+                msg = q.pop(0)
+            try:
+                if msg.op == "revoke":
+                    self.fs._handle_revoke(msg)
+                else:
+                    self.fs._handle_snapc(msg)
+            except Exception as ex:      # never kill the drain thread,
+                # but never hide the failure either: an unacked revoke
+                # wedges the MDS with zero diagnostics otherwise
+                dout("client", 0).write(
+                    "cap %s handler failed for ino %x: %r",
+                    msg.op, ino, ex)
+
+    def _enqueue_cap(self, msg) -> None:
+        with self._capq_lock:
+            q = self._capqs.get(msg.ino)
+            if q is not None:
+                q.append(msg)        # a drain thread is already live
+                return
+            self._capqs[msg.ino] = [msg]
+        threading.Thread(target=self._cap_drain, args=(msg.ino,),
+                         daemon=True).start()
 
     def ms_dispatch(self, msg: Message) -> bool:
         if isinstance(msg, MClientCaps):
-            if self.fs is not None and msg.op == "revoke":
-                # flushing runs sync IO — never on the dispatch thread
-                threading.Thread(target=self.fs._handle_revoke,
-                                 args=(msg,), daemon=True).start()
-            elif self.fs is not None and msg.op == "snapc":
-                self.fs._handle_snapc(msg)
+            if self.fs is not None and msg.op in ("revoke", "snapc"):
+                self._enqueue_cap(msg)
             return True
         if not isinstance(msg, MClientReply):
             return False
@@ -133,11 +169,9 @@ class FileHandle:
         self.snapid = rec.get("snapid")
         self._dirty_size = False
         self._rcache: dict[tuple[int, int], bytes] = {}
+        self._snapc_seq = -1
+        self._snapc_lock = threading.Lock()
         self._io = fs.rados.open_ioctx(rec["pool"])
-        # writes under a snapped realm carry its snap context so the
-        # OSD COWs pre-snap state (ref: SnapRealm::get_snap_context
-        # feeding every data op)
-        self.set_snapc(rec.get("snapc"))
         # write-back object cache (ref: ObjectCacher mounted by
         # Client.cc; the caps ARE its coherence protocol: CAP_EXCL
         # buffers writes, CAP_CACHE serves cached reads, revocation
@@ -151,23 +185,41 @@ class FileHandle:
             self._oc, self._oc_io = fs._get_cache(
                 self.ino, rec["pool"],
                 page=min(self.layout.stripe_unit, 1 << 16))
-            if rec.get("snapc"):
-                self._oc_io.set_write_snapc(rec["snapc"]["seq"],
-                                            rec["snapc"]["snaps"])
+        # writes under a snapped realm carry its snap context so the
+        # OSD COWs pre-snap state (ref: SnapRealm::get_snap_context
+        # feeding every data op).  Register FIRST, then merge+apply:
+        # a broadcast landing in the gap then reaches this handle too,
+        # and the monotone guards make the two applications commute —
+        # the reverse order would let a stale open reply overwrite a
+        # broadcast the sibling handles already applied.
         fs._register_handle(self)
+        self.set_snapc(fs._merge_snapc(self.ino, rec.get("snapc")))
 
     def set_snapc(self, snapc: dict | None) -> None:
-        if snapc:
-            oc = getattr(self, "_oc", None)
-            if oc is not None:
+        if not snapc:
+            return
+        # snap contexts only widen: a late-arriving older broadcast
+        # (delivery reordering, or a sibling open whose MDS reply
+        # predates a mksnap) must not roll the handle back to a stale
+        # seq — writes would then skip COW for the newer snapshot
+        # (ref: SnapContext seq monotonicity, src/osdc/Objecter).
+        # The lock makes check+apply atomic against the per-ino cap
+        # drain thread racing a constructor-time apply.
+        with self._snapc_lock:
+            if snapc["seq"] <= self._snapc_seq:
+                return
+            if self._oc is not None:
                 # buffered writes predate the new snap context: they
                 # must flush under the OLD one or the OSD won't COW
                 # them into the snapshot they logically belong to
-                oc.flush()
+                self._oc.flush()
             self._io.set_write_snapc(snapc["seq"], snapc["snaps"])
-            if getattr(self, "_oc_io", None) is not None:
-                self._oc_io.set_write_snapc(snapc["seq"],
-                                            snapc["snaps"])
+            if self._oc_io is not None:
+                self.fs._apply_snapc_shared(self.ino)
+            # advance only after every apply succeeded: an exception
+            # above (flush hitting a transient RADOS error) must leave
+            # a re-delivery of this seq acceptable
+            self._snapc_seq = snapc["seq"]
 
     # -- data path (ref: Client::_write -> Striper + Objecter) ---------
     def write(self, offset: int, data: bytes) -> int:
@@ -325,6 +377,8 @@ class CephFS:
         #: per-inode shared ObjectCacher: ino -> (cacher, io, refs)
         #: (ref: Client.cc mounts ONE ObjectCacher per inode)
         self._caches: dict[int, tuple] = {}
+        #: per-inode authoritative (highest-seq) snap context
+        self._ino_snapc: dict[int, dict] = {}
         self._hlock = threading.Lock()
 
     def _get_cache(self, ino: int, pool: str, page: int):
@@ -370,6 +424,33 @@ class CephFS:
         oc.flush()
         oc.invalidate()
 
+    def _merge_snapc(self, ino: int, snapc: dict | None) -> dict | None:
+        """Per-ino monotone snap context: merge `snapc` in, return the
+        authoritative (highest-seq) one.  EVERY path that applies a
+        context to the shared per-ino cache io must route through
+        here — a stale MDS open reply racing a broadcast would
+        otherwise roll the shared seq back and later flushes would
+        skip COW for the newest snapshot."""
+        with self._hlock:
+            cur = self._ino_snapc.get(ino)
+            if snapc and (cur is None or snapc["seq"] > cur["seq"]):
+                self._ino_snapc[ino] = cur = dict(snapc)
+            return cur
+
+    def _apply_snapc_shared(self, ino: int) -> None:
+        """Install the per-ino AUTHORITATIVE context on the shared
+        cache io.  Always applying the current _ino_snapc max (never a
+        caller-supplied context) makes the applied seq monotone by
+        construction — two handles racing, one with a stale merge
+        result, both land on the max.  set_write_snapc is pure state
+        (no IO), so holding _hlock is safe."""
+        with self._hlock:
+            ent = self._caches.get(ino)
+            cur = self._ino_snapc.get(ino)
+            if ent is None or cur is None:
+                return
+            ent[1].set_write_snapc(cur["seq"], cur["snaps"])
+
     # -- capability plumbing -------------------------------------------
     def _register_handle(self, fh) -> None:
         with self._hlock:
@@ -386,6 +467,11 @@ class CephFS:
                 lst.remove(fh)
             if not lst:
                 self._handles.pop(fh.ino, None)
+                # last handle gone: no broadcasts can target this ino
+                # anymore (the MDS only notifies cap holders), so the
+                # next open's MDS reply is authoritative — prune the
+                # merged record rather than leak one entry per ino
+                self._ino_snapc.pop(fh.ino, None)
                 return True
             return False
 
@@ -421,10 +507,21 @@ class CephFS:
         """mksnap widened the realm's snap context: every open handle
         on the ino switches its write snapc so the OSD COWs pre-snap
         state (ref: the SnapRealm update broadcast)."""
+        from ..common.log import dout
+        snapc = self._merge_snapc(msg.ino, msg.snapc)
         with self._hlock:
             handles = list(self._handles.get(msg.ino, []))
         for fh in handles:
-            fh.set_snapc(msg.snapc)
+            try:
+                fh.set_snapc(snapc)
+            except Exception as ex:
+                # one handle's transient flush failure must not strand
+                # its SIBLINGS on the old context; the failed handle's
+                # _snapc_seq was not advanced (set_snapc applies before
+                # it records), so the next broadcast retries it
+                dout("client", 0).write(
+                    "snapc apply failed on ino %x handle: %r",
+                    msg.ino, ex)
 
     # -- namespace ------------------------------------------------------
     def mkdir(self, path: str) -> None:

@@ -147,6 +147,11 @@ class OSDDaemon(Dispatcher, MonHunter):
         self._remote_backfills: set = set()         # (pg, primary osd)
         self._local_waitq: list = []                # PGs awaiting a slot
         self._remote_waitq: list = []               # (key, reply addr)
+        #: peak reserver occupancy since boot — recorded at the moment
+        #: a slot is taken, so tests can assert throttled backfills ran
+        #: without racing the (often sub-tick) hold window
+        self.bf_peak_local = 0
+        self.bf_peak_remote = 0
         #: cached stray self-notifies: pg -> (PGNotify, primary osd)
         self._stray_notifies: dict = {}
         # in-flight notifies: notify_id -> state
@@ -1240,6 +1245,8 @@ class OSDDaemon(Dispatcher, MonHunter):
             if key in self._remote_backfills or \
                     len(self._remote_backfills) < limit:
                 self._remote_backfills.add(key)
+                self.bf_peak_remote = max(self.bf_peak_remote,
+                                          len(self._remote_backfills))
                 if not self.ms.connect(msg.src).send_message(
                         BackfillReserve(pgid=msg.pgid,
                                         from_osd=self.whoami,
@@ -1271,6 +1278,8 @@ class OSDDaemon(Dispatcher, MonHunter):
         while self._remote_waitq and len(self._remote_backfills) < limit:
             key, src = self._remote_waitq.pop(0)
             self._remote_backfills.add(key)
+            self.bf_peak_remote = max(self.bf_peak_remote,
+                                      len(self._remote_backfills))
             if not self.ms.connect(src).send_message(BackfillReserve(
                     pgid=key[0], from_osd=self.whoami, op="grant")):
                 self._remote_backfills.discard(key)   # requester died
@@ -1280,6 +1289,8 @@ class OSDDaemon(Dispatcher, MonHunter):
             if st is None or st.peering is None:
                 continue
             self._local_backfills.add(pg)
+            self.bf_peak_local = max(self.bf_peak_local,
+                                     len(self._local_backfills))
             st.peering.local_granted()
 
     def reserve_local_backfill(self, pg: PG) -> bool:
@@ -1293,6 +1304,8 @@ class OSDDaemon(Dispatcher, MonHunter):
                 self._local_waitq.append(pg)
             return False
         self._local_backfills.add(pg)
+        self.bf_peak_local = max(self.bf_peak_local,
+                                 len(self._local_backfills))
         return True
 
     def release_local_backfill(self, pg: PG) -> None:

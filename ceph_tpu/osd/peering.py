@@ -152,6 +152,11 @@ class PGPeering:
         self.push_pending = 0
         #: set while we ourselves full-copy from the auth holder
         self.primary_backfill_from: int | None = None
+        #: the auth holder's full log landed (_on_full_log); a primary
+        #: backfill may not go clean before this — testing head ==
+        #: ZERO_VERSION instead would let a stale non-empty local log
+        #: slip through when pulls finish before the log reply
+        self._log_adopted = False
         # backfill walk state
         self.bf_target: int | None = None
         self.bf_cursor = ""            # exclusive lower bound
@@ -339,6 +344,7 @@ class PGPeering:
                                       tail=_ev(msg.tail))
         shard.pg_log.log.can_rollback_to = _ev(msg.head)
         shard.persist_log()
+        self._log_adopted = True
         self._maybe_pulls_done()
 
     # ------------------------------------------------------- GetMissing
@@ -433,7 +439,7 @@ class PGPeering:
         if self.phase != RECOVERING or self.pull_pending:
             return
         if self.primary_backfill_from is not None and \
-                self._shard().pg_log.log.head == ZERO_VERSION:
+                not self._log_adopted:
             return      # primary backfill: log adoption still in flight
         jobs = [(oid, osd) for osd, objs in self.peer_missing.items()
                 for oid in objs]
@@ -556,7 +562,10 @@ class PGPeering:
                     pgid=self.pg, from_osd=self.d.whoami,
                     entries=entries, head=head, tail=tail,
                     activate=True, epoch=self.epoch))
-        elif self.phase == RECOVERING and self.pull_pending:
+        elif self.phase == RECOVERING and \
+                (self.pull_pending or
+                 (self.primary_backfill_from is not None and
+                  not self._log_adopted)):
             if self.primary_backfill_from is not None:
                 self._send(self.primary_backfill_from,
                            PGScan(pgid=self.pg, ec=False))

@@ -39,12 +39,19 @@ from typing import Callable
 
 
 class _CachedObject:
-    __slots__ = ("pages", "valid", "dirty")
+    __slots__ = ("pages", "valid", "dirty", "vlen")
 
     def __init__(self):
         self.pages: dict[int, bytearray] = {}
         self.valid: set[int] = set()
         self.dirty: set[int] = set()
+        #: per-page count of bytes known to exist in the backing (from
+        #: the fill read) or written through this cache.  Flushing
+        #: truncates a run's FINAL page to this, so a 10-byte file
+        #: never grows to a 64 KiB backing object of trailing zeros
+        #: (the reference's BufferHeads are byte-granular for the same
+        #: reason; ref: src/osdc/ObjectCacher.h bh lengths)
+        self.vlen: dict[int, int] = {}
 
 
 class ObjectCacher:
@@ -81,11 +88,12 @@ class ObjectCacher:
         return o
 
     def _install(self, o: _CachedObject, p: int,
-                 buf: bytearray) -> None:
+                 buf: bytearray, vlen: int = 0) -> None:
         if p not in o.valid:
             self._n_pages += 1
         o.pages[p] = buf
         o.valid.add(p)
+        o.vlen[p] = max(o.vlen.get(p, 0), vlen)
 
     def _fill_page(self, oid: str, o: _CachedObject, p: int) -> None:
         """Write-allocate: fetch the page so a later flush writes only
@@ -95,7 +103,7 @@ class ObjectCacher:
         data = self._read(oid, p * self.page, self.page) or b""
         buf = bytearray(self.page)
         buf[:len(data)] = data
-        self._install(o, p, buf)
+        self._install(o, p, buf, vlen=len(data))
 
     def _fill_span(self, oid: str, o: _CachedObject,
                    pages: list[int]) -> None:
@@ -117,7 +125,7 @@ class ObjectCacher:
             buf = bytearray(self.page)
             chunk = data[base:base + self.page]
             buf[:len(chunk)] = chunk
-            self._install(o, p, buf)
+            self._install(o, p, buf, vlen=len(chunk))
 
     def _page_range(self, off: int, length: int):
         return range(off // self.page,
@@ -158,6 +166,7 @@ class ObjectCacher:
                 elif p not in o.valid:
                     self._install(o, p, bytearray(self.page))
                 o.pages[p][lo:hi] = data[pos:pos + (hi - lo)]
+                o.vlen[p] = max(o.vlen.get(p, 0), hi)
                 pos += hi - lo
                 if p not in o.dirty:
                     o.dirty.add(p)
@@ -185,6 +194,7 @@ class ObjectCacher:
                     o.pages.pop(p, None)
                     o.valid.discard(p)
                     o.dirty.discard(p)
+                    o.vlen.pop(p, None)
                 elif p in o.valid:
                     o.pages[p][lo:hi] = b"\0" * (hi - lo)
 
@@ -196,6 +206,11 @@ class ObjectCacher:
             if run and (p is None or p != run[-1] + 1):
                 start = run[0] * self.page
                 blob = b"".join(bytes(o.pages[q]) for q in run)
+                # truncate the run's tail to the last page's known
+                # length: writing the zero padding would extend the
+                # backing object past its logical size
+                tail = o.vlen.get(run[-1], self.page)
+                blob = blob[:(len(run) - 1) * self.page + tail]
                 self._write(oid, start, blob)
                 self.stats["flush_writes"] += 1
                 wrote += len(blob)
@@ -247,6 +262,7 @@ class ObjectCacher:
                     for p in clean:
                         o.pages.pop(p, None)
                         o.valid.discard(p)
+                        o.vlen.pop(p, None)
                         self._n_pages -= 1
                         self.stats["evicted_pages"] += 1
                     if not o.pages:

@@ -226,3 +226,30 @@ def test_dotsnap_substring_names_unaffected(fscluster):
         b"rewritable"
     fs.rename("/subst.snapdir/report.snapshot", "/subst.snapdir/r2")
     fs.unlink("/subst.snapdir/r2")
+
+
+def test_snapc_monotone_against_reordered_delivery(fscluster):
+    """A late-arriving older snapc (reordered broadcast, or a sibling
+    open whose MDS reply predates a mksnap) must not roll a handle —
+    or the shared per-ino cache io — back to a stale seq (r5 advisor
+    follow-up: snapc handling is order-sensitive)."""
+    c, _mds = fscluster
+    fs = _fs(c)
+    fs.mkdirs("/mono")
+    fh = fs.open("/mono/f", "w")
+    fh.write(0, b"A" * 16)
+    fh.fsync()
+    fs.mksnap("/mono", "m1")
+    fs.mksnap("/mono", "m2")
+    time.sleep(0.3)                    # drain the broadcasts
+    seq = fh._snapc_seq
+    assert seq >= 2
+    # simulate an out-of-order older broadcast: must be ignored
+    fh.set_snapc({"seq": seq - 1, "snaps": []})
+    assert fh._snapc_seq == seq
+    # a sibling open (reply snapc can be stale in a real race) adopts
+    # the per-ino merged context, never regressing the shared io
+    fh2 = fs.open("/mono/f", "r+")
+    assert fh2._snapc_seq >= seq
+    assert fs._merge_snapc(fh.ino, None)["seq"] >= seq
+    fh.close(); fh2.close()

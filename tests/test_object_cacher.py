@@ -187,3 +187,34 @@ def test_cephfs_cap_revoke_flushes_cached_writes():
         if mds is not None:
             mds.shutdown()
         c.shutdown()
+
+
+def test_flush_does_not_zero_extend_short_objects():
+    """A small write to a short (or empty) object flushes only the
+    bytes known to exist — not a zero-padded full page that would
+    inflate the backing object's size (advisor r4 #4)."""
+    b, oc = mk()
+    oc.write("tiny", 0, b"0123456789")
+    oc.flush()
+    assert len(b.objs["tiny"]) == 10
+    # RMW on a short backing object keeps its true length too
+    b.objs["short"] = bytearray(b"x" * 100)
+    oc.write("short", 5, b"yy")                 # partial-page RMW
+    oc.flush()
+    assert len(b.objs["short"]) == 100
+    assert bytes(b.objs["short"][:10]) == b"xxxxxyyxxx"
+    # but a write that genuinely extends the object does extend it
+    oc.write("short", 98, b"zzzz")
+    oc.flush()
+    assert len(b.objs["short"]) == 102
+    assert bytes(b.objs["short"][96:]) == b"xxzzzz"
+
+
+def test_flush_run_tail_truncation_multipage():
+    """Multi-page dirty runs truncate only the run's FINAL page."""
+    b, oc = mk(page=64)
+    data = bytes(range(256)) * 100               # spans many 64B pages
+    oc.write("obj", 0, data[:130])               # 2 full pages + 2 bytes
+    oc.flush()
+    assert len(b.objs["obj"]) == 130
+    assert bytes(b.objs["obj"]) == data[:130]

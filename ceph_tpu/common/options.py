@@ -143,6 +143,32 @@ OPTIONS: dict[str, Option] = {opt.name: opt for opt in [
        desc="scrub op-class weight", runtime=True),
     _o("osd_mclock_scrub_lim", T.FLOAT, 100.0, L.ADVANCED,
        desc="scrub limit, ops/s (0 = unlimited)", runtime=True),
+    # automatic scrub scheduling (ref: options.cc:3351
+    # osd_scrub_min_interval / osd_deep_scrub_interval / osd_max_scrubs)
+    _o("osd_scrub_auto", T.BOOL, True, L.ADVANCED, runtime=True,
+       desc="schedule scrubs automatically from the heartbeat tick"),
+    _o("osd_scrub_min_interval", T.FLOAT, 24 * 3600.0, L.ADVANCED,
+       runtime=True,
+       desc="seconds between shallow scrubs of a clean PG"),
+    _o("osd_deep_scrub_interval", T.FLOAT, 7 * 24 * 3600.0,
+       L.ADVANCED, runtime=True,
+       desc="seconds between deep scrubs of a clean PG"),
+    _o("osd_max_scrubs", T.UINT, 1, L.ADVANCED, runtime=True,
+       desc="concurrent scrubs an OSD will drive or serve"),
+    _o("osd_scrub_auto_repair", T.BOOL, True, L.ADVANCED,
+       runtime=True,
+       desc="repair inconsistencies found by scheduled deep scrubs "
+            "(diverges from the reference default=false: BlueStore "
+            "at-rest checksums make auto-repair the useful default "
+            "here; the repair is re-verified in-round either way)"),
+    # MDS balancer (ref: options.cc mds_bal_* family)
+    _o("mds_bal_interval", T.FLOAT, 5.0, L.ADVANCED, runtime=True,
+       desc="seconds between MDS balancer passes"),
+    _o("mds_bal_min_load", T.FLOAT, 20.0, L.ADVANCED, runtime=True,
+       desc="minimum decayed op load before a rank exports"),
+    _o("mds_bal_ratio", T.FLOAT, 1.5, L.ADVANCED, runtime=True,
+       desc="load multiple over the coldest rank that triggers an "
+            "export"),
     _o("mon_target_pg_per_osd", T.UINT, 100, L.ADVANCED,
        desc="pg_autoscaler target PG replicas per OSD", runtime=True),
     _o("osd_ec_batch_stripes", T.UINT, 64, L.ADVANCED,

@@ -47,6 +47,9 @@ class MgrDaemon(Dispatcher, MonHunter):
         #: progress module (ref: pybind/mgr/progress); enable with
         #: start_progress(), driven by progress_tick
         self.progress = None
+        #: devicehealth module (ref: pybind/mgr/devicehealth); enable
+        #: with start_devicehealth(), driven by devicehealth_tick
+        self.devicehealth = None
         self._lock = threading.RLock()
         self.ms = Messenger.create(network, self.name, threaded=threaded)
         self.ms.add_dispatcher(self)
@@ -128,6 +131,16 @@ class MgrDaemon(Dispatcher, MonHunter):
         self.progress = ProgressModule(self)
         return self.progress
 
+    def start_devicehealth(self):
+        """Device media-error health (ref: pybind/mgr/devicehealth)."""
+        from .devicehealth import DeviceHealth
+        self.devicehealth = DeviceHealth(self)
+        return self.devicehealth
+
+    def devicehealth_tick(self) -> None:
+        if getattr(self, "devicehealth", None) is not None:
+            self.devicehealth.tick()
+
     def progress_tick(self) -> int:
         if self.progress is None:
             return 0
@@ -141,7 +154,10 @@ class MgrDaemon(Dispatcher, MonHunter):
         self.prometheus = PrometheusExporter(
             self.mon_command, port=port,
             progress_ls=lambda: (self.progress.ls()
-                                 if self.progress is not None else []))
+                                 if self.progress is not None else []),
+            device_ls=lambda: (self.devicehealth.ls()
+                               if self.devicehealth is not None
+                               else []))
         self.prometheus.start()
         return self.prometheus
 

@@ -89,11 +89,10 @@ class PGAutoscaler:
         sent = 0
         for p in self.last_plan:
             pool = osdmap.pools.get(p["pool_id"])
-            if pool is not None and pool.pgp_num < pool.pg_num and \
-                    pool.is_replicated():
-                # EC pools keep children on the parent's seed: their
-                # recovery has no prior-interval backfill to chase a
-                # reseed (the mon refuses it too)
+            if pool is not None and pool.pgp_num < pool.pg_num:
+                # both pool types: the peering statecharts chase a
+                # reseed through prior-interval queries + backfill
+                # (replicated osd/peering.py; EC osd/ec_peering.py)
                 dout("mgr", 1).write(
                     "pg_autoscaler: pool %s pgp_num %d -> %d (reseed)",
                     p["pool_name"], pool.pgp_num, pool.pg_num)

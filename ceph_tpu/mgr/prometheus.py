@@ -43,12 +43,14 @@ class PrometheusExporter:
     (rc, outs, outb)`): a Rados handle or a Monitor both qualify."""
 
     def __init__(self, mon_command, host: str = "127.0.0.1",
-                 port: int = 0, progress_ls=None):
+                 port: int = 0, progress_ls=None, device_ls=None):
         self._cmd = mon_command
         #: optional callable returning the mgr progress module's
         #: event list (ref: the progress metrics the reference's
         #: prometheus module exports)
         self._progress_ls = progress_ls
+        #: optional callable returning devicehealth records
+        self._device_ls = device_ls
         exporter = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -132,6 +134,14 @@ class PrometheusExporter:
                 b.sample("ceph_pool_bytes", st["bytes"],
                          {"pool": pool})
 
+        rc, _, counts = self._cmd({"prefix": "log counts"})
+        if rc == 0:
+            b.metric("ceph_cluster_log_messages",
+                     "cluster log entries by severity", "counter")
+            for level, n in sorted((counts or {}).items()):
+                b.sample("ceph_cluster_log_messages", n,
+                         {"level": level})
+
         rc, _, perf = self._cmd({"prefix": "osd perf dump"})
         if rc == 0:
             emitted: set[str] = set()
@@ -161,6 +171,18 @@ class PrometheusExporter:
                 b.metric(name, f"cluster-wide sum of {key}", "counter")
                 b.sample(name, val)
 
+        if self._device_ls is not None:
+            b.metric("ceph_device_health",
+                     "device health (0=GOOD 1=WARNING 2=FAILING)")
+            b.metric("ceph_device_media_errors",
+                     "media error count per device", "counter")
+            sev = {"GOOD": 0, "WARNING": 1, "FAILING": 2}
+            for d in self._device_ls():
+                lbl = {"device": d["device"], "daemon": d["daemon"]}
+                b.sample("ceph_device_health",
+                         sev.get(d["health"], 2), lbl)
+                b.sample("ceph_device_media_errors",
+                         d["csum_errors"] + d["read_errors"], lbl)
         if self._progress_ls is not None:
             b.metric("ceph_progress_event",
                      "long-running event completion ratio")

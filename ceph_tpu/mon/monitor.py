@@ -28,7 +28,8 @@ from collections import deque
 
 from ..common.log import dout
 from ..common.options import global_config
-from ..msg.messages import (MAuthRequest, MConfig, MMap, MMonCommand,
+from ..msg.messages import (MAuthRequest, MConfig, MLog, MLogAck,
+                            MMap, MMonCommand,
                             MMonCommandAck,
                             MMonElection, MMonForward, MMonLease,
                             MMonLeaseAck, MMonSubscribe, MOSDBoot,
@@ -39,6 +40,7 @@ from ..msg.messages import (MAuthRequest, MConfig, MMap, MMonCommand,
 from ..msg.messenger import Dispatcher, LocalNetwork, Message, Messenger
 from ..osd.osdmap import CEPH_OSD_AUTOOUT, CEPH_OSD_IN, OSDMap
 from .config_monitor import ConfigMonitor
+from .log_monitor import LogMonitor
 from .elector import Elector
 from .osd_monitor import OSDMonitor
 from .pg_map import OSDStatReport, PGMap, health_checks, health_status
@@ -85,6 +87,7 @@ class Monitor(Dispatcher):
         self.paxos = Paxos(self.store)
         self.osdmon = OSDMonitor(self.paxos, initial_map, initial_wrapper)
         self.configmon = ConfigMonitor(self.paxos)
+        self.logmon = LogMonitor(self.paxos)
         self.ms = Messenger.create(network, self.name, threaded=threaded)
         # cephx: the mon runs the key server and gates inbound traffic
         # (ref: AuthMonitor + CephxServiceHandler)
@@ -130,6 +133,7 @@ class Monitor(Dispatcher):
     def init(self) -> None:
         self.osdmon.init()
         self.configmon.init()
+        self.logmon.init()
         self.ms.start()
         if not self.standalone:
             self.elector.start()
@@ -196,6 +200,8 @@ class Monitor(Dispatcher):
         self.osdmon.create_pending()
         self.configmon.update_from_paxos()
         self.configmon.create_pending()
+        self.logmon.update_from_paxos()
+        self.logmon.create_pending()
         self._persist_elector()
         self._broadcast_lease()
         self._publish()
@@ -243,6 +249,7 @@ class Monitor(Dispatcher):
         and serve our subscribers."""
         self.osdmon.update_from_paxos()
         self.configmon.update_from_paxos()
+        self.logmon.update_from_paxos()
         self._publish()
 
     # -------------------------------------------------------- dispatch
@@ -273,6 +280,11 @@ class Monitor(Dispatcher):
                 if self._relay_if_peon(msg):
                     return True
                 self._handle_pg_temp(msg)
+                return True
+            if isinstance(msg, MLog):
+                if self._relay_if_peon(msg):
+                    return True
+                self._handle_log(msg)
                 return True
             if isinstance(msg, MPGStats):
                 self.pgmap.ingest(OSDStatReport(
@@ -402,6 +414,16 @@ class Monitor(Dispatcher):
 
         self._dispatch_command(cmdmap, reply, client=client, tid=tid)
 
+    def _service_for(self, cmdmap: dict):
+        """Command prefix -> owning PaxosService (ref:
+        Monitor::dispatch_op's service fan-out)."""
+        pfx = str(cmdmap.get("prefix", ""))
+        if pfx.startswith("config"):
+            return self.configmon
+        if pfx == "log" or pfx.startswith("log "):
+            return self.logmon
+        return self.osdmon
+
     def _dispatch_command(self, cmdmap: dict, reply_cb,
                           client: str = "", tid: int = 0) -> None:
         """preprocess locally; stage writes through the change queue
@@ -413,8 +435,7 @@ class Monitor(Dispatcher):
         if res is not None:
             reply_cb(*res)
             return
-        svc = self.configmon if str(cmdmap.get("prefix", ""))\
-            .startswith("config") else self.osdmon
+        svc = self._service_for(cmdmap)
         try:
             res = svc.preprocess_command(cmdmap)
         except (KeyError, ValueError, TypeError) as ex:
@@ -444,6 +465,16 @@ class Monitor(Dispatcher):
 
     def _preprocess_mon_command(self, cmdmap: dict):
         prefix = cmdmap.get("prefix", "")
+        if prefix == "mgr health report":
+            # volatile module health (devicehealth etc.) — replaces
+            # the previous report wholesale so cleared checks vanish
+            self._module_health = {
+                str(k): {"severity": str(v.get("severity",
+                                               "HEALTH_WARN")),
+                         "summary": str(v.get("summary", "")),
+                         "detail": list(v.get("detail", []))}
+                for k, v in dict(cmdmap.get("checks", {})).items()}
+            return 0, "", None
         if prefix == "osd perf dump":
             # per-daemon counters as last reported (the mgr's
             # prometheus module scrapes these; ref: DaemonState
@@ -464,6 +495,11 @@ class Monitor(Dispatcher):
             self.osdmap, self.pgmap, self.quorum(), self.mon_ranks,
             now, stale_after=global_config()
             ["mon_osd_stale_report_grace"], pgs=pgs)
+        # mgr-module health reports (devicehealth etc.) merge in
+        # (ref: MgrStatMonitor's health contributions — volatile here
+        # rather than paxos'd: the mgr re-reports every tick, so a
+        # failed-over mon repopulates within one period)
+        checks.update(getattr(self, "_module_health", {}))
         if prefix in ("health", "health detail"):
             out = {"status": health_status(checks),
                    "checks": {k: {"severity": v["severity"],
@@ -529,8 +565,7 @@ class Monitor(Dispatcher):
                 res = self._preprocess_mon_command(cmdmap)
                 if res is not None:
                     return res
-                svc = self.configmon if str(cmdmap.get("prefix", ""))\
-                    .startswith("config") else self.osdmon
+                svc = self._service_for(cmdmap)
                 try:
                     res = svc.preprocess_command(cmdmap)
                 except (KeyError, ValueError, TypeError) as ex:
@@ -557,41 +592,59 @@ class Monitor(Dispatcher):
         self._pump_changes()
 
     def _pump_changes(self) -> None:
-        if self._chg_busy or not self._chg_queue:
+        # Re-entrancy guard: a stage() callback may itself submit a
+        # change (e.g. the osd-failure stage logging through
+        # clog_event -> logmon).  The nested call must only ENQUEUE —
+        # running it inline would pop and propose a second service
+        # while the outer frame's proposal is still being staged,
+        # breaking the one-proposal-at-a-time plug.  The outer drain
+        # loop picks nested submissions up in order.
+        if getattr(self, "_pumping", False):
             return
-        if not self.is_leader:
-            self._fail_queued("EAGAIN")
-            return
-        if self._catchup_pending:
-            return   # collect phase: lease acks will pump us
-        stage, reply_cb, svc = self._chg_queue.popleft()
+        self._pumping = True
         try:
-            res = stage()
-        except (KeyError, ValueError, TypeError) as ex:
-            svc.create_pending()
-            if reply_cb is not None:
-                reply_cb(-22, f"invalid command arguments: {ex}", None)
-            self._pump_changes()
-            return
-        r, outs, outb = res if res is not None else (0, "", None)
-        if r != 0 or svc._is_pending_empty():
-            svc.create_pending()
-            if reply_cb is not None:
-                reply_cb(r, outs, outb)
-            self._pump_changes()
-            return
-        self._chg_busy = True
-        self._chg_inflight_reply = reply_cb
+            while not self._chg_busy and self._chg_queue:
+                if not self.is_leader:
+                    self._fail_queued("EAGAIN")
+                    return
+                if self._catchup_pending:
+                    return   # collect phase: lease acks will pump us
+                stage, reply_cb, svc = self._chg_queue.popleft()
+                try:
+                    res = stage()
+                except (KeyError, ValueError, TypeError) as ex:
+                    svc.create_pending()
+                    if reply_cb is not None:
+                        reply_cb(-22,
+                                 f"invalid command arguments: {ex}",
+                                 None)
+                    continue
+                r, outs, outb = res if res is not None \
+                    else (0, "", None)
+                if r != 0 or svc._is_pending_empty():
+                    svc.create_pending()
+                    if reply_cb is not None:
+                        reply_cb(r, outs, outb)
+                    continue
+                self._chg_busy = True
+                self._chg_inflight_reply = reply_cb
 
-        def committed():
-            self._chg_busy = False
-            self._chg_inflight_reply = None
-            self._publish()
-            if reply_cb is not None:
-                reply_cb(r, outs, outb)
-            self._pump_changes()
+                def committed(reply_cb=reply_cb, r=r, outs=outs,
+                              outb=outb):
+                    self._chg_busy = False
+                    self._chg_inflight_reply = None
+                    self._publish()
+                    if reply_cb is not None:
+                        reply_cb(r, outs, outb)
+                    # async completion (paxos round-trip): drain what
+                    # queued meanwhile; a SYNCHRONOUS completion
+                    # (standalone mon) is suppressed by _pumping and
+                    # the outer while-loop continues instead
+                    self._pump_changes()
 
-        svc.propose_pending(on_done=committed)
+                svc.propose_pending(on_done=committed)
+        finally:
+            self._pumping = False
 
     # ---------------------------------------------------- subscriptions
     def _handle_subscribe(self, msg: MMonSubscribe) -> None:
@@ -735,6 +788,49 @@ class Monitor(Dispatcher):
 
         self._submit_change(stage)
 
+    def _handle_log(self, msg: MLog) -> None:
+        """Daemon LogClient batch: stage through the logm paxos
+        service and ack the sender's high-water seq once committed
+        (ref: LogMonitor::prepare_log + MLogAck)."""
+        src = msg.src
+        by_name: dict[str, int] = {}
+        for e in msg.entries:
+            n = str(e.get("name", "?"))
+            by_name[n] = max(by_name.get(n, -1), int(e.get("seq", 0)))
+
+        def stage():
+            if not self.logmon.stage_entries(list(msg.entries)):
+                # pure resend: ack again without an empty proposal
+                for n, s in by_name.items():
+                    self.ms.connect(src).send_message(MLogAck(
+                        name=n, last_seq=s))
+                return (1, "", None)
+            return (0, "", None)
+
+        def done(r, _outs, _outb):
+            if r == 0:
+                for n, s in by_name.items():
+                    self.ms.connect(src).send_message(MLogAck(
+                        name=n, last_seq=s))
+
+        self._submit_change(stage, reply_cb=done, svc=self.logmon)
+
+    def clog_event(self, level: str, text: str) -> None:
+        """Mon-originated cluster-log entry (osd down/out, health
+        transitions) staged for the next logm proposal (ref: the
+        mon_clog channel in LogMonitor).  Staging happens inside the
+        serialized stage callback so the seq is computed against the
+        pending state it actually lands on."""
+        def stage():
+            seq = self.logmon.last_seq_for(self.name) + 1 + len(
+                [e for e in self.logmon.pending
+                 if e["name"] == self.name])
+            ok = self.logmon.stage_entries([{
+                "seq": seq, "stamp": self.clock(),
+                "name": self.name, "level": level, "text": text}])
+            return (0, "", None) if ok else (1, "", None)
+        self._submit_change(stage, svc=self.logmon)
+
     def _mark_down_pgmap(self, osd: int) -> None:
         """Drop a downed OSD's stat report: its capacity must leave the
         df totals and its stale primary claims must not fight the new
@@ -752,6 +848,10 @@ class Monitor(Dispatcher):
             self.osdmon.pending_inc.new_down_osds.append(osd)
             dout("mon", 1).write("%s: marking osd.%d down", self.name,
                                  osd)
+            # log only when this stage actually marks it (a racing
+            # second failure quorum must not double-count the event)
+            self.clog_event("warn", f"osd.{osd} marked down after "
+                            "failure reports from its peers")
             return (0, "", None)
 
         self._submit_change(stage)
@@ -802,6 +902,9 @@ class Monitor(Dispatcher):
                     changed = True
                     dout("mon", 1).write("%s: auto-out osd.%d",
                                          self.name, osd)
+                    self.clog_event(
+                        "warn", f"osd.{osd} auto-marked out after "
+                        "staying down past the interval")
                 return (0, "", None) if changed else (1, "", None)
 
             self._submit_change(stage)

@@ -462,17 +462,11 @@ class OSDMonitor(PaxosService):
                     if n < pool.pgp_num:
                         return -EPERM, \
                             "pgp_num reduction not supported", None
-                    if n > pool.pgp_num and pool.is_erasure():
-                        # EC recovery reconciles the acting set's
-                        # shard inventories only — no prior-interval
-                        # queries, so a reseed would orphan split
-                        # data on the old placement
-                        return -EPERM, ("pgp_num growth on erasure "
-                                        "pools is not supported"), None
-                    # replicated growth reseeds split PGs' placement;
-                    # the peering statechart's prior-interval queries
-                    # + backfill chase the relocated data
-                    # (osd/peering.py)
+                    # growth reseeds split PGs' placement; the peering
+                    # statecharts' prior-interval queries + backfill
+                    # chase the relocated data — replicated via
+                    # osd/peering.py, EC via osd/ec_peering.py's
+                    # cross-set chunk sources + pg_temp override
                 setattr(pool, var, n)
                 if var == "pg_num":
                     pool.pgp_num = min(pool.pgp_num, n)

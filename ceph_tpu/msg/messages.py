@@ -36,6 +36,12 @@ class ECSubWrite(Message):
     fabric_key: Any = None          # (pgid, tid) staging key
     chunk_off: int = 0              # chunk-space write offset
     hinfo_append: bool = False      # cumulative crc append is valid
+    # --- v3: recovery-push version guard — the receiving shard skips
+    # the txn (ack success) when its local copy of `oid` is already
+    # STRICTLY newer: a backfill push planned before a client write
+    # landed must not roll the chunk back (ref: the last_backfill
+    # ordering guarantee this guard replaces)
+    guard_version: Any = None       # (epoch, version) or None
 
 
 @dataclass
@@ -144,9 +150,15 @@ class PGScanReply(Message):
 @dataclass
 class PGQuery(Message):
     """Primary asks a (possibly prior-interval) peer for its pg_info
-    (GetInfo phase, ref: src/messages/MOSDPGQuery.h)."""
+    (GetInfo phase, ref: src/messages/MOSDPGQuery.h).
+
+    v2 appends `ec`: the querying primary's pool type, so the peer
+    answers from the matching store view (EC collections hold
+    sharded ObjectIds the replicated view cannot read)."""
     pgid: Any = None
     epoch: int = 0
+    # --- v2: EC-pool peering ---
+    ec: bool = False
 
 
 @dataclass
@@ -166,6 +178,10 @@ class PGNotify(Message):
     have_data: bool = False      # store collection is non-empty
     n_objects: int = 0
     stray: bool = False          # unsolicited self-notify leg
+    # --- v2: EC-pool peering — shard indexes present in the peer's
+    # store (a remapped holder may carry several; ref: pg_info_t's
+    # shard-qualified pg identity, src/osd/osd_types.h spg_t)
+    shards: list = field(default_factory=list)
 
 
 @dataclass
@@ -176,6 +192,8 @@ class PGLogReq(Message):
     since: Any = None            # EVersion: send entries > since
     epoch: int = 0               # staleness guard
     full: bool = False           # wholesale adoption (primary backfill)
+    # --- v2: EC-pool peering — answer from the EC shard log view
+    ec: bool = False
 
 
 @dataclass
@@ -215,6 +233,18 @@ class BackfillReserve(Message):
     src/messages/MBackfillReserve.h REQUEST/GRANT/REJECT_TOOFULL/
     RELEASE): a target only serves `osd_max_backfills` concurrent
     backfills; rejected primaries retry on the tick."""
+    pgid: Any = None
+    from_osd: int = -1
+    op: str = "request"          # request|grant|reject|release
+
+
+@dataclass
+class ScrubReserve(Message):
+    """Scrub reservation handshake (ref:
+    src/messages/MOSDScrubReserve.h REQUEST/GRANT/REJECT/RELEASE):
+    a replica serves at most `osd_max_scrubs` concurrent scrubs, so
+    the cluster-wide scrub load is bounded no matter how many
+    primaries come due at once."""
     pgid: Any = None
     from_osd: int = -1
     op: str = "request"          # request|grant|reject|release
@@ -563,6 +593,23 @@ class MMonForward(Message):
     cmd: dict = field(default_factory=dict)
 
 
+@dataclass
+class MLog(Message):
+    """Daemon -> mon cluster-log batch (ref: src/messages/MLog.h
+    carrying LogEntry vectors; src/common/LogClient.cc).  Entries are
+    dicts {seq, stamp, name, level, text}; `seq` is the sender's
+    monotonically increasing counter so the mon can dedup resends."""
+    entries: list = field(default_factory=list)
+
+
+@dataclass
+class MLogAck(Message):
+    """Mon -> daemon ack up to `last_seq` for `name` (ref:
+    src/messages/MLogAck.h); the client trims its resend buffer."""
+    name: str = ""
+    last_seq: int = 0
+
+
 # ---------------------------------------------------------------- pings
 
 
@@ -586,12 +633,15 @@ class PingReply(Message):
 # type's version here when appending fields.
 #: per-type (version, compat) overrides — bump when appending fields
 _VERSIONS: dict[str, tuple[int, int]] = {
-    "ECSubWrite": (2, 1),       # v2: ICI-fabric fields appended
+    "ECSubWrite": (3, 1),       # v2: ICI-fabric; v3: push version guard
     "PGScan": (2, 1),           # v2: ranged backfill walk
     "PGScanReply": (2, 1),      # v2: ranged/begin/end echo fields
     "PGPush": (2, 1),           # v2: authoritative backfill flag
     "MClientCaps": (2, 1),      # v2: snapc broadcast leg
     "MClientReply": (2, 1),     # v2: cross-rank forward
+    "PGQuery": (2, 1),          # v2: EC pool-type flag
+    "PGNotify": (2, 1),         # v2: held EC shard indexes
+    "PGLogReq": (2, 1),         # v2: EC shard-log view flag
 }
 
 

@@ -64,6 +64,26 @@ def ec_tombstone_txn(cid: str, oid: str, shard: int, ver: tuple,
                 HINFO_ATTR: HashInfo(n_chunks).to_dict()}))
 
 
+def spread_tombstones(pgid, k_plus_m: int, local_shard, whoami: int,
+                      send_osd, oid: str, ver: tuple,
+                      targets: dict) -> None:
+    """Spread a delete to shards that missed it — the EC analogue of
+    pushing a replicated whiteout.  `targets` is {shard_index: osd};
+    the version guard keeps a racing newer write authoritative.  The
+    single implementation behind the daemon's scrub repair AND the
+    peering statechart's reconcile/backfill."""
+    cid = pg_cid(pgid)
+    for s, osd in targets.items():
+        txn = ec_tombstone_txn(cid, oid, s, ver, k_plus_m)
+        msg = ECSubWrite(pgid=pgid, tid=0, shard=s, txn=txn,
+                         log_entries=[], oid=oid,
+                         guard_version=tuple(ver))
+        if osd == whoami:
+            local_shard.handle_sub_write(msg)
+        else:
+            send_osd(osd, msg)
+
+
 def ec_store_inventory(store, cid: str) -> dict:
     """oid -> {shard_index: ((epoch, ver), whiteout)} straight from a
     PG collection, independent of any live ECPGShard (a peer whose map
@@ -95,10 +115,20 @@ def ec_store_inventory(store, cid: str) -> dict:
 
 
 class ECPGShard:
-    """Per-OSD shard service for one PG."""
+    """Per-OSD shard service for one PG.
+
+    The shard's pg_log is durable in the pgmeta omap (same key format
+    as the replicated shard's — ref: PGLog::write_log_and_missing), so
+    a restarted OSD re-peers from real log bounds and the EC peering
+    statechart's GetInfo/GetLog phases have honest history to compare.
+    Unlike the replicated shard the entries ride a trailing
+    transaction rather than the data txn (the data txn arrives
+    pre-encoded from the primary); the window where data landed
+    without its log entry resolves through peering's version
+    reconcile, which reads authoritative versions from OI attrs."""
 
     def __init__(self, pgid, shard: int, store, k: int, m: int,
-                 fabric=None):
+                 fabric=None, create: bool = True):
         self.pgid = pgid
         self.shard = shard
         self.store = store
@@ -110,20 +140,97 @@ class ECPGShard:
         #: (ceph_tpu.dist.fabric) — fabric sub-writes gather their
         #: chunk slice from the mesh instead of the message
         self.fabric = fabric
-        if not store.collection_exists(self.cid):
+        if create and not store.collection_exists(self.cid):
             store.queue_transaction(
                 Transaction().create_collection(self.cid))
+        self._load_log()
+
+    # -- durable log (shared format with ReplicatedPGShard) ------------
+    def _load_log(self) -> None:
+        from ..msg import encoding as wire
+        from .pg_log import IndexedLog
+        from .replicated_backend import _TAIL_KEY, PGMETA
+        if not self.store.collection_exists(self.cid) or \
+                not self.store.exists(self.cid, PGMETA):
+            return
+        omap = self.store.omap_get(self.cid, PGMETA)
+        entries = [wire.decode(v) for k, v in sorted(omap.items())
+                   if k.startswith("l.")]
+        if not entries and _TAIL_KEY not in omap:
+            return
+        tail = wire.decode(omap[_TAIL_KEY]) if _TAIL_KEY in omap \
+            else ZERO_VERSION
+        head = entries[-1].version if entries else tail
+        self.pg_log = PGLog(IndexedLog(entries, head=head, tail=tail))
+
+    def persist_log(self) -> None:
+        """Rewrite the whole durable log (after a peering merge)."""
+        from ..msg import encoding as wire
+        from .replicated_backend import _TAIL_KEY, _log_key, PGMETA
+        txn = Transaction()
+        if not self.store.collection_exists(self.cid):
+            txn.create_collection(self.cid)
+        txn.touch(self.cid, PGMETA)
+        txn.omap_clear(self.cid, PGMETA)
+        txn.omap_setkeys(self.cid, PGMETA, dict(
+            {_log_key(e.version): wire.encode(e)
+             for e in self.pg_log.log.entries},
+            **{_TAIL_KEY: wire.encode(self.pg_log.log.tail)}))
+        self.store.queue_transaction(txn)
+
+    def log_info(self) -> tuple:
+        """(last_update, log_tail) — the pg_info_t core GetInfo
+        exchanges."""
+        return self.pg_log.log.head, self.pg_log.log.tail
+
+    def _append_log_durable(self, entries: list) -> None:
+        from ..common.options import global_config
+        from ..msg import encoding as wire
+        from .replicated_backend import _TAIL_KEY, _log_key, PGMETA
+        txn = Transaction()
+        txn.touch(self.cid, PGMETA)
+        txn.omap_setkeys(self.cid, PGMETA,
+                         {_log_key(e.version): wire.encode(e)
+                          for e in entries})
+        cfg = global_config()
+        if len(self.pg_log.log) > cfg["osd_max_pg_log_entries"]:
+            keep = cfg["osd_min_pg_log_entries"]
+            dropped = self.pg_log.log.entries[:-keep]
+            if dropped:
+                txn.omap_rmkeys(self.cid, PGMETA,
+                                [_log_key(e.version) for e in dropped])
+                self.pg_log.log.entries = \
+                    self.pg_log.log.entries[-keep:]
+                self.pg_log.log.tail = dropped[-1].version
+                self.pg_log.log.index()
+                txn.omap_setkeys(self.cid, PGMETA, {
+                    _TAIL_KEY: wire.encode(self.pg_log.log.tail)})
+        self.store.queue_transaction(txn)
 
     # -- write side (ref: ECBackend.cc:912 handle_sub_write) -----------
     def handle_sub_write(self, m: ECSubWrite) -> ECSubWriteReply:
         try:
+            if m.guard_version is not None and m.oid and \
+                    self._local_version(
+                        m.oid,
+                        shard=m.shard if m.shard >= 0
+                        else self.shard) > tuple(m.guard_version):
+                # recovery push planned before a newer client write
+                # landed here: the local copy is already authoritative,
+                # rolling it back would lose the write.  Ack success —
+                # the pushing primary's goal (shard at >= guard) holds.
+                return ECSubWriteReply(pgid=self.pgid, tid=m.tid,
+                                       shard=self.shard, committed=True)
             if m.txn is not None and not m.txn.empty():
                 self.store.queue_transaction(m.txn)
             if m.fabric_key is not None:
                 self._apply_fabric_write(m)
-            for e in m.log_entries:
-                if e.version > self.pg_log.log.head:
-                    self.pg_log.append(e)
+            fresh = [e for e in m.log_entries
+                     if e.version > self.pg_log.log.head]
+            for e in fresh:
+                self.pg_log.append(e)
+            if fresh:
+                self._append_log_durable(fresh)
             committed = True
         except (StoreError, KeyError, ValueError) as err:
             dout("osd", 0).write("%s shard %s sub_write failed: %s",
@@ -131,6 +238,30 @@ class ECPGShard:
             committed = False
         return ECSubWriteReply(pgid=self.pgid, tid=m.tid,
                                shard=self.shard, committed=committed)
+
+    def _local_version(self, oid: str, shard: int | None = None) -> tuple:
+        """Stored OI version of a chunk — `shard` defaults to this
+        service's own index; guarded pushes check the INCOMING
+        message's shard (a map-lagging receiver may serve a different
+        index than the one being pushed)."""
+        soid = ObjectId(oid, shard=self.shard if shard is None
+                        else shard)
+        try:
+            v = self.store.getattr(self.cid, soid, OI_ATTR).get(
+                "version", (0, 0))
+        except StoreError:
+            return (0, 0)
+        return (v.epoch, v.version) if hasattr(v, "epoch") else \
+            tuple(v) if v else (0, 0)
+
+    def remove_shard_object(self, oid: str) -> None:
+        """Drop the local chunk for `oid` (peering divergence: the
+        authoritative interval does not know this entry — the chunk
+        re-arrives through recovery at the authoritative version)."""
+        soid = ObjectId(oid, shard=self.shard)
+        if self.store.exists(self.cid, soid):
+            self.store.queue_transaction(
+                Transaction().remove(self.cid, soid))
 
     def _apply_fabric_write(self, m: ECSubWrite) -> None:
         """Device-mesh data path: gather this shard's chunk slice from
@@ -359,7 +490,8 @@ class ECBackend:
                  acting: list[int],
                  local_shard: ECPGShard,
                  send: Callable[[int, object], bool],
-                 epoch: int = 1, tid_gen=None, fabric=None):
+                 epoch: int = 1, tid_gen=None, fabric=None,
+                 send_osd: Callable[[int, object], bool] | None = None):
         self.pgid = pgid
         self.ec = ec
         #: ICIFabric when the acting set can be device-mesh co-resident
@@ -374,6 +506,10 @@ class ECBackend:
         self.acting = list(acting)
         self.local_shard = local_shard
         self.send = send
+        #: OSD-id addressed send for pushes outside the acting set
+        #: (EC backfill targets); shard-index send covers everything
+        #: else
+        self.send_osd = send_osd or (lambda _osd, _msg: False)
         self.epoch = epoch
         self.last_version = ZERO_VERSION
         self.committed_to = ZERO_VERSION
@@ -1054,24 +1190,32 @@ class ECBackend:
     # recovery (ref: ECBackend.cc:735 recover_object,
     #           :567 continue_recovery_op)
     # ==================================================================
-    def recover_object(self, oid: str, target_shards: Iterable[int],
-                       on_done: Callable, version=None) -> None:
+    def recover_object(self, oid: str, target_shards,
+                       on_done: Callable, version=None,
+                       target_osds: dict | None = None) -> None:
         """Reconstruct `oid`'s chunks on target shards and push them.
 
         `version`: the authoritative object version to stamp on the
         rebuilt shards.  Callers whose pg_log was rebuilt (daemon
         peering/scrub) MUST pass it — the local prior-version fallback
-        is only correct while the primary's log is intact."""
+        is only correct while the primary's log is intact.
+
+        `target_osds`: optional {shard_index: osd} override for
+        pushes outside the acting set — the EC backfill case, where a
+        temp primary rebuilds chunks for the UP set's shards while
+        the old acting set still serves (ref: ECBackend recovery
+        pushing to backfill targets)."""
         targets = sorted(set(target_shards))
         # read enough shards (+ attrs) to rebuild the logical object
         self.objects_read_and_reconstruct(
             {oid: None}, lambda r, e, a=None: self._recovery_reads_done(
-                oid, targets, r, e, on_done, version, a),
+                oid, targets, r, e, on_done, version, a, target_osds),
             for_recovery=True, want_attrs=True)
 
     def _recovery_reads_done(self, oid: str, targets, results, errors,
                              on_done, version=None,
-                             shard_attrs=None) -> None:
+                             shard_attrs=None,
+                             target_osds=None) -> None:
         if errors.get(oid) or oid not in results:
             on_done(False)
             return
@@ -1089,8 +1233,21 @@ class ECBackend:
                 best = (ver, mut.user_xattrs(a))
         if best is not None:
             user_attrs = best[1]
+        self.push_rebuilt(oid, results[oid], targets, on_done,
+                          version=version, user_attrs=user_attrs,
+                          target_osds=target_osds)
+
+    def push_rebuilt(self, oid: str, logical: bytes, targets,
+                     on_done: Callable, version=None,
+                     user_attrs: dict | None = None,
+                     target_osds: dict | None = None) -> None:
+        """Encode a rebuilt logical object and push its chunks to
+        `targets` (shard indexes).  `target_osds` optionally overrides
+        the destination OSD per shard — the EC peering statechart's
+        backfill path rebuilds from cross-set sources and pushes to
+        up-set shards outside the current acting set."""
+        user_attrs = user_attrs or {}
         with self._lock:
-            logical = results[oid]
             # re-encode the full object: every shard's chunk stream
             width = self.sinfo.stripe_width
             padded = logical + b"\0" * (-len(logical) % width)
@@ -1122,6 +1279,7 @@ class ECBackend:
                     on_done(state["ok"])
 
             self._recovery_cbs = getattr(self, "_recovery_cbs", {})
+            osd_map = dict(target_osds) if target_osds else None
             if not targets:
                 on_done(True)
                 return
@@ -1140,10 +1298,21 @@ class ECBackend:
                               for k, v in user_attrs.items()}}))
                 tid = self._next_tid()
                 msg = ECSubWrite(pgid=self.pgid, tid=tid, shard=s,
-                                 txn=txn, log_entries=[])
-                if self.acting[s] == self.whoami:
+                                 txn=txn, log_entries=[], oid=oid,
+                                 guard_version=(version.epoch,
+                                                version.version))
+                dest = osd_map.get(s) if osd_map else (
+                    self.acting[s] if s < len(self.acting) else -1)
+                if dest == self.whoami and \
+                        s == self.local_shard.shard:
                     rep = self.local_shard.handle_sub_write(msg)
                     reply_cb(s, rep.committed)
+                elif osd_map is not None:
+                    self._recovery_cbs[tid] = (s, reply_cb)
+                    if dest is None or dest < 0 or not self.send_osd(
+                            dest, msg):
+                        self._recovery_cbs.pop(tid, None)
+                        reply_cb(s, False)
                 else:
                     self._recovery_cbs[tid] = (s, reply_cb)
                     if not self.send(s, msg):

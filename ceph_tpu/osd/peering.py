@@ -47,9 +47,11 @@ the primary's _PGState:
   a temp primary clears its pg_temp override, flipping the map back
   to the true up set.
 
-EC pools keep the inventory-scan recovery path (`daemon._ec_recover`)
-— their shard-wise version reconciliation already converges per
-(object, shard index); this statechart owns the replicated world.
+EC pools run the same phase machine with shard-aware semantics in
+`osd/ec_peering.py` (ECPGPeering): per-shard pg_info from durable EC
+shard logs, cross-set chunk sources, and reservation-gated chunk
+backfill — sharing this module's phase constants, the daemon's
+reservation pools, and the pg_temp plumbing.
 """
 from __future__ import annotations
 

@@ -79,6 +79,10 @@ class BlueStore(ObjectStore):
         self.mounted = False
         self._lock = threading.RLock()
         self._block = None
+        #: device-health feed (ref: the SMART-style error counters
+        #: mgr/devicehealth consumes): csum mismatches and read
+        #: errors observed on this store's media
+        self.media_errors = {"csum_errors": 0, "read_errors": 0}
         self.db: KeyValueDB | None = None
         # in-memory metadata mirror (metadata only — data stays on disk)
         self._colls: dict[str, dict[ObjectId, dict]] = {}
@@ -385,6 +389,7 @@ class BlueStore(ObjectStore):
             raise StoreError("EIO", f"missing blob {blob_id}")
         stored = self._read_stored(b)
         if crc32c(0, stored) != b["csum"]:
+            self.media_errors["csum_errors"] += 1
             raise StoreError("EIO", f"blob {blob_id} checksum mismatch")
         if b.get("comp") is not None:
             return comp_mod.decompress(stored)
@@ -420,6 +425,7 @@ class BlueStore(ObjectStore):
         with self._lock:
             if ((cid, oid) in self._read_err_objs and
                     global_config()["objectstore_debug_inject_read_err"]):
+                self.media_errors["read_errors"] += 1
                 raise StoreError("EIO", f"injected read error {cid}/{oid}")
             return self._read_onode(self._obj(cid, oid), off, length)
 

@@ -146,7 +146,9 @@ def run_mds(args) -> int:
     mds.init()
     print(f"mds.{args.rank}: serving on "
           f"{mm['addrs'][f'mds.{args.rank}']}", flush=True)
-    _serve(lambda: None, interval=1.0)
+    # the tick drives the load balancer (heat decay, load publication,
+    # hot-subtree export) — without it the mds_bal_* machinery is dead
+    _serve(lambda: mds.tick(), interval=1.0)
     mds.shutdown()
     r.shutdown()
     return 0

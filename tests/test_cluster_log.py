@@ -1,0 +1,176 @@
+"""Cluster log: LogClient -> mon LogMonitor through paxos (VERDICT r4
+#4; ref: src/common/LogClient.cc, src/mon/LogMonitor.cc).
+
+Acceptance: osd failure, scrub inconsistency, and repair outcome all
+appear in `log last`, surviving mon failover."""
+import time
+
+import pytest
+
+from ceph_tpu.common.options import global_config
+from ceph_tpu.store import ObjectId, Transaction
+from ceph_tpu.testing import MiniCluster
+
+
+def locate(c, r, pool_name, oid):
+    pid = r.pool_lookup(pool_name)
+    m = c.mon.osdmap
+    raw = m.object_locator_to_pg(oid, pid)
+    pg = m.pools[pid].raw_pg_to_pg(raw)
+    _, _, acting, primary = m.pg_to_up_acting_osds(raw)
+    return pid, pg, acting, primary
+
+
+def log_last(r, n=50, level="debug"):
+    rc, outs, out = r.mon_command({"prefix": "log last", "num": n,
+                                   "level": level})
+    assert rc == 0, outs
+    return out
+
+
+def test_operator_log_and_log_last():
+    c = MiniCluster(n_osd=3, threaded=False)
+    c.pump()
+    c.wait_all_up()
+    r = c.rados()
+    try:
+        rc, outs, _ = r.mon_command({"prefix": "log",
+                                     "logtext": "hello cluster"})
+        assert rc == 0, outs
+        c.pump()
+        entries = log_last(r)
+        assert any(e["text"] == "hello cluster" for e in entries)
+        # level filter drops info entries
+        assert not any(e["text"] == "hello cluster"
+                       for e in log_last(r, level="error"))
+        # counts surface for prometheus
+        rc, _, counts = r.mon_command({"prefix": "log counts"})
+        assert rc == 0 and counts.get("info", 0) >= 1
+    finally:
+        c.shutdown()
+
+
+def test_daemon_clog_flush_and_ack():
+    """An OSD's clog entry reaches `log last` via the tick flush and
+    the ack trims the client buffer (resends dedup by seq)."""
+    c = MiniCluster(n_osd=3, threaded=False)
+    c.pump()
+    c.wait_all_up()
+    r = c.rados()
+    try:
+        d = c.osds[0]
+        d.clog.warn("something odd happened")
+        assert d.clog.pending() == 1
+        for i in range(6):
+            c.tick(100.0 + i)
+        entries = log_last(r)
+        assert any(e["text"] == "something odd happened" and
+                   e["name"] == "osd.0" and e["level"] == "warn"
+                   for e in entries)
+        assert d.clog.pending() == 0, "ack never trimmed the buffer"
+        # duplicate-flush storm must not duplicate the entry
+        d.clog.flush()
+        c.pump()
+        n = sum(1 for e in log_last(r)
+                if e["text"] == "something odd happened")
+        assert n == 1
+    finally:
+        c.shutdown()
+
+
+def test_osd_failure_scrub_and_repair_in_log():
+    """The acceptance triple: a failed OSD, a scrub inconsistency,
+    and its repair outcome all land in the cluster log with no
+    operator log commands."""
+    from ceph_tpu.osd.ec_backend import pg_cid
+    g = global_config()
+    saved = {k: g[k] for k in ("osd_scrub_min_interval",
+                               "osd_deep_scrub_interval")}
+    g.set("osd_scrub_min_interval", 30.0)
+    g.set("osd_deep_scrub_interval", 60.0)
+    c = MiniCluster(n_osd=4, threaded=False)
+    c.pump()
+    c.wait_all_up()
+    r = c.rados()
+    try:
+        r.pool_create("p", pg_num=4)
+        io = r.open_ioctx("p")
+        payload = b"log-me" * 700
+        io.write_full("victim", payload)
+        c.pump()
+        _pid, pg, acting, primary = locate(c, r, "p", "victim")
+        replica = next(o for o in acting if o != primary)
+        c.osds[replica].store.queue_transaction(
+            Transaction().write(pg_cid(pg), ObjectId("victim"), 0,
+                                b"ROTROTRO"))
+        # kill an uninvolved osd so the failure report line appears
+        dead = next(o for o in range(4)
+                    if o not in acting and o != primary)
+        c.kill_osd(dead)
+        t = 1000.0
+        for i in range(50):
+            t += 5.0
+            c.tick(t)
+            if c.mon.osdmap.is_down(dead) and \
+                    c.osds[replica].pgs[pg].shard.read("victim") == \
+                    payload:
+                break
+        # let the repair's clog line flush + commit
+        for i in range(6):
+            t += 5.0
+            c.tick(t)
+        texts = [e["text"] for e in log_last(r, n=100)]
+        assert any(f"osd.{dead} marked down" in t_ for t_ in texts), \
+            texts
+        assert any("inconsistent" in t_ and str(pg) in t_
+                   for t_ in texts), texts
+        assert any("repaired and re-verified" in t_
+                   for t_ in texts), texts
+    finally:
+        for k, v in saved.items():
+            g.set(k, v)
+        c.shutdown()
+
+
+def test_log_survives_mon_failover():
+    """Entries committed through paxos answer identically from the
+    surviving quorum after the leader dies."""
+    c = MiniCluster(n_osd=3, n_mon=3, threaded=False)
+    c.pump()
+    c.wait_all_up()
+    r = c.rados()
+    try:
+        rc, outs, _ = r.mon_command({"prefix": "log",
+                                     "logtext": "before failover"})
+        assert rc == 0, outs
+        c.pump()
+        assert any(e["text"] == "before failover"
+                   for e in log_last(r))
+        leader = next(m for m in c.mons.values() if m.is_leader)
+        c.kill_mon(leader.rank)
+        t = 2000.0
+        for i in range(10):
+            t += 3.0
+            c.tick(t)
+        # the first command after the kill may time out while the
+        # client hunts to a live mon — that's the reconnect, not the
+        # log; retry a few times
+        deadline = time.monotonic() + 90
+        entries = None
+        while time.monotonic() < deadline:
+            t += 3.0
+            c.tick(t)
+            try:
+                rc, _, out = r.mon_command({"prefix": "log last",
+                                            "num": 50,
+                                            "level": "debug"})
+                if rc == 0 and any(e["text"] == "before failover"
+                                   for e in out):
+                    entries = out
+                    break
+            except Exception:
+                pass
+        assert entries is not None, \
+            "log last never answered after failover"
+    finally:
+        c.shutdown()

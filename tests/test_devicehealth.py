@@ -1,0 +1,81 @@
+"""mgr devicehealth-lite: BlueStore media errors -> device records ->
+DEVICE_HEALTH warning + progress event + cluster log (VERDICT r4 #9;
+ref: src/pybind/mgr/devicehealth/module.py)."""
+import os
+
+import pytest
+
+from ceph_tpu.testing import MiniCluster
+
+
+def test_bluestore_counts_media_errors(tmp_path):
+    """The feed itself: csum mismatches and injected read errors bump
+    the store's media_errors counters."""
+    from ceph_tpu.common.options import global_config
+    from ceph_tpu.store import BlueStore, ObjectId, StoreError, \
+        Transaction
+    bs = BlueStore(str(tmp_path / "bs"))
+    bs.mkfs()
+    bs.mount()
+    try:
+        bs.queue_transaction(Transaction()
+                             .create_collection("c")
+                             .write("c", ObjectId("x"), 0, b"payload"))
+        assert bs.read("c", ObjectId("x")) == b"payload"
+        assert bs.media_errors["csum_errors"] == 0
+        bs.corrupt_blob_bytes("c", ObjectId("x"), b"ROT")
+        with pytest.raises(StoreError):
+            bs.read("c", ObjectId("x"))
+        assert bs.media_errors["csum_errors"] == 1
+    finally:
+        bs.umount()
+
+
+def test_devicehealth_module_end_to_end():
+    """Errors on an OSD's store surface as a DEVICE_HEALTH warning in
+    `ceph health`, a progress event, and a cluster-log line."""
+    c = MiniCluster(n_osd=3, threaded=True)
+    c.wait_all_up()
+    r = c.rados()
+    try:
+        mgr = c.start_mgr()
+        mgr.start_progress()
+        dh = mgr.start_devicehealth()
+        # healthy pass: no checks
+        c.tick(10.0)
+        mgr.devicehealth_tick()
+        c.pump()
+        assert all(d["health"] == "GOOD" for d in dh.ls())
+        rc, _, health = r.mon_command({"prefix": "health"})
+        assert "DEVICE_HEALTH" not in health["checks"]
+        # inject media errors on osd.1's store (the BlueStore feed,
+        # simulated at the counter level so the cluster can run on
+        # the default memstore)
+        c.osds[1].store.media_errors = {"csum_errors": 3,
+                                        "read_errors": 1}
+        c.tick(20.0)       # stat report carries the counters
+        c.pump()
+        mgr.devicehealth_tick()
+        c.pump()
+        rec = dh.get_health("osd.1-dev")
+        assert rec is not None and rec["health"] == "WARNING"
+        assert rec["csum_errors"] == 3 and rec["read_errors"] == 1
+        # health check reached the mon
+        rc, _, health = r.mon_command({"prefix": "health"})
+        assert rc == 0
+        assert "DEVICE_HEALTH" in health["checks"], health
+        # progress event recorded (completed immediately)
+        assert any("devicehealth" in e["message"]
+                   for e in mgr.progress.history())
+        # cluster log line landed
+        c.pump()
+        rc, _, entries = r.mon_command({"prefix": "log last",
+                                        "num": 20, "level": "warn"})
+        assert rc == 0
+        assert any("osd.1-dev" in e["text"] for e in entries), entries
+        # prometheus surfaces the per-device severity
+        text = mgr.start_prometheus(port=0).collect()
+        assert "ceph_device_health" in text
+        mgr.prometheus.shutdown()
+    finally:
+        c.shutdown()

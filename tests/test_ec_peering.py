@@ -1,0 +1,236 @@
+"""EC-pool peering statechart: shard-aware GetInfo/GetLog, durable EC
+shard logs, reservation-gated chunk backfill, and — the round-5
+headline — pgp_num growth on erasure pools, where reseeded children
+rebuild from the prior interval's holders (VERDICT r4 #1; ref:
+src/osd/PG.h:2085-2195 governing EC and replicated PGs identically,
+src/osd/ECBackend.cc:735,567)."""
+import numpy as np
+import pytest
+
+from ceph_tpu.osd.types import PG
+from ceph_tpu.store import ObjectId
+from ceph_tpu.testing import MiniCluster, OSDThrasher
+
+
+def make_cluster(n=7, pg_num=8):
+    c = MiniCluster(n_osd=n, threaded=False)
+    c.pump()
+    c.wait_all_up()
+    r = c.rados()
+    r.mon_command({"prefix": "osd erasure-code-profile set",
+                   "name": "k2m2",
+                   "profile": {"plugin": "tpu", "k": "2", "m": "2",
+                               "crush-failure-domain": "host"}})
+    r.pool_create("ec", pg_num=pg_num, pool_type="erasure",
+                  erasure_code_profile="k2m2")
+    c.pump()
+    return c, r
+
+
+def wait_clean(c, rounds=60):
+    for _ in range(rounds):
+        c.pump()
+        if all(d.pgs_recovering() == 0 for d in c.osds.values()):
+            return
+    raise TimeoutError("EC peering never went clean")
+
+
+def write_corpus(io, n=24, seed=5):
+    rng = np.random.default_rng(seed)
+    objs = {f"p{i:03d}": rng.integers(0, 256, 2000 + 37 * i,
+                                      dtype=np.uint8).tobytes()
+            for i in range(n)}
+    for oid, data in objs.items():
+        io.write_full(oid, data)
+    return objs
+
+
+def test_ec_shard_log_durable():
+    """EC sub-writes land in the pgmeta omap; a reconstructed shard
+    object reloads real log bounds (the GetInfo/GetLog phases depend
+    on this — an empty post-restart log would force a full walk)."""
+    from ceph_tpu.osd.ec_backend import ECPGShard
+    c, r = make_cluster(n=4)
+    io = r.open_ioctx("ec")
+    io.write_full("durable", b"x" * 5000)
+    io.write_full("durable2", b"y" * 3000)
+    c.pump()
+    pid = r.pool_lookup("ec")
+    m = c.mon.osdmap
+    raw = m.object_locator_to_pg("durable", pid)
+    pg = m.pools[pid].raw_pg_to_pg(raw)
+    _, _, acting, _ = m.pg_to_up_acting_osds(raw)
+    osd = next(o for o in acting if 0 <= o < (1 << 30))
+    live = c.osds[osd].pgs[pg].shard
+    head, tail = live.log_info()
+    assert head.version > 0
+    # a FRESH shard object over the same store sees the same bounds
+    reloaded = ECPGShard(pg, live.shard, c.osds[osd].store, 2, 2,
+                         create=False)
+    assert reloaded.log_info() == (head, tail)
+    assert len(reloaded.pg_log.log.entries) == \
+        len(live.pg_log.log.entries)
+    c.shutdown()
+
+
+def test_ec_peering_phases_run():
+    """An acting change drives the statechart through its phases and
+    the PG carries an ECPGPeering (not the legacy scan)."""
+    from ceph_tpu.osd.ec_peering import ECPGPeering
+    from ceph_tpu.osd.peering import CLEAN
+    c, r = make_cluster()
+    io = r.open_ioctx("ec")
+    objs = write_corpus(io, n=8)
+    c.pump()
+    r.mon_command({"prefix": "osd out", "ids": [0]})
+    wait_clean(c)
+    found = 0
+    for d in c.osds.values():
+        for st in d.pgs.values():
+            if st.backend is not None and st.peering is not None:
+                assert isinstance(st.peering, ECPGPeering)
+                assert st.peering.phase == CLEAN
+                found += 1
+    assert found > 0, "no EC primary ran the statechart"
+    for oid, data in objs.items():
+        assert io.read(oid) == data, oid
+    c.shutdown()
+
+
+def test_ec_pgp_num_growth_rebalances():
+    """THE unlock: grow pg_num + pgp_num on an EC pool; reseeded
+    children rebuild their shards from the prior interval's holders
+    and every object survives (mon refusal dropped,
+    mon/osd_monitor.py; ref: OSDMonitor pgp_num growth)."""
+    c, r = make_cluster(pg_num=4)
+    io = r.open_ioctx("ec")
+    objs = write_corpus(io, n=32, seed=9)
+    c.pump()
+    rc, out, _ = r.mon_command({"prefix": "osd pool set", "pool": "ec",
+                                "var": "pg_num", "val": "8"})
+    assert rc == 0, out
+    wait_clean(c)
+    rc, out, _ = r.mon_command({"prefix": "osd pool set", "pool": "ec",
+                                "var": "pgp_num", "val": "8"})
+    assert rc == 0, out     # must no longer be refused for EC
+    wait_clean(c, rounds=120)
+    for oid, data in objs.items():
+        assert io.read(oid) == data, oid
+    # every acting shard of every object's CURRENT placement holds its
+    # chunk at the authoritative version (data really moved, not just
+    # readable-from-strays)
+    pid = r.pool_lookup("ec")
+    m = c.mon.osdmap
+    assert m.pools[pid].pgp_num == 8
+    for oid in objs:
+        raw = m.object_locator_to_pg(oid, pid)
+        pg = m.pools[pid].raw_pg_to_pg(raw)
+        _, _, acting, _ = m.pg_to_up_acting_osds(raw)
+        for s, osd in enumerate(acting):
+            if osd < 0 or osd >= (1 << 30):
+                continue
+            st = c.osds[osd].pgs.get(pg)
+            assert st is not None, (oid, pg, osd)
+            assert st.shard.store.exists(
+                st.shard.cid, ObjectId(oid, shard=s)), (oid, s, osd)
+    c.shutdown()
+
+
+def test_ec_pgp_growth_under_io_and_thrashing():
+    """The autoscaler acceptance shape: grow pg_num+pgp_num while
+    client IO keeps writing and a thrasher flaps an OSD — everything
+    converges and reads back."""
+    c, r = make_cluster(pg_num=4)
+    io = r.open_ioctx("ec")
+    objs = write_corpus(io, n=16, seed=3)
+    c.pump()
+    r.mon_command({"prefix": "osd pool set", "pool": "ec",
+                   "var": "pg_num", "val": "8"})
+    c.pump()
+    r.mon_command({"prefix": "osd pool set", "pool": "ec",
+                   "var": "pgp_num", "val": "8"})
+    # interleave: writes + a mid-flight out/in while backfill runs
+    rng = np.random.default_rng(21)
+    for i in range(8):
+        data = rng.integers(0, 256, 1500 + i, dtype=np.uint8).tobytes()
+        objs[f"live{i}"] = data
+        try:
+            io.write_full(f"live{i}", data)
+        except Exception:
+            # ESTALE-parked during a peering window: retry once clean
+            wait_clean(c)
+            io.write_full(f"live{i}", data)
+        c.pump()
+        if i == 3:
+            r.mon_command({"prefix": "osd out", "ids": [2]})
+        if i == 6:
+            r.mon_command({"prefix": "osd in", "ids": [2]})
+    wait_clean(c, rounds=180)
+    for oid, data in objs.items():
+        assert io.read(oid) == data, oid
+    c.shutdown()
+
+
+def test_ec_autoscaler_grows_ec_pool():
+    """pg_autoscaler acceptance: the mgr module itself raises
+    pg_num AND pgp_num on an EC pool (the round-4 code refused the
+    pgp leg) and the cluster converges."""
+    from ceph_tpu.mgr.pg_autoscaler import PGAutoscaler
+    c, r = make_cluster(pg_num=4)
+    io = r.open_ioctx("ec")
+    objs = write_corpus(io, n=12, seed=17)
+    c.pump()
+    pid = r.pool_lookup("ec")
+
+    class _Mgr:
+        osdmap = None
+
+        def _command(self, cmd):
+            return r.mon_command(cmd)
+    mgr = _Mgr()
+    mgr.osdmap = c.mon.osdmap
+    auto = PGAutoscaler(mgr)
+    # big logical usage -> the planner wants more PGs
+    for _ in range(6):
+        mgr.osdmap = c.mon.osdmap
+        auto.tick(pool_bytes={pid: 1 << 30})
+        c.pump()
+        wait_clean(c, rounds=120)
+    m = c.mon.osdmap
+    assert m.pools[pid].pg_num > 4, "autoscaler never grew the pool"
+    assert m.pools[pid].pgp_num == m.pools[pid].pg_num, \
+        "pgp_num did not follow pg_num on the EC pool"
+    for oid, data in objs.items():
+        assert io.read(oid) == data, oid
+    c.shutdown()
+
+
+def test_ec_backfill_reservations_exercised():
+    """EC backfill rides the same reservation pools as replicated:
+    throttled at osd_max_backfills on both ends, and actually
+    exercised by a reseed."""
+    from ceph_tpu.common.options import global_config
+    g = global_config()
+    old = g["osd_max_backfills"]
+    g.set("osd_max_backfills", 1)
+    try:
+        c, r = make_cluster(pg_num=4)
+        io = r.open_ioctx("ec")
+        objs = write_corpus(io, n=24, seed=29)
+        c.pump()
+        r.mon_command({"prefix": "osd pool set", "pool": "ec",
+                       "var": "pg_num", "val": "8"})
+        c.pump()
+        r.mon_command({"prefix": "osd pool set", "pool": "ec",
+                       "var": "pgp_num", "val": "8"})
+        wait_clean(c, rounds=240)
+        for d in c.osds.values():
+            assert d.bf_peak_local <= 1
+            assert d.bf_peak_remote <= 1
+        assert any(d.bf_peak_local >= 1 for d in c.osds.values()), \
+            "no EC backfill took a local reservation"
+        for oid, data in objs.items():
+            assert io.read(oid) == data, oid
+    finally:
+        g.set("osd_max_backfills", old)
+        c.shutdown()

@@ -1,0 +1,145 @@
+"""Automatic scrub scheduling + verified repair (VERDICT r4 #3; ref:
+OSD::sched_scrub src/osd/OSD.cc:7581, PG::sched_scrub
+src/osd/PG.cc:4276, osd_scrub_min_interval family
+src/common/options.cc:3351, scrub reservations OSD.cc:1323-1341).
+
+The acceptance shape: an idle cluster scrubs itself on the heartbeat
+tick; bitrot injected under the stack is detected, repaired, AND
+re-verified with no operator command."""
+import pytest
+
+from ceph_tpu.common.options import global_config
+from ceph_tpu.osd.types import PG
+from ceph_tpu.store import ObjectId, Transaction
+from ceph_tpu.testing import MiniCluster
+
+
+def locate(c, r, pool_name, oid):
+    pid = r.pool_lookup(pool_name)
+    m = c.mon.osdmap
+    raw = m.object_locator_to_pg(oid, pid)
+    pg = m.pools[pid].raw_pg_to_pg(raw)
+    _, _, acting, primary = m.pg_to_up_acting_osds(raw)
+    return pid, pg, acting, primary
+
+
+@pytest.fixture()
+def cluster():
+    g = global_config()
+    saved = {k: g[k] for k in ("osd_scrub_min_interval",
+                               "osd_deep_scrub_interval",
+                               "osd_max_scrubs")}
+    # sim-clock friendly intervals: ticks advance seconds, not days
+    g.set("osd_scrub_min_interval", 30.0)
+    g.set("osd_deep_scrub_interval", 60.0)
+    c = MiniCluster(n_osd=4, threaded=False)
+    c.pump()
+    c.wait_all_up()
+    r = c.rados()
+    r.pool_create("p", pg_num=4)
+    c.pump()
+    yield c, r
+    for k, v in saved.items():
+        g.set(k, v)
+    c.shutdown()
+
+
+def run_idle(c, t0, ticks, step=5.0):
+    for i in range(ticks):
+        c.tick(t0 + i * step)
+    return t0 + ticks * step
+
+
+def test_idle_cluster_scrubs_itself(cluster):
+    """Stamps advance on every primary PG with ZERO operator
+    commands — the tick alone schedules, reserves, and runs scrubs."""
+    c, r = cluster
+    io = r.open_ioctx("p")
+    for i in range(8):
+        io.write_full(f"o{i}", bytes([i]) * 512)
+    c.pump()
+    t = run_idle(c, 1000.0, 4)          # seed stamps (jittered)
+    seeded = {}
+    for d in c.osds.values():
+        for pg, st in d.pgs.items():
+            if st.backend is not None:
+                assert st.last_scrub_stamp is not None
+                seeded[pg] = st.last_scrub_stamp
+    assert seeded, "no primary PGs"
+    # advance WELL past min_interval: every primary PG re-scrubs
+    t = run_idle(c, t + 100.0, 30)
+    for d in c.osds.values():
+        for pg, st in d.pgs.items():
+            if st.backend is not None and pg in seeded:
+                assert st.last_scrub_stamp > seeded[pg], \
+                    f"pg {pg} never auto-scrubbed"
+
+
+def test_auto_scrub_respects_max_scrubs(cluster):
+    """Replica-side reservations bound concurrency at
+    osd_max_scrubs even when every PG comes due at once."""
+    c, r = cluster
+    io = r.open_ioctx("p")
+    for i in range(8):
+        io.write_full(f"m{i}", bytes([i + 1]) * 256)
+    c.pump()
+    t = run_idle(c, 2000.0, 4)
+    run_idle(c, t + 200.0, 30)
+    limit = global_config()["osd_max_scrubs"]
+    for d in c.osds.values():
+        assert d.scrub_peak_remote <= limit, \
+            f"{d.name} served {d.scrub_peak_remote} concurrent scrubs"
+    assert any(d.scrub_peak_remote >= 1 for d in c.osds.values()), \
+        "no scrub ever took a replica reservation"
+
+
+def test_bitrot_detected_repaired_verified_no_operator(cluster):
+    """THE acceptance: corrupt a replica under the stack; the
+    scheduled deep scrub detects it, auto-repairs from the
+    authoritative copy, and a chained verify round proves the fix —
+    all from ticks, no pg_scrub command anywhere."""
+    from ceph_tpu.osd.ec_backend import pg_cid
+    c, r = cluster
+    io = r.open_ioctx("p")
+    payload = b"precious" * 512
+    io.write_full("victim", payload)
+    c.pump()
+    _pid, pg, acting, primary = locate(c, r, "p", "victim")
+    replica = next(o for o in acting if o != primary)
+    c.osds[replica].store.queue_transaction(
+        Transaction().write(pg_cid(pg), ObjectId("victim"), 0,
+                            b"BITROT!!"))
+    assert c.osds[replica].pgs[pg].shard.read("victim")[:8] == \
+        b"BITROT!!"
+    # seed stamps, then cross the DEEP interval so the scheduled
+    # scrub runs deep (crc compare catches the rot)
+    t = run_idle(c, 3000.0, 4)
+    run_idle(c, t + 200.0, 40)
+    assert c.osds[replica].pgs[pg].shard.read("victim") == payload, \
+        "bitrot was not auto-repaired"
+    # the repairing primary verified in-round and went clean: stamps
+    # advanced past the detection pass
+    st = c.osds[primary].pgs[pg]
+    assert st.scrub is None
+    assert st.last_deep_scrub_stamp is not None
+
+
+def test_manual_scrub_still_works_and_reports_verified(cluster):
+    """The operator command path coexists with the scheduler and a
+    repair now reports the verify round's outcome."""
+    from ceph_tpu.osd.ec_backend import pg_cid
+    c, r = cluster
+    io = r.open_ioctx("p")
+    io.write_full("manual", b"m" * 2048)
+    c.pump()
+    pid, pg, acting, primary = locate(c, r, "p", "manual")
+    replica = next(o for o in acting if o != primary)
+    c.osds[replica].store.queue_transaction(
+        Transaction().write(pg_cid(pg), ObjectId("manual"), 0,
+                            b"ROT"))
+    res = r.pg_scrub(pid, pg.ps, repair=True)
+    c.pump()
+    assert res["inconsistent"] == ["manual"]
+    assert res.get("verified") is True
+    assert res["repaired"] == 1 and not res["unrepairable"]
+    assert c.osds[replica].pgs[pg].shard.read("manual") == b"m" * 2048

@@ -346,9 +346,17 @@ class FileHandle:
             return
         if self._oc is not None:
             self._oc.flush()
-        self.fs._session.call("setattr", {"path": self.path,
-                                          "size": self.size,
-                                          "grow_only": True})
+        try:
+            self.fs._session.call("setattr", {"path": self.path,
+                                              "size": self.size,
+                                              "grow_only": True})
+        except CephFSError as e:
+            # the path was renamed/unlinked under this open handle
+            # (POSIX-legal): the data is flushed; the size record
+            # moved with the dentry and was captured by the rename's
+            # revoke-and-wait, so there is nothing left to update
+            if e.errno_name != "ENOENT":
+                raise
         self._dirty_size = False
 
     def close(self) -> None:

@@ -41,6 +41,7 @@ nlink reaches 0 -> the itable entry dies and the client purges data.
 """
 from __future__ import annotations
 
+import itertools
 import json
 import threading
 import time
@@ -61,6 +62,17 @@ SNAPTABLE_OBJ = "mds.snaptable"
 #: maintain + the ceph.dir.pin export pin): omap key = normalized
 #: directory path -> owning rank; longest prefix wins, "/" -> 0
 SUBTREE_OBJ = "mds.subtrees"
+#: balancer-made subtree assignments (ref: MDBalancer's export
+#: decisions): same shape as SUBTREE_OBJ; explicit pins override on
+#: path conflicts and are never auto-migrated
+AUTO_SUBTREE_OBJ = "mds.auto_subtrees"
+#: per-rank load publication for the balancer (ref: mds_load_t
+#: exchanged via MHeartbeat in src/mds/MDBalancer.cc)
+LOAD_OBJ = "mds.load"
+#: in-flight cross-rank rename intents (ref: the slave-request
+#: journaling Server::handle_client_rename does for multi-rank
+#: renames): omap key = intent id -> json{src, dst, dent, dst_rank}
+XRENAME_OBJ = "mds.xrename"
 #: per-rank inode-number spaces (ref: each rank's InoTable range):
 #: ino = (rank << INO_RANK_SHIFT) | n, so allocations never collide
 INO_RANK_SHIFT = 48
@@ -84,6 +96,16 @@ class MDSForward(Exception):
     def __init__(self, rank: int):
         self.rank = rank
         super().__init__(f"forward to mds.{rank}")
+
+
+class _CrossRankRename(Exception):
+    """A rename whose source we own but whose destination another
+    rank owns: handled off the dispatch thread through the two-phase
+    slave protocol (ref: Server::handle_client_rename:7310 +
+    Migrator.h:51 slave requests)."""
+
+    def __init__(self, dst_rank: int):
+        self.dst_rank = dst_rank
 
 
 def snap_dir_obj(snapid: int, ino: int) -> str:
@@ -160,6 +182,15 @@ class MDSDaemon(Dispatcher):
         self._subtree_cache_at = 0.0
         self._pending_revokes: list[tuple[str, MClientCaps]] = []
         self._revoking: dict[tuple[int, str], float] = {}
+        # MDS-to-MDS slave calls (cross-rank rename): tid -> (event,
+        # reply slot); replies ride MClientReply like client traffic
+        self._peer_tids = itertools.count(1)
+        self._peer_pending: dict[int, tuple] = {}
+        # balancer heat: top-level dir -> decayed op count
+        # (ref: MDBalancer's per-subtree load)
+        self._heat: dict[str, float] = {}
+        self._ops_handled = 0
+        self._last_bal = 0.0
         self._mkfs_or_replay()
         # subtree-table invalidation channel: set_pin on any rank
         # notifies every MDS to drop its cached pin table
@@ -186,6 +217,10 @@ class MDSDaemon(Dispatcher):
 
     def init(self) -> None:
         self.ms.start()
+        # finish coordinator-crashed cross-rank renames off-thread
+        # (the slave call needs the messenger live)
+        threading.Thread(target=self._recover_xrenames,
+                         daemon=True).start()
 
     def shutdown(self) -> None:
         with self._lock:
@@ -639,13 +674,27 @@ class MDSDaemon(Dispatcher):
                 now - self._subtree_cache_at < self._SUBTREE_TTL:
             return cached
         try:
-            vals, _ = self.meta.get_omap_vals(SUBTREE_OBJ)
+            vals, _ = self.meta.get_omap_vals(AUTO_SUBTREE_OBJ)
             table = {k: int(v) for k, v in vals.items()}
         except RadosError:
             table = {}
+        try:
+            vals, _ = self.meta.get_omap_vals(SUBTREE_OBJ)
+            # explicit pins overwrite balancer assignments on the
+            # same path (pins are the operator's override)
+            table.update({k: int(v) for k, v in vals.items()})
+        except RadosError:
+            pass
         self._subtree_cache = table
         self._subtree_cache_at = now
         return table
+
+    def _explicit_pins(self) -> dict[str, int]:
+        try:
+            vals, _ = self.meta.get_omap_vals(SUBTREE_OBJ)
+            return {k: int(v) for k, v in vals.items()}
+        except RadosError:
+            return {}
 
     def _subtree_notify(self, notify_id=None, notifier=None,
                         payload=None):
@@ -684,8 +733,15 @@ class MDSDaemon(Dispatcher):
         auth = self._authority(path)
         dst = a.get("dst")
         if dst is not None and self._authority(dst) != auth:
-            # cross-rank rename/link would need the reference's slave
-            # request machinery
+            if op == "rename":
+                # the SOURCE authority coordinates a two-phase
+                # cross-rank rename (ref: Server::handle_client_rename
+                # with remote witnesses); anyone else forwards there
+                if auth != self.rank:
+                    raise MDSForward(auth)
+                raise _CrossRankRename(self._authority(dst))
+            # cross-rank hardlink would additionally need remote-link
+            # refcounting through the slave machinery
             raise MDSError("EXDEV", "paths belong to different ranks")
         if auth != self.rank:
             raise MDSForward(auth)
@@ -717,9 +773,18 @@ class MDSDaemon(Dispatcher):
         if target < 0:
             raise MDSError("EINVAL", f"rank {target}")
         path = self._norm(a["path"])
+        self._do_pin(dent, path, target, SUBTREE_OBJ)
+        return {"path": path, "rank": target}
+
+    def _do_pin(self, dent: dict, path: str, target: int,
+                table_obj: str) -> None:
+        """Migrate a subtree's authority into `table_obj` (explicit
+        pin table or the balancer's): journal the entry, persist, and
+        evict our caps/open state under it — clients re-acquire
+        through the new rank on their next forwarded op."""
         self._journal("set_pin", [
-            ("mkobj", SUBTREE_OBJ),
-            ("set", SUBTREE_OBJ, {path: str(target)})])
+            ("mkobj", table_obj),
+            ("set", table_obj, {path: str(target)})])
         # clean handoff: nothing of ours left unflushed for the new
         # authority to miss
         self._persist_applied()
@@ -731,20 +796,97 @@ class MDSDaemon(Dispatcher):
         except RadosError:
             pass
         if target != self.rank:
-            for _ino, ents, _chain in self._walk_realm(dent["ino"]):
-                for d in ents.values():
-                    if d.get("type") != "f":
-                        continue
-                    ino = d["ino"]
-                    holders = list(self._caps.get(ino, {}))
-                    if holders:
-                        self._queue_revoke(ino, holders)
-                    self._caps.pop(ino, None)
-                    self._opens.pop(ino, None)
-        return {"path": path, "rank": target}
+            self._evict_moved(dent)
 
     def _op_get_pins(self, a):
         return self._subtrees()
+
+    # --------------------------------------------- load balancer
+    def tick(self, now: float | None = None) -> None:
+        """Periodic MDBalancer pass (ref: src/mds/MDBalancer.cc —
+        ranks exchange loads, the overloaded one exports a hot
+        subtree).  Loads ride a shared RADOS object instead of
+        MHeartbeat; an export is an entry in the balancer's own
+        subtree table, so explicit pins stay the operator's override
+        and are never auto-migrated."""
+        from ..common.options import global_config
+        now = time.monotonic() if now is None else now
+        cfg = global_config()
+        interval = cfg["mds_bal_interval"]
+        with self._lock:
+            if now - self._last_bal < interval:
+                return
+            self._last_bal = now
+            my_load = sum(self._heat.values())
+            # half-life decay so load reflects the recent window
+            for k in list(self._heat):
+                self._heat[k] *= 0.5
+                if self._heat[k] < 0.01:
+                    del self._heat[k]
+        try:
+            self.meta.create(LOAD_OBJ)
+        except RadosError:
+            pass
+        # stamps shared through RADOS need a SHARED clock: monotonic
+        # bases are per-host, so freshness math across ranks on
+        # different hosts would be garbage (ref: mds_load_t rides
+        # wall-clock utime_t)
+        wall = time.time()
+        try:
+            self.meta.operate(LOAD_OBJ, WriteOp().set_omap({
+                str(self.rank): json.dumps(
+                    {"load": my_load, "stamp": wall}).encode()}))
+            vals, _ = self.meta.get_omap_vals(LOAD_OBJ)
+        except RadosError:
+            return
+        loads: dict[int, float] = {}
+        for r, blob in vals.items():
+            try:
+                rec = json.loads(blob)
+                if wall - float(rec["stamp"]) <= 3 * interval:
+                    loads[int(r)] = float(rec["load"])
+            except (ValueError, KeyError):
+                continue
+        if len(loads) < 2:
+            return                      # no live peer to export to
+        coldest = min((r for r in loads if r != self.rank),
+                      key=lambda r: loads[r])
+        if my_load < cfg["mds_bal_min_load"] or \
+                my_load < cfg["mds_bal_ratio"] * (loads[coldest] + 1):
+            return
+        with self._lock:
+            pins = self._explicit_pins()
+            best = None
+            for d, h in sorted(self._heat.items(),
+                               key=lambda kv: -kv[1]):
+                if d in pins or self._authority(d) != self.rank:
+                    continue
+                # exporting our ONLY load would just ping-pong;
+                # keep at least something resident
+                if h >= my_load * 0.9 and len(self._heat) == 1 and \
+                        loads[coldest] <= 0.0 and my_load < \
+                        2 * cfg["mds_bal_min_load"]:
+                    continue
+                _p, _n, dent = self._resolve(d)
+                if dent is None or dent.get("type") != "d":
+                    continue
+                best = (d, dent)
+                break
+            if best is None:
+                return
+            path, dent = best
+            dout("mds", 1).write(
+                "%s: balancer exporting %s (heat %.1f, load %.1f) "
+                "-> mds.%d (load %.1f)", self.name, path,
+                self._heat.get(path, 0.0), my_load, coldest,
+                loads[coldest])
+            self._do_pin(dent, path, coldest, AUTO_SUBTREE_OBJ)
+            self._heat.pop(path, None)
+            revokes, self._pending_revokes = self._pending_revokes, []
+        # tick runs outside the dispatch loop: send the evictions
+        # ourselves (dispatch would otherwise drain them on the next op)
+        for client, cap_msg in revokes:
+            self.ms.connect(client).send_message(cap_msg)
 
     # ------------------------------------------------------- operations
     #: ops allowed to traverse `.snap` paths — everything else on a
@@ -757,6 +899,15 @@ class MDSDaemon(Dispatcher):
         (ref: Server::dispatch_client_request op switch)."""
         with self._lock:
             self._route(op, args)
+            # balancer heat: ops we actually serve, attributed to the
+            # path's top-level subtree (ref: MDBalancer hit_dir)
+            _p = args.get("path") or args.get("src")
+            if _p and not str(args.get("__client", "")
+                              ).startswith("mds."):
+                parts = self._norm(_p).strip("/").split("/")
+                if parts and parts[0]:
+                    top = "/" + parts[0]
+                    self._heat[top] = self._heat.get(top, 0.0) + 1.0
             if op not in self._SNAP_RO_OPS and any(
                     ".snap" in str(args.get(k, "")).split("/")
                     for k in ("path", "src", "dst")):
@@ -984,6 +1135,233 @@ class MDSDaemon(Dispatcher):
         self._journal("rename", deltas)
         return sdent
 
+    # ---------------------------------------- cross-rank rename (slave)
+    def _peer_call(self, rank: int, op: str, args: dict,
+                   timeout: float = 15.0):
+        """Synchronous MDS-to-MDS request (the slave-request channel,
+        ref: Migrator.h:51 / MMDSSlaveRequest).  MUST run off the
+        dispatch thread — the reply rides it."""
+        tid = next(self._peer_tids)
+        ev, slot = threading.Event(), []
+        self._peer_pending[tid] = (ev, slot)
+        req = MClientRequest(tid=tid, op=op, args=args)
+        if not self.ms.connect(f"mds.{rank}").send_message(req):
+            self._peer_pending.pop(tid, None)
+            raise MDSError("EAGAIN", f"mds.{rank} unreachable")
+        if not ev.wait(timeout):
+            self._peer_pending.pop(tid, None)
+            raise MDSError("EAGAIN", f"mds.{rank} slave call timeout")
+        rep = slot[0]
+        if rep.forward is not None and rep.forward >= 0:
+            # the subtree moved mid-protocol: the slave did NOT apply.
+            # EAGAIN (not success!) — the caller re-resolves the
+            # authority and retries; treating this as success would
+            # commit a src removal whose dst insert never happened.
+            raise MDSError("EAGAIN",
+                           f"slave forwarded to mds.{rep.forward}")
+        if rep.result < 0:
+            raise MDSError(rep.errno_name or "EIO", op)
+        return rep.out
+
+    def _cross_rank_rename(self, msg, a: dict, dst_rank: int) -> None:
+        """Two-phase rename into another rank's subtree (ref:
+        Server::handle_client_rename:7310 coordinating witnesses
+        through the Migrator):
+
+        1. journal a durable INTENT (this rank, the src authority, is
+           the transaction coordinator — replay finishes half-done
+           renames, see _recover_xrenames);
+        2. slave-insert the dentry at the destination authority
+           (idempotent: same-ino insert acks success);
+        3. journal the src removal + intent clear, evict our caps on
+           the moved inode(s) so the new authority grants them fresh.
+
+        The inode record itself (embedded or itable-backed) lives in
+        the shared metadata pool, so identity and hardlinks survive
+        the move untouched."""
+        reply_err = None
+        out = None
+        try:
+            out = self._xrename_run(a, dst_rank)
+        except MDSError as e:
+            reply_err = e.errno_name
+        except Exception as e:      # noqa: BLE001 — reply, never hang
+            dout("mds", 0).write("%s: cross-rank rename failed: %r",
+                                 self.name, e)
+            reply_err = "EIO"
+        if reply_err is None:
+            reply = MClientReply(tid=msg.tid, result=0, out=out)
+        else:
+            reply = MClientReply(tid=msg.tid,
+                                 result=_ERRNO.get(reply_err, -22),
+                                 errno_name=reply_err)
+        with self._lock:
+            revokes, self._pending_revokes = self._pending_revokes, []
+        self.ms.connect(msg.src).send_message(reply)
+        for client, cap_msg in revokes:
+            self.ms.connect(client).send_message(cap_msg)
+
+    def _xrename_run(self, a: dict, dst_rank: int):
+        src = self._norm(a["src"])
+        dst = self._norm(a["dst"])
+        with self._lock:
+            sp, sname, sdent = self._resolve(a["src"])
+            if sdent is None:
+                raise MDSError("ENOENT", a["src"])
+            if dst.startswith(src + "/"):
+                raise MDSError("EINVAL", f"{dst} is inside {src}")
+            ino = self._dent_ino(sdent)
+        # revoke-and-wait BEFORE touching the namespace: EXCL holders
+        # flush buffered sizes against the still-existing src path
+        # (the xlock-then-rename ordering Server::handle_client_rename
+        # gets from the Locker) — evicting after the commit would
+        # race their flushes against a vanished dentry
+        self._revoke_and_wait(sdent)
+        with self._lock:
+            sp, sname, sdent = self._resolve(a["src"])
+            if sdent is None:
+                raise MDSError("ENOENT", a["src"])
+            # deterministic per-(rank, ino) key: a client retry after
+            # an ambiguous failure re-drives the SAME intent instead
+            # of stacking duplicates
+            intent_id = f"{self.rank}.{ino}"
+            self._journal("xrename_prepare", [
+                ("mkobj", XRENAME_OBJ),
+                ("set", XRENAME_OBJ, {intent_id: json.dumps({
+                    "src": src, "dst": dst, "dent": sdent,
+                    "dst_rank": dst_rank})})])
+        try:
+            self._peer_call(dst_rank, "slave_rename_insert", {
+                "dst": dst, "dent": sdent})
+        except MDSError as e:
+            if e.errno_name == "EAGAIN":
+                # AMBIGUOUS: the slave may have applied the insert
+                # (slow peer / lost reply).  The intent must survive
+                # — aborting here could leave the file visible at
+                # BOTH paths with no record to reconcile.  The client
+                # retries (same intent key) and boot-time recovery
+                # finishes orphans.
+                raise
+            # definitive refusal (EEXIST/ENOTEMPTY/ENOTDIR): the
+            # insert did not happen, dropping the intent is safe
+            with self._lock:
+                self._journal("xrename_abort", [
+                    ("rm", XRENAME_OBJ, [intent_id])])
+            raise
+        with self._lock:
+            self._journal("xrename_commit", [
+                ("rm", dir_obj(sp), [sname]),
+                ("rm", XRENAME_OBJ, [intent_id])])
+            self._evict_moved(sdent)
+        return sdent
+
+    @staticmethod
+    def _dent_ino(dent: dict):
+        """A dentry's logical inode number — remote (hardlink)
+        dentries carry it as the itable pointer."""
+        return dent["remote"] if "remote" in dent else dent["ino"]
+
+    def _inos_under(self, dent: dict) -> list[int]:
+        """File inode numbers covered by a dentry (the dentry itself,
+        or every file in the realm when it's a directory) — the one
+        walk behind pin/rename eviction and revoke-and-wait."""
+        if dent.get("type") == "d":
+            return [self._dent_ino(d) for _i, ents, _ch in
+                    self._walk_realm(dent["ino"])
+                    for d in ents.values() if d.get("type") == "f"]
+        return [self._dent_ino(dent)]
+
+    def _revoke_and_wait(self, dent: dict,
+                         timeout: float | None = None) -> None:
+        """Queue revokes for every cap holder under `dent`, send them
+        now (we run off the dispatch thread), and wait for the acks —
+        unacked holders past the grace are force-dropped by
+        _queue_revoke's own timeout machinery."""
+        timeout = self.REVOKE_GRACE if timeout is None else timeout
+        with self._lock:
+            pending = {i for i in self._inos_under(dent)
+                       if self._caps.get(i)}
+            for i in pending:
+                self._queue_revoke(i, list(self._caps.get(i, {})))
+            revokes, self._pending_revokes = self._pending_revokes, []
+        for client, cap_msg in revokes:
+            self.ms.connect(client).send_message(cap_msg)
+        end = time.monotonic() + timeout
+        while time.monotonic() < end:
+            with self._lock:
+                if not any(self._caps.get(i) for i in pending):
+                    return
+            time.sleep(0.02)
+
+    def _evict_moved(self, dent: dict) -> None:
+        """Drop cap/open authority for inode(s) that just left our
+        subtrees (the set_pin handoff, per-inode): clients re-acquire
+        through the destination rank."""
+        for ino in self._inos_under(dent):
+            holders = list(self._caps.get(ino, {}))
+            if holders:
+                self._queue_revoke(ino, holders)
+            self._caps.pop(ino, None)
+            self._opens.pop(ino, None)
+
+    def _op_slave_rename_insert(self, a):
+        """Destination-side half of a cross-rank rename: validate and
+        journal the dentry insert (ref: the slave request's
+        PREPARE/COMMIT collapsed to one idempotent insert — the
+        coordinator's durable intent provides the crash story)."""
+        if not str(a.get("__client", "")).startswith("mds."):
+            raise MDSError("EINVAL", "slave op from non-mds")
+        auth = self._authority(a["dst"])
+        if auth != self.rank:
+            raise MDSForward(auth)   # table moved mid-flight
+        dent = a["dent"]
+        dp, dname, ddent = self._resolve(a["dst"])
+        if not dname:
+            raise MDSError("EINVAL", a["dst"])
+        if ddent is not None:
+            if self._dent_ino(ddent) == self._dent_ino(dent):
+                return None          # replayed intent: already landed
+            if ddent["type"] == "d":
+                if self._readdir(ddent["ino"]):
+                    raise MDSError("ENOTEMPTY", a["dst"])
+            elif dent["type"] == "d":
+                raise MDSError("ENOTDIR", a["dst"])
+        deltas = [("set", dir_obj(dp), {dname: json.dumps(dent)})]
+        if ddent is not None and ddent["type"] == "d":
+            deltas.append(("rmobj", dir_obj(ddent["ino"])))
+        self._journal("xrename_in", deltas)
+        return None
+
+    def _recover_xrenames(self) -> None:
+        """Finish cross-rank renames whose coordinator crashed between
+        intent and commit: re-drive the (idempotent) slave insert and
+        the src removal.  Runs once per boot off-thread; intents that
+        still can't complete stay durable for the next boot."""
+        try:
+            vals, _ = self.meta.get_omap_vals(XRENAME_OBJ)
+        except RadosError:
+            return
+        for intent_id, blob in vals.items():
+            try:
+                rec = json.loads(blob)
+                if not intent_id.startswith(f"{self.rank}."):
+                    continue
+                self._peer_call(rec["dst_rank"],
+                                "slave_rename_insert",
+                                {"dst": rec["dst"],
+                                 "dent": rec["dent"]})
+                with self._lock:
+                    sp, sname, sdent = self._resolve(rec["src"])
+                    deltas = [("rm", XRENAME_OBJ, [intent_id])]
+                    if sdent is not None and self._dent_ino(sdent) \
+                            == self._dent_ino(rec["dent"]):
+                        deltas.append(("rm", dir_obj(sp), [sname]))
+                    self._journal("xrename_commit", deltas)
+            except (MDSError, RadosError, KeyError, ValueError) as ex:
+                dout("mds", 1).write(
+                    "%s: xrename intent %s not recovered: %r",
+                    self.name, intent_id, ex)
+
     def _op_setattr(self, a):
         parent, name, dent = self._resolve(a["path"])
         if dent is None:
@@ -1021,6 +1399,14 @@ class MDSDaemon(Dispatcher):
         if isinstance(msg, MClientCaps):
             self.handle_caps(msg)
             return True
+        if isinstance(msg, MClientReply):
+            # slave-call reply from a peer rank
+            entry = self._peer_pending.pop(msg.tid, None)
+            if entry is not None:
+                ev, slot = entry
+                slot.append(msg)
+                ev.set()
+            return True
         if not isinstance(msg, MClientRequest):
             return False
         try:
@@ -1028,6 +1414,15 @@ class MDSDaemon(Dispatcher):
             args["__client"] = msg.src
             out = self.handle_op(msg.op, args)
             reply = MClientReply(tid=msg.tid, result=0, out=out)
+        except _CrossRankRename as x:
+            # two-phase protocol runs off the dispatch thread (the
+            # slave reply would otherwise deadlock this thread); the
+            # worker sends the client reply itself
+            threading.Thread(
+                target=self._cross_rank_rename,
+                args=(msg, dict(msg.args), x.dst_rank),
+                daemon=True).start()
+            return True
         except MDSForward as f:
             reply = MClientReply(tid=msg.tid, result=0,
                                  forward=f.rank)

@@ -102,18 +102,24 @@ def test_migration_revokes_live_handles(cluster):
     assert fs.read_file("/moving/live")[:4] == b"AAAA"
 
 
-def test_cross_rank_rename_refused(cluster):
+def test_cross_rank_rename_works_and_link_refused(cluster):
+    """Round 5 removed the rename EXDEV (two-phase slave protocol);
+    cross-rank HARDLINKS still refuse — remote-link refcounting is
+    the documented remaining gap."""
     c, _m0, _m1 = cluster
     fs = _fs(c)
     fs.mkdirs("/xr-a")
     fs.mkdirs("/xr-b")
     fs.set_pin("/xr-b", 1)
     fs.write_file("/xr-a/f", b"data")
+    fs.rename("/xr-a/f", "/xr-b/f")
+    assert fs.read_file("/xr-b/f") == b"data"
     with pytest.raises(CephFSError) as ei:
-        fs.rename("/xr-a/f", "/xr-b/f")
+        fs.link("/xr-b/f", "/xr-a/alias")
     assert ei.value.errno_name == "EXDEV"
     # same-rank renames still fine on both ranks
-    fs.rename("/xr-a/f", "/xr-a/g")
+    fs.write_file("/xr-a/g0", b"ga")
+    fs.rename("/xr-a/g0", "/xr-a/g")
     fs.write_file("/xr-b/h", b"hb")
     fs.rename("/xr-b/h", "/xr-b/h2")
     assert fs.read_file("/xr-b/h2") == b"hb"
@@ -249,3 +255,114 @@ def test_force_repin_rescues_bad_pin(cluster):
     fs._session.call("set_pin", {"path": "/bricked", "rank": 0,
                                  "force": True})
     assert fs.read_file("/bricked/f") == b"data"
+
+
+def test_cross_rank_rename_file(cluster):
+    """The EXDEV is gone: a rename whose src and dst live on
+    different ranks runs the two-phase slave protocol, preserves
+    inode identity, and moves cap authority (VERDICT r4 #6; ref:
+    Server::handle_client_rename:7310, Migrator.h:51)."""
+    c, mds0, mds1 = cluster
+    fs = _fs(c)
+    fs.mkdirs("/xr-src")
+    fs.mkdirs("/xr-dst")
+    fs.set_pin("/xr-dst", 1)
+    fs.write_file("/xr-src/mover", b"identity survives")
+    ino = fs.stat("/xr-src/mover")["ino"]
+    fs.rename("/xr-src/mover", "/xr-dst/mover")
+    # gone from src, present at dst, same inode, data intact
+    with pytest.raises(CephFSError, match="ENOENT"):
+        fs.stat("/xr-src/mover")
+    assert fs.stat("/xr-dst/mover")["ino"] == ino
+    assert fs.read_file("/xr-dst/mover") == b"identity survives"
+    # the new authority (rank 1) now grants the caps
+    fh = fs.open("/xr-dst/mover", "w")
+    assert ino in mds1._caps or ino in mds1._opens
+    assert ino not in mds0._caps
+    fh.close()
+
+
+def test_cross_rank_rename_preserves_hardlinks(cluster):
+    """A hardlinked inode renamed across ranks keeps its other link
+    alive (the itable-backed record never moves pools)."""
+    c, _mds0, _mds1 = cluster
+    fs = _fs(c)
+    fs.mkdirs("/xh-src")
+    fs.mkdirs("/xh-dst")
+    fs.set_pin("/xh-dst", 1)
+    fs.write_file("/xh-src/orig", b"two names")
+    fs.link("/xh-src/orig", "/xh-src/alias")
+    ino = fs.stat("/xh-src/orig")["ino"]
+    fs.rename("/xh-src/orig", "/xh-dst/orig")
+    assert fs.stat("/xh-dst/orig")["ino"] == ino
+    assert fs.stat("/xh-src/alias")["ino"] == ino
+    # writing through the surviving src-side link is visible at dst
+    fs.write_file("/xh-src/alias", b"updated via alias")
+    assert fs.read_file("/xh-dst/orig") == b"updated via alias"
+
+
+def test_cross_rank_rename_directory_under_io(cluster):
+    """A directory moves into another rank's subtree while a client
+    holds an open handle inside it; the handle's caps are revoked and
+    subsequent IO through fresh opens works at the new authority."""
+    c, _mds0, _mds1 = cluster
+    fs = _fs(c)
+    fs.mkdirs("/xd-src/deep")
+    fs.mkdirs("/xd-dst")
+    fs.set_pin("/xd-dst", 1)
+    fs.write_file("/xd-src/deep/a", b"aaa")
+    fs.write_file("/xd-src/deep/b", b"bbb")
+    fh = fs.open("/xd-src/deep/a", "r+")
+    fh.write(0, b"AAA")
+    fs.rename("/xd-src/deep", "/xd-dst/deep")
+    time.sleep(0.3)                   # revoke lands, cache flushed
+    assert fs.read_file("/xd-dst/deep/a")[:3] == b"AAA"
+    assert fs.read_file("/xd-dst/deep/b") == b"bbb"
+    fh.close()
+
+
+def test_balancer_migrates_hot_subtree(cluster):
+    """A hot directory on an overloaded rank auto-migrates to the
+    colder rank, observable in get_pins; explicit pins are never
+    auto-migrated (VERDICT r4 #6; ref: src/mds/MDBalancer.cc)."""
+    from ceph_tpu.common.options import global_config
+    g = global_config()
+    saved = {k: g[k] for k in ("mds_bal_interval", "mds_bal_min_load",
+                               "mds_bal_ratio")}
+    g.set("mds_bal_interval", 1.0)
+    g.set("mds_bal_min_load", 10.0)
+    g.set("mds_bal_ratio", 1.5)
+    c, mds0, mds1 = cluster
+    fs = _fs(c)
+    try:
+        fs.mkdirs("/hot")
+        fs.mkdirs("/pinned-hot")
+        fs.set_pin("/pinned-hot", 0)      # operator override
+        # hammer both dirs through rank 0
+        for i in range(40):
+            fs.write_file("/hot/f", b"x" * 64)
+            fs.read_file("/hot/f")
+            fs.write_file("/pinned-hot/f", b"y" * 64)
+        t = 10_000.0
+        mds1.tick(t); mds0.tick(t)        # both publish loads
+        t += 2.0
+        mds1.tick(t); mds0.tick(t)        # rank 0 sees a cold peer
+        for _ in range(6):
+            t += 2.0
+            mds0.tick(t); mds1.tick(t)
+            if fs.get_pins().get("/hot") == 1:
+                break
+        pins = fs.get_pins()
+        assert pins.get("/hot") == 1, pins
+        assert pins.get("/pinned-hot") == 0, \
+            "explicit pin was auto-migrated"
+        # the subtree actually serves from rank 1 now
+        fs.write_file("/hot/after", b"post-migration")
+        ino = fs.stat("/hot/after")["ino"]
+        fh = fs.open("/hot/after", "r")
+        assert ino in mds1._opens
+        assert ino not in mds0._opens
+        fh.close()
+    finally:
+        for k, v in saved.items():
+            g.set(k, v)

@@ -243,6 +243,27 @@ class RBD:
                       for oid in ioctx.list_objects()
                       if oid.startswith("rbd_header."))
 
+    # -- live migration (ref: librbd::RBD migration_* API surface) -----
+    def migration_prepare(self, src_ioctx: IoCtx, src_name: str,
+                          dst_ioctx: IoCtx, dst_name: str) -> None:
+        from .migration import migration_prepare
+        migration_prepare(src_ioctx, src_name, dst_ioctx, dst_name)
+
+    def migration_execute(self, dst_ioctx: IoCtx,
+                          dst_name: str) -> None:
+        from .migration import migration_execute
+        migration_execute(dst_ioctx, dst_name)
+
+    def migration_commit(self, dst_ioctx: IoCtx,
+                         dst_name: str) -> None:
+        from .migration import migration_commit
+        migration_commit(dst_ioctx, dst_name)
+
+    def migration_abort(self, dst_ioctx: IoCtx,
+                        dst_name: str) -> None:
+        from .migration import migration_abort
+        migration_abort(dst_ioctx, dst_name)
+
     @staticmethod
     def _exists(ioctx: IoCtx, name: str) -> bool:
         try:
@@ -262,7 +283,8 @@ class Image:
     opening at a snapshot reads each data object at that snapid."""
 
     def __init__(self, ioctx: IoCtx, name: str,
-                 snapshot: str | None = None):
+                 snapshot: str | None = None,
+                 _migration_internal: bool = False):
         self.ioctx = ioctx
         self.name = name
         try:
@@ -270,6 +292,13 @@ class Image:
         except RadosError as ex:
             raise RBDError(2, f"image {name!r} does not exist") from ex
         meta = json.loads(raw.decode())
+        if meta.get("migration") and not _migration_internal:
+            # a migration source only serves the destination's
+            # fall-through reads; clients must open the destination
+            # (ref: Migration.cc's migrating state gating opens)
+            raise RBDError(30, f"image {name!r} is migrating to "
+                           f"{meta['migration']['dst_image']!r}")
+        self._migrating_source = bool(meta.get("migration"))
         self.size = int(meta["size"])
         self.order = int(meta["order"])
         self.layout = StripeLayout(
@@ -463,8 +492,11 @@ class Image:
             return None
         if self._parent_image is None:
             pio = self.ioctx.rados.open_ioctx(self.parent["pool"])
-            self._parent_image = Image(pio, self.parent["image"],
-                                       snapshot=self.parent["snap_name"])
+            self._parent_image = Image(
+                pio, self.parent["image"],
+                snapshot=self.parent["snap_name"],
+                _migration_internal=bool(
+                    self.parent.get("migration")))
         return self._parent_image
 
     def _detach_from_parent(self) -> None:
@@ -696,6 +728,8 @@ class Image:
     def _check_writable(self) -> None:
         if self._snap_id is not None:
             raise RBDError(30, "image is open read-only at a snapshot")
+        if self._migrating_source:
+            raise RBDError(30, "image is a migration source")
         if self.mirror is not None and \
                 not self.mirror.get("primary", True) and \
                 not getattr(self, "_replaying", False):

@@ -1,0 +1,115 @@
+"""rbd live migration: prepare/execute/commit/abort with client IO
+running against the destination throughout (VERDICT r4 #7; ref:
+src/librbd/api/Migration.cc)."""
+import numpy as np
+import pytest
+
+from ceph_tpu.rbd import RBD, Image, RBDError
+from ceph_tpu.testing import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(n_osd=4, threaded=True)
+    c.wait_all_up()
+    r = c.rados()
+    r.pool_create("rbd-a", pg_num=8)
+    r.pool_create("rbd-b", pg_num=8)
+    yield c, r
+    c.shutdown()
+
+
+def mk_image(r, pool, name, mib=4, seed=1):
+    io = r.open_ioctx(pool)
+    RBD().create(io, name, mib << 20, order=20)   # 1 MiB objects
+    img = Image(io, name)
+    rng = np.random.default_rng(seed)
+    data = rng.integers(0, 256, mib << 20, dtype=np.uint8).tobytes()
+    img.write(0, data)
+    img.flush()
+    img.release_lock()
+    img.close()
+    return io, data
+
+
+def test_migration_full_cycle_with_concurrent_writes(cluster):
+    """prepare -> (writes against dst) -> execute -> (more writes) ->
+    commit: data identical, source gone, dst standalone."""
+    c, r = cluster
+    src_io, data = mk_image(r, "rbd-a", "mover", seed=7)
+    dst_io = r.open_ioctx("rbd-b")
+    rbd = RBD()
+    rbd.migration_prepare(src_io, "mover", dst_io, "mover")
+    # the source refuses direct opens now
+    with pytest.raises(RBDError):
+        Image(src_io, "mover")
+    # client IO proceeds against the destination BEFORE the copy
+    img = Image(dst_io, "mover")
+    expected = bytearray(data)
+    img.write(123456, b"during-migration-1")
+    expected[123456:123456 + 18] = b"during-migration-1"
+    assert img.read(0, 1 << 20) == bytes(expected[:1 << 20])
+    rbd.migration_execute(dst_io, "mover")
+    # ... and after the deep-copy, still against the same open image
+    img.write((3 << 20) + 5, b"during-migration-2")
+    expected[(3 << 20) + 5:(3 << 20) + 23] = b"during-migration-2"
+    img.flush()
+    rbd.migration_commit(dst_io, "mover")
+    assert img.read(0, len(expected)) == bytes(expected)
+    img.close()
+    # source is gone (header removed)
+    with pytest.raises(RBDError):
+        Image(src_io, "mover")
+    # destination reopens standalone (no parent link left)
+    img2 = Image(dst_io, "mover")
+    assert img2.parent is None
+    assert img2.read(0, len(expected)) == bytes(expected)
+    img2.close()
+
+
+def test_migration_abort_restores_source(cluster):
+    c, r = cluster
+    src_io, data = mk_image(r, "rbd-a", "undo", seed=13)
+    dst_io = r.open_ioctx("rbd-b")
+    rbd = RBD()
+    rbd.migration_prepare(src_io, "undo", dst_io, "undo")
+    img = Image(dst_io, "undo")
+    img.write(0, b"scribble on the destination")
+    img.flush()
+    img.close()
+    rbd.migration_abort(dst_io, "undo")
+    # destination gone, source back, bit-identical
+    with pytest.raises(RBDError):
+        Image(dst_io, "undo")
+    img = Image(src_io, "undo")
+    assert img.read(0, len(data)) == data
+    img.close()
+
+
+def test_migration_guards(cluster):
+    c, r = cluster
+    rbd = RBD()
+    src_io, _ = mk_image(r, "rbd-a", "guarded", mib=1, seed=3)
+    dst_io = r.open_ioctx("rbd-b")
+    # snapshotted sources refuse (documented divergence)
+    img = Image(src_io, "guarded")
+    img.snap_create("s1")
+    img.close()
+    with pytest.raises(RBDError):
+        rbd.migration_prepare(src_io, "guarded", dst_io, "g2")
+    img = Image(src_io, "guarded")
+    img.snap_remove("s1")
+    img.close()
+    # an active writer (exclusive lock held) refuses
+    img = Image(src_io, "guarded")
+    img.write(0, b"live")           # takes the lock
+    with pytest.raises(RBDError):
+        rbd.migration_prepare(src_io, "guarded", dst_io, "g2")
+    img.release_lock()
+    img.close()
+    # commit before execute refuses
+    rbd.migration_prepare(src_io, "guarded", dst_io, "g2")
+    with pytest.raises(RBDError):
+        rbd.migration_commit(dst_io, "g2")
+    rbd.migration_abort(dst_io, "g2")
+    assert Image(src_io, "guarded").read(0, 4) == b"live"

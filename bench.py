@@ -183,7 +183,11 @@ def main() -> None:
     @jax.jit
     def chained_stage(chunks):
         def body(c, i):
-            sv = (chunks ^ i)[:, sel, :]
+            # optimization_barrier forces the dense survivor layout to
+            # MATERIALIZE: without it XLA fuses the static gather into
+            # the reduce and the "stage" never writes HBM, reporting a
+            # copy rate ~2x what reply assembly actually sustains
+            sv = lax.optimization_barrier((chunks ^ i)[:, sel, :])
             return c + jnp.sum(sv, dtype=jnp.int32), None
         acc, _ = lax.scan(body, jnp.int32(0),
                           jnp.arange(REPS, dtype=jnp.uint8))

@@ -137,7 +137,7 @@ class ClassHandler:
     """Singleton method registry (ref: src/osd/ClassHandler.cc —
     open_class/dlopen replaced by lazy import of built-in modules)."""
 
-    _BUILTIN = ("lock", "refcount", "version")
+    _BUILTIN = ("lock", "refcount", "version", "rgw", "queue")
 
     def __init__(self):
         self._methods: dict[tuple[str, str], tuple[int, Callable]] = {}

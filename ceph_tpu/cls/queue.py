@@ -1,0 +1,73 @@
+"""cls queue: an ordered, persistent FIFO on one RADOS object.
+
+The reference's persistent bucket notifications ride a rados-backed
+queue maintained by cls methods (ref: src/cls/queue/cls_queue.cc,
+src/cls/2pc_queue — rgw_pubsub's persistent topics enqueue there and
+a pusher drains it).  Here the queue is the object's omap: the header
+carries the next sequence number, entries live under zero-padded
+sequence keys so omap order IS arrival order, and enqueue allocates
+the sequence inside the OSD — concurrent producers (two gateways
+publishing to one topic) can never collide or reorder.
+"""
+from __future__ import annotations
+
+import json
+
+from . import CLS_METHOD_RD, CLS_METHOD_WR, cls_method
+
+_SEQ_W = 16      # zero-pad width; omap lexical order == numeric order
+
+
+def _seq_key(seq: int) -> str:
+    return f"{seq:0{_SEQ_W}d}"
+
+
+def _header(ctx) -> dict:
+    raw = ctx.omap_get_header()
+    return json.loads(raw) if raw else {"next": 0}
+
+
+@cls_method("queue", "enqueue", CLS_METHOD_WR)
+def enqueue(ctx, d):
+    """Append entries; returns the first sequence assigned
+    (ref: cls_queue_enqueue)."""
+    hdr = _header(ctx)
+    first = hdr["next"]
+    kv = {}
+    for i, data in enumerate(d["entries"]):
+        kv[_seq_key(first + i)] = (data if isinstance(data, bytes)
+                                   else str(data).encode())
+    hdr["next"] = first + len(d["entries"])
+    ctx.omap_set(kv)
+    ctx.omap_set_header(json.dumps(hdr).encode())
+    return {"first": first}
+
+
+@cls_method("queue", "list", CLS_METHOD_RD)
+def list_entries(ctx, d):
+    """Entries from sequence `start`, up to `max` of them, in order
+    (ref: cls_queue_list_entries)."""
+    start = int(d.get("start", 0))
+    limit = int(d.get("max", 128))
+    om = ctx.omap_get()
+    out = []
+    for k in sorted(om):
+        seq = int(k)
+        if seq < start:
+            continue
+        out.append({"seq": seq, "data": om[k]})
+        if len(out) >= limit:
+            break
+    return {"entries": out, "next": _header(ctx)["next"]}
+
+
+@cls_method("queue", "remove", CLS_METHOD_WR)
+def remove(ctx, d):
+    """Ack entries with sequence < `upto` (ref:
+    cls_queue_remove_entries — the consumer trims what it delivered)."""
+    upto = int(d["upto"])
+    om = ctx.omap_get()
+    dead = [k for k in om if int(k) < upto]
+    if dead:
+        ctx.omap_rmkeys(dead)
+    return {"removed": len(dead)}

@@ -1,0 +1,224 @@
+"""cls_rgw: bucket-index transactions executed inside the OSD.
+
+The reference maintains its bucket index with cls methods running on
+the index object's primary OSD (ref: src/cls/rgw/cls_rgw.cc,
+cls_rgw_ops.h), so every gateway's read-modify-write of an index entry
+serializes on the PG — not on any gateway-local lock.  Same contract
+here: each method below reads the current entry, computes the new
+version stack, and queues the omap update; the daemon runs the method
+under its dispatch lock and commits the mutation atomically with the
+reply (osd/daemon.py _do_exec).  Two radosgw processes over one pool
+therefore cannot lose a concurrent PUT's version record.
+
+Entry format (JSON, one omap value per key; shared with
+rgw/gateway.py):
+  plain:     {"size", "etag", "mtime"}
+  versioned: {"versions": [head..tail], "size", "etag", "mtime", "dm"}
+Each version: {"vid", "size", "etag", "mtime", "dm", "obj"} where
+"obj" names the RADOS data object backing that version (None for
+delete markers).
+
+Methods return the data objects orphaned by the operation in
+"removed" — the gateway deletes those AFTER the index commit, the
+same order the reference uses (index transaction first, data gc
+second) so a crash leaves garbage, never a dangling index entry.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+from . import CLS_METHOD_WR, ClsError, cls_method
+
+#: the one timestamp format for index entries — shared with the
+#: gateway (rgw/gateway.py imports these; a format drift between
+#: writer and OSD-side trimmer would misage every version)
+MTIME_FMT = "%Y-%m-%dT%H:%M:%S.000Z"
+
+
+def now_str() -> str:
+    return time.strftime(MTIME_FMT, time.gmtime())
+
+
+def parse_mtime(s: str) -> float:
+    try:
+        return time.mktime(time.strptime(s, MTIME_FMT)) - time.timezone
+    except ValueError:
+        return 0.0
+
+
+def _load(ctx, key: str) -> dict | None:
+    raw = ctx.omap_get().get(key)
+    return json.loads(raw) if raw else None
+
+
+def _fold(ent: dict | None, plain_obj: str | None) -> list:
+    """Existing version stack; a pre-versioning plain entry becomes
+    the S3 'null' version backed by the plain data object
+    (ref: rgw null-version semantics)."""
+    if ent is None:
+        return []
+    if ent.get("versions") is not None:
+        return ent["versions"]
+    return [{"vid": "null", "size": ent["size"], "etag": ent["etag"],
+             "mtime": ent["mtime"], "dm": False,
+             "obj": ent.get("obj") or plain_obj}]
+
+
+def _store(ctx, key: str, versions: list) -> None:
+    if not versions:
+        ctx.omap_rmkeys([key])
+        return
+    head = versions[0]
+    meta = {"versions": versions, "size": head.get("size", 0),
+            "etag": head.get("etag", ""), "mtime": head["mtime"],
+            "dm": bool(head.get("dm"))}
+    ctx.omap_set({key: json.dumps(meta).encode()})
+
+
+@cls_method("rgw", "obj_store", CLS_METHOD_WR)
+def obj_store(ctx, d):
+    """Record a completed PUT in the index
+    (ref: cls_rgw bucket_complete_op CLS_RGW_OP_ADD).
+
+    mode "plain": unversioned entry, last writer wins per key.
+    mode "enabled": push a new version onto the stack.
+    mode "suspended": replace the 'null' version in place.
+
+    Every mode writes its data to a FRESH object first and links it
+    here (the reference's instance-object model); the entry this
+    commit orphans comes back in "removed" so the caller can gc it —
+    a plain overwrite therefore never clobbers bytes a concurrent
+    reader (or a version stack that appeared meanwhile) still needs.
+    """
+    key, mode = d["key"], d.get("mode", "plain")
+    ent = _load(ctx, key)
+    if mode == "plain":
+        if ent is not None and ent.get("versions") is not None:
+            # versioning got enabled (and a version committed) after
+            # the caller read the bucket meta — a plain overwrite
+            # would erase that stack.  Caller retries as versioned.
+            raise ClsError("ECANCELED", key)
+        removed = []
+        old = (ent.get("obj") or d.get("plain_obj")) \
+            if ent is not None else None
+        if old and old != d["obj"]:
+            removed.append(old)
+        ctx.omap_set({key: json.dumps(
+            {"size": d["size"], "etag": d["etag"],
+             "mtime": d["mtime"], "obj": d["obj"]}).encode()})
+        return {"vid": None, "removed": removed}
+    versions = _fold(ent, d.get("plain_obj"))
+    rec = {"vid": d["vid"], "size": d["size"], "etag": d["etag"],
+           "mtime": d["mtime"], "dm": False, "obj": d["obj"]}
+    removed = []
+    if mode == "suspended":
+        for v in versions:
+            if v["vid"] == "null" and not v.get("dm") and v.get("obj") \
+                    and v["obj"] != d["obj"]:
+                removed.append(v["obj"])
+        versions = [v for v in versions if v["vid"] != "null"]
+        rec["vid"] = "null"
+    elif mode != "enabled":
+        raise ClsError("EINVAL", f"mode {mode}")
+    versions.insert(0, rec)
+    _store(ctx, key, versions)
+    return {"vid": rec["vid"], "removed": removed}
+
+
+@cls_method("rgw", "obj_delete_marker", CLS_METHOD_WR)
+def obj_delete_marker(ctx, d):
+    """Insert a delete marker at the head of the stack (ref: rgw
+    delete-marker flow, cls_rgw CLS_RGW_OP_LINK_OLH_DM).
+
+    replace_null: drop the existing 'null' version first (Suspended
+    buckets replace the null version with a null marker); its data
+    object comes back in "removed".
+    if_head_vid / if_mtime: optional guards — ECANCELED when the head
+    changed since the caller's read (lifecycle uses them so an expiry
+    decided on a stale snapshot never clobbers a fresh PUT).  BOTH are
+    needed: a Suspended-bucket overwrite keeps vid "null", so only the
+    mtime moves.
+    """
+    key = d["key"]
+    versions = _fold(_load(ctx, key), d.get("plain_obj"))
+    if "if_head_vid" in d:
+        head = versions[0]["vid"] if versions else None
+        if head != d["if_head_vid"]:
+            raise ClsError("ECANCELED", key)
+    if "if_mtime" in d:
+        head_mtime = versions[0]["mtime"] if versions else None
+        if head_mtime != d["if_mtime"]:
+            raise ClsError("ECANCELED", key)
+    removed = []
+    if d.get("replace_null"):
+        for v in versions:
+            if v["vid"] == "null" and not v.get("dm") and v.get("obj"):
+                removed.append(v["obj"])
+        versions = [v for v in versions if v["vid"] != "null"]
+    versions.insert(0, {"vid": d["vid"], "size": 0, "etag": "",
+                        "mtime": d["mtime"], "dm": True, "obj": None})
+    _store(ctx, key, versions)
+    return {"vid": d["vid"], "removed": removed}
+
+
+@cls_method("rgw", "obj_delete_version", CLS_METHOD_WR)
+def obj_delete_version(ctx, d):
+    """Remove one explicit version (ref: cls_rgw
+    CLS_RGW_OP_UNLINK_INSTANCE).  ENOENT when the vid isn't in the
+    stack; an emptied stack removes the index entry."""
+    key = d["key"]
+    ent = _load(ctx, key)
+    if ent is None:
+        raise ClsError("ENOENT", key)
+    versions = _fold(ent, d.get("plain_obj"))
+    keep = [v for v in versions if v["vid"] != d["vid"]]
+    if len(keep) == len(versions):
+        raise ClsError("ENOENT", d["vid"])
+    removed = [v["obj"] for v in versions
+               if v["vid"] == d["vid"] and v.get("obj")
+               and not v.get("dm")]
+    _store(ctx, key, keep)
+    return {"removed": removed}
+
+
+@cls_method("rgw", "obj_delete_plain", CLS_METHOD_WR)
+def obj_delete_plain(ctx, d):
+    """Unversioned delete: drop the index entry (ref: cls_rgw
+    CLS_RGW_OP_DEL).  ECANCELED if the entry meanwhile grew a version
+    stack — the caller re-runs the versioned delete path.
+    if_mtime: optional guard for lifecycle (see obj_delete_marker)."""
+    key = d["key"]
+    ent = _load(ctx, key)
+    if ent is None:
+        return {"removed": []}
+    if ent.get("versions") is not None:
+        raise ClsError("ECANCELED", key)
+    if "if_mtime" in d and ent.get("mtime") != d["if_mtime"]:
+        raise ClsError("ECANCELED", key)
+    ctx.omap_rmkeys([key])
+    dead = ent.get("obj") or d.get("plain_obj")
+    return {"removed": [dead] if dead else []}
+
+
+@cls_method("rgw", "obj_trim_noncurrent", CLS_METHOD_WR)
+def obj_trim_noncurrent(ctx, d):
+    """Drop noncurrent versions older than max_age_s (lifecycle
+    NoncurrentVersionExpiration; ref: src/rgw/rgw_lc.cc noncurrent
+    expiry).  The age test runs HERE against the committed stack, so
+    two gateways' lifecycle ticks can race without double-freeing."""
+    key = d["key"]
+    ent = _load(ctx, key)
+    if ent is None or ent.get("versions") is None:
+        return {"removed": [], "dropped": 0}
+    versions = ent["versions"]
+    keep, removed = versions[:1], []
+    for v in versions[1:]:
+        if d["now"] - parse_mtime(v["mtime"]) > d["max_age_s"]:
+            if v.get("obj") and not v.get("dm"):
+                removed.append(v["obj"])
+        else:
+            keep.append(v)
+    if len(keep) != len(versions):
+        _store(ctx, key, keep)
+    return {"removed": removed, "dropped": len(versions) - len(keep)}

@@ -53,6 +53,10 @@ from xml.sax.saxutils import escape
 from ..client import RadosError, WriteOp
 from .auth import (SigV4Error, verify as sigv4_verify,
                    verify_presigned as presigned_verify)
+from ..cls.rgw import now_str, parse_mtime
+from .notify import (EventPusher, TopicStore, _queue_obj,
+                     event_matches, make_event, notification_xml,
+                     parse_notification_xml)
 
 #: omap object holding the bucket registry (name -> creation meta)
 BUCKETS_OBJ = ".rgw.buckets.list"
@@ -167,21 +171,47 @@ class RGWGateway:
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self.httpd.server_address[1]
         self._thread: threading.Thread | None = None
-        #: serializes version-stack read-modify-writes — the HTTP
-        #: server is threaded, and an unlocked RMW would lose a
-        #: concurrent PUT's version record (the cls_rgw index
-        #: transaction's job in the reference)
-        self._vlock = threading.Lock()
+        self.topics = TopicStore(self.io)
+        self.pusher = EventPusher(self.io, self.topics)
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> None:
         self._thread = threading.Thread(target=self.httpd.serve_forever,
                                         name="rgw", daemon=True)
         self._thread.start()
+        self.pusher.start()
 
     def shutdown(self) -> None:
+        self.pusher.stop()
         self.httpd.shutdown()
         self.httpd.server_close()
+
+    # -- notifications (ref: src/rgw/rgw_pubsub.cc) ----------------------
+    def _notify_event(self, bucket: str, key: str, event: str,
+                      size: int, etag: str, vid: str | None = None,
+                      bmeta: dict | None = None) -> None:
+        """Publish an event to every topic whose bucket config
+        matches.  The append goes through cls queue.enqueue so the
+        OSD assigns the sequence — concurrent gateways publishing to
+        one topic keep a single total order (ref: rgw_notify.cc
+        persistent notifications over cls_2pc_queue)."""
+        if bmeta is None:
+            bmeta = self._buckets().get(bucket) or {}
+        cfgs = bmeta.get("notifications") or []
+        for cfg in cfgs:
+            if not event_matches(cfg, event, key):
+                continue
+            t = self.topics.get(cfg["topic"])
+            if not t or not t.get("endpoint"):
+                # nothing will ever drain an endpointless topic's
+                # queue — don't grow it without bound
+                continue
+            data = make_event(bucket, key, event, size, etag, vid)
+            try:
+                self.io.exec(_queue_obj(cfg["topic"]), "queue",
+                             "enqueue", {"entries": [data]})
+            except RadosError:
+                pass            # lost event beats failed client op
 
     # -- helpers ---------------------------------------------------------
     def _buckets(self) -> dict[str, dict]:
@@ -255,6 +285,8 @@ class RGWGateway:
         bucket = parts[0]
         key = parts[1] if len(parts) > 1 else ""
         if not bucket:
+            if "Action" in q:
+                return self._topic_op(h, method, q)
             if method != "GET":
                 raise S3Error(405, "MethodNotAllowed")
             return self._list_buckets(h)
@@ -283,6 +315,8 @@ class RGWGateway:
             return self._versioning_op(h, method, bucket)
         if "lifecycle" in q:
             return self._lifecycle_op(h, method, bucket)
+        if "notification" in q:
+            return self._notification_op(h, method, bucket)
         if method == "PUT":
             if bucket in self._buckets():
                 # idempotent re-create must NOT rebuild the meta —
@@ -443,13 +477,68 @@ class RGWGateway:
         self._update_bucket_meta(bucket, meta)
         self._respond(h, 200)
 
+    # -- topics + notification configs (ref: rgw_rest_pubsub.cc) --------
+    def _topic_op(self, h, method: str, q: dict) -> None:
+        """SNS-flavored topic admin: POST /?Action=CreateTopic&Name=x
+        &push-endpoint=http://... (ref: RGWPSCreateTopicOp and
+        friends — the reference exposes topics through the same
+        Action-style API)."""
+        action = q.get("Action", "")
+        if method != "POST" and action != "ListTopics":
+            # mutating Actions are POST-only (GET must stay safe)
+            raise S3Error(405, "MethodNotAllowed", method)
+        if action == "CreateTopic":
+            name = q.get("Name", "")
+            if not name:
+                raise S3Error(400, "InvalidArgument", "Name")
+            self.topics.create(name, q.get("push-endpoint", ""))
+            return self._respond(h, 200, (
+                '<?xml version="1.0"?><CreateTopicResponse>'
+                f"<TopicArn>arn:aws:sns:::{escape(name)}</TopicArn>"
+                "</CreateTopicResponse>").encode())
+        if action == "DeleteTopic":
+            self.topics.delete(q.get("TopicArn", "").rsplit(":", 1)[-1])
+            return self._respond(h, 200,
+                                 b"<DeleteTopicResponse/>")
+        if action == "ListTopics":
+            ents = "".join(
+                f"<member><TopicArn>arn:aws:sns:::{escape(n)}"
+                f"</TopicArn></member>"
+                for n in sorted(self.topics.list()))
+            return self._respond(h, 200, (
+                '<?xml version="1.0"?><ListTopicsResponse>'
+                f"<Topics>{ents}</Topics>"
+                "</ListTopicsResponse>").encode())
+        raise S3Error(400, "InvalidAction", action)
+
+    def _notification_op(self, h, method: str, bucket: str) -> None:
+        """Get/Put/DeleteBucketNotificationConfiguration."""
+        meta = self._require_bucket(bucket)
+        if method == "GET":
+            return self._respond(h, 200, notification_xml(
+                meta.get("notifications") or []))
+        if method == "DELETE":
+            meta.pop("notifications", None)
+            self._update_bucket_meta(bucket, meta)
+            return self._respond(h, 204)
+        if method != "PUT":
+            raise S3Error(405, "MethodNotAllowed", method)
+        try:
+            cfgs = parse_notification_xml(self._read_body(h))
+        except ValueError as e:
+            raise S3Error(400, "MalformedXML", str(e))
+        for cfg in cfgs:
+            if self.topics.get(cfg["topic"]) is None:
+                raise S3Error(400, "InvalidArgument",
+                              f"no such topic {cfg['topic']}")
+        meta["notifications"] = cfgs
+        self._update_bucket_meta(bucket, meta)
+        self._respond(h, 200)
+
     @staticmethod
     def _parse_mtime(s: str) -> float:
-        try:
-            return time.mktime(time.strptime(
-                s, "%Y-%m-%dT%H:%M:%S.000Z")) - time.timezone
-        except ValueError:
-            return 0.0
+        # one parser for writer and OSD-side trimmer (cls/rgw.py)
+        return parse_mtime(s)
 
     def lc_tick(self, now: float | None = None) -> int:
         """One lifecycle pass (ref: RGWLC::process — the reference
@@ -471,48 +560,68 @@ class RGWGateway:
                 if key.startswith(".upload."):
                     continue
                 acted_on_key = False
-                with self._vlock:
-                    for r in rules:
-                        if acted_on_key:
-                            # one action per key per tick: a second
-                            # matching rule would act on a stale
-                            # snapshot (stacked delete markers)
-                            break
-                        if not key.startswith(r["prefix"]):
-                            continue
-                        if r.get("days"):
-                            age = now - self._parse_mtime(
-                                ent.get("mtime", ""))
-                            latest_dm = bool((ent.get("versions") or
-                                              [{}])[0].get("dm"))
-                            if age > r["days"] * 86400 and \
-                                    not latest_dm:
+                for r in rules:
+                    if acted_on_key:
+                        # one action per key per tick: a second
+                        # matching rule would act on a stale
+                        # snapshot (stacked delete markers)
+                        break
+                    if not key.startswith(r["prefix"]):
+                        continue
+                    if r.get("days"):
+                        age = now - self._parse_mtime(
+                            ent.get("mtime", ""))
+                        latest_dm = bool((ent.get("versions") or
+                                          [{}])[0].get("dm"))
+                        if age > r["days"] * 86400 and not latest_dm:
+                            # expiry decided on this tick's snapshot;
+                            # the cls guard cancels it if the head
+                            # moved meanwhile (fresh PUT wins)
+                            try:
                                 if versioned or ent.get("versions"):
-                                    self._insert_delete_marker(bucket,
-                                                               key)
+                                    hv = (ent.get("versions")
+                                          or [{"vid": "null"}])[0]
+                                    self._insert_delete_marker(
+                                        bucket, key,
+                                        guard={"if_head_vid":
+                                               hv["vid"],
+                                               "if_mtime":
+                                               hv.get("mtime",
+                                                      ent.get(
+                                                          "mtime",
+                                                          ""))})
                                 else:
-                                    self._delete_unversioned(bucket,
-                                                             key)
+                                    self._index_exec(
+                                        bucket, key,
+                                        "obj_delete_plain",
+                                        {"plain_obj":
+                                         _data_obj(bucket, key),
+                                         "if_mtime":
+                                         ent.get("mtime", "")})
                                 acted += 1
-                                acted_on_key = True
-                                continue
-                        if r.get("noncurrent_days") and \
-                                ent.get("versions"):
-                            keep, dropped = [], 0
-                            for i, v in enumerate(ent["versions"]):
-                                age = now - self._parse_mtime(
-                                    v["mtime"])
-                                if i > 0 and age > \
-                                        r["noncurrent_days"] * 86400:
-                                    self._remove_version_data(v)
-                                    dropped += 1
-                                else:
-                                    keep.append(v)
-                            if dropped:
-                                acted += dropped
-                                acted_on_key = True
-                                self._store_versions(bucket, key,
-                                                     keep)
+                                self._notify_event(
+                                    bucket, key,
+                                    "s3:LifecycleExpiration:"
+                                    "DeleteMarkerCreated"
+                                    if versioned or
+                                    ent.get("versions") else
+                                    "s3:LifecycleExpiration:Delete",
+                                    0, "", bmeta=meta)
+                            except RadosError as e:
+                                if e.errno_name != "ECANCELED":
+                                    raise
+                            acted_on_key = True
+                            continue
+                    if r.get("noncurrent_days") and \
+                            ent.get("versions"):
+                        out = self._index_exec(
+                            bucket, key, "obj_trim_noncurrent",
+                            {"now": now,
+                             "max_age_s":
+                             r["noncurrent_days"] * 86400})
+                        if out.get("dropped"):
+                            acted += out["dropped"]
+                            acted_on_key = True
         return acted
 
     def _list_objects(self, h, bucket: str, q: dict) -> None:
@@ -551,7 +660,7 @@ class RGWGateway:
             return self._initiate_multipart(h, bucket, key)
         if method == "POST" and "uploadId" in q:
             return self._complete_multipart(h, bucket, key,
-                                            q["uploadId"])
+                                            q["uploadId"], bmeta)
         if method == "PUT" and "uploadId" in q:
             return self._upload_part(h, bucket, key, q)
         if method == "DELETE" and "uploadId" in q:
@@ -604,11 +713,15 @@ class RGWGateway:
             raise S3Error(404, "NoSuchKey", key)
         return versions[0]
 
+    def _now_str(self) -> str:
+        return now_str()
+
     def _store_versions(self, bucket: str, key: str,
-                        versions: list,
-                        nshards: int | None = None) -> None:
-        shard = _shard_of(key, nshards if nshards is not None
-                          else self._nshards(bucket))
+                        versions: list) -> None:
+        """Administrative stack rewrite (tests back-dating mtimes,
+        offline surgery).  NOT the client data path — that runs
+        through the cls_rgw index transactions above."""
+        shard = _shard_of(key, self._nshards(bucket))
         if not versions:
             self.io.remove_omap_keys(_index_obj(bucket, shard), [key])
             return
@@ -619,136 +732,130 @@ class RGWGateway:
         self.io.set_omap(_index_obj(bucket, shard),
                          {key: json.dumps(meta).encode()})
 
-    def _now_str(self) -> str:
-        return time.strftime("%Y-%m-%dT%H:%M:%S.000Z", time.gmtime())
-
-    def _versions_of(self, bucket: str, key: str,
-                     nshards: int | None = None) -> list:
-        """Existing version list; a pre-versioning plain entry folds
-        into the S3 'null' version (ref: null version semantics)."""
-        ent = self._index_entry(bucket, key, nshards)
-        if ent is None:
-            return []
-        if ent.get("versions") is not None:
-            return ent["versions"]
-        return [{"vid": "null", "size": ent["size"],
-                 "etag": ent["etag"], "mtime": ent["mtime"],
-                 "dm": False, "obj": _data_obj(bucket, key)}]
-
-    def _remove_version_data(self, v: dict) -> None:
-        if v.get("dm") or not v.get("obj"):
-            return
-        try:
-            self.io.remove(v["obj"])
-        except RadosError:
-            pass
-
     def _insert_delete_marker(self, bucket: str, key: str,
-                              vid: str | None = None) -> str:
-        versions = self._versions_of(bucket, key)
-        vid = vid or uuid.uuid4().hex
-        versions.insert(0, {"vid": vid, "size": 0, "etag": "",
-                            "mtime": self._now_str(), "dm": True,
-                            "obj": None})
-        self._store_versions(bucket, key, versions)
-        return vid
-
-    def _delete_unversioned(self, bucket: str, key: str) -> None:
-        try:
-            self.io.remove(_data_obj(bucket, key))
-        except RadosError:
-            pass
-        self.io.remove_omap_keys(
-            _index_obj(bucket, _shard_of(key, self._nshards(bucket))),
-            [key])
+                              vid: str | None = None,
+                              replace_null: bool = False,
+                              guard: dict | None = None) -> str:
+        out = self._index_exec(bucket, key, "obj_delete_marker", dict(
+            guard or {}, vid=vid or uuid.uuid4().hex,
+            mtime=self._now_str(), replace_null=replace_null,
+            plain_obj=_data_obj(bucket, key)))
+        return out["vid"]
 
     def _delete_object(self, h, bucket: str, key: str, bmeta: dict,
                        meta: dict, want_vid: str) -> None:
         """Versioned deletes (ref: rgw delete marker flow): no
         versionId = insert a delete marker (Enabled) or replace the
         null version with one (Suspended); an explicit versionId
-        removes that version outright."""
+        removes that version outright.  Every index RMW runs on the
+        OSD (cls/rgw.py) — concurrent gateways stay consistent."""
         versioning = self._versioning_of(bmeta)
-        with self._vlock:
-            if want_vid:
-                versions = self._versions_of(bucket, key)
-                keep = []
-                for v in versions:
-                    if v["vid"] == want_vid:
-                        self._remove_version_data(v)
-                    else:
-                        keep.append(v)
-                if len(keep) == len(versions):
+        plain_obj = _data_obj(bucket, key)
+        if want_vid:
+            try:
+                self._index_exec(bucket, key, "obj_delete_version",
+                                 {"vid": want_vid,
+                                  "plain_obj": plain_obj})
+            except RadosError as e:
+                if e.errno_name == "ENOENT":
                     raise S3Error(404, "NoSuchVersion", want_vid)
-                if not keep and meta.get("versions") is None:
-                    self._delete_unversioned(bucket, key)
-                else:
-                    self._store_versions(bucket, key, keep)
-                return self._respond(h, 204, headers={
-                    "x-amz-version-id": want_vid})
-            if not versioning and meta.get("versions") is None:
-                self._delete_unversioned(bucket, key)
+                raise
+            self._notify_event(bucket, key, "s3:ObjectRemoved:Delete",
+                               0, "", want_vid, bmeta)
+            return self._respond(h, 204, headers={
+                "x-amz-version-id": want_vid})
+        if not versioning and meta.get("versions") is None:
+            try:
+                self._index_exec(bucket, key, "obj_delete_plain",
+                                 {"plain_obj": plain_obj})
+                self._notify_event(bucket, key,
+                                   "s3:ObjectRemoved:Delete", 0, "",
+                                   bmeta=bmeta)
                 return self._respond(h, 204)
-            if versioning == "Suspended":
-                # the null version is replaced by a null delete marker
-                keep = []
-                for v in self._versions_of(bucket, key):
-                    if v["vid"] == "null":
-                        self._remove_version_data(v)
-                    else:
-                        keep.append(v)
-                self._store_versions(bucket, key, keep)
-                vid = self._insert_delete_marker(bucket, key,
-                                                 vid="null")
-            else:
-                vid = self._insert_delete_marker(bucket, key)
+            except RadosError as e:
+                if e.errno_name != "ECANCELED":
+                    raise
+                # a concurrent versioned PUT grew a stack under us:
+                # fall through to the delete-marker path
+        vid = self._insert_delete_marker(
+            bucket, key, vid="null" if versioning == "Suspended"
+            else None, replace_null=versioning == "Suspended")
+        self._notify_event(bucket, key,
+                           "s3:ObjectRemoved:DeleteMarkerCreated",
+                           0, "", vid, bmeta)
         self._respond(h, 204, headers={"x-amz-delete-marker": "true",
                                        "x-amz-version-id": vid})
 
+    def _index_exec(self, bucket: str, key: str, method: str,
+                    indata: dict, nshards: int | None = None) -> dict:
+        """Run a cls_rgw index transaction on the key's index shard.
+        The RMW executes inside the OSD (cls/rgw.py) so concurrent
+        gateways serialize on the PG — the reference's cls_rgw
+        contract (ref: src/cls/rgw/cls_rgw.cc), replacing the old
+        gateway-local _vlock which could not protect two processes."""
+        if nshards is None:
+            nshards = self._nshards(bucket)
+        iobj = _index_obj(bucket, _shard_of(key, nshards))
+        out = self.io.exec(iobj, "rgw", method,
+                           dict(indata, key=key)) or {}
+        self._remove_objs(out.get("removed", ()))
+        return out
+
+    def _remove_objs(self, objs) -> None:
+        """Delete data objects AFTER their index commit orphaned them
+        (index-first ordering: a crash leaves garbage, never a
+        dangling index entry — the reference's gc does the same)."""
+        for obj in objs:
+            try:
+                self.io.remove(obj)
+            except RadosError:
+                pass
+
     def _store_object(self, bucket: str, key: str, data: bytes,
                       etag: str, bmeta: dict | None = None) -> str | None:
-        """Write object data + index honoring the bucket's versioning
-        state; returns the new version id (None = unversioned bucket).
-        The version-stack read-modify-write runs under _vlock — a
-        concurrent PUT on the same key must not lose a version."""
+        """Write object data, then commit the index transaction on the
+        OSD; returns the new version id (None = unversioned bucket)."""
         bmeta = bmeta if bmeta is not None \
             else self._require_bucket(bucket)
         versioning = self._versioning_of(bmeta)
         nshards = int(bmeta.get("shards", 1))
-        with self._vlock:
-            if versioning == "Enabled":
-                vid = uuid.uuid4().hex
-                obj = f"{bucket}/{key}@{vid}"
-                self.io.write_full(obj, data)
-                versions = self._versions_of(bucket, key, nshards)
-                versions.insert(0, {"vid": vid, "size": len(data),
-                                    "etag": etag,
-                                    "mtime": self._now_str(),
-                                    "dm": False, "obj": obj})
-                self._store_versions(bucket, key, versions, nshards)
-                return vid
-            if versioning == "Suspended":
-                # overwrite the null version in place
-                obj = _data_obj(bucket, key)
-                self.io.write_full(obj, data)
-                versions = [v for v in
-                            self._versions_of(bucket, key, nshards)
-                            if v["vid"] != "null"]
-                versions.insert(0, {"vid": "null", "size": len(data),
-                                    "etag": etag,
-                                    "mtime": self._now_str(),
-                                    "dm": False, "obj": obj})
-                self._store_versions(bucket, key, versions, nshards)
-                return "null"
-            self.io.write_full(_data_obj(bucket, key), data)
-            self._write_index(bucket, key, len(data), etag)
-            return None
+        # every write lands in a FRESH object; the index transaction
+        # links it and reports what it orphaned (the reference's
+        # instance-object model) — an overwrite never clobbers bytes
+        # a concurrent reader or a surprise version stack still needs
+        gen = uuid.uuid4().hex
+        if versioning == "Enabled":
+            vid, mode = gen, "enabled"
+            obj = f"{bucket}/{key}@{vid}"
+        elif versioning == "Suspended":
+            vid, mode = "null", "suspended"
+            obj = f"{bucket}/{key}@null.{gen}"
+        else:
+            vid, mode = "", "plain"
+            obj = f"{bucket}/{key}#{gen}"
+        self.io.write_full(obj, data)
+        try:
+            out = self._index_exec(bucket, key, "obj_store", {
+                "mode": mode, "vid": vid, "size": len(data),
+                "etag": etag, "mtime": self._now_str(), "obj": obj,
+                "plain_obj": _data_obj(bucket, key)}, nshards)
+        except RadosError as e:
+            if e.errno_name != "ECANCELED" or mode != "plain":
+                raise
+            # the entry grew a version stack under us (versioning
+            # enabled concurrently): drop the unlinked staging object
+            # and retry with fresh bucket meta
+            self._remove_objs([obj])
+            return self._store_object(bucket, key, data, etag)
+        return out.get("vid")
 
     def _put_object(self, h, bucket: str, key: str,
                     bmeta: dict | None = None) -> None:
         data = self._read_body(h)
         etag = hashlib.md5(data).hexdigest()
         vid = self._store_object(bucket, key, data, etag, bmeta)
+        self._notify_event(bucket, key, "s3:ObjectCreated:Put",
+                           len(data), etag, vid, bmeta)
         hdrs = {"ETag": f'"{etag}"'}
         if vid is not None:
             hdrs["x-amz-version-id"] = vid
@@ -769,21 +876,14 @@ class RGWGateway:
         data = self.io.read(sv.get("obj") or _data_obj(s_bucket,
                                                        s_key))
         etag = hashlib.md5(data).hexdigest()
-        self._store_object(bucket, key, data, etag, bmeta)
+        vid = self._store_object(bucket, key, data, etag, bmeta)
+        self._notify_event(bucket, key, "s3:ObjectCreated:Copy",
+                           len(data), etag, vid, bmeta)
         self._respond(h, 200, (
             '<?xml version="1.0"?><CopyObjectResult>'
             f"<ETag>&quot;{etag}&quot;</ETag>"
             f"<LastModified>{s_meta['mtime']}</LastModified>"
             "</CopyObjectResult>").encode())
-
-    def _write_index(self, bucket: str, key: str, size: int,
-                     etag: str) -> None:
-        meta = {"size": size, "etag": etag,
-                "mtime": time.strftime("%Y-%m-%dT%H:%M:%S.000Z",
-                                       time.gmtime())}
-        shard = _shard_of(key, self._nshards(bucket))
-        self.io.set_omap(_index_obj(bucket, shard),
-                         {key: json.dumps(meta).encode()})
 
     # -- multipart (ref: rgw RGWInitMultipart/CompleteMultipart) ---------
     def _initiate_multipart(self, h, bucket: str, key: str) -> None:
@@ -823,7 +923,8 @@ class RGWGateway:
         self._respond(h, 200, headers={"ETag": f'"{etag}"'})
 
     def _complete_multipart(self, h, bucket: str, key: str,
-                            upload_id: str) -> None:
+                            upload_id: str,
+                            bmeta: dict | None = None) -> None:
         meta = self._upload_meta(bucket, upload_id)
         body = self._read_body(h)
         wanted = []
@@ -844,7 +945,11 @@ class RGWGateway:
         etag = hashlib.md5(
             b"".join(bytes.fromhex(e) for e in etags)).hexdigest() \
             + f"-{len(wanted)}"
-        self._store_object(bucket, key, bytes(blob), etag)
+        vid = self._store_object(bucket, key, bytes(blob), etag,
+                                 bmeta)
+        self._notify_event(bucket, key,
+                           "s3:ObjectCreated:CompleteMultipartUpload",
+                           len(blob), etag, vid, bmeta)
         self._cleanup_upload(bucket, upload_id, meta)
         self._respond(h, 200, (
             '<?xml version="1.0"?><CompleteMultipartUploadResult>'

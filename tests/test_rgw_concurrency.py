@@ -1,0 +1,141 @@
+"""Cross-gateway index safety: the version-stack RMW executes inside
+the OSD (cls/rgw.py), so two radosgw processes over one pool can race
+without losing records — the reference's cls_rgw contract
+(ref: src/cls/rgw/cls_rgw.cc; VERDICT r4 weak #4)."""
+import threading
+import urllib.request
+from xml.etree import ElementTree as ET
+
+import pytest
+
+from ceph_tpu.rgw import RGWGateway
+from ceph_tpu.testing import MiniCluster
+
+VERS_ON = (b"<VersioningConfiguration>"
+           b"<Status>Enabled</Status></VersioningConfiguration>")
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(n_osd=4, threaded=True)
+    c.wait_all_up()
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture(scope="module")
+def two_gateways(cluster):
+    """Two independent gateway instances — separate RADOS clients,
+    separate HTTP servers, NO shared process state — on one pool."""
+    g1 = RGWGateway(cluster.rados(), pool="rgwrace")
+    g2 = RGWGateway(cluster.rados(), pool="rgwrace")
+    g1.start()
+    g2.start()
+    yield g1, g2
+    g1.shutdown()
+    g2.shutdown()
+
+
+def req(gw, method, path, data=None, headers=None):
+    r = urllib.request.Request(f"http://127.0.0.1:{gw.port}{path}",
+                               data=data, method=method,
+                               headers=headers or {})
+    with urllib.request.urlopen(r, timeout=30) as resp:
+        return resp.status, dict(resp.headers), resp.read()
+
+
+def test_racing_versioned_puts_lose_nothing(two_gateways):
+    """N concurrent PUTs to ONE key through TWO gateways must yield
+    exactly N distinct version records."""
+    g1, g2 = two_gateways
+    req(g1, "PUT", "/race")
+    req(g1, "PUT", "/race?versioning", VERS_ON)
+    n_threads, per_thread = 8, 6
+    vids, errs = [], []
+    lock = threading.Lock()
+
+    def worker(i):
+        gw = (g1, g2)[i % 2]
+        try:
+            for j in range(per_thread):
+                _, hdrs, _ = req(gw, "PUT", "/race/hot",
+                                 f"w{i}.{j}".encode())
+                with lock:
+                    vids.append(hdrs["x-amz-version-id"])
+        except Exception as e:            # noqa: BLE001
+            with lock:
+                errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert len(set(vids)) == n_threads * per_thread
+    # every returned vid is actually in the committed stack
+    _, _, body = req(g1, "GET", "/race?versions")
+    listed = {e.text for e in ET.fromstring(body).iter()
+              if e.tag == "VersionId"}
+    assert set(vids) <= listed
+    assert len(listed) == n_threads * per_thread
+
+
+def test_racing_plain_puts_different_keys_one_shard(two_gateways):
+    """Unversioned PUTs to distinct keys racing through both gateways
+    keep every index entry (per-key omap values never clobber each
+    other)."""
+    g1, g2 = two_gateways
+    req(g1, "PUT", "/race2")
+    keys = [f"k{i}" for i in range(24)]
+
+    def worker(i):
+        gw = (g1, g2)[i % 2]
+        req(gw, "PUT", f"/race2/{keys[i]}", b"x" * 10)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(len(keys))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    _, _, body = req(g2, "GET", "/race2")
+    listed = {e.text for e in ET.fromstring(body).iter()
+              if e.tag == "Key"}
+    assert listed == set(keys)
+
+
+def test_delete_vs_put_race_stays_consistent(two_gateways):
+    """Concurrent delete-marker inserts and PUTs through different
+    gateways: the final stack contains every PUT's version and every
+    returned marker vid — nothing vanishes."""
+    g1, g2 = two_gateways
+    req(g1, "PUT", "/race3")
+    req(g1, "PUT", "/race3?versioning", VERS_ON)
+    req(g1, "PUT", "/race3/obj", b"seed")
+    put_vids, dm_vids = [], []
+    lock = threading.Lock()
+
+    def putter():
+        for j in range(5):
+            _, hdrs, _ = req(g1, "PUT", "/race3/obj", b"p%d" % j)
+            with lock:
+                put_vids.append(hdrs["x-amz-version-id"])
+
+    def deleter():
+        for _ in range(5):
+            _, hdrs, _ = req(g2, "DELETE", "/race3/obj")
+            with lock:
+                dm_vids.append(hdrs["x-amz-version-id"])
+
+    t1, t2 = (threading.Thread(target=putter),
+              threading.Thread(target=deleter))
+    t1.start(), t2.start()
+    t1.join(), t2.join()
+    _, _, body = req(g1, "GET", "/race3?versions")
+    listed = {e.text for e in ET.fromstring(body).iter()
+              if e.tag == "VersionId"}
+    assert set(put_vids) <= listed
+    assert set(dm_vids) <= listed
+    assert len(listed) == 1 + len(put_vids) + len(dm_vids)

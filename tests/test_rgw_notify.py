@@ -1,0 +1,186 @@
+"""Bucket notifications: topics, configs, persistent queues, ordered
+push delivery (ref: src/rgw/rgw_pubsub.cc, rgw_notify.cc;
+VERDICT r4 missing #4)."""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from ceph_tpu.rgw import RGWGateway
+from ceph_tpu.testing import MiniCluster
+
+VERS_ON = (b"<VersioningConfiguration>"
+           b"<Status>Enabled</Status></VersioningConfiguration>")
+
+
+class _Receiver:
+    """Endpoint that records events; can be told to fail for a while
+    (delivery must retry without losing order)."""
+
+    def __init__(self):
+        self.events = []
+        self.fail = False
+        rec = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                body = self.rfile.read(
+                    int(self.headers.get("Content-Length", 0)))
+                if rec.fail:
+                    self.send_response(503)
+                    self.end_headers()
+                    return
+                rec.events.append(json.loads(body))
+                self.send_response(200)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def names(self):
+        return [e["Records"][0]["eventName"] for e in self.events]
+
+    def keys(self):
+        return [e["Records"][0]["s3"]["object"]["key"]
+                for e in self.events]
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(n_osd=4, threaded=True)
+    c.wait_all_up()
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture()
+def gw(cluster):
+    g = RGWGateway(cluster.rados(), pool="rgwnote")
+    g.start()
+    yield g
+    g.shutdown()
+
+
+@pytest.fixture()
+def receiver():
+    r = _Receiver()
+    yield r
+    r.close()
+
+
+def req(gw, method, path, data=None):
+    r = urllib.request.Request(f"http://127.0.0.1:{gw.port}{path}",
+                               data=data, method=method)
+    with urllib.request.urlopen(r, timeout=30) as resp:
+        return resp.status, dict(resp.headers), resp.read()
+
+
+def _wait(cond, timeout=10.0):
+    end = time.time() + timeout
+    while time.time() < end:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return cond()
+
+
+NOTIF = (b'<NotificationConfiguration><TopicConfiguration>'
+         b'<Id>n1</Id><Topic>arn:aws:sns:::t1</Topic>'
+         b'<Event>s3:ObjectCreated:*</Event>'
+         b'<Event>s3:ObjectRemoved:*</Event>'
+         b'</TopicConfiguration></NotificationConfiguration>')
+
+
+def _setup(gw, receiver, bucket):
+    req(gw, "POST",
+        f"/?Action=CreateTopic&Name=t1&push-endpoint="
+        f"http%3A%2F%2F127.0.0.1%3A{receiver.port}%2F")
+    req(gw, "PUT", f"/{bucket}")
+    req(gw, "PUT", f"/{bucket}?notification", NOTIF)
+
+
+def test_topic_admin_and_config_roundtrip(gw, receiver):
+    _setup(gw, receiver, "nb0")
+    _, _, body = req(gw, "GET", "/?Action=ListTopics")
+    assert b"arn:aws:sns:::t1" in body
+    _, _, body = req(gw, "GET", "/nb0?notification")
+    assert b"s3:ObjectCreated:*" in body and b"t1" in body
+    # config referencing an unknown topic is rejected
+    bad = NOTIF.replace(b":::t1", b":::nope")
+    try:
+        req(gw, "PUT", "/nb0?notification", bad)
+        assert False, "expected 400"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+
+
+def test_events_delivered_in_order(gw, receiver):
+    _setup(gw, receiver, "nb1")
+    for i in range(6):
+        req(gw, "PUT", f"/nb1/k{i}", b"data%d" % i)
+    req(gw, "DELETE", "/nb1/k0")
+    assert _wait(lambda: len(receiver.events) >= 7)
+    assert receiver.keys() == [f"k{i}" for i in range(6)] + ["k0"]
+    assert receiver.names()[:6] == ["s3:ObjectCreated:Put"] * 6
+    assert receiver.names()[6] == "s3:ObjectRemoved:Delete"
+    rec = receiver.events[0]["Records"][0]
+    assert rec["s3"]["bucket"]["name"] == "nb1"
+    assert rec["s3"]["object"]["size"] == 5
+
+
+def test_prefix_filter_and_event_match(gw, receiver):
+    req(gw, "POST",
+        f"/?Action=CreateTopic&Name=t1&push-endpoint="
+        f"http%3A%2F%2F127.0.0.1%3A{receiver.port}%2F")
+    req(gw, "PUT", "/nb2")
+    cfg = (b'<NotificationConfiguration><TopicConfiguration>'
+           b'<Id>p</Id><Topic>arn:aws:sns:::t1</Topic>'
+           b'<Event>s3:ObjectCreated:Put</Event>'
+           b'<Filter><S3Key><FilterRule><Name>prefix</Name>'
+           b'<Value>logs/</Value></FilterRule></S3Key></Filter>'
+           b'</TopicConfiguration></NotificationConfiguration>')
+    req(gw, "PUT", "/nb2?notification", cfg)
+    req(gw, "PUT", "/nb2/logs/a", b"x")
+    req(gw, "PUT", "/nb2/other/b", b"x")     # filtered out
+    req(gw, "DELETE", "/nb2/logs/a")         # event type not matched
+    assert _wait(lambda: len(receiver.events) >= 1)
+    time.sleep(0.3)
+    assert receiver.keys() == ["logs/a"]
+
+
+def test_endpoint_outage_redelivers_in_order(gw, receiver):
+    """Persistent queue semantics: events published while the endpoint
+    is down survive and arrive in order once it recovers."""
+    _setup(gw, receiver, "nb3")
+    receiver.fail = True
+    for i in range(4):
+        req(gw, "PUT", f"/nb3/q{i}", b"y")
+    time.sleep(0.3)
+    assert receiver.events == []
+    receiver.fail = False
+    assert _wait(lambda: len(receiver.events) >= 4)
+    assert receiver.keys() == [f"q{i}" for i in range(4)]
+
+
+def test_versioned_events_carry_version_id(gw, receiver):
+    _setup(gw, receiver, "nb4")
+    req(gw, "PUT", "/nb4?versioning", VERS_ON)
+    _, hdrs, _ = req(gw, "PUT", "/nb4/v", b"z")
+    vid = hdrs["x-amz-version-id"]
+    assert _wait(lambda: len(receiver.events) >= 1)
+    assert receiver.events[0]["Records"][0]["s3"]["object"][
+        "versionId"] == vid

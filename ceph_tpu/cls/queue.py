@@ -4,10 +4,17 @@ The reference's persistent bucket notifications ride a rados-backed
 queue maintained by cls methods (ref: src/cls/queue/cls_queue.cc,
 src/cls/2pc_queue — rgw_pubsub's persistent topics enqueue there and
 a pusher drains it).  Here the queue is the object's omap: the header
-carries the next sequence number, entries live under zero-padded
-sequence keys so omap order IS arrival order, and enqueue allocates
-the sequence inside the OSD — concurrent producers (two gateways
+carries [head, next) — the live contiguous sequence range — entries
+live under zero-padded sequence keys, and enqueue allocates the
+sequence inside the OSD, so concurrent producers (two gateways
 publishing to one topic) can never collide or reorder.
+
+Because the live range is contiguous, list and remove address entries
+by GENERATED keys instead of scanning/sorting the whole backlog.
+Honest limit: MethodContext exposes only a full omap_get, so the read
+side still materializes the backlog dict once per list call (an
+in-memory copy, no per-key decode/sort); remove is O(acked range).
+A ranged omap read in the object store would finish the job.
 """
 from __future__ import annotations
 
@@ -24,7 +31,10 @@ def _seq_key(seq: int) -> str:
 
 def _header(ctx) -> dict:
     raw = ctx.omap_get_header()
-    return json.loads(raw) if raw else {"next": 0}
+    hdr = json.loads(raw) if raw else {}
+    hdr.setdefault("next", 0)
+    hdr.setdefault("head", 0)
+    return hdr
 
 
 @cls_method("queue", "enqueue", CLS_METHOD_WR)
@@ -45,29 +55,31 @@ def enqueue(ctx, d):
 
 @cls_method("queue", "list", CLS_METHOD_RD)
 def list_entries(ctx, d):
-    """Entries from sequence `start`, up to `max` of them, in order
-    (ref: cls_queue_list_entries)."""
-    start = int(d.get("start", 0))
+    """Entries from sequence max(`start`, head), up to `max` of them,
+    in order (ref: cls_queue_list_entries)."""
+    hdr = _header(ctx)
+    start = max(int(d.get("start", 0)), hdr["head"])
     limit = int(d.get("max", 128))
     om = ctx.omap_get()
     out = []
-    for k in sorted(om):
-        seq = int(k)
-        if seq < start:
-            continue
-        out.append({"seq": seq, "data": om[k]})
-        if len(out) >= limit:
-            break
-    return {"entries": out, "next": _header(ctx)["next"]}
+    for seq in range(start, min(hdr["next"], start + limit)):
+        data = om.get(_seq_key(seq))
+        if data is not None:
+            out.append({"seq": seq, "data": data})
+    return {"entries": out, "next": hdr["next"], "head": hdr["head"]}
 
 
 @cls_method("queue", "remove", CLS_METHOD_WR)
 def remove(ctx, d):
     """Ack entries with sequence < `upto` (ref:
-    cls_queue_remove_entries — the consumer trims what it delivered)."""
-    upto = int(d["upto"])
-    om = ctx.omap_get()
-    dead = [k for k in om if int(k) < upto]
+    cls_queue_remove_entries — the consumer trims what it delivered).
+    Keys are generated from the contiguous [head, upto) range, never
+    scanned."""
+    hdr = _header(ctx)
+    upto = min(int(d["upto"]), hdr["next"])
+    dead = [_seq_key(s) for s in range(hdr["head"], upto)]
     if dead:
         ctx.omap_rmkeys(dead)
+        hdr["head"] = upto
+        ctx.omap_set_header(json.dumps(hdr).encode())
     return {"removed": len(dead)}

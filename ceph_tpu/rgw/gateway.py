@@ -121,6 +121,11 @@ class RGWGateway:
                 try:
                     body = gw._read_body(self)
                     self._body = body
+                    u = urlparse(self.path)
+                    if u.path == "/auth/v1.0" or \
+                            u.path.startswith("/swift/v1"):
+                        # Swift speaks TempAuth tokens, not SigV4
+                        return gw._run_swift(self, method, u)
                     if gw.keyring is not None:
                         try:
                             presigned = "X-Amz-Signature" in parse_qs(
@@ -173,6 +178,56 @@ class RGWGateway:
         self._thread: threading.Thread | None = None
         self.topics = TopicStore(self.io)
         self.pusher = EventPusher(self.io, self.topics)
+        from .swift import SwiftFrontend
+        self.swift = SwiftFrontend(self)
+        #: deferred GC of data objects orphaned by index commits —
+        #: a reader that resolved the OLD index entry gets a grace
+        #: window to finish its data read (the reference defers the
+        #: same way via rgw gc; immediate deletion 500'd racing GETs)
+        self._gc_queue: list[tuple[float, str]] = []
+        self._gc_lock = threading.Lock()
+        self._gc_stop = threading.Event()
+
+    #: seconds an orphaned object outlives its index unlink
+    GC_GRACE_S = 2.0
+
+    def _gc_loop(self) -> None:
+        while not self._gc_stop.is_set():
+            self._gc_tick()
+            self._gc_stop.wait(0.25)
+
+    def _gc_tick(self, everything: bool = False) -> int:
+        now = time.time()
+        with self._gc_lock:
+            due = [o for t, o in self._gc_queue
+                   if everything or t <= now]
+            self._gc_queue = [(t, o) for t, o in self._gc_queue
+                              if not everything and t > now]
+        for obj in due:
+            try:
+                self.io.remove(obj)
+            except RadosError:
+                pass
+        return len(due)
+
+    def _run_swift(self, h, method: str, u) -> None:
+        """Swift protocol branch (ref: rgw_rest_swift.cc — one
+        radosgw process serves both APIs over one bucket namespace)."""
+        from .swift import SwiftError
+        q = {k: v[0] for k, v in parse_qs(
+            u.query, keep_blank_values=True).items()}
+        try:
+            if u.path == "/auth/v1.0":
+                return self.swift.handle_auth(h)
+            return self.swift.route(h, method, unquote(u.path), q)
+        except SwiftError as e:
+            body = (e.msg or "").encode()
+            h.send_response(e.status)
+            h.send_header("Content-Type", "text/plain")
+            h.send_header("Content-Length", str(len(body)))
+            h.end_headers()
+            if h.command != "HEAD":
+                h.wfile.write(body)
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> None:
@@ -180,11 +235,16 @@ class RGWGateway:
                                         name="rgw", daemon=True)
         self._thread.start()
         self.pusher.start()
+        threading.Thread(target=self._gc_loop, name="rgw-gc",
+                         daemon=True).start()
 
     def shutdown(self) -> None:
         self.pusher.stop()
+        self._gc_stop.set()
         self.httpd.shutdown()
         self.httpd.server_close()
+        # no requests can race us anymore: collect everything pending
+        self._gc_tick(everything=True)
 
     # -- notifications (ref: src/rgw/rgw_pubsub.cc) ----------------------
     def _notify_event(self, bucket: str, key: str, event: str,
@@ -318,18 +378,7 @@ class RGWGateway:
         if "notification" in q:
             return self._notification_op(h, method, bucket)
         if method == "PUT":
-            if bucket in self._buckets():
-                # idempotent re-create must NOT rebuild the meta —
-                # that would silently wipe versioning/lifecycle state
-                return self._respond(h, 200,
-                                     headers={"Location": f"/{bucket}"})
-            meta = json.dumps({"created": time.strftime(
-                "%Y-%m-%dT%H:%M:%S.000Z", time.gmtime()),
-                "shards": self.index_shards}).encode()
-            self.io.operate(BUCKETS_OBJ,
-                            WriteOp().set_omap({bucket: meta}))
-            for shard in range(self.index_shards):
-                self.io.create(_index_obj(bucket, shard))
+            self._create_bucket(bucket)
             return self._respond(h, 200,
                                  headers={"Location": f"/{bucket}"})
         self._require_bucket(bucket)
@@ -342,15 +391,34 @@ class RGWGateway:
         if method == "DELETE":
             if self._index(bucket):
                 raise S3Error(409, "BucketNotEmpty", bucket)
-            nshards = self._nshards(bucket)
-            self.io.remove_omap_keys(BUCKETS_OBJ, [bucket])
-            for shard in range(nshards):
-                try:
-                    self.io.remove(_index_obj(bucket, shard))
-                except RadosError:
-                    pass
+            self._delete_bucket(bucket)
             return self._respond(h, 204)
         raise S3Error(405, "MethodNotAllowed", method)
+
+    def _create_bucket(self, bucket: str) -> bool:
+        """Shared by the S3 and Swift frontends — ONE place defines
+        bucket meta and index layout.  Returns False when the bucket
+        already existed (idempotent re-create must NOT rebuild the
+        meta: that would silently wipe versioning/lifecycle state)."""
+        if bucket in self._buckets():
+            return False
+        meta = json.dumps({"created": self._now_str(),
+                           "shards": self.index_shards}).encode()
+        self.io.operate(BUCKETS_OBJ, WriteOp().set_omap({bucket: meta}))
+        for shard in range(self.index_shards):
+            self.io.create(_index_obj(bucket, shard))
+        return True
+
+    def _delete_bucket(self, bucket: str) -> None:
+        """Emptiness is the caller's check (protocols differ on the
+        error shape)."""
+        nshards = self._nshards(bucket)
+        self.io.remove_omap_keys(BUCKETS_OBJ, [bucket])
+        for shard in range(nshards):
+            try:
+                self.io.remove(_index_obj(bucket, shard))
+            except RadosError:
+                pass
 
     # -- versioning (ref: rgw versioned buckets; S3 PutBucketVersioning)
     def _versioning_op(self, h, method: str, bucket: str) -> None:
@@ -491,7 +559,12 @@ class RGWGateway:
             name = q.get("Name", "")
             if not name:
                 raise S3Error(400, "InvalidArgument", "Name")
-            self.topics.create(name, q.get("push-endpoint", ""))
+            endpoint = q.get("push-endpoint", "")
+            if endpoint and not endpoint.startswith(
+                    ("http://", "https://")):
+                raise S3Error(400, "InvalidArgument",
+                              f"push-endpoint {endpoint}")
+            self.topics.create(name, endpoint)
             return self._respond(h, 200, (
                 '<?xml version="1.0"?><CreateTopicResponse>'
                 f"<TopicArn>arn:aws:sns:::{escape(name)}</TopicArn>"
@@ -674,14 +747,15 @@ class RGWGateway:
             raise S3Error(404, "NoSuchKey", key)
         want_vid = q.get("versionId", "")
         if method in ("HEAD", "GET"):
-            v = self._select_version(meta, want_vid, key)
             if method == "HEAD":
+                v = self._select_version(meta, want_vid, key)
                 return self._respond(
                     h, 200, b"", "application/octet-stream",
                     {"ETag": f'"{v["etag"]}"',
                      "Content-Length": str(v["size"]),
                      "x-amz-version-id": v.get("vid", "null")})
-            data = self.io.read(v.get("obj") or _data_obj(bucket, key))
+            v, data = self._read_version_data(bucket, key, meta,
+                                              want_vid)
             return self._respond(h, 200, data,
                                  "application/octet-stream",
                                  {"ETag": f'"{v["etag"]}"',
@@ -691,6 +765,28 @@ class RGWGateway:
             return self._delete_object(h, bucket, key, bmeta, meta,
                                        want_vid)
         raise S3Error(405, "MethodNotAllowed", method)
+
+    def _read_version_data(self, bucket: str, key: str, meta: dict,
+                           want_vid: str) -> tuple[dict, bytes]:
+        """Resolve + read the served version's bytes.  If a racing
+        overwrite garbage-collected our object between the index read
+        and the data read (the GC grace window lost the race), the
+        index is re-resolved ONCE — the fresh entry names the new
+        object, so the reader gets consistent (headers, bytes) instead
+        of a 500."""
+        v = self._select_version(meta, want_vid, key)
+        try:
+            return v, self.io.read(v.get("obj")
+                                   or _data_obj(bucket, key))
+        except RadosError as e:
+            if e.errno_name != "ENOENT":
+                raise
+            meta2 = self._index_entry(bucket, key)
+            if meta2 is None:
+                raise S3Error(404, "NoSuchKey", key)
+            v2 = self._select_version(meta2, want_vid, key)
+            return v2, self.io.read(v2.get("obj")
+                                    or _data_obj(bucket, key))
 
     def _select_version(self, meta: dict, vid: str, key: str) -> dict:
         """The version a read serves: the newest live one, or the
@@ -801,10 +897,18 @@ class RGWGateway:
         self._remove_objs(out.get("removed", ()))
         return out
 
-    def _remove_objs(self, objs) -> None:
+    def _remove_objs(self, objs, defer: bool = True) -> None:
         """Delete data objects AFTER their index commit orphaned them
         (index-first ordering: a crash leaves garbage, never a
-        dangling index entry — the reference's gc does the same)."""
+        dangling index entry — the reference's gc does the same).
+        Deletion is deferred GC_GRACE_S so a reader holding the old
+        index entry can still finish; defer=False is for objects no
+        reader can have seen (never-linked staging writes)."""
+        if defer:
+            expire = time.time() + self.GC_GRACE_S
+            with self._gc_lock:
+                self._gc_queue.extend((expire, o) for o in objs)
+            return
         for obj in objs:
             try:
                 self.io.remove(obj)
@@ -845,7 +949,7 @@ class RGWGateway:
             # the entry grew a version stack under us (versioning
             # enabled concurrently): drop the unlinked staging object
             # and retry with fresh bucket meta
-            self._remove_objs([obj])
+            self._remove_objs([obj], defer=False)
             return self._store_object(bucket, key, data, etag)
         return out.get("vid")
 
@@ -872,9 +976,7 @@ class RGWGateway:
         s_meta = self._index_entry(s_bucket, s_key)
         if s_meta is None:
             raise S3Error(404, "NoSuchKey", s_key)
-        sv = self._select_version(s_meta, "", s_key)
-        data = self.io.read(sv.get("obj") or _data_obj(s_bucket,
-                                                       s_key))
+        _, data = self._read_version_data(s_bucket, s_key, s_meta, "")
         etag = hashlib.md5(data).hexdigest()
         vid = self._store_object(bucket, key, data, etag, bmeta)
         self._notify_event(bucket, key, "s3:ObjectCreated:Copy",

@@ -146,7 +146,9 @@ class EventPusher:
             sent = 0
             try:
                 sent = self.tick()
-            except RadosError:
+            except Exception:       # noqa: BLE001 — the pusher is a
+                # daemon-lifetime loop; one bad topic/endpoint must
+                # not silently end delivery for every other topic
                 pass
             wait = self.interval if sent else \
                 min(wait * 2, self.MAX_IDLE_INTERVAL)
@@ -252,7 +254,10 @@ class EventPusher:
                 headers={"Content-Type": "application/json"})
             with urllib.request.urlopen(req, timeout=5.0) as resp:
                 return 200 <= resp.status < 300
-        except OSError:
+        except Exception:           # noqa: BLE001 — a malformed
+            # endpoint raises ValueError/InvalidURL, not OSError; any
+            # delivery failure must count as retryable, never kill
+            # the pusher thread
             self.push_errors += 1
             return False
 

@@ -1,0 +1,167 @@
+"""Swift frontend over the S3 bucket namespace
+(ref: src/rgw/rgw_rest_swift.cc, rgw_swift_auth.cc TempAuth;
+VERDICT r4 missing #4)."""
+import base64
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from ceph_tpu.auth import KeyRing
+from ceph_tpu.rgw import RGWGateway
+from ceph_tpu.testing import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(n_osd=4, threaded=True)
+    c.wait_all_up()
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture(scope="module")
+def gw(cluster):
+    g = RGWGateway(cluster.rados(), pool="swift")
+    g.start()
+    yield g
+    g.shutdown()
+
+
+def req(gw, method, path, data=None, headers=None):
+    r = urllib.request.Request(f"http://127.0.0.1:{gw.port}{path}",
+                               data=data, method=method,
+                               headers=headers or {})
+    with urllib.request.urlopen(r, timeout=30) as resp:
+        return resp.status, dict(resp.headers), resp.read()
+
+
+def test_container_crud_and_listing(gw):
+    assert req(gw, "PUT", "/swift/v1/c1")[0] == 201
+    assert req(gw, "PUT", "/swift/v1/c1")[0] == 202   # idempotent
+    st, hdrs, _ = req(gw, "HEAD", "/swift/v1/c1")
+    assert st == 204 and hdrs["X-Container-Object-Count"] == "0"
+    # account listing sees it (text + json)
+    st, _, body = req(gw, "GET", "/swift/v1")
+    assert b"c1\n" in body
+    st, _, body = req(gw, "GET", "/swift/v1?format=json")
+    names = [r["name"] for r in json.loads(body)]
+    assert "c1" in names
+    assert req(gw, "DELETE", "/swift/v1/c1")[0] == 204
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        req(gw, "HEAD", "/swift/v1/c1")
+    assert ei.value.code == 404
+
+
+def test_object_crud_headers_and_listing(gw):
+    req(gw, "PUT", "/swift/v1/c2")
+    st, hdrs, _ = req(gw, "PUT", "/swift/v1/c2/a/b.txt", b"hello")
+    assert st == 201
+    assert '"' not in hdrs["ETag"]          # Swift: unquoted md5
+    st, hdrs, body = req(gw, "GET", "/swift/v1/c2/a/b.txt")
+    assert body == b"hello"
+    assert hdrs["ETag"] == "5d41402abc4b2a76b9719d911017c592"
+    st, hdrs, body = req(gw, "HEAD", "/swift/v1/c2/a/b.txt")
+    assert st == 200 and hdrs["Content-Length"] == "5"
+    assert body == b""
+    req(gw, "PUT", "/swift/v1/c2/a/c.txt", b"xx")
+    req(gw, "PUT", "/swift/v1/c2/z.txt", b"yy")
+    # prefix + json listing
+    st, _, body = req(gw, "GET", "/swift/v1/c2?prefix=a/&format=json")
+    rows = json.loads(body)
+    assert [r["name"] for r in rows] == ["a/b.txt", "a/c.txt"]
+    assert rows[0]["bytes"] == 5 and rows[0]["hash"]
+    # container stats
+    _, hdrs, _ = req(gw, "HEAD", "/swift/v1/c2")
+    assert hdrs["X-Container-Object-Count"] == "3"
+    assert hdrs["X-Container-Bytes-Used"] == "9"
+    # delete via swift; non-empty container refuses deletion first
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        req(gw, "DELETE", "/swift/v1/c2")
+    assert ei.value.code == 409
+    assert req(gw, "DELETE", "/swift/v1/c2/a/b.txt")[0] == 204
+    with pytest.raises(urllib.error.HTTPError):
+        req(gw, "GET", "/swift/v1/c2/a/b.txt")
+
+
+def test_copy_from(gw):
+    req(gw, "PUT", "/swift/v1/c3")
+    req(gw, "PUT", "/swift/v1/c3/src", b"payload")
+    st, _, _ = req(gw, "PUT", "/swift/v1/c3/dst", b"",
+                   {"X-Copy-From": "/c3/src"})
+    assert st == 201
+    assert req(gw, "GET", "/swift/v1/c3/dst")[2] == b"payload"
+
+
+def test_s3_and_swift_share_namespace(gw):
+    """A bucket made over S3 is a Swift container and vice versa —
+    the reference's single-namespace contract."""
+    req(gw, "PUT", "/xproto")                       # S3 create
+    req(gw, "PUT", "/xproto/via-s3", b"one")        # S3 PUT
+    st, _, body = req(gw, "GET", "/swift/v1/xproto?format=json")
+    assert [r["name"] for r in json.loads(body)] == ["via-s3"]
+    assert req(gw, "GET", "/swift/v1/xproto/via-s3")[2] == b"one"
+    req(gw, "PUT", "/swift/v1/xproto/via-swift", b"two")
+    st, _, body = req(gw, "GET", "/xproto")         # S3 listing
+    assert b"via-swift" in body
+    assert req(gw, "GET", "/xproto/via-swift")[2] == b"two"
+
+
+@pytest.fixture(scope="module")
+def auth_gw(cluster):
+    kr = KeyRing.generate(["client.swift"])
+    g = RGWGateway(cluster.rados(), pool="swiftauth", keyring=kr)
+    g.start()
+    yield g, kr
+    g.shutdown()
+
+
+def test_tempauth_token_flow(auth_gw):
+    gw, kr = auth_gw
+    secret = kr.get("client.swift")
+    key = secret if isinstance(secret, str) \
+        else base64.b64encode(secret).decode()
+    # wrong key -> 401
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        req(gw, "GET", "/auth/v1.0",
+            headers={"X-Auth-User": "client.swift",
+                     "X-Auth-Key": "bogus"})
+    assert ei.value.code == 401
+    st, hdrs, _ = req(gw, "GET", "/auth/v1.0",
+                      headers={"X-Auth-User": "client.swift",
+                               "X-Auth-Key": key})
+    assert st == 204
+    token = hdrs["X-Auth-Token"]
+    assert hdrs["X-Storage-Url"].endswith("/swift/v1")
+    # no token -> 401; with token -> works
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        req(gw, "PUT", "/swift/v1/ac")
+    assert ei.value.code == 401
+    tk = {"X-Auth-Token": token}
+    assert req(gw, "PUT", "/swift/v1/ac", headers=tk)[0] == 201
+    assert req(gw, "PUT", "/swift/v1/ac/o", b"d",
+               headers=tk)[0] == 201
+    assert req(gw, "GET", "/swift/v1/ac/o",
+               headers=tk)[2] == b"d"
+
+
+def test_token_valid_across_gateways(auth_gw, cluster):
+    """Tokens live in RADOS, so a token issued by one gateway
+    authenticates against another on the same pool."""
+    gw, kr = auth_gw
+    secret = kr.get("client.swift")
+    key = secret if isinstance(secret, str) \
+        else base64.b64encode(secret).decode()
+    _, hdrs, _ = req(gw, "GET", "/auth/v1.0",
+                     headers={"X-Auth-User": "client.swift",
+                              "X-Auth-Key": key})
+    token = hdrs["X-Auth-Token"]
+    g2 = RGWGateway(cluster.rados(), pool="swiftauth", keyring=kr)
+    g2.start()
+    try:
+        st, _, _ = req(g2, "PUT", "/swift/v1/xgw",
+                       headers={"X-Auth-Token": token})
+        assert st == 201
+    finally:
+        g2.shutdown()

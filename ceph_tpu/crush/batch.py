@@ -38,8 +38,7 @@ callers fall back to the scalar engine):
 """
 from __future__ import annotations
 
-import functools
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import numpy as np
 
@@ -183,6 +182,28 @@ def crush_ln16(u):
     return jnp.asarray(_LN16)[u]
 
 
+# crush_ln is monotone in u EXCEPT at the very top: u=65535 normalizes
+# x=u+1=0x10000 with iexpon capped at 15, so its value dips BELOW
+# ln(65534) (and sits above ln(65533)).  The weight-class straw2 path
+# relies on monotonicity, so it orders hashes through a key space that
+# swaps that single pair.  Verified against the table here; if a
+# regenerated table ever breaks differently, the class path disables
+# itself rather than silently diverging.
+_LN16_DIPS = np.nonzero(np.diff(_LN16.astype(np.int64)) < 0)[0]
+LN16_MONO_BY_SWAP = (
+    len(_LN16_DIPS) == 0
+    or (len(_LN16_DIPS) == 1 and int(_LN16_DIPS[0]) == 65534
+        and _LN16[65533] <= _LN16[65535]))
+
+
+def _mono_key(u):
+    """Involution mapping u-space <-> a space where ln16 is monotone
+    (swaps 65534 and 65535; identity elsewhere, incl. the -1 dead
+    sentinel)."""
+    return jnp.where(u == 65534, jnp.int64(65535),
+                     jnp.where(u == 65535, jnp.int64(65534), u))
+
+
 def _div_trunc(a, b):
     """C truncating signed division, b > 0."""
     q = jnp.abs(a) // jnp.maximum(b, 1)
@@ -191,6 +212,82 @@ def _div_trunc(a, b):
 
 # ---------------------------------------------------------------------------
 # compiled map
+
+@dataclass(frozen=True)
+class _StaticCfg:
+    """Everything _do_rule_one decides at TRACE time, as a hashable
+    key.  The compiled executable is cached module-wide on this (plus
+    jit's own shape keying), so a new CompiledCrushMap for every
+    osdmap epoch — same topology shape, same rules — reuses the
+    executable instead of paying a fresh XLA compile (the reference's
+    mgr calls calc_pg_upmaps every tick; a ~40 s recompile per epoch
+    would dwarf the mapping itself)."""
+    steps: tuple          # ((op, arg1, arg2, take_ok), ...)
+    result_max: int
+    tries: int            # choose_total_tries + 1
+    local_retries: int
+    vary_r: int
+    stable: int
+    descend_once: int
+    max_devices: int
+    max_buckets: int
+    n_positions: int
+    max_depth: int
+    n_class_max: int
+    use_classes: bool
+    first_valid: int
+
+
+@dataclass
+class _CmView:
+    """The array half of a compiled map, rebuilt inside the jitted
+    function from ARGUMENTS (not closure constants) so the weights/
+    items tables are runtime inputs.  Field names mirror
+    CompiledCrushMap — every choose helper works on either."""
+    items: object
+    ids: object
+    weights: object
+    sizes: object
+    btypes: object
+    valid: object
+    class_of: object
+    class_w: object
+    static: object
+
+    @property
+    def max_buckets(self):
+        return self.static.max_buckets
+
+    @property
+    def max_depth(self):
+        return self.static.max_depth
+
+    @property
+    def n_positions(self):
+        return self.static.n_positions
+
+    @property
+    def n_class_max(self):
+        return self.static.n_class_max
+
+    @property
+    def use_classes(self):
+        return self.static.use_classes
+
+    @property
+    def max_devices(self):
+        return self.static.max_devices
+
+
+#: module-wide executable cache: _StaticCfg -> jitted vmapped rule fn
+_RULE_JIT: dict = {}
+
+
+#: class-path cutoff: with more distinct weights per bucket than this,
+#: the masked per-class max (I x C compares per draw) costs more than
+#: the ln gathers it saves and the engine keeps the direct path
+CLASS_PATH_MAX = 16
+
 
 @dataclass
 class CompiledCrushMap:
@@ -206,7 +303,14 @@ class CompiledCrushMap:
     max_buckets: int
     n_positions: int
     max_depth: int            # longest bucket chain (static descend bound)
-    _jit_cache: dict = field(default_factory=dict)
+    #: weight-class tables (see _straw2): class_of (P, B, I) int32 with
+    #: -1 for zero-weight/pad lanes; class_w (P, B, C) int64
+    class_of: jnp.ndarray | None = None
+    class_w: jnp.ndarray | None = None
+    n_class_max: int = 0
+    use_classes: bool = False
+    #: id of any non-empty bucket (safe target for masked lanes)
+    first_valid: int = -1
 
     # -- public API ---------------------------------------------------------
     def map_batch(self, xs, weight, ruleno=0, result_max=None,
@@ -243,25 +347,50 @@ class CompiledCrushMap:
                     total += wmax
                     wmax = 0
             result_max = max(total, 1)
-        key = (ruleno, int(result_max))
+        m = self.map_
+        steps = tuple(
+            (st.op, st.arg1, st.arg2,
+             bool((0 <= st.arg1 < m.max_devices)
+                  or (st.arg1 < 0 and m.bucket(st.arg1) is not None))
+             if st.op == CRUSH_RULE_TAKE else False)
+            for st in rule.steps)
+        static = _StaticCfg(
+            steps=steps, result_max=int(result_max),
+            tries=m.choose_total_tries + 1,
+            local_retries=m.choose_local_tries,
+            vary_r=m.chooseleaf_vary_r, stable=m.chooseleaf_stable,
+            descend_once=m.chooseleaf_descend_once,
+            max_devices=m.max_devices, max_buckets=self.max_buckets,
+            n_positions=self.n_positions, max_depth=self.max_depth,
+            n_class_max=self.n_class_max,
+            use_classes=self.use_classes,
+            first_valid=self.first_valid)
         with jax.enable_x64(True):
-            fn = self._jit_cache.get(key)
+            fn = _RULE_JIT.get(static)
             if fn is None:
-                fn = jax.jit(jax.vmap(
-                    functools.partial(_do_rule_one, self, ruleno,
-                                      int(result_max)),
-                    in_axes=(0, None)))
-                self._jit_cache[key] = fn
+                def one(arrays, x, weight, static=static):
+                    cm = _CmView(*arrays, static)
+                    return _do_rule_one(cm, static, x, weight)
+                fn = jax.jit(jax.vmap(one, in_axes=(None, 0, None)))
+                _RULE_JIT[static] = fn
+            arrays = (self.items, self.ids, self.weights, self.sizes,
+                      self.btypes, self.valid, self.class_of,
+                      self.class_w)
             xs = jnp.asarray(xs, dtype=jnp.int64)
             weight = jnp.asarray(weight, dtype=jnp.int64)
-            res, cnt = fn(xs, weight)
+            res, cnt = fn(arrays, xs, weight)
         if return_counts:
             return res, cnt
         return res
 
 
-def compile_map(map_: CrushMap, choose_args=None) -> CompiledCrushMap:
-    """Flatten a CrushMap for the batch engine (straw2-only)."""
+def compile_map(map_: CrushMap, choose_args=None,
+                class_path: bool | None = None) -> CompiledCrushMap:
+    """Flatten a CrushMap for the batch engine (straw2-only).
+
+    class_path: None = auto (on when every bucket has at most
+    CLASS_PATH_MAX distinct positive weights per position); True/False
+    force it — tests use this to pin each straw2 formulation."""
     if isinstance(choose_args, str):
         choose_args = map_.choose_args.get(choose_args)
     choose_args = choose_args or {}
@@ -337,36 +466,118 @@ def compile_map(map_: CrushMap, choose_args=None) -> CompiledCrushMap:
             else:
                 ws = b.item_weights
             weights[p, bi, :n] = ws
+    # -- weight classes (the straw2 argmax shortcut, see _straw2) -------
+    # group each bucket's items by their exact weight; per draw the
+    # engine takes a masked max of the raw 16-bit hashes per class and
+    # evaluates ln only on the C class winners instead of all I items
+    class_lists: dict[tuple[int, int], list[int]] = {}
+    cmax = 1
+    for bi, b in enumerate(map_.buckets):
+        if b is None:
+            continue
+        for p in range(P):
+            # dict preserves first-occurrence order with O(1)
+            # membership (a list scan here was O(I*C) per bucket
+            # per position on every compile)
+            seen = {int(w): None for w in weights[p, bi, :b.size]
+                    if w > 0}
+            class_lists[(p, bi)] = list(seen)
+            cmax = max(cmax, len(seen))
+    use_classes = (cmax <= CLASS_PATH_MAX if class_path is None
+                   else class_path) and LN16_MONO_BY_SWAP
+    class_of = np.full((P, B, I), -1, dtype=np.int32)
+    class_w = np.ones((P, B, cmax), dtype=np.int64)
+    for (p, bi), seen in class_lists.items():
+        class_w[p, bi, :len(seen)] = seen
+        lut = {w: c for c, w in enumerate(seen)}
+        n = map_.buckets[bi].size
+        for i in range(n):
+            w = int(weights[p, bi, i])
+            if w > 0:
+                class_of[p, bi, i] = lut[w]
     with jax.enable_x64(True):  # weights table must stay int64
         return CompiledCrushMap(
             map_=map_, items=jnp.asarray(items), ids=jnp.asarray(ids),
             weights=jnp.asarray(weights), sizes=jnp.asarray(sizes),
             btypes=jnp.asarray(btypes), valid=jnp.asarray(valid),
             max_devices=map_.max_devices, max_buckets=B, n_positions=P,
-            max_depth=max_depth)
-
-
-def _first_valid(cm: CompiledCrushMap):
-    """Id of any non-empty bucket (safe target for masked lanes)."""
-    for bi, b in enumerate(cm.map_.buckets):
-        if b is not None and b.size > 0:
-            return jnp.int32(-1 - bi)
-    return jnp.int32(-1)
+            max_depth=max_depth, class_of=jnp.asarray(class_of),
+            class_w=jnp.asarray(class_w), n_class_max=cmax,
+            use_classes=use_classes,
+            first_valid=next(
+                (-1 - bi for bi, b in enumerate(map_.buckets)
+                 if b is not None and b.size > 0), -1))
 
 
 # ---------------------------------------------------------------------------
 # core choose primitives (single-x; vmapped by map_batch)
 
 def _straw2(cm: CompiledCrushMap, bidx, x, r, position):
-    """bucket_straw2_choose (mapper.c:361-390) for dense bucket bidx."""
+    """bucket_straw2_choose (mapper.c:361-390) for dense bucket bidx.
+
+    Two bit-identical formulations:
+
+    * **class path** (default): `crush_ln` is monotonically
+      nondecreasing and `draw = trunc(ln(u)/w)` is monotone in ln for
+      fixed w > 0, so WITHIN a weight class the winning item is simply
+      the one with the max 16-bit hash — no ln, no division.  The
+      engine takes a masked max of the raw hashes per class (compile
+      time grouped, C classes) and evaluates ln/div only on the C
+      class winners; a uniform bucket (C=1) pays ONE ln per draw
+      instead of I.  This is the TPU answer to the reference's
+      per-item serial ln loop (mapper.c:377): the 64Ki-table gather
+      was the placement wall (~5/6 of a draw pass), and it shrinks by
+      I/C.  Ties keep C semantics: first index wins (argmax picks the
+      first in-class max; cross-class ties resolve to the smallest
+      item index, matching the strict `>` update in
+      bucket_straw2_choose).
+    * **direct path**: per-item ln gather — kept for maps with more
+      than CLASS_PATH_MAX distinct weights in a bucket, where the
+      (I x C) class masking would outgrow the gather it saves.
+    """
     ids = cm.ids[bidx]
     pos = jnp.minimum(position, cm.n_positions - 1)
-    w = cm.weights[pos, bidx]
     u = jhash3(x, ids, r).astype(jnp.int64) & U16
+    I = cm.items.shape[1]
+    lane_ok = jnp.arange(I) < cm.sizes[bidx]
+    if cm.use_classes:
+        cls = cm.class_of[pos, bidx]                   # (I,) -1 = dead
+        cw = cm.class_w[pos, bidx]                     # (C,)
+        ue = jnp.where(lane_ok & (cls >= 0), u, jnp.int64(-1))
+        uk = _mono_key(ue)          # ln16 is monotone in key space
+        cmask = cls[None, :] == jnp.arange(cm.n_class_max)[:, None]
+        kc = jnp.where(cmask, uk[None, :], jnp.int64(-1))   # (C, I)
+        kmax = kc.max(axis=1)
+        umax = _mono_key(kmax)      # back to u-space for the table
+        # the class draw: ln(u)-LN_BIAS is always negative for 16-bit
+        # u, so trunc(ln_val/w) = -(|ln_val| // w)
+        absln = LN_BIAS - crush_ln16(jnp.maximum(umax, 0))
+        k = absln // cw
+        draws = jnp.where(kmax >= 0, -k, S64_MIN)      # (C,)
+        # tie floor: the truncating division collapses a contiguous
+        # key range onto the winning draw — the C core's strict->
+        # update means the FIRST index in that range wins, not the
+        # max-key one.  kk = min{key : ln16(unkey) >= thr}, found by
+        # 16-step binary search in key space (C lanes, not I)
+        x_thr = LN_BIAS - (k + 1) * cw + 1
+        lo = jnp.zeros_like(kmax)
+        hi = jnp.maximum(kmax, 0)
+        for _ in range(16):
+            mid = (lo + hi) >> 1
+            ok = crush_ln16(_mono_key(mid)) >= x_thr
+            hi = jnp.where(ok, mid, hi)
+            lo = jnp.where(ok, lo, mid + 1)
+        # first item index whose draw equals the class draw
+        idx_c = jnp.where(cmask & (uk[None, :] >= hi[:, None]),
+                          jnp.arange(I)[None, :], I).min(axis=1)
+        best = draws.max()
+        idx = jnp.where(draws == best, idx_c, I).min()
+        idx = jnp.where(best == S64_MIN, 0, idx)       # all-dead bucket
+        return cm.items[bidx, idx]
+    w = cm.weights[pos, bidx]
     ln = crush_ln16(u) - LN_BIAS
     draws = jnp.where(w > 0, _div_trunc(ln, w), S64_MIN)
-    draws = jnp.where(jnp.arange(cm.items.shape[1]) < cm.sizes[bidx],
-                      draws, S64_MIN - 1)
+    draws = jnp.where(lane_ok, draws, S64_MIN - 1)
     return cm.items[bidx, jnp.argmax(draws)]
 
 
@@ -661,16 +872,16 @@ def _choose_indep(cm, x, take_item, weight, left0, numrep, target_type,
 # ---------------------------------------------------------------------------
 # rule interpreter (steps are static; state is traced)
 
-def _do_rule_one(cm: CompiledCrushMap, ruleno: int, result_max: int,
-                 x, weight):
-    """do_rule (mapper.c:900-1105) for one input x."""
-    m = cm.map_
-    rule = m.rules[ruleno]
-    tries = m.choose_total_tries + 1
+def _do_rule_one(cm, static: _StaticCfg, x, weight):
+    """do_rule (mapper.c:900-1105) for one input x.  cm is a _CmView
+    (arrays are traced jit arguments); every rule decision comes from
+    the static config so the executable caches across map epochs."""
+    result_max = static.result_max
+    tries = static.tries
     leaf_tries = 0
-    local_retries = m.choose_local_tries
-    vary_r = m.chooseleaf_vary_r
-    stable = m.chooseleaf_stable
+    local_retries = static.local_retries
+    vary_r = static.vary_r
+    stable = static.stable
 
     x = jnp.asarray(x, dtype=jnp.int64)
     result = jnp.full((result_max,), CRUSH_ITEM_NONE, dtype=jnp.int32)
@@ -679,42 +890,40 @@ def _do_rule_one(cm: CompiledCrushMap, ruleno: int, result_max: int,
     w_count = jnp.int32(0)
     w_max = 0  # static upper bound on w_count
     pos_idx = jnp.arange(result_max)
-    safe_bucket = _first_valid(cm)
+    safe_bucket = jnp.int32(static.first_valid)
 
-    for step in rule.steps:
-        if step.op == CRUSH_RULE_TAKE:
-            ok = (0 <= step.arg1 < m.max_devices) or (
-                step.arg1 < 0 and m.bucket(step.arg1) is not None)
-            if ok:
-                w_items = w_items.at[0].set(step.arg1)
+    for op, arg1, arg2, take_ok in static.steps:
+        if op == CRUSH_RULE_TAKE:
+            if take_ok:
+                w_items = w_items.at[0].set(arg1)
                 w_count = jnp.int32(1)
                 w_max = 1
-        elif step.op == CRUSH_RULE_SET_CHOOSE_TRIES:
-            if step.arg1 > 0:
-                tries = step.arg1
-        elif step.op == CRUSH_RULE_SET_CHOOSELEAF_TRIES:
-            if step.arg1 > 0:
-                leaf_tries = step.arg1
-        elif step.op == CRUSH_RULE_SET_CHOOSE_LOCAL_TRIES:
-            if step.arg1 >= 0:
-                local_retries = step.arg1
-        elif step.op == CRUSH_RULE_SET_CHOOSE_LOCAL_FALLBACK_TRIES:
-            if step.arg1 > 0:
+        elif op == CRUSH_RULE_SET_CHOOSE_TRIES:
+            if arg1 > 0:
+                tries = arg1
+        elif op == CRUSH_RULE_SET_CHOOSELEAF_TRIES:
+            if arg1 > 0:
+                leaf_tries = arg1
+        elif op == CRUSH_RULE_SET_CHOOSE_LOCAL_TRIES:
+            if arg1 >= 0:
+                local_retries = arg1
+        elif op == CRUSH_RULE_SET_CHOOSE_LOCAL_FALLBACK_TRIES:
+            if arg1 > 0:
                 raise BatchUnsupported("set_choose_local_fallback_tries > 0")
-        elif step.op == CRUSH_RULE_SET_CHOOSELEAF_VARY_R:
-            if step.arg1 >= 0:
-                vary_r = step.arg1
-        elif step.op == CRUSH_RULE_SET_CHOOSELEAF_STABLE:
-            if step.arg1 >= 0:
-                stable = step.arg1
-        elif step.op in (CRUSH_RULE_CHOOSE_FIRSTN, CRUSH_RULE_CHOOSE_INDEP,
-                         CRUSH_RULE_CHOOSELEAF_FIRSTN,
-                         CRUSH_RULE_CHOOSELEAF_INDEP):
-            firstn = step.op in (CRUSH_RULE_CHOOSE_FIRSTN,
-                                 CRUSH_RULE_CHOOSELEAF_FIRSTN)
-            recurse = step.op in (CRUSH_RULE_CHOOSELEAF_FIRSTN,
-                                  CRUSH_RULE_CHOOSELEAF_INDEP)
-            numrep = step.arg1
+        elif op == CRUSH_RULE_SET_CHOOSELEAF_VARY_R:
+            if arg1 >= 0:
+                vary_r = arg1
+        elif op == CRUSH_RULE_SET_CHOOSELEAF_STABLE:
+            if arg1 >= 0:
+                stable = arg1
+        elif op in (CRUSH_RULE_CHOOSE_FIRSTN, CRUSH_RULE_CHOOSE_INDEP,
+                    CRUSH_RULE_CHOOSELEAF_FIRSTN,
+                    CRUSH_RULE_CHOOSELEAF_INDEP):
+            firstn = op in (CRUSH_RULE_CHOOSE_FIRSTN,
+                            CRUSH_RULE_CHOOSELEAF_FIRSTN)
+            recurse = op in (CRUSH_RULE_CHOOSELEAF_FIRSTN,
+                             CRUSH_RULE_CHOOSELEAF_INDEP)
+            numrep = arg1
             if numrep <= 0:
                 numrep += result_max
             o = jnp.zeros((result_max,), dtype=jnp.int32)
@@ -723,7 +932,7 @@ def _do_rule_one(cm: CompiledCrushMap, ruleno: int, result_max: int,
             if firstn:
                 if leaf_tries:
                     recurse_tries = leaf_tries
-                elif m.chooseleaf_descend_once:
+                elif static.descend_once:
                     recurse_tries = 1
                 else:
                     recurse_tries = tries
@@ -741,7 +950,7 @@ def _do_rule_one(cm: CompiledCrushMap, ruleno: int, result_max: int,
                 take = jnp.where(wi_ok, wi_item, safe_bucket)
                 if firstn:
                     seg_o, seg_c, got = _choose_firstn(
-                        cm, x, take, weight, numrep, step.arg2,
+                        cm, x, take, weight, numrep, arg2,
                         result_max - osize, tries, recurse_tries,
                         local_retries, recurse, vary_r, stable,
                         result_max)
@@ -749,7 +958,7 @@ def _do_rule_one(cm: CompiledCrushMap, ruleno: int, result_max: int,
                     got = jnp.minimum(jnp.int32(numrep),
                                       result_max - osize)
                     seg_o, seg_c = _choose_indep(
-                        cm, x, take, weight, got, numrep, step.arg2,
+                        cm, x, take, weight, got, numrep, arg2,
                         tries, recurse_tries, recurse, result_max)
                 got = jnp.where(wi_ok, got, 0)
                 seg_idx = jnp.clip(pos_idx - osize, 0, result_max - 1)
@@ -763,7 +972,7 @@ def _do_rule_one(cm: CompiledCrushMap, ruleno: int, result_max: int,
             w_count = osize
             w_max = (min(result_max, max(w_max * numrep, 1))
                      if numrep > 0 else 0)
-        elif step.op == CRUSH_RULE_EMIT:
+        elif op == CRUSH_RULE_EMIT:
             # gather formulation (result[p] = w[p - rcount] for the
             # emitted range) rather than a scatter with computed
             # indices: the scatter form miscompiles on the TPU backend

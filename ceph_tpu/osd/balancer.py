@@ -57,8 +57,28 @@ def _build_pgs_by_osd(tmp: OSDMap, pool_ids: list[int],
         pm = mapping.pools[pool_id]
         valid = (pm.up != CRUSH_ITEM_NONE) & (pm.up >= 0)
         rows, cols = np.nonzero(valid)
-        for ps, osd in zip(rows.tolist(), pm.up[rows, cols].tolist()):
-            pgs_by_osd.setdefault(osd, set()).add(PG(pool_id, ps))
+        osds_flat = pm.up[rows, cols]
+        # group rows by osd with one stable sort instead of 3M
+        # setdefault/add calls (this build was ~90% of a 1M-PG
+        # balancer invocation); one PG object per ps, shared across
+        # every set that references it
+        if len(osds_flat) == 0:
+            continue
+        order = np.argsort(osds_flat, kind="stable")
+        so = osds_flat[order]
+        sp = rows[order]
+        pg_of = [PG(pool_id, ps) for ps in range(pool.pg_num)]
+        cuts = np.nonzero(np.diff(so))[0] + 1
+        starts = np.concatenate(([0], cuts))
+        ends = np.concatenate((cuts, [len(so)]))
+        for s, e in zip(starts.tolist(), ends.tolist()):
+            osd = int(so[s])
+            seg = {pg_of[ps] for ps in sp[s:e].tolist()}
+            ex = pgs_by_osd.get(osd)
+            if ex is None:
+                pgs_by_osd[osd] = seg
+            else:
+                ex |= seg
     return pgs_by_osd, total_pgs
 
 

@@ -123,8 +123,11 @@ class RGWGateway:
                     self._body = body
                     u = urlparse(self.path)
                     if u.path == "/auth/v1.0" or \
-                            u.path.startswith("/swift/v1"):
-                        # Swift speaks TempAuth tokens, not SigV4
+                            u.path == "/swift/v1" or \
+                            u.path.startswith("/swift/v1/"):
+                        # Swift speaks TempAuth tokens, not SigV4.
+                        # The boundary matters: bucket "swift" with
+                        # key "v1.txt" is an S3 path, not Swift.
                         return gw._run_swift(self, method, u)
                     if gw.keyring is not None:
                         try:
@@ -173,7 +176,14 @@ class RGWGateway:
             def do_HEAD(self):
                 self._run("HEAD")
 
-        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        class Server(ThreadingHTTPServer):
+            # join handler threads on close (ThreadingHTTPServer
+            # defaults daemon_threads=True): the final GC sweep in
+            # shutdown() must observe every in-flight request's
+            # deferred deletions
+            daemon_threads = False
+
+        self.httpd = Server((host, port), Handler)
         self.port = self.httpd.server_address[1]
         self._thread: threading.Thread | None = None
         self.topics = TopicStore(self.io)

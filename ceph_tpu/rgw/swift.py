@@ -51,11 +51,14 @@ class SwiftError(Exception):
 
 def _json_or_text(q, rows, text_key):
     """Swift listings: newline-separated names by default, full
-    records with ?format=json."""
+    records with ?format=json.  -> (body, content-type, status):
+    json is ALWAYS 200 (an empty list has the body '[]' — a 204 with
+    a body corrupts HTTP/1.1 keep-alive); only the empty TEXT listing
+    is Swift's bodyless 204."""
     if q.get("format") == "json":
-        return (json.dumps(rows).encode(), "application/json")
-    return (("".join(r[text_key] + "\n" for r in rows)).encode(),
-            "text/plain")
+        return (json.dumps(rows).encode(), "application/json", 200)
+    body = ("".join(r[text_key] + "\n" for r in rows)).encode()
+    return (body, "text/plain", 200 if rows else 204)
 
 
 class SwiftFrontend:
@@ -168,8 +171,8 @@ class SwiftFrontend:
             rows.append({"name": name, "count": len(idx),
                          "bytes": sum(e.get("size", 0)
                                       for e in idx.values())})
-        body, ctype = _json_or_text(q, rows, "name")
-        self.gw._respond(h, 200 if rows else 204, body, ctype)
+        body, ctype, status = _json_or_text(q, rows, "name")
+        self.gw._respond(h, status, body, ctype)
 
     # -- container -----------------------------------------------------
     def _container_op(self, h, method: str, container: str,
@@ -213,8 +216,8 @@ class SwiftFrontend:
                  "hash": idx[k].get("etag", ""),
                  "last_modified": idx[k].get("mtime", "")}
                 for k in keys]
-        body, ctype = _json_or_text(q, rows, "name")
-        gw._respond(h, 200 if rows else 204, body, ctype)
+        body, ctype, status = _json_or_text(q, rows, "name")
+        gw._respond(h, status, body, ctype)
 
     # -- object --------------------------------------------------------
     def _object_op(self, h, method: str, container: str, obj: str,

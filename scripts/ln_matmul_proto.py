@@ -1,0 +1,109 @@
+"""Prototype: straw2 ln lookup as one-hot int8 MXU matmuls vs the
+64Ki-entry gather (PERF_NOTES round-3: the gather is ~520ms of a
+627ms (64Ki x 500) draw pass; VERDICT r4 weak #1 names this attack).
+
+Formulation: u = hi*256 + lo.  T = _LN16 reshaped (256, 256), split
+into 6 int8 byte limbs, LIMB-MAJOR columns (col = j*256 + lo) so the
+second selection reduces over the minor axis:
+    A_hi = onehot(hi)  (N, 256) int8
+    M    = A_hi @ L    (N, 6*256) int32      # MXU row-select
+    sel  = sum(M.reshape(N,6,256) * onehot(lo)[:,None,:], -1)  # VPU
+    ln   = sum_j sel[:, j] << 8j  (int64)
+The intermediate M costs 6KB/element, so the full (64Ki x 500) draw
+cannot run in one piece (201 GB) — lax.map over x-chunks bounds it.
+This script measures gather vs chunked matmul at several chunk sizes
+to pick the map_batch chunking.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import sys
+sys.path.insert(0, "/root/repo")
+from ceph_tpu.crush.batch import _LN16, crush_ln16  # noqa: E402
+
+N_X, N_I = 65536, 500           # the placement draw shape
+REPS = 20
+
+
+def build_limbs() -> np.ndarray:
+    t = _LN16.reshape(256, 256)          # [hi, lo] int64
+    limbs = np.zeros((6, 256, 256), dtype=np.int8)   # [j, hi, lo]
+    for j in range(6):
+        limbs[j] = ((t >> (8 * j)) & 0xFF).astype(np.int8)
+    # (hi, j*256+lo): limb-major columns
+    return np.transpose(limbs, (1, 0, 2)).reshape(256, 6 * 256)
+
+
+_LIMBS = build_limbs()
+
+
+def ln16_matmul(u):
+    """u: (...,) int in [0, 65536) -> int64 crush_ln.  Intermediate:
+    1536 int32 per element — caller bounds the batch."""
+    hi = (u >> 8).astype(jnp.int32)
+    lo = (u & 0xFF).astype(jnp.int32)
+    iota = jnp.arange(256, dtype=jnp.int32)
+    a_hi = (hi[..., None] == iota).astype(jnp.int8)
+    m = jax.lax.dot_general(
+        a_hi, jnp.asarray(_LIMBS),
+        (((a_hi.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)        # (..., 6*256)
+    m = (m & 0xFF).reshape(*u.shape, 6, 256)     # undo int8 wrap
+    a_lo = (lo[..., None] == iota)
+    sel = jnp.where(a_lo[..., None, :], m, 0).sum(axis=-1)  # (...,6)
+    out = jnp.zeros(u.shape, dtype=jnp.int64)
+    for j in range(6):
+        out = out + (sel[..., j].astype(jnp.int64) << (8 * j))
+    return out
+
+
+def chunked(fn, u, c):
+    """lax.map over x-chunks — the shape map_batch would use."""
+    chunks = u.reshape(u.shape[0] // c, c, *u.shape[1:])
+    return jax.lax.map(fn, chunks).reshape(u.shape)
+
+
+def chain(fn, u0):
+    """REPS unique-work scan chain (PERF_NOTES methodology)."""
+    def body(c, i):
+        u = (c ^ i) & 0xFFFF
+        return c, fn(u).sum()
+    _, sums = jax.lax.scan(body, u0, jnp.arange(REPS, dtype=u0.dtype))
+    return sums.sum()
+
+
+def bench(name, fn, u0):
+    f = jax.jit(lambda u: chain(fn, u))
+    r = f(u0); r.block_until_ready()            # compile
+    t0 = time.perf_counter()
+    r = f(u0); r.block_until_ready()
+    dt = (time.perf_counter() - t0) / REPS
+    n = u0.size
+    print(f"{name:20s} {dt*1e3:8.2f} ms/pass "
+          f"({n/dt/1e9:.2f} G-lookups/s)  checksum={int(r)}")
+    return dt
+
+
+def main():
+    print("backend:", jax.default_backend())
+    rng = np.random.default_rng(7)
+    u_np = rng.integers(0, 65536, size=(N_X, N_I), dtype=np.int64)
+    small = jnp.asarray(u_np[:8])
+    want = np.asarray(crush_ln16(small))
+    got = np.asarray(ln16_matmul(small))
+    assert (want == got).all(), \
+        f"MISMATCH {np.argwhere(want != got)[:4]}"
+    print("bit-exact over", small.size, "lookups")
+    u0 = jnp.asarray(u_np)
+    t_g = bench("gather", crush_ln16, u0)
+    for c in (64, 128, 256, 512):
+        t_m = bench(f"matmul chunk={c}",
+                    lambda u, c=c: chunked(ln16_matmul, u, c), u0)
+        print(f"  -> speedup vs gather: {t_g / t_m:.2f}x")
+
+
+if __name__ == "__main__":
+    main()

@@ -106,7 +106,7 @@ def run(n_osd: int, pg_num: int, sample: int = 256,
                          mapping=mapping)
     t_upmap = time.perf_counter() - t0
 
-    return {
+    out = {
         "metric": "crush_mappings_per_s",
         "value": round(mappings_per_s, 1),
         "unit": "mappings/s",
@@ -123,6 +123,22 @@ def run(n_osd: int, pg_num: int, sample: int = 256,
             "backend": _backend(),
         },
     }
+    if pg_num == BASELINE_PG_NUM and n_osd == BASELINE_N_OSD:
+        out["detail"]["baseline_mappings_per_s"] = BASELINE_MAPPINGS_PER_S
+        out["detail"]["baseline_engine"] = BASELINE_ENGINE
+        out["vs_baseline"] = round(
+            mappings_per_s / BASELINE_MAPPINGS_PER_S, 3)
+    return out
+
+
+#: reference C core throughput on this host at the canonical scale,
+#: measured by scripts/placement_baseline.py (oracle_map_bulk: one
+#: C-side loop over all 1M PGs, -O2, single thread) — re-run that
+#: script to refresh after a toolchain change
+BASELINE_PG_NUM = 1 << 20
+BASELINE_N_OSD = 10_000
+BASELINE_MAPPINGS_PER_S = 7468.8
+BASELINE_ENGINE = "reference crush C core, 1 thread (-O2)"
 
 
 def _backend() -> str:

@@ -293,3 +293,30 @@ def test_balancer_score_shape():
     assert len(s["osds"]) == 8
     total = sum(v["pgs"] for v in s["osds"].values())
     assert total == 64 * 3
+
+
+def test_contains_up_matches_subtree_contains_shared_subtree():
+    """A bucket referenced by TWO roots (shared subtree): the upward
+    parent-map walk only sees one ancestry, so _contains_up must fall
+    back to the exact recursion for flagged items."""
+    from ceph_tpu.crush.remap import (_contains_up, build_parent_map,
+                                      subtree_contains)
+    from ceph_tpu.crush.types import CRUSH_BUCKET_STRAW2, CrushBucket, CrushMap
+    m = CrushMap()
+    host = m.add_bucket(CrushBucket(
+        id=0, type=1, alg=CRUSH_BUCKET_STRAW2, items=[0, 1],
+        item_weights=[0x10000, 0x10000], weight=0x20000))
+    root_a = m.add_bucket(CrushBucket(
+        id=0, type=2, alg=CRUSH_BUCKET_STRAW2, items=[host],
+        item_weights=[0x20000], weight=0x20000))
+    root_b = m.add_bucket(CrushBucket(
+        id=0, type=2, alg=CRUSH_BUCKET_STRAW2, items=[host],
+        item_weights=[0x20000], weight=0x20000))
+    m.max_devices = 2
+    parent = build_parent_map(m)
+    assert host in parent.multi
+    for root in (root_a, root_b):
+        for item in (host, 0, 1):
+            assert _contains_up(m, parent, root, item) == \
+                subtree_contains(m, root, item), (root, item)
+    assert not _contains_up(m, parent, root_a, 99)

@@ -244,3 +244,109 @@ def test_ln16_table_matches_computed():
         u = jnp.arange(65536, dtype=jnp.int64)
         want = np.asarray(B.crush_ln_vec(u))
     assert np.array_equal(B._LN16, want)
+
+
+# -- weight-class straw2 path (the argmax-u shortcut) ----------------------
+
+def build_flat(weights_list, tunables="jewel"):
+    """root -> osds directly, exact weights as given."""
+    m = CrushMap()
+    m.set_tunables_profile(tunables)
+    items = list(range(len(weights_list)))
+    root = m.add_bucket(CrushBucket(
+        id=0, type=1, alg=CRUSH_BUCKET_STRAW2, items=items,
+        item_weights=list(weights_list), weight=sum(weights_list)))
+    m.max_devices = len(weights_list)
+    m.rules.append(CrushRule(steps=[
+        CrushRuleStep(CRUSH_RULE_TAKE, root),
+        CrushRuleStep(CRUSH_RULE_CHOOSE_FIRSTN, 3, 0),
+        CrushRuleStep(CRUSH_RULE_EMIT)]))
+    return m
+
+
+def test_class_path_tie_heavy_matches_scalar():
+    """Huge equal weights collapse distinct hashes onto equal draws —
+    the exact case where picking the max-u item instead of the FIRST
+    max-draw item would silently diverge from bucket_straw2_choose's
+    strict-> update.  2000 xs against the scalar engine."""
+    w = [0xFFFF0000] * 20          # draws span only ~2^16 values
+    m = build_flat(w)
+    cc = compile_map(m)
+    assert cc.use_classes and cc.n_class_max == 1
+    weight = np.full(20, 0x10000, dtype=np.int64)
+    xs = np.arange(2000, dtype=np.int64)
+    res, cnt = cc.map_batch(xs, weight, ruleno=0, result_max=3,
+                            return_counts=True)
+    res = np.asarray(res)
+    for i, x in enumerate(xs):
+        want = mapper.do_rule(m, 0, int(x), 3, list(weight))
+        assert list(res[i][:cnt[i]]) == want, f"x={x}"
+
+
+def test_class_path_and_direct_path_agree_heterogeneous():
+    """Same map compiled both ways must map identically (and match
+    the scalar oracle) with several distinct weight classes."""
+    rng = np.random.default_rng(11)
+    w = [int(c) for c in rng.choice(
+        [0x8000, 0x10000, 0x18000, 0x20000, 0x28000], size=24)]
+    m = build_flat(w)
+    c_on = compile_map(m, class_path=True)
+    c_off = compile_map(m, class_path=False)
+    assert c_on.use_classes and not c_off.use_classes
+    weight = make_weight(24, seed=3)
+    xs = np.arange(1500, dtype=np.int64)
+    r_on, n_on = c_on.map_batch(xs, weight, 0, 3, return_counts=True)
+    r_off, n_off = c_off.map_batch(xs, weight, 0, 3,
+                                   return_counts=True)
+    assert (np.asarray(r_on) == np.asarray(r_off)).all()
+    assert (np.asarray(n_on) == np.asarray(n_off)).all()
+    for x in range(0, 1500, 97):
+        want = mapper.do_rule(m, 0, x, 3, list(weight))
+        got = list(np.asarray(r_on)[x][:np.asarray(n_on)[x]])
+        assert got == want, f"x={x}"
+
+
+def test_class_path_auto_disables_past_threshold():
+    """More distinct weights than CLASS_PATH_MAX -> auto fallback to
+    the direct per-item ln path; forcing class_path=True must still
+    be bit-identical."""
+    from ceph_tpu.crush.batch import CLASS_PATH_MAX
+    n = CLASS_PATH_MAX + 8
+    w = [0x10000 + i * 0x100 for i in range(n)]   # all distinct
+    m = build_flat(w)
+    auto = compile_map(m)
+    assert not auto.use_classes
+    forced = compile_map(m, class_path=True)
+    assert forced.use_classes and forced.n_class_max == n
+    weight = np.full(n, 0x10000, dtype=np.int64)
+    xs = np.arange(800, dtype=np.int64)
+    r_a, n_a = auto.map_batch(xs, weight, 0, 3, return_counts=True)
+    r_f, n_f = forced.map_batch(xs, weight, 0, 3, return_counts=True)
+    assert (np.asarray(r_a) == np.asarray(r_f)).all()
+    assert (np.asarray(n_a) == np.asarray(n_f)).all()
+
+
+def test_class_path_ln_boundary_and_wide_sweep():
+    """crush_ln dips at u=65535 (x=u+1 overflows the normalization) —
+    the class path orders hashes through a key space that swaps the
+    65534/65535 pair.  Sweep enough xs that several draws hit those
+    boundary hashes, comparing against the direct per-item-ln path
+    (itself fixture-pinned to the C core), plus scalar spot checks.
+    Regression for the 1M-PG bench divergence at pps=1420417868."""
+    from ceph_tpu.crush.batch import LN16_MONO_BY_SWAP
+    assert LN16_MONO_BY_SWAP
+    m = build_flat([0x20000] * 16)
+    c_on = compile_map(m, class_path=True)
+    c_off = compile_map(m, class_path=False)
+    weight = np.full(16, 0x10000, dtype=np.int64)
+    xs = np.arange(120_000, dtype=np.int64)
+    r_on, n_on = c_on.map_batch(xs, weight, 0, 3, return_counts=True)
+    r_off, n_off = c_off.map_batch(xs, weight, 0, 3,
+                                   return_counts=True)
+    r_on, r_off = np.asarray(r_on), np.asarray(r_off)
+    bad = np.nonzero((r_on != r_off).any(axis=1))[0]
+    assert bad.size == 0, f"diverged at xs {bad[:5]}"
+    assert (np.asarray(n_on) == np.asarray(n_off)).all()
+    for x in (0, 31337, 65534, 65535, 119_999):
+        want = mapper.do_rule(m, 0, x, 3, list(weight))
+        assert list(r_on[x][:np.asarray(n_on)[x]]) == want, f"x={x}"

@@ -169,6 +169,12 @@ OPTIONS: dict[str, Option] = {opt.name: opt for opt in [
     _o("mds_bal_ratio", T.FLOAT, 1.5, L.ADVANCED, runtime=True,
        desc="load multiple over the coldest rank that triggers an "
             "export"),
+    _o("mds_bal_split_size", T.UINT, 10000, L.ADVANCED, runtime=True,
+       desc="dentries per directory fragment before it splits "
+            "(ref: options.cc mds_bal_split_size)"),
+    _o("mds_bal_merge_size", T.UINT, 50, L.ADVANCED, runtime=True,
+       desc="total dentries under which a fragmented directory "
+            "merges back (ref: options.cc mds_bal_merge_size)"),
     _o("mon_target_pg_per_osd", T.UINT, 100, L.ADVANCED,
        desc="pg_autoscaler target PG replicas per OSD", runtime=True),
     _o("osd_ec_batch_stripes", T.UINT, 64, L.ADVANCED,

@@ -130,10 +130,17 @@ class CrushWrapper:
     # -- rules -------------------------------------------------------------
     def add_simple_rule(self, name: str, root_name: str,
                         failure_domain: str, device_class: str = "",
-                        mode: str = "firstn", rule_type: str = "replicated"
-                        ) -> int:
+                        mode: str = "firstn", rule_type: str = "replicated",
+                        max_size: int | None = None) -> int:
         """ref: CrushWrapper.h:1199 add_simple_rule -> steps
-        TAKE root / CHOOSELEAF_<mode> 0 type <domain> / EMIT."""
+        TAKE root / CHOOSELEAF_<mode> 0 type <domain> / EMIT.
+
+        max_size widens the legacy rule-mask ceiling (default 10):
+        find_rule filters on min_size <= pool.size <= max_size, so a
+        wide EC pool (k+m > 10) MUST pass its chunk count or the rule
+        silently never matches and every PG maps empty (ref:
+        ErasureCode.cc create_rule passing get_chunk_count() as the
+        rule's max_size)."""
         root = self.get_item_id(root_name)
         if root is None:
             raise ValueError(f"root item {root_name} does not exist")
@@ -152,9 +159,10 @@ class CrushWrapper:
                 CRUSH_RULE_CHOOSELEAF_INDEP
             steps.append(CrushRuleStep(op, 0, tid))
         steps.append(CrushRuleStep(CRUSH_RULE_EMIT, 0, 0))
-        rule = CrushRule(steps=steps,
-                         mask=CrushRuleMask(ruleset=len(self.crush.rules),
-                                            type=rtype))
+        mask = CrushRuleMask(ruleset=len(self.crush.rules), type=rtype)
+        if max_size is not None:
+            mask.max_size = max(max_size, mask.max_size)
+        rule = CrushRule(steps=steps, mask=mask)
         self.crush.rules.append(rule)
         rid = len(self.crush.rules) - 1
         self.rule_name_map[rid] = name

@@ -241,7 +241,10 @@ class ErasureCode(ErasureCodeInterface):
     # -- crush rule --------------------------------------------------------
     def create_rule(self, name: str, crush) -> int:
         """indep/erasure rule under crush-root with crush-failure-domain
-        (ref: ErasureCode.cc:64 create_rule -> add_simple_rule)."""
+        (ref: ErasureCode.cc:64 create_rule -> add_simple_rule).  The
+        rule mask must admit pool.size == k+m — wide codes exceed the
+        legacy default ceiling of 10."""
         return crush.add_simple_rule(
             name, self.rule_root, self.rule_failure_domain,
-            self.rule_device_class, "indep", rule_type="erasure")
+            self.rule_device_class, "indep", rule_type="erasure",
+            max_size=self.get_chunk_count())

@@ -409,8 +409,9 @@ class ErasureCodeLrc(ErasureCode):
             steps.append(CrushRuleStep(op, step.n, tid))
         steps.append(CrushRuleStep(CRUSH_RULE_EMIT, 0, 0))
         rule = CrushRule(steps=steps,
-                         mask=CrushRuleMask(ruleset=len(crush.crush.rules),
-                                            type=3))
+                         mask=CrushRuleMask(
+                             ruleset=len(crush.crush.rules), type=3,
+                             max_size=max(10, self.get_chunk_count())))
         crush.crush.rules.append(rule)
         rid = len(crush.crush.rules) - 1
         crush.rule_name_map[rid] = name

@@ -45,6 +45,7 @@ import itertools
 import json
 import threading
 import time
+import zlib
 
 from ..client import RadosError, WriteOp
 from ..common.log import dout
@@ -116,6 +117,23 @@ def snap_dir_obj(snapid: int, ino: int) -> str:
 
 def dir_obj(ino: int) -> str:
     return f"dir.{ino:x}"
+
+
+def dir_frag_obj(ino: int, frag: int) -> str:
+    """One fragment of a directory (ref: src/mds/CDir.cc dirfrags —
+    a dir's dentries hash across 2^bits RADOS objects once it grows
+    past mds_bal_split_size).  Fragment 0 IS the base object: it
+    always exists and its omap HEADER records the current bits, so
+    every rank resolves the layout from shared state."""
+    return dir_obj(ino) if frag == 0 else f"{dir_obj(ino)}.f{frag}"
+
+
+def name_frag(name: str, bits: int) -> int:
+    """dentry -> fragment placement (ref: CDir::pick_dirfrag via
+    ceph_str_hash; any stable hash works, split points are ours)."""
+    if bits <= 0:
+        return 0
+    return zlib.crc32(name.encode()) & ((1 << bits) - 1)
 
 
 class MDSError(Exception):
@@ -324,6 +342,8 @@ class MDSDaemon(Dispatcher):
                     self.meta.create(obj)
                 except RadosError:
                     pass               # replay idempotency (EEXIST)
+            elif kind == "sethdr":
+                self.meta.set_omap_header(obj, d[2].encode())
 
     def _persist_applied(self) -> None:
         self.meta.set_omap(META_OBJ, {
@@ -337,12 +357,119 @@ class MDSDaemon(Dispatcher):
         self.meta.write_full(self._journal_obj, b"")
 
     # ------------------------------------------------------- name space
-    def _readdir(self, ino: int) -> dict[str, dict]:
+    def _frag_bits(self, ino: int) -> int:
+        """Current fragmentation of a directory, from the base
+        object's omap header.  Deliberately UNCACHED: the bits are
+        shared cluster state (another rank's authority may split a dir
+        we later walk for a snapshot), and a stale-cached layout would
+        silently drop the suffixed fragments' dentries."""
         try:
-            vals, _ = self.meta.get_omap_vals(dir_obj(ino))
+            hdr = self.meta.get_omap_header(dir_obj(ino))
         except RadosError:
-            raise MDSError("ENOENT", f"dir ino {ino:x}")
-        return {k: json.loads(v) for k, v in vals.items()}
+            return 0
+        if not hdr:
+            return 0
+        try:
+            return int(json.loads(hdr).get("bits", 0))
+        except (ValueError, AttributeError):
+            return 0
+
+    def _dent_obj(self, ino: int, name: str) -> str:
+        """The fragment object holding (or due to hold) this dentry."""
+        return dir_frag_obj(ino, name_frag(name, self._frag_bits(ino)))
+
+    def _dir_rmobj_deltas(self, ino: int) -> list:
+        """rmobj deltas covering EVERY fragment of a directory."""
+        bits = self._frag_bits(ino)
+        return [("rmobj", dir_frag_obj(ino, f))
+                for f in range(1 << bits)]
+
+    def _lookup_dentry(self, ino: int, name: str) -> dict | None:
+        """Single-dentry lookup reading only its fragment — the
+        resolve fast path (a fragmented dir's full listing would read
+        every fragment)."""
+        obj = self._dent_obj(ino, name)
+        try:
+            vals = self.meta.get_omap_vals_by_keys(obj, [name])
+        except RadosError:
+            if obj == dir_obj(ino):
+                raise MDSError("ENOENT", f"dir ino {ino:x}")
+            return None       # absent fragment object = no dentry
+        return json.loads(vals[name]) if name in vals else None
+
+    def _readdir(self, ino: int) -> dict[str, dict]:
+        bits = self._frag_bits(ino)
+        out: dict[str, dict] = {}
+        for f in range(1 << bits):
+            try:
+                vals, _ = self.meta.get_omap_vals(dir_frag_obj(ino, f))
+            except RadosError:
+                if f == 0:
+                    raise MDSError("ENOENT", f"dir ino {ino:x}")
+                continue      # empty fragment was never materialized
+            for k, v in vals.items():
+                out[k] = json.loads(v)
+        return out
+
+    # ------------------------------------------------- dir fragmentation
+    def _refrag(self, ino: int, new_bits: int) -> None:
+        """Rewrite a directory into 2^new_bits fragments as ONE
+        journaled entry (ref: CDir::split/merge + the EFragment event
+        MDLog records — crash mid-refrag replays the whole layout
+        change).  Deviation from the reference: fragments stay uniform
+        (one global bits per dir) instead of an arbitrary frag tree —
+        a split rewrites the whole directory, which is bounded by
+        split_size * fragments."""
+        old_bits = self._frag_bits(ino)
+        if new_bits == old_bits:
+            return
+        ents = self._readdir(ino)
+        buckets: dict[int, dict[str, str]] = {}
+        for nm, rec in ents.items():
+            buckets.setdefault(name_frag(nm, new_bits),
+                               {})[nm] = json.dumps(rec)
+        deltas: list = []
+        for f in range(1, 1 << old_bits):
+            deltas.append(("rmobj", dir_frag_obj(ino, f)))
+        gone = [nm for nm in ents if name_frag(nm, new_bits) != 0]
+        if gone:
+            deltas.append(("rm", dir_obj(ino), gone))
+        for f, kv in sorted(buckets.items()):
+            if f:
+                deltas.append(("mkobj", dir_frag_obj(ino, f)))
+            deltas.append(("set", dir_frag_obj(ino, f), kv))
+        deltas.append(("sethdr", dir_obj(ino),
+                       json.dumps({"bits": new_bits})))
+        self._journal("refrag", deltas)
+        dout("mds", 4).write("%s: dir %x refrag %d -> %d bits "
+                             "(%d dentries)", self.name, ino,
+                             old_bits, new_bits, len(ents))
+
+    def _maybe_refrag(self, ino: int, name: str | None = None,
+                      removed: bool = False) -> None:
+        """Split/merge check after a dentry change (ref:
+        MDBalancer::maybe_fragment).  Split looks only at the TOUCHED
+        fragment (per-frag threshold, like mds_bal_split_size); merge
+        pre-gates on that fragment before paying a full count."""
+        from ..common.options import global_config
+        cfg = global_config()
+        bits = self._frag_bits(ino)
+        frag_obj = self._dent_obj(ino, name) if name else dir_obj(ino)
+        try:
+            vals, _ = self.meta.get_omap_vals(frag_obj)
+            n = len(vals)
+        except RadosError:
+            n = 0
+        if not removed:
+            if n > int(cfg["mds_bal_split_size"]) and bits < 12:
+                self._refrag(ino, bits + 1)
+            return
+        if bits == 0:
+            return
+        merge = int(cfg["mds_bal_merge_size"])
+        if n * (1 << bits) < merge and \
+                len(self._readdir(ino)) < merge:
+            self._refrag(ino, 0)
 
     def _readdir_at(self, ino: int, snapid: int | None) -> dict:
         """Directory listing now, or as captured at `snapid` (the
@@ -392,14 +519,16 @@ class MDSDaemon(Dispatcher):
                                        "snapid": snapid}
                 i += 2
                 continue
-            ents = self._readdir_at(ino, snapid)
+            if snapid is None:
+                # live namespace: read only the dentry's fragment
+                d = self._lookup_dentry(ino, comp)
+            else:
+                d = self._readdir_at(ino, snapid).get(comp)
             if is_last:
-                d = ents.get(comp)
                 if d is not None and snapid is not None:
                     d = dict(d)
                     d["snapid"] = snapid
                 return ino, comp, d
-            d = ents.get(comp)
             if d is None:
                 raise MDSError("ENOENT", "/".join(parts[:i + 1]))
             if d["type"] != "d":
@@ -595,7 +724,7 @@ class MDSDaemon(Dispatcher):
             self._journal(op, [("set", ITABLE_OBJ,
                                 {str(dent["remote"]): json.dumps(rec)})])
         else:
-            self._journal(op, [("set", dir_obj(parent),
+            self._journal(op, [("set", self._dent_obj(parent, name),
                                 {name: json.dumps(rec)})])
 
     # --------------------------------------------------- capabilities
@@ -935,7 +1064,8 @@ class MDSDaemon(Dispatcher):
                "mtime": time.time()}
         self._journal("mkdir", [
             ("mkobj", dir_obj(ino)),
-            ("set", dir_obj(parent), {name: json.dumps(rec)})])
+            ("set", self._dent_obj(parent, name), {name: json.dumps(rec)})])
+        self._maybe_refrag(parent, name)
         return rec
 
     def _op_create(self, a):
@@ -968,7 +1098,8 @@ class MDSDaemon(Dispatcher):
                 "object_size": 1 << 18},
                "pool": self.data_pool}
         self._journal("create", [
-            ("set", dir_obj(parent), {name: json.dumps(rec)})])
+            ("set", self._dent_obj(parent, name), {name: json.dumps(rec)})])
+        self._maybe_refrag(parent, name)
         return self._with_snapc(rec)
 
     def _op_lookup(self, a):
@@ -1031,7 +1162,7 @@ class MDSDaemon(Dispatcher):
             rec["nlink"] = rec.get("nlink", 1) + 1
             self._journal("link", [
                 ("set", ITABLE_OBJ, {str(rec["ino"]): json.dumps(rec)}),
-                ("set", dir_obj(dp),
+                ("set", self._dent_obj(dp, dname),
                  {dname: json.dumps({"type": "f",
                                      "remote": rec["ino"]})})])
             return rec
@@ -1040,8 +1171,9 @@ class MDSDaemon(Dispatcher):
         remote = {"type": "f", "remote": rec["ino"]}
         self._journal("link", [
             ("set", ITABLE_OBJ, {str(rec["ino"]): json.dumps(rec)}),
-            ("set", dir_obj(sp), {sname: json.dumps(remote)}),
-            ("set", dir_obj(dp), {dname: json.dumps(remote)})])
+            ("set", self._dent_obj(sp, sname), {sname: json.dumps(remote)}),
+            ("set", self._dent_obj(dp, dname), {dname: json.dumps(remote)})])
+        self._maybe_refrag(dp, dname)
         return rec
 
     def _op_readdir(self, a):
@@ -1067,19 +1199,19 @@ class MDSDaemon(Dispatcher):
             # hardlink: drop the reference; purge only at nlink 0
             rec = self._iget(dent["remote"])
             if rec is None:
-                self._journal("unlink", [("rm", dir_obj(parent),
+                self._journal("unlink", [("rm", self._dent_obj(parent, name),
                                           [name])])
                 raise MDSError("ENOENT", a["path"])
             rec["nlink"] = rec.get("nlink", 1) - 1
             if rec["nlink"] <= 0:
                 self._journal("unlink", [
-                    ("rm", dir_obj(parent), [name]),
+                    ("rm", self._dent_obj(parent, name), [name]),
                     ("rm", ITABLE_OBJ, [str(rec["ino"])])])
                 out = self._with_snapc(dict(rec))
                 out["purge"] = True
                 return out
             self._journal("unlink", [
-                ("rm", dir_obj(parent), [name]),
+                ("rm", self._dent_obj(parent, name), [name]),
                 ("set", ITABLE_OBJ, {str(rec["ino"]): json.dumps(rec)})])
             out = self._with_snapc(dict(rec))
             out["purge"] = False
@@ -1089,7 +1221,8 @@ class MDSDaemon(Dispatcher):
         # so `.snap` reads keep serving the file's frozen state
         out = self._with_snapc(dict(dent))
         out["purge"] = True
-        self._journal("unlink", [("rm", dir_obj(parent), [name])])
+        self._journal("unlink", [("rm", self._dent_obj(parent, name), [name])])
+        self._maybe_refrag(parent, name, removed=True)
         return out                       # client purges the data objs
 
     def _op_rmdir(self, a):
@@ -1101,8 +1234,9 @@ class MDSDaemon(Dispatcher):
         if self._readdir(dent["ino"]):
             raise MDSError("ENOTEMPTY", a["path"])
         self._journal("rmdir", [
-            ("rm", dir_obj(parent), [name]),
-            ("rmobj", dir_obj(dent["ino"]))])
+            ("rm", self._dent_obj(parent, name), [name])]
+            + self._dir_rmobj_deltas(dent["ino"]))
+        self._maybe_refrag(parent, name, removed=True)
         return None
 
     def _op_rename(self, a):
@@ -1128,11 +1262,14 @@ class MDSDaemon(Dispatcher):
                     raise MDSError("ENOTEMPTY", a["dst"])
             elif sdent["type"] == "d":
                 raise MDSError("ENOTDIR", a["dst"])
-        deltas = [("set", dir_obj(dp), {dname: json.dumps(sdent)}),
-                  ("rm", dir_obj(sp), [sname])]
+        deltas = [("set", self._dent_obj(dp, dname),
+                   {dname: json.dumps(sdent)}),
+                  ("rm", self._dent_obj(sp, sname), [sname])]
         if ddent is not None and ddent["type"] == "d":
-            deltas.append(("rmobj", dir_obj(ddent["ino"])))
+            deltas.extend(self._dir_rmobj_deltas(ddent["ino"]))
         self._journal("rename", deltas)
+        self._maybe_refrag(dp, dname)
+        self._maybe_refrag(sp, sname, removed=True)
         return sdent
 
     # ---------------------------------------- cross-rank rename (slave)
@@ -1250,7 +1387,7 @@ class MDSDaemon(Dispatcher):
             raise
         with self._lock:
             self._journal("xrename_commit", [
-                ("rm", dir_obj(sp), [sname]),
+                ("rm", self._dent_obj(sp, sname), [sname]),
                 ("rm", XRENAME_OBJ, [intent_id])])
             self._evict_moved(sdent)
         return sdent
@@ -1326,9 +1463,9 @@ class MDSDaemon(Dispatcher):
                     raise MDSError("ENOTEMPTY", a["dst"])
             elif dent["type"] == "d":
                 raise MDSError("ENOTDIR", a["dst"])
-        deltas = [("set", dir_obj(dp), {dname: json.dumps(dent)})]
+        deltas = [("set", self._dent_obj(dp, dname), {dname: json.dumps(dent)})]
         if ddent is not None and ddent["type"] == "d":
-            deltas.append(("rmobj", dir_obj(ddent["ino"])))
+            deltas.extend(self._dir_rmobj_deltas(ddent["ino"]))
         self._journal("xrename_in", deltas)
         return None
 
@@ -1355,7 +1492,7 @@ class MDSDaemon(Dispatcher):
                     deltas = [("rm", XRENAME_OBJ, [intent_id])]
                     if sdent is not None and self._dent_ino(sdent) \
                             == self._dent_ino(rec["dent"]):
-                        deltas.append(("rm", dir_obj(sp), [sname]))
+                        deltas.append(("rm", self._dent_obj(sp, sname), [sname]))
                     self._journal("xrename_commit", deltas)
             except (MDSError, RadosError, KeyError, ValueError) as ex:
                 dout("mds", 1).write(

@@ -40,6 +40,8 @@ class MgrDaemon(Dispatcher, MonHunter):
         self._pending: set[int] = set()       # unacked command tids
         self._sync_cmds: dict = {}            # tid -> (Event, slot)
         self.prometheus = None
+        #: restful admin API (ref: pybind/mgr/restful); start_restful
+        self.restful = None
         self.failed_commands = 0
         #: pg_autoscaler module (ref: pybind/mgr/pg_autoscaler);
         #: enable with start_pg_autoscaler(), driven by autoscale_tick
@@ -70,6 +72,8 @@ class MgrDaemon(Dispatcher, MonHunter):
     def shutdown(self) -> None:
         if self.prometheus is not None:
             self.prometheus.shutdown()
+        if getattr(self, "restful", None) is not None:
+            self.restful.shutdown()
         self.ms.shutdown()
 
     # -------------------------------------------------------- dispatch
@@ -160,6 +164,13 @@ class MgrDaemon(Dispatcher, MonHunter):
                                else []))
         self.prometheus.start()
         return self.prometheus
+
+    def start_restful(self, port: int = 0):
+        """Serve the JSON admin API (ref: pybind/mgr/restful)."""
+        from .restful import RestfulServer
+        self.restful = RestfulServer(self, port=port)
+        self.restful.start()
+        return self.restful
 
     # ------------------------------------------------------- balancing
     def tick(self) -> int:

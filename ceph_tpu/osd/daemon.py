@@ -39,6 +39,7 @@ from . import mutations as mut
 from .mutations import MutationError
 from .ec_backend import ECBackend, ECPGShard
 from .osdmap import OSDMap
+from .peering import GETINFO, GETLOG, GETMISSING
 from .pg_types import EVersion
 from .replicated_backend import ReplicatedBackend, ReplicatedPGShard
 from .types import PG, POOL_TYPE_ERASURE
@@ -1985,6 +1986,19 @@ class OSDDaemon(Dispatcher, MonHunter):
             # ops wait out recovery via the client's retry machinery
             # (the reference queues them on the PG; ESTALE re-parks the
             # op until the rescan timer retries)
+            self._reply(msg, -1, "ESTALE")
+            return
+        pr = st.peering
+        if pr is not None and pr.phase in (GETINFO, GETLOG,
+                                           GETMISSING):
+            # pre-active peering: the acting set's logs/missing are
+            # not reconciled yet, so a write's fan-out could land on
+            # shards that will be rolled by log adoption — and an EC
+            # sub-write to a still-initializing shard is simply never
+            # acked (the client op then dies by timeout instead of
+            # retrying).  The reference parks ops on waiting_for_peered
+            # until Active; here ESTALE sends them through the same
+            # client rescan-retry as recovery does.
             self._reply(msg, -1, "ESTALE")
             return
         self.perf.inc("op")

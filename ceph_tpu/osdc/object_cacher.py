@@ -39,7 +39,8 @@ from typing import Callable
 
 
 class _CachedObject:
-    __slots__ = ("pages", "valid", "dirty", "vlen")
+    __slots__ = ("pages", "valid", "dirty", "vlen", "seq_end",
+                 "ra_window")
 
     def __init__(self):
         self.pages: dict[int, bytearray] = {}
@@ -52,17 +53,24 @@ class _CachedObject:
         #: (the reference's BufferHeads are byte-granular for the same
         #: reason; ref: src/osdc/ObjectCacher.h bh lengths)
         self.vlen: dict[int, int] = {}
+        #: sequential-read detector (ref: src/common/Readahead.cc):
+        #: where the last read ended, and the current readahead window
+        self.seq_end: int = -1
+        self.ra_window: int = 0
 
 
 class ObjectCacher:
     def __init__(self, read_fn: Callable, write_fn: Callable,
                  max_dirty: int = 8 << 20, max_size: int = 32 << 20,
-                 page: int = 1 << 16):
+                 page: int = 1 << 16, max_readahead: int = 512 << 10):
         self._read = read_fn
         self._write = write_fn
         self.max_dirty = max_dirty
         self.max_size = max_size
         self.page = page
+        #: sequential readahead cap (ref: rbd_readahead_max_bytes /
+        #: ObjectCacher's max_readahead); 0 disables
+        self.max_readahead = max_readahead
         self._objs: "OrderedDict[str, _CachedObject]" = OrderedDict()
         self._lock = threading.RLock()
         # O(1) accounting: page counts maintained at every transition
@@ -70,7 +78,8 @@ class ObjectCacher:
         self._n_pages = 0
         self._n_dirty = 0
         self.stats = {"hit": 0, "miss": 0, "flush_writes": 0,
-                      "write_back": 0, "evicted_pages": 0}
+                      "write_back": 0, "evicted_pages": 0,
+                      "readahead_pages": 0}
 
     # -- accounting -----------------------------------------------------
     def dirty_bytes(self) -> int:
@@ -138,11 +147,30 @@ class ObjectCacher:
         with self._lock:
             o = self._obj(oid)
             pages = list(self._page_range(off, length))
+            # sequential detection: a read starting where the last one
+            # ended doubles the readahead window (up to max_readahead)
+            # and extends the FILL — not the returned bytes — past the
+            # request (ref: src/common/Readahead.cc update; the
+            # reference's ObjectCacher issues the same overshoot via
+            # max_readahead).  Random reads reset the window, so
+            # amplification only ever follows a proven pattern.
+            if self.max_readahead and off == o.seq_end:
+                o.ra_window = min(max(o.ra_window * 2, self.page),
+                                  self.max_readahead)
+            else:
+                o.ra_window = 0
+            o.seq_end = off + length
+            fill_pages = pages
+            if o.ra_window:
+                fill_pages = list(self._page_range(
+                    off, length + o.ra_window))
+                self.stats["readahead_pages"] += \
+                    len(fill_pages) - len(pages)
             if all(p in o.valid for p in pages):
                 self.stats["hit"] += 1
             else:
                 self.stats["miss"] += 1
-                self._fill_span(oid, o, pages)
+                self._fill_span(oid, o, fill_pages)
             out = bytearray()
             for p in pages:
                 out += o.pages[p]

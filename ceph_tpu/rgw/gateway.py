@@ -57,6 +57,7 @@ from ..cls.rgw import now_str, parse_mtime
 from .notify import (EventPusher, TopicStore, _queue_obj,
                      event_matches, make_event, notification_xml,
                      parse_notification_xml)
+from .sts import AKID_PREFIX, STSEngine, STSError
 
 #: omap object holding the bucket registry (name -> creation meta)
 BUCKETS_OBJ = ".rgw.buckets.list"
@@ -130,6 +131,17 @@ class RGWGateway:
                         # key "v1.txt" is an S3 path, not Swift.
                         return gw._run_swift(self, method, u)
                     if gw.keyring is not None:
+                        def lookup(name, _h=self.headers):
+                            # STS-prefixed access keys resolve their
+                            # signing secret from the temp-credential
+                            # table (session token required), not the
+                            # cephx keyring (ref: rgw_auth_s3.cc
+                            # STSAuthStrategy)
+                            if name.startswith(AKID_PREFIX):
+                                return gw.sts.resolve_secret(
+                                    name, _h.get(
+                                        "x-amz-security-token", ""))
+                            return gw.keyring.get(name)
                         try:
                             presigned = "X-Amz-Signature" in parse_qs(
                                 urlparse(self.path).query)
@@ -137,13 +149,15 @@ class RGWGateway:
                                 # query-string auth: presigned URL
                                 self.s3_user = presigned_verify(
                                     method, self.path, self.headers,
-                                    gw.keyring.get)
+                                    lookup)
                             else:
                                 self.s3_user = sigv4_verify(
                                     method, self.path, self.headers,
-                                    body, gw.keyring.get)
+                                    body, lookup)
                         except SigV4Error as e:
                             raise S3Error(403, e.code, str(e))
+                        except STSError as e:
+                            raise S3Error(e.status, e.code, e.msg)
                     gw._route(self, method)
                 except S3Error as e:
                     body = (f'<?xml version="1.0"?><Error><Code>'
@@ -188,6 +202,7 @@ class RGWGateway:
         self._thread: threading.Thread | None = None
         self.topics = TopicStore(self.io)
         self.pusher = EventPusher(self.io, self.topics)
+        self.sts = STSEngine(self.io)
         from .swift import SwiftFrontend
         self.swift = SwiftFrontend(self)
         #: deferred GC of data objects orphaned by index commits —

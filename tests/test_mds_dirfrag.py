@@ -1,0 +1,128 @@
+"""Directory fragmentation (ref: src/mds/CDir.cc split/merge,
+MDBalancer::maybe_fragment; VERDICT r4 missing #5): a directory's
+dentries hash across 2^bits RADOS fragment objects once a fragment
+grows past mds_bal_split_size, and merge back below
+mds_bal_merge_size."""
+import json
+
+import pytest
+
+from ceph_tpu.common.options import global_config
+from ceph_tpu.fs import CephFS, MDSDaemon
+from ceph_tpu.fs.mds import dir_frag_obj, dir_obj, name_frag
+from ceph_tpu.testing import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def fs_cluster():
+    cfg = global_config()
+    old_split = cfg["mds_bal_split_size"]
+    old_merge = cfg["mds_bal_merge_size"]
+    cfg.set("mds_bal_split_size", 40)
+    cfg.set("mds_bal_merge_size", 10)
+    c = MiniCluster(n_osd=4, threaded=True)
+    c.wait_all_up()
+    mds = MDSDaemon(c.network, c.rados())
+    mds.init()
+    fs = CephFS(c.rados())
+    yield c, mds, fs
+    mds.shutdown()
+    c.shutdown()
+    cfg.set("mds_bal_split_size", old_split)
+    cfg.set("mds_bal_merge_size", old_merge)
+
+
+def _bits(mds, ino):
+    return mds._frag_bits(ino)
+
+
+def _ino(mds, path):
+    _, _, dent = mds._resolve(path)
+    return dent["ino"]
+
+
+def test_split_on_growth_and_lookup_correctness(fs_cluster):
+    _c, mds, fs = fs_cluster
+    fs.mkdir("/big")
+    names = [f"file-{i:04d}" for i in range(120)]
+    for n in names:
+        fs.open(f"/big/{n}", "w").close()
+    ino = _ino(mds, "/big")
+    bits = _bits(mds, ino)
+    assert bits >= 1, "directory never split"
+    # suffixed fragment objects actually exist and hold the dentries
+    per_frag = {}
+    for f in range(1 << bits):
+        try:
+            vals, _ = mds.meta.get_omap_vals(dir_frag_obj(ino, f))
+        except Exception:
+            vals = {}
+        per_frag[f] = set(vals)
+    assert set().union(*per_frag.values()) == set(names)
+    for n in names:
+        assert n in per_frag[name_frag(n, bits)]
+    # full listing merges fragments; per-name lookup reads one
+    assert sorted(fs.listdir("/big")) == names
+    assert fs.stat("/big/file-0077")["type"] == "f"
+
+
+def test_ops_on_fragmented_dir(fs_cluster):
+    _c, mds, fs = fs_cluster
+    ino = _ino(mds, "/big")
+    assert _bits(mds, ino) >= 1
+    # create/overwrite/rename/unlink against the fragmented layout
+    fs.write_file("/big/file-0007", b"fresh")
+    assert fs.read_file("/big/file-0007") == b"fresh"
+    fs.rename("/big/file-0008", "/big/renamed")
+    assert fs.stat("/big/renamed")["type"] == "f"
+    fs.unlink("/big/file-0009")
+    names = fs.listdir("/big")
+    assert "file-0009" not in names and "renamed" in names
+
+
+def test_snapshot_of_fragmented_dir_captures_all_fragments(fs_cluster):
+    _c, mds, fs = fs_cluster
+    before = sorted(fs.listdir("/big"))
+    fs.mksnap("/big", "s1")
+    fs.unlink("/big/file-0012")
+    snap = sorted(fs.listdir("/big/.snap/s1"))
+    assert snap == before
+    assert "file-0012" not in fs.listdir("/big")
+    fs.rmsnap("/big", "s1")
+
+
+def test_merge_when_shrunk(fs_cluster):
+    _c, mds, fs = fs_cluster
+    fs.mkdir("/shrink")
+    for i in range(120):
+        fs.open(f"/shrink/f{i:03d}", "w").close()
+    ino = _ino(mds, "/shrink")
+    assert _bits(mds, ino) >= 1
+    for i in range(120):
+        fs.unlink(f"/shrink/f{i:03d}")
+    assert _bits(mds, ino) == 0, "directory never merged back"
+    assert fs.listdir("/shrink") == []
+    # base object is intact (header cleared, no stale fragments)
+    hdr = mds.meta.get_omap_header(dir_obj(ino))
+    assert json.loads(hdr)["bits"] == 0
+    fs.open("/shrink/again", "w").close()
+    assert fs.listdir("/shrink") == ["again"]
+
+
+def test_journal_replay_preserves_fragmentation(fs_cluster):
+    c, mds, fs = fs_cluster
+    ino = _ino(mds, "/big")
+    bits = _bits(mds, ino)
+    listing = sorted(fs.listdir("/big"))
+    mds.shutdown()
+    mds2 = MDSDaemon(c.network, c.rados())
+    mds2.init()
+    try:
+        assert mds2._frag_bits(ino) == bits
+        fs2 = CephFS(c.rados())
+        assert sorted(fs2.listdir("/big")) == listing
+        assert fs2.stat("/big/file-0077")["type"] == "f"
+    finally:
+        # runs LAST: the module daemon stays down; fixture teardown's
+        # second shutdown is a no-op
+        mds2.shutdown()

@@ -1,0 +1,104 @@
+"""mgr restful module: JSON admin API over the mon-command plumbing
+(ref: src/pybind/mgr/restful/module.py; VERDICT r4 missing #7)."""
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from ceph_tpu.mgr.restful import RestfulServer
+from ceph_tpu.testing import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def setup():
+    c = MiniCluster(n_osd=4, threaded=True)
+    c.wait_all_up()
+    mgr = c.start_mgr()
+    srv = RestfulServer(mgr)
+    srv.start()
+    yield c, mgr, srv
+    srv.shutdown()
+    c.shutdown()
+
+
+def req(srv, method, path, payload=None, key=None):
+    headers = {}
+    if key:
+        headers["Authorization"] = f"Bearer {key}"
+    data = json.dumps(payload).encode() if payload is not None else None
+    r = urllib.request.Request(f"http://127.0.0.1:{srv.port}{path}",
+                               data=data, method=method,
+                               headers=headers)
+    with urllib.request.urlopen(r, timeout=30) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_status_health_df(setup):
+    _c, _mgr, srv = setup
+    st, idx = req(srv, "GET", "/")
+    assert "/status" in idx["endpoints"]
+    st, status = req(srv, "GET", "/status")
+    assert st == 200 and "health" in status
+    st, health = req(srv, "GET", "/health")
+    assert st == 200
+    st, df = req(srv, "GET", "/df")
+    assert st == 200
+
+
+def test_osd_listing_and_command(setup):
+    _c, _mgr, srv = setup
+    st, osds = req(srv, "GET", "/osd")
+    assert st == 200 and len(osds) == 4
+    assert all(o["up"] == 1 for o in osds)
+    st, one = req(srv, "GET", "/osd/2")
+    assert one["osd"] == 2
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        req(srv, "GET", "/osd/99")
+    assert ei.value.code == 404
+    # mark out then back in through the API
+    st, _ = req(srv, "POST", "/osd/1/command", {"command": "out"})
+    assert st == 200
+    st, one = req(srv, "GET", "/osd/1")
+    assert one["in"] == 0
+    req(srv, "POST", "/osd/1/command", {"command": "in"})
+    st, one = req(srv, "GET", "/osd/1")
+    assert one["in"] == 1
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        req(srv, "POST", "/osd/1/command", {"command": "explode"})
+    assert ei.value.code == 400
+
+
+def test_pool_lifecycle(setup):
+    _c, _mgr, srv = setup
+    st, _ = req(srv, "POST", "/pool",
+                {"name": "viarest", "pg_num": 8})
+    assert st == 200
+    st, pools = req(srv, "GET", "/pool")
+    names = [p["pool_name"] for p in pools]
+    assert "viarest" in names
+    st, one = req(srv, "GET", "/pool/viarest")
+    assert one["pg_num"] == 8
+    st, _ = req(srv, "DELETE", "/pool/viarest")
+    assert st == 200
+    st, pools = req(srv, "GET", "/pool")
+    assert "viarest" not in [p["pool_name"] for p in pools]
+
+
+def test_api_key_auth(setup):
+    _c, mgr, srv = setup
+    key = srv.create_key("admin")
+    try:
+        # keyed server refuses anonymous...
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            req(srv, "GET", "/status")
+        assert ei.value.code == 401
+        with pytest.raises(urllib.error.HTTPError):
+            req(srv, "GET", "/status", key="wrong")
+        # ...and serves the bearer
+        st, _ = req(srv, "GET", "/status", key=key)
+        assert st == 200
+    finally:
+        srv.delete_key(key)
+    st, _ = req(srv, "GET", "/status")   # open again
+    assert st == 200

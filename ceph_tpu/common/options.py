@@ -161,6 +161,16 @@ OPTIONS: dict[str, Option] = {opt.name: opt for opt in [
             "(diverges from the reference default=false: BlueStore "
             "at-rest checksums make auto-repair the useful default "
             "here; the repair is re-verified in-round either way)"),
+    # MDS beacons / failover (ref: options.cc mds_beacon_interval,
+    # mds_beacon_grace, mds_standby_replay)
+    _o("mds_beacon_interval", T.SECS, 4.0, L.ADVANCED, runtime=True,
+       desc="seconds between MDS beacons to the monitor"),
+    _o("mds_beacon_grace", T.SECS, 15.0, L.ADVANCED, runtime=True,
+       desc="beacon silence before the monitor marks a rank failed "
+            "and promotes a standby"),
+    _o("mds_standby_replay", T.BOOL, False, L.ADVANCED,
+       desc="standby daemons warm-tail their target rank's journal "
+            "so takeover replay starts from a warm cursor"),
     # MDS balancer (ref: options.cc mds_bal_* family)
     _o("mds_bal_interval", T.FLOAT, 5.0, L.ADVANCED, runtime=True,
        desc="seconds between MDS balancer passes"),

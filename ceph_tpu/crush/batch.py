@@ -46,6 +46,11 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+try:
+    enable_x64 = jax.enable_x64
+except AttributeError:      # newer jax moved the scoped toggle
+    from jax.experimental import enable_x64
+
 from ._ln_tables import RH_LH_TBL, LL_TBL
 from .hashes import _mix
 from .types import (
@@ -365,7 +370,7 @@ class CompiledCrushMap:
             n_class_max=self.n_class_max,
             use_classes=self.use_classes,
             first_valid=self.first_valid)
-        with jax.enable_x64(True):
+        with enable_x64(True):
             fn = _RULE_JIT.get(static)
             if fn is None:
                 def one(arrays, x, weight, static=static):
@@ -495,7 +500,7 @@ def compile_map(map_: CrushMap, choose_args=None,
             w = int(weights[p, bi, i])
             if w > 0:
                 class_of[p, bi, i] = lut[w]
-    with jax.enable_x64(True):  # weights table must stay int64
+    with enable_x64(True):  # weights table must stay int64
         return CompiledCrushMap(
             map_=map_, items=jnp.asarray(items), ids=jnp.asarray(ids),
             weights=jnp.asarray(weights), sizes=jnp.asarray(sizes),

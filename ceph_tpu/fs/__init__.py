@@ -1,11 +1,13 @@
 """cephfs-lite: a POSIX-ish file namespace on RADOS.
 
-Single-rank metadata server + libcephfs-like client
-(ref: src/mds + src/client, radically reduced: one rank, no caps/
-locks/fragmentation — but the same storage shapes: dentry-omap
-directory objects in a metadata pool, write-ahead journal, striped
-file data objects `{ino}.{objno}` in a data pool)."""
+Multi-rank metadata servers + libcephfs-like client
+(ref: src/mds + src/client: dentry-omap directory objects in a
+metadata pool, a per-rank write-ahead journal over ceph_tpu.journal,
+striped file data objects `{ino}.{objno}` in a data pool, caps,
+subtree pinning/balancing, snapshots — and standby/failover: the mon's
+MDSMonitor promotes MDSStandby daemons through replay -> resolve ->
+active when a rank's beacon lapses)."""
 from .client import CephFS, FileHandle
-from .mds import MDSDaemon
+from .mds import MDSDaemon, MDSStandby
 
-__all__ = ["MDSDaemon", "CephFS", "FileHandle"]
+__all__ = ["MDSDaemon", "MDSStandby", "CephFS", "FileHandle"]

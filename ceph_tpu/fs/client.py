@@ -19,10 +19,18 @@ import threading
 import time as _time
 
 from ..client import RadosError
-from ..msg.messages import MClientCaps, MClientReply, MClientRequest
+from ..msg.messages import (MClientCaps, MClientReply, MClientRequest,
+                            MFSMap, MMonSubscribe)
 from ..msg.messenger import Dispatcher, Message
 from .mds import CAP_CACHE, CAP_EXCL
 from ..osdc.striper import StripeLayout, Striper
+
+_SESSION_NONCE = itertools.count(1)
+
+
+class _SendTimeout(TimeoutError):
+    """The request was never delivered (endpoint unreachable) — a
+    retry is NOT a replay: the op cannot have executed anywhere."""
 
 
 class CephFSError(Exception):
@@ -49,6 +57,26 @@ class _MDSSession(Dispatcher):
         self._pending: dict[int, tuple[threading.Event, list]] = {}
         self._rados = rados
         self.fs: "CephFS | None" = None
+        # fsmap awareness (ref: Client subscribing to "mdsmap" and
+        # resending unsafe requests after an MDS failover): reqids are
+        # session-unique so the new rank's completed-request table can
+        # dedup a replayed op
+        self.fsmap = None
+        self.fsmap_epoch = 0
+        # process-unique nonce: the completed-request table persists
+        # in RADOS across client restarts, and a restarted process
+        # reusing entity name + counter would be served a PREVIOUS
+        # incarnation's recorded replies
+        import os as _os
+        import secrets as _secrets
+        self._nonce = (f"{_os.getpid():x}-{_secrets.token_hex(3)}-"
+                       f"{next(_SESSION_NONCE)}")
+        self._reqids = itertools.count(1)
+        try:
+            self.ms.connect(rados.objecter.mon).send_message(
+                MMonSubscribe(what="fsmap"))
+        except Exception:      # noqa: BLE001 — monless harness
+            pass
         # cap messages (revoke/snapc) run sync RADOS IO whose replies
         # ride the dispatch thread, so they must be offloaded — but
         # ordered PER INO, not a thread per message: two snapc
@@ -92,6 +120,17 @@ class _MDSSession(Dispatcher):
                          daemon=True).start()
 
     def ms_dispatch(self, msg: Message) -> bool:
+        if isinstance(msg, MFSMap):
+            if msg.epoch > self.fsmap_epoch:
+                self.fsmap = msg.fsmap
+                self.fsmap_epoch = msg.epoch
+                if self.fs is not None:
+                    # cap recovery runs sync MDS calls whose replies
+                    # ride this dispatch thread: offload
+                    threading.Thread(target=self.fs._on_fsmap,
+                                     args=(msg.fsmap,),
+                                     daemon=True).start()
+            return True
         if isinstance(msg, MClientCaps):
             if self.fs is not None and msg.op in ("revoke", "snapc"):
                 self._enqueue_cap(msg)
@@ -110,12 +149,46 @@ class _MDSSession(Dispatcher):
     #: a racing migration could bounce once or twice)
     MAX_FORWARDS = 4
 
+    #: per-attempt reply-wait slice once an fsmap is known — a dead
+    #: rank's unreplied op is replayed to its successor instead of
+    #: burning the whole timeout on one silent attempt
+    ATTEMPT_SLICE = 5.0
+
     def call(self, op: str, args: dict, timeout: float = 30.0):
         import time
         deadline = time.monotonic() + timeout
+        args = dict(args)
+        # session-unique reqid: the MDS completed-request table dedups
+        # a replay of an op whose reply the dead rank never sent
+        args["__reqid"] = f"{self._nonce}.{next(self._reqids)}"
+        while True:
+            try:
+                return self._call_forwarding(op, args, deadline)
+            except _SendTimeout:
+                # never delivered: a plain retry, NOT a replay (the
+                # op cannot have half-executed anywhere)
+                if self.fsmap is None or \
+                        time.monotonic() >= deadline:
+                    raise
+            except TimeoutError:
+                if self.fsmap is None or \
+                        time.monotonic() >= deadline:
+                    raise
+                # delivered but unanswered — MDS failover in
+                # progress: replay the op; the completed table makes
+                # mutating replays exactly-once (ref:
+                # Client::kick_requests resend after reconnect)
+                args["__replay"] = True
+
+    def _call_forwarding(self, op: str, args: dict, deadline: float):
+        import time
         target = self.mds
         for _hop in range(self.MAX_FORWARDS):
-            rep = self._call_one(target, op, args, deadline)
+            att = deadline
+            if self.fsmap is not None:
+                att = min(deadline,
+                          time.monotonic() + self.ATTEMPT_SLICE)
+            rep = self._call_one(target, op, args, att)
             if rep.forward is not None and rep.forward >= 0:
                 # another rank owns this subtree (ref: MDS forward)
                 target = f"mds.{rep.forward}"
@@ -140,7 +213,7 @@ class _MDSSession(Dispatcher):
         while not self.ms.connect(target).send_message(msg):
             if time.monotonic() >= deadline:
                 self._pending.pop(tid, None)
-                raise TimeoutError(f"mds {target} unreachable")
+                raise _SendTimeout(f"mds {target} unreachable")
             time.sleep(0.25)
         if not self._rados.objecter.wait_sync(
                 ev.is_set, max(0.1, deadline - time.monotonic()),
@@ -388,6 +461,10 @@ class CephFS:
         #: per-inode authoritative (highest-seq) snap context
         self._ino_snapc: dict[int, dict] = {}
         self._hlock = threading.Lock()
+        #: last gid seen ACTIVE per rank — a gid change on an active
+        #: rank means a failover happened and our caps died with the
+        #: old daemon's session state
+        self._rank_gids: dict[int, int] = {}
 
     def _get_cache(self, ino: int, pool: str, page: int):
         from ..common.options import global_config
@@ -458,6 +535,56 @@ class CephFS:
             if ent is None or cur is None:
                 return
             ent[1].set_write_snapc(cur["seq"], cur["snaps"])
+
+    # -- failover -------------------------------------------------------
+    def _on_fsmap(self, fsmap) -> None:
+        """A new fsmap epoch arrived (runs off the dispatch thread):
+        when an active rank's gid changed, the old daemon died and a
+        standby took over — re-state our open files and recover caps
+        through the new rank (ref: the client reconnect phase of MDS
+        rejoin; Client::resend_unsafe_requests)."""
+        if fsmap is None:
+            return
+        failed_over = False
+        with self._hlock:
+            for rank, info in fsmap.ranks.items():
+                if info.state != "active" or not info.gid:
+                    continue
+                old = self._rank_gids.get(rank)
+                self._rank_gids[rank] = info.gid
+                if old is not None and old != info.gid:
+                    failed_over = True
+        if not failed_over:
+            return
+        with self._hlock:
+            handles = [fh for lst in self._handles.values()
+                       for fh in lst if fh.snapid is None]
+        for fh in handles:
+            try:
+                out = self._session.call("reconnect", {
+                    "path": fh.path,
+                    "wants_write": fh.wants_write}, timeout=15.0)
+                fh.caps = out.get("caps", 0)
+                rec = out.get("rec") or {}
+                fh.size = max(fh.size, rec.get("size", 0))
+                if fh._dirty_size and not fh.caps & CAP_EXCL:
+                    fh.fsync()     # lost EXCL: flush the buffered size
+            except (CephFSError, TimeoutError):
+                pass       # handle runs cap-less; ops still work
+
+    def wait_rank_active(self, rank: int = 0,
+                         timeout: float = 30.0) -> bool:
+        """Block until the fsmap shows `rank` active (failover tests/
+        tools; returns False on timeout)."""
+        end = _time.monotonic() + timeout
+        while _time.monotonic() < end:
+            m = self._session.fsmap
+            if m is not None:
+                info = m.ranks.get(rank)
+                if info is not None and info.state == "active":
+                    return True
+            _time.sleep(0.05)
+        return False
 
     # -- capability plumbing -------------------------------------------
     def _register_handle(self, fh) -> None:

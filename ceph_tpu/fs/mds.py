@@ -9,12 +9,23 @@ before the dirfrag update, and replayed on startup):
 * `dir.<ino:x>` objects in the metadata pool hold one directory each:
   omap dentry name -> JSON inode record (primary dentries embed the
   inode, like CDentry::linkage).
-* `mds.journal` is the write-ahead log: each op appends one JSON line
-  (seq, op, omap deltas) BEFORE the dirfrag omap update; `mds.meta`
-  tracks `applied_seq` (advanced lazily every few ops, so a crash
-  leaves a replay window) and the inode allocator.  On boot the MDS
-  replays entries past applied_seq — all deltas are idempotent
-  upserts/deletes, so replay converges (ref: MDLog::replay).
+* the write-ahead log is a per-rank `ceph_tpu.journal` Journaler
+  (`journal.mds.<rank>` + framed data objects): each op appends one
+  entry (seq, op, omap deltas) BEFORE the dirfrag omap update;
+  `mds.meta` tracks `applied_seq` (advanced lazily every few ops, so
+  a crash leaves a replay window) and the inode allocator, and the
+  rank's journal commit position trims consumed objects.  On boot —
+  or standby takeover — the MDS replays entries past applied_seq;
+  all deltas are idempotent upserts/deletes, so replay converges
+  (ref: MDLog::replay over src/osdc/Journaler.cc).
+* high availability (this round, ref: MDSMonitor + FSMap): daemons
+  beacon to the mon cluster; a rank whose beacon lapses past
+  `mds_beacon_grace` is marked failed and a registered `MDSStandby`
+  is promoted through replay -> resolve -> active.  Mutating ops
+  record their reply in a per-rank completed-request table
+  (`mds.completed.<rank>`) keyed by the client's reqid, so a client
+  replaying an unreplied op after failover gets the original answer
+  instead of a re-execution (ref: Session::completed_requests).
 * File data never touches the MDS: clients stripe `{ino:x}.{objno:08x}`
   objects into the data pool themselves (ref: file_layout_t +
   Striper), and report size growth via setattr like cap flushes.
@@ -43,17 +54,19 @@ from __future__ import annotations
 
 import itertools
 import json
+import os
 import threading
 import time
 import zlib
 
 from ..client import RadosError, WriteOp
 from ..common.log import dout
-from ..msg.messages import MClientCaps, MClientReply, MClientRequest
+from ..journal import Journaler
+from ..msg.messages import (MClientCaps, MClientReply, MClientRequest,
+                            MFSMap, MMDSBeacon)
 from ..msg.messenger import Dispatcher, Message, Messenger
 
 ROOT_INO = 1
-JOURNAL_OBJ = "mds.journal"
 META_OBJ = "mds.meta"
 ITABLE_OBJ = "mds.itable"
 #: realm table (ref: src/mds/SnapServer.cc's snap table): omap key =
@@ -79,6 +92,35 @@ XRENAME_OBJ = "mds.xrename"
 INO_RANK_SHIFT = 48
 #: applied_seq persists every N ops: the gap is the replay window
 APPLY_EVERY = 8
+#: per-rank completed-request table (ref: the per-session
+#: completed_requests the reference journals so a reconnecting client
+#: can safely replay an unreplied op): omap key = client entity ->
+#: json {reqid: reply}, capped per client
+COMPLETED_RETAIN = 16
+
+#: ops that mutate the namespace — replay of these consults the
+#: completed table (read ops are naturally replay-safe)
+_MUTATING_OPS = frozenset({"mkdir", "create", "setattr", "unlink",
+                           "rmdir", "rename", "link", "mksnap",
+                           "rmsnap", "set_pin"})
+
+_GID_SEQ = itertools.count(1)
+
+
+def _alloc_gid() -> int:
+    """Cluster-unique daemon gid (the mds_gid_t analogue): pid-scoped
+    so multi-process (TCP) daemons never collide."""
+    return (os.getpid() << 20) | next(_GID_SEQ)
+
+
+def journal_id(rank: int) -> str:
+    """The rank's metadata WAL journal id (ceph_tpu.journal naming:
+    header `journal.mds.<rank>`, data `journal_data.mds.<rank>.*`)."""
+    return f"mds.{rank}"
+
+
+def completed_obj(rank: int) -> str:
+    return f"mds.completed.{rank}"
 
 # capability bits (reduced from src/include/ceph_fs.h CEPH_CAP_*)
 CAP_CACHE = 1          # may cache reads
@@ -155,10 +197,25 @@ class MDSDaemon(Dispatcher):
     def __init__(self, network, rados, rank: int = 0,
                  metadata_pool: str = "cephfs_metadata",
                  data_pool: str = "cephfs_data",
-                 threaded: bool = True, keyring=None):
+                 threaded: bool = True, keyring=None,
+                 mon=None, gid: int | None = None):
         self.name = f"mds.{rank}"
         self.rank = rank
         self.rados = rados
+        # beacon/failover plumbing (ref: MDSDaemon beacon_sender):
+        # with `mon` set the daemon announces itself and walks
+        # resolve -> active; without it the legacy standalone behavior
+        # is unchanged (no beacons, no fsmap)
+        self.mons = [mon] if isinstance(mon, str) else list(mon or [])
+        self.gid = gid if gid is not None else _alloc_gid()
+        self._mds_state = "resolve"
+        self._beacon_seq = itertools.count(1)
+        self._beacon_stop = threading.Event()
+        #: test/fault hook: True = stop sending beacons (a "hung" MDS,
+        #: the inject_heartbeat_mute analogue on the OSD)
+        self.inject_beacon_mute = False
+        self.fsmap_epoch = 0
+        self.stopped = False
         for pool in (metadata_pool, data_pool):
             try:
                 rados.pool_lookup(pool)
@@ -179,13 +236,21 @@ class MDSDaemon(Dispatcher):
                             time.sleep(0.2)
         self.meta = rados.open_ioctx(metadata_pool)
         self.data_pool = data_pool
-        # per-rank journal + meta keys (rank 0 keeps the legacy names)
-        self._journal_obj = JOURNAL_OBJ if rank == 0 \
-            else f"{JOURNAL_OBJ}.{rank}"
+        # per-rank WAL over the generic journal library (ref:
+        # src/osdc/Journaler.cc — the MDS log IS a Journaler client);
+        # the rank itself is the committing client, standby-replay
+        # followers tail without registering
+        self.jr = Journaler(self.meta, journal_id(rank),
+                            client_id=f"rank{rank}")
+        self._jpos = (0, 0)
         self._k_applied = "applied_seq" if rank == 0 \
             else f"applied_seq.{rank}"
         self._k_next_ino = "next_ino" if rank == 0 \
             else f"next_ino.{rank}"
+        # completed-request table: client -> {reqid: reply} (rebuilt
+        # from the omap on boot so a replayed op after failover never
+        # re-executes; ref: Session::completed_requests)
+        self._completed: dict[str, dict[str, object]] = {}
         self._ino_base = rank << INO_RANK_SHIFT
         self._lock = threading.RLock()
         self._seq = 0
@@ -235,12 +300,74 @@ class MDSDaemon(Dispatcher):
 
     def init(self) -> None:
         self.ms.start()
-        # finish coordinator-crashed cross-rank renames off-thread
-        # (the slave call needs the messenger live)
-        threading.Thread(target=self._recover_xrenames,
+        # resolve phase off-thread: finish coordinator-crashed
+        # cross-rank renames (the slave call needs the messenger
+        # live), then go active and keep beaconing
+        threading.Thread(target=self._startup_and_beacon,
                          daemon=True).start()
 
+    def _startup_and_beacon(self) -> None:
+        """resolve -> active walk + the periodic beacon loop
+        (ref: MDSRank::resolve_done/active_start + Beacon::_send)."""
+        from ..common.options import global_config
+        self._send_beacon()                      # announce "resolve"
+        try:
+            self._recover_xrenames()
+        except Exception as ex:      # noqa: BLE001 — must reach active
+            dout("mds", 0).write("%s: resolve recovery failed: %r",
+                                 self.name, ex)
+        self._mds_state = "active"
+        self._send_beacon()
+        while self.mons and not self._beacon_stop.wait(
+                global_config()["mds_beacon_interval"]):
+            self._send_beacon()
+
+    def _send_beacon(self) -> None:
+        if not self.mons or self.inject_beacon_mute or self.stopped:
+            return
+        msg = MMDSBeacon(gid=self.gid, name=self.name, rank=self.rank,
+                         state=self._mds_state,
+                         seq=next(self._beacon_seq))
+        for m in self.mons:
+            if self.ms.connect(m).send_message(msg):
+                return
+
+    def _handle_fsmap(self, msg: MFSMap) -> None:
+        """Beacon reply / subscription push: stand down when another
+        gid holds our rank (the split-brain fence — a muted-but-alive
+        daemon must not keep serving after its replacement took over;
+        ref: MDSDaemon::handle_mds_map respawning on removal)."""
+        if msg.epoch < self.fsmap_epoch:
+            return          # stale push must not stand us down
+        self.fsmap_epoch = msg.epoch
+        m = msg.fsmap
+        info = m.ranks.get(self.rank) if m is not None else None
+        if info is not None and info.gid and info.gid != self.gid \
+                and info.state != "failed" and not self.stopped:
+            dout("mds", 0).write(
+                "%s: fsmap e%d says gid %d holds our rank (we are "
+                "gid %d) — standing down", self.name, msg.epoch,
+                info.gid, self.gid)
+            # kill() joins the dispatch thread: must run off it
+            threading.Thread(target=self.kill, daemon=True).start()
+
+    def kill(self) -> None:
+        """Hard stop for tests/standdown: no flush, no journal commit
+        — the next holder of the rank replays (the SIGKILL model the
+        thrasher uses)."""
+        self.stopped = True
+        self._beacon_stop.set()
+        if self._subtree_watch is not None:
+            try:
+                self.meta.unwatch(SUBTREE_OBJ, self._subtree_watch)
+            except Exception:
+                pass
+            self._subtree_watch = None
+        self.ms.shutdown()
+
     def shutdown(self) -> None:
+        self.stopped = True
+        self._beacon_stop.set()
         with self._lock:
             self._persist_applied()
         if self._subtree_watch is not None:
@@ -253,11 +380,18 @@ class MDSDaemon(Dispatcher):
 
     # ------------------------------------------------------ journal/WAL
     def _mkfs_or_replay(self) -> None:
-        """(ref: MDSRank boot: journal replay before going active)."""
+        """(ref: MDSRank boot: journal replay before going active).
+        The WAL rides the generic journal library: the rank is a
+        registered journal client whose commit position IS the
+        applied checkpoint — a takeover (standby promotion after a
+        kill) replays the dead holder's tail from that position, with
+        idempotent deltas making double-apply safe."""
+        self.jr.create()
+        self.jr.register_client()
         try:
             meta = self.meta.get_omap_vals(META_OBJ)[0]
         except RadosError:
-            # fresh fs: root dir + meta + itable + empty journal
+            # fresh fs: root dir + meta + itable
             # (exclusive create arbitrates racing first-boot ranks:
             # the loser re-reads the winner's state)
             try:
@@ -265,8 +399,7 @@ class MDSDaemon(Dispatcher):
             except RadosError:
                 meta = self.meta.get_omap_vals(META_OBJ)[0]
             else:
-                for obj in (self._journal_obj, dir_obj(ROOT_INO),
-                            ITABLE_OBJ):
+                for obj in (dir_obj(ROOT_INO), ITABLE_OBJ):
                     try:
                         self.meta.create(obj)
                     except RadosError:
@@ -275,46 +408,39 @@ class MDSDaemon(Dispatcher):
                     self._k_applied: b"0",
                     self._k_next_ino:
                         str(self._ino_base + ROOT_INO + 1).encode()})
+                self._load_completed()
                 return
-        try:
-            self.meta.create(self._journal_obj)   # first boot of rank
-        except RadosError:
-            pass
         applied = int(meta.get(self._k_applied, b"0"))
         self._seq = applied          # stay monotonic across journal trims
         self._next_ino = max(
             self._ino_base + ROOT_INO + 1,
             int(meta.get(self._k_next_ino,
                          str(self._ino_base + ROOT_INO + 1).encode())))
-        try:
-            raw = self.meta.read(self._journal_obj)
-        except RadosError:
-            raw = b""
-        replayed = 0
-        for line in raw.splitlines():
-            if not line.strip():
-                continue
-            ent = json.loads(line)
+        replayed = [0]
+
+        def handler(_tag, ent):
             self._seq = max(self._seq, ent["seq"])
             self._next_ino = max(self._next_ino,
                                  ent.get("next_ino", 0))
             if ent["seq"] <= applied:
-                continue
+                return
             self._apply_deltas(ent["deltas"])
-            replayed += 1
-        if replayed:
+            replayed[0] += 1
+
+        self._jpos = self.jr.replay(handler)
+        if replayed[0]:
             dout("mds", 1).write("%s: replayed %d journal entries",
-                                 self.name, replayed)
+                                 self.name, replayed[0])
+        self._load_completed()
         self._persist_applied()
 
     def _journal(self, op: str, deltas: list) -> None:
-        """Append-then-apply: the WAL write lands before the dirfrag
+        """Append-then-apply: the WAL entry lands before the dirfrag
         mutation (ref: Journaler::append_entry + flush)."""
         self._seq += 1
-        line = json.dumps({"seq": self._seq, "op": op,
-                           "next_ino": self._next_ino,
-                           "deltas": deltas}) + "\n"
-        self.meta.append(self._journal_obj, line.encode())
+        self._jpos = self.jr.append(op, {
+            "seq": self._seq, "op": op, "next_ino": self._next_ino,
+            "deltas": deltas})
         self._apply_deltas(deltas)
         self._ops_since_apply += 1
         if self._ops_since_apply >= APPLY_EVERY:
@@ -350,11 +476,90 @@ class MDSDaemon(Dispatcher):
             self._k_applied: str(self._seq).encode(),
             self._k_next_ino: str(self._next_ino).encode()})
         self._ops_since_apply = 0
-        # Runtime trim (ref: MDLog::trim): everything <= applied_seq is
-        # fully applied, so the journal can be emptied.  Ordering
-        # matters — applied_seq persists first; a crash in between just
-        # replays already-applied idempotent deltas.
-        self.meta.write_full(self._journal_obj, b"")
+        # Checkpoint + trim (ref: MDLog::trim via the Journaler's
+        # commit position): everything <= applied_seq is fully
+        # applied, so the commit cursor advances and whole data
+        # objects behind every client's cursor are reclaimed.
+        # Ordering matters — applied_seq persists first; a crash in
+        # between just replays already-applied idempotent deltas.
+        try:
+            self.jr.commit(self._jpos)
+            self.jr.trim()
+        except RadosError:
+            pass          # journal may be mid-create on first boot
+
+    # -------------------------------------------- completed requests
+    def _load_completed(self) -> None:
+        """Rebuild the replay dedup table on boot (a promoted standby
+        must answer a dead rank's unreplied ops from it)."""
+        try:
+            vals, _ = self.meta.get_omap_vals(completed_obj(self.rank))
+        except RadosError:
+            self._completed = {}
+            return
+        self._completed = {c: json.loads(v) for c, v in vals.items()}
+
+    def _completed_get(self, client: str, reqid: str):
+        ent = self._completed.get(client)
+        if ent is None or reqid not in ent:
+            return None
+        return (ent[reqid],)          # 1-tuple: a None reply is a hit
+
+    def _completed_put(self, client: str, reqid: str, out) -> None:
+        """Record the reply BEFORE it goes on the wire: a client that
+        never saw it can replay the op and get the same answer
+        (ref: the journaled completed_requests table).  Eviction is
+        insertion-ordered — comparing reqid sequence numbers across
+        session nonces would evict a live session's fresh entries
+        before a dead session's stale ones."""
+        ent = self._completed.setdefault(client, {})
+        ent[reqid] = out
+        while len(ent) > COMPLETED_RETAIN:
+            del ent[next(iter(ent))]
+        obj = completed_obj(self.rank)
+        try:
+            self.meta.operate(obj, WriteOp().set_omap(
+                {client: json.dumps(ent).encode()}))
+        except RadosError:
+            try:
+                self.meta.create(obj)
+                self.meta.set_omap(obj, {client:
+                                         json.dumps(ent).encode()})
+            except RadosError:
+                pass      # volatile fallback: in-memory table serves
+
+    def _replay_tolerate(self, op: str, args: dict, err: MDSError):
+        """A replayed mutating op that re-executed into the tiny
+        journal-applied-but-completed-unrecorded window: map the
+        already-done outcome to success instead of surfacing EEXIST/
+        ENOENT to a client that is just retrying its own op.  Only
+        reachable for DELIVERED ops whose result was never recorded
+        (genuine errors of executed ops replay from the completed
+        table; never-delivered retries don't carry the replay flag)."""
+        if err.errno_name == "EEXIST":
+            if op == "mksnap":
+                # answer in the mksnap reply shape: the existing
+                # snap's id, not the directory dentry
+                _p, _n, dent = self._resolve(args["path"])
+                if dent is not None:
+                    snaps = self._snaps_of(dent["ino"])
+                    name = args.get("name", "")
+                    if name in snaps:
+                        return {"id": snaps[name]["id"],
+                                "name": name}
+            elif op in ("mkdir", "link"):
+                _p, _n, dent = self._resolve(
+                    args.get("path") or args.get("dst") or "/")
+                if dent is not None:
+                    return self._record_of(dent)
+        if err.errno_name == "ENOENT":
+            if op in ("unlink", "rmdir", "rmsnap"):
+                return {"purge": False} if op == "unlink" else None
+            if op == "rename":
+                _p, _n, ddent = self._resolve(args["dst"])
+                if ddent is not None:
+                    return ddent      # already moved
+        raise err
 
     # ------------------------------------------------------- name space
     def _frag_bits(self, ino: int) -> int:
@@ -888,6 +1093,29 @@ class MDSDaemon(Dispatcher):
             bool(a.get("wants_write"))
         return None
 
+    def _op_reconnect(self, a):
+        """Session reconnect after an MDS failover (ref: the client
+        reconnect phase of MDSRank rejoin — clients re-state their
+        open files and the new rank re-issues caps).  Best-effort:
+        conflicting caps come back as 0 and the handle runs
+        write-through until the conflict clears."""
+        _parent, _name, dent = self._resolve(a["path"])
+        if dent is None:
+            raise MDSError("ENOENT", a["path"])
+        rec = self._record_of(dent)
+        if rec["type"] != "f":
+            raise MDSError("EISDIR", a["path"])
+        ino = rec["ino"]
+        wants_write = bool(a.get("wants_write"))
+        try:
+            caps = self._grant_caps(ino, a["__client"], wants_write)
+        except MDSError:
+            # revoke in flight: register the intent cap-less
+            self._opens.setdefault(ino, {})[a["__client"]] = \
+                wants_write
+            caps = 0
+        return {"caps": caps, "rec": rec}
+
     def _op_set_pin(self, a):
         """Migrate a subtree's authority (ref: Migrator export +
         `setfattr ceph.dir.pin`): journal the new pin, then evict our
@@ -1041,6 +1269,38 @@ class MDSDaemon(Dispatcher):
                     ".snap" in str(args.get(k, "")).split("/")
                     for k in ("path", "src", "dst")):
                 raise MDSError("EROFS", "snapshots are read-only")
+            client = args.get("__client")
+            reqid = args.get("__reqid")
+            if reqid and client and op in _MUTATING_OPS:
+                hit = self._completed_get(client, reqid)
+                if hit is not None:
+                    # the op already ran on this rank (or the rank we
+                    # replaced): answer from the table — success OR
+                    # error — never re-execute (ref:
+                    # completed_requests dedup)
+                    stored = hit[0]
+                    if isinstance(stored, dict) and \
+                            "__mds_errno" in stored:
+                        raise MDSError(stored["__mds_errno"],
+                                       "(replayed)")
+                    return stored
+                try:
+                    out = getattr(self, f"_op_{op}")(args)
+                except MDSError as e:
+                    if e.errno_name == "EAGAIN":
+                        raise      # transient: client retries fresh
+                    if args.get("__replay"):
+                        out = self._replay_tolerate(op, args, e)
+                    else:
+                        # record the failure too: a replay after a
+                        # lost error reply must re-fail identically,
+                        # not be tolerance-mapped to success
+                        self._completed_put(
+                            client, reqid,
+                            {"__mds_errno": e.errno_name})
+                        raise
+                self._completed_put(client, reqid, out)
+                return out
             return getattr(self, f"_op_{op}")(args)
 
     def _with_snapc(self, rec: dict) -> dict:
@@ -1533,6 +1793,9 @@ class MDSDaemon(Dispatcher):
 
     # --------------------------------------------------------- dispatch
     def ms_dispatch(self, msg: Message) -> bool:
+        if isinstance(msg, MFSMap):
+            self._handle_fsmap(msg)
+            return True
         if isinstance(msg, MClientCaps):
             self.handle_caps(msg)
             return True
@@ -1580,3 +1843,158 @@ class MDSDaemon(Dispatcher):
         for client, cap_msg in revokes:
             self.ms.connect(client).send_message(cap_msg)
         return True
+
+
+class MDSStandby(Dispatcher):
+    """A standby MDS daemon (ref: the standby/standby-replay daemon
+    states in src/mds/MDSMap.h + MDSMonitor promotion):
+
+    * registers with the monitor cluster via ``standby`` beacons and
+      waits in the pool;
+    * optionally warm-tails a target rank's journal
+      (``mds_standby_replay``) so a takeover starts from a warm
+      cursor;
+    * when the monitor assigns its gid to a failed rank (fsmap state
+      ``replay``), it boots a full :class:`MDSDaemon` for that rank —
+      the daemon's constructor replays the dead holder's journal tail,
+      then walks resolve -> active via beacons.
+
+    The promoted daemon binds the rank's entity name (``mds.<rank>``),
+    so clients keep addressing ranks the same way before and after a
+    failover.
+    """
+
+    def __init__(self, network, rados, name: str = "a", mon=(),
+                 metadata_pool: str = "cephfs_metadata",
+                 data_pool: str = "cephfs_data",
+                 standby_replay_rank: int | None = None,
+                 keyring=None):
+        self.network = network
+        self.rados = rados
+        self.name = f"mds.{name}"
+        self.mons = [mon] if isinstance(mon, str) else list(mon or [])
+        self.metadata_pool = metadata_pool
+        self.data_pool = data_pool
+        self.keyring = keyring
+        self.gid = _alloc_gid()
+        self.standby_replay_rank = -1 if standby_replay_rank is None \
+            else int(standby_replay_rank)
+        #: the rank daemon after promotion
+        self.active: MDSDaemon | None = None
+        self.rank: int | None = None
+        #: journal entries warm-tailed while standby (observability)
+        self.tailed = 0
+        self._tail_pos = (0, 0)
+        self._seq = itertools.count(1)
+        self._stop = threading.Event()
+        self._promoting = False
+        self.inject_beacon_mute = False
+        self.ms = Messenger.create(network, self.name, threaded=True)
+        self.ms.add_dispatcher(self)
+
+    def init(self) -> None:
+        self.ms.start()
+        threading.Thread(target=self._standby_loop,
+                         daemon=True).start()
+
+    def _standby_loop(self) -> None:
+        from ..common.options import global_config
+        self._send_beacon()
+        while not self._stop.wait(
+                global_config()["mds_beacon_interval"]):
+            self._send_beacon()
+            if self.standby_replay_rank >= 0 and \
+                    global_config()["mds_standby_replay"]:
+                self._tail_journal()
+
+    def _send_beacon(self) -> None:
+        if self.inject_beacon_mute or self._promoting:
+            return
+        msg = MMDSBeacon(gid=self.gid, name=self.name, rank=-1,
+                         state="standby", seq=next(self._seq),
+                         standby_replay_rank=self.standby_replay_rank)
+        for m in self.mons:
+            if self.ms.connect(m).send_message(msg):
+                return
+
+    def _tail_journal(self) -> None:
+        """Warm-follow the target rank's WAL without registering as a
+        journal client (a registered-but-lagging follower would pin
+        the active's trim; ref: the standby-replay MDS replaying
+        MDLog continuously)."""
+        try:
+            meta = self.rados.open_ioctx(self.metadata_pool)
+            jr = Journaler(meta, journal_id(self.standby_replay_rank),
+                           client_id=f"standby.{self.gid}")
+            if not jr.exists():
+                return
+            n = [0]
+            pos = jr.replay(lambda _t, _e: n.__setitem__(0, n[0] + 1),
+                            from_pos=self._tail_pos)
+            self._tail_pos = pos
+            self.tailed += n[0]
+        except Exception:      # noqa: BLE001
+            pass            # tailing is an optimization, never fatal
+
+    # ------------------------------------------------------- promotion
+    def ms_dispatch(self, msg: Message) -> bool:
+        if not isinstance(msg, MFSMap):
+            return False
+        m = msg.fsmap
+        if m is None or self._promoting or self.active is not None:
+            return True
+        for rank, info in m.ranks.items():
+            if info.gid == self.gid and info.state == "replay":
+                self._promoting = True
+                threading.Thread(target=self._promote, args=(rank,),
+                                 daemon=True).start()
+                break
+        return True
+
+    def _promote(self, rank: int) -> None:
+        """Take over the failed rank: boot an MDSDaemon (journal
+        replay happens in its constructor, before the rank's entity
+        name starts serving)."""
+        dout("mds", 1).write("%s: promoting to mds.%d (gid %d)",
+                             self.name, rank, self.gid)
+        deadline = time.monotonic() + 30.0
+        while True:
+            d = None
+            try:
+                d = MDSDaemon(self.network, self.rados, rank=rank,
+                              metadata_pool=self.metadata_pool,
+                              data_pool=self.data_pool,
+                              mon=self.mons, gid=self.gid,
+                              keyring=self.keyring)
+                d.init()
+                break
+            except (ValueError, OSError):
+                # the dead holder's entity name/port is still
+                # unbinding: back off and retry the whole boot
+                if d is not None:
+                    try:
+                        d.kill()
+                    except Exception:      # noqa: BLE001
+                        pass
+                if time.monotonic() >= deadline:
+                    self._promoting = False
+                    raise
+                time.sleep(0.1)
+        self.active = d
+        self.rank = rank
+        self._stop.set()          # standby beacons end; the rank's own
+        #                           beacon loop carries liveness now
+
+    # -------------------------------------------------------- teardown
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self.active is not None:
+            self.active.shutdown()
+        self.ms.shutdown()
+
+    def kill(self) -> None:
+        """Hard stop (no flush) — thrasher model."""
+        self._stop.set()
+        if self.active is not None:
+            self.active.kill()
+        self.ms.shutdown()

@@ -150,7 +150,12 @@ class Journaler:
             else self.commit_position()
         first, active = self._range()
         objno, off = pos
-        objno = max(objno, first)
+        if objno < first:
+            # the position's object was trimmed away: resume at the
+            # start of the first surviving object — carrying the old
+            # byte offset into a different object would land mid-frame
+            # and read as a permanently torn tail
+            objno, off = first, 0
         while objno <= active:
             try:
                 raw = self.io.read(data_obj(self.jid, objno))

@@ -140,7 +140,11 @@ class RestfulServer:
                 osds = dump.get("osds", dump)
                 if len(parts) == 1:
                     return 200, osds
-                want = int(parts[1])
+                try:
+                    want = int(parts[1])
+                except ValueError:
+                    # client error, not a 500 from the blanket except
+                    return 400, {"error": "bad osd id"}
                 for o in osds:
                     if int(o.get("osd", -1)) == want:
                         return 200, o

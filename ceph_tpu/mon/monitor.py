@@ -28,8 +28,9 @@ from collections import deque
 
 from ..common.log import dout
 from ..common.options import global_config
-from ..msg.messages import (MAuthRequest, MConfig, MLog, MLogAck,
-                            MMap, MMonCommand,
+from ..msg.messages import (MAuthRequest, MConfig, MFSMap, MLog,
+                            MLogAck,
+                            MMap, MMDSBeacon, MMonCommand,
                             MMonCommandAck,
                             MMonElection, MMonForward, MMonLease,
                             MMonLeaseAck, MMonSubscribe, MOSDBoot,
@@ -42,6 +43,7 @@ from ..osd.osdmap import CEPH_OSD_AUTOOUT, CEPH_OSD_IN, OSDMap
 from .config_monitor import ConfigMonitor
 from .log_monitor import LogMonitor
 from .elector import Elector
+from .mds_monitor import MDSMonitor
 from .osd_monitor import OSDMonitor
 from .pg_map import OSDStatReport, PGMap, health_checks, health_status
 from .paxos import Paxos
@@ -88,6 +90,7 @@ class Monitor(Dispatcher):
         self.osdmon = OSDMonitor(self.paxos, initial_map, initial_wrapper)
         self.configmon = ConfigMonitor(self.paxos)
         self.logmon = LogMonitor(self.paxos)
+        self.mdsmon = MDSMonitor(self.paxos)
         self.ms = Messenger.create(network, self.name, threaded=threaded)
         # cephx: the mon runs the key server and gates inbound traffic
         # (ref: AuthMonitor + CephxServiceHandler)
@@ -101,6 +104,8 @@ class Monitor(Dispatcher):
         self._subs: dict[str, int] = {}
         # config subscribers: entity -> last version sent
         self._config_subs: dict[str, int] = {}
+        # fsmap subscribers: entity -> last epoch sent
+        self._fsmap_subs: dict[str, int] = {}
         # failure reports: target osd -> {reporter: stamp}
         self._failure_reports: dict[int, dict[int, float]] = {}
         # cluster statistics digest (ref: src/mon/PGMap.h)
@@ -134,6 +139,7 @@ class Monitor(Dispatcher):
         self.osdmon.init()
         self.configmon.init()
         self.logmon.init()
+        self.mdsmon.init()
         self.ms.start()
         if not self.standalone:
             self.elector.start()
@@ -202,6 +208,8 @@ class Monitor(Dispatcher):
         self.configmon.create_pending()
         self.logmon.update_from_paxos()
         self.logmon.create_pending()
+        self.mdsmon.update_from_paxos()
+        self.mdsmon.create_pending()
         self._persist_elector()
         self._broadcast_lease()
         self._publish()
@@ -250,6 +258,7 @@ class Monitor(Dispatcher):
         self.osdmon.update_from_paxos()
         self.configmon.update_from_paxos()
         self.logmon.update_from_paxos()
+        self.mdsmon.update_from_paxos()
         self._publish()
 
     # -------------------------------------------------------- dispatch
@@ -275,6 +284,11 @@ class Monitor(Dispatcher):
                 if self._relay_if_peon(msg):
                     return True
                 self._handle_failure(msg)
+                return True
+            if isinstance(msg, MMDSBeacon):
+                if self._relay_if_peon(msg):
+                    return True
+                self._handle_mds_beacon(msg)
                 return True
             if isinstance(msg, MOSDPGTemp):
                 if self._relay_if_peon(msg):
@@ -422,6 +436,8 @@ class Monitor(Dispatcher):
             return self.configmon
         if pfx == "log" or pfx.startswith("log "):
             return self.logmon
+        if pfx.startswith(("fs ", "mds ")) or pfx in ("fs", "mds"):
+            return self.mdsmon
         return self.osdmon
 
     def _dispatch_command(self, cmdmap: dict, reply_cb,
@@ -652,10 +668,25 @@ class Monitor(Dispatcher):
             self._config_subs[msg.src] = 0
             self._send_config(msg.src)
             return
+        if msg.what == "fsmap":
+            self._fsmap_subs[msg.src] = 0
+            self._send_fsmap(msg.src)
+            return
         if msg.what != "osdmap":
             return
         self._subs[msg.src] = msg.start or 1
         self._send_maps(msg.src)
+
+    def _send_fsmap(self, entity: str) -> None:
+        """Push the current fsmap when the subscriber hasn't seen this
+        epoch (ref: Monitor handle_subscribe "fsmap" / MDSMonitor
+        check_subs)."""
+        m = self.mdsmon.fsmap
+        if self._fsmap_subs.get(entity, 0) >= m.epoch:
+            return
+        self._fsmap_subs[entity] = m.epoch
+        self.ms.connect(entity).send_message(
+            MFSMap(epoch=m.epoch, fsmap=m))
 
     def _send_config(self, entity: str) -> None:
         """Push the entity's merged config when it changed since the
@@ -700,6 +731,8 @@ class Monitor(Dispatcher):
             self._send_maps(entity)
         for entity in list(self._config_subs):
             self._send_config(entity)
+        for entity in list(self._fsmap_subs):
+            self._send_fsmap(entity)
 
     # ------------------------------------------------------------- boot
     def _handle_boot(self, msg: MOSDBoot) -> None:
@@ -756,6 +789,26 @@ class Monitor(Dispatcher):
         need = global_config()["mon_osd_min_down_reporters"]
         if len(reports) >= need:
             self._mark_down(target)
+
+    def _handle_mds_beacon(self, msg: MMDSBeacon) -> None:
+        """(ref: MDSMonitor::preprocess_beacon/prepare_beacon): stamp
+        the gid, stage any fsmap change, and answer the sender with
+        the current map so it learns assignments/standdowns without a
+        separate subscription."""
+        self.mdsmon.note_beacon(msg.gid, self.clock())
+        # reply to the daemon's ENTITY name, not msg.src: a beacon
+        # relayed through a peon arrives with the peon's src
+        src = msg.name or msg.src
+
+        def reply(_r, _outs, _outb):
+            m = self.mdsmon.fsmap
+            self.ms.connect(src).send_message(
+                MFSMap(epoch=m.epoch, fsmap=m))
+
+        now = self.clock()
+        self._submit_change(
+            lambda: self.mdsmon.stage_beacon(msg, now),
+            reply_cb=reply, svc=self.mdsmon)
 
     def _handle_pg_temp(self, msg: MOSDPGTemp) -> None:
         """pg_temp request from a peering primary (ref:
@@ -877,6 +930,13 @@ class Monitor(Dispatcher):
                     self._persist_elector()
             if not self.is_leader:
                 return
+            # MDS beacon-lapse detection + standby promotion
+            # (ref: MDSMonitor::tick)
+            m = self.mdsmon.fsmap
+            if m.ranks or m.standbys:
+                self._submit_change(
+                    lambda now=now: self.mdsmon.stage_failures(now),
+                    svc=self.mdsmon)
             interval = global_config()["mon_osd_down_out_interval"]
             to_out = []
             for osd, stamp in list(self._down_stamp.items()):

@@ -438,6 +438,31 @@ class MClientCaps(Message):
 
 
 @dataclass
+class MMDSBeacon(Message):
+    """mds -> mon liveness + state beacon (ref:
+    src/messages/MMDSBeacon.h; MDSMonitor::preprocess_beacon).
+    `state` walks standby -> replay -> resolve -> active; the monitor
+    answers every beacon with the current MFSMap so the daemon learns
+    assignments and standdowns without a separate subscription."""
+    gid: int = 0
+    name: str = ""
+    rank: int = -1
+    state: str = "standby"
+    seq: int = 0
+    #: standby-replay target rank (-1 = plain standby; ref:
+    #: mds_standby_replay / MDSMap::DAEMON_STATE standby-replay)
+    standby_replay_rank: int = -1
+
+
+@dataclass
+class MFSMap(Message):
+    """mon -> subscriber/daemon FSMap publish (ref:
+    src/messages/MFSMap.h; Monitor handle_subscribe "fsmap")."""
+    epoch: int = 0
+    fsmap: Any = None
+
+
+@dataclass
 class MConfig(Message):
     """mon -> daemon: your merged centralized-config view changed
     (ref: src/messages/MConfig.h)."""

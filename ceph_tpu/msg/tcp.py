@@ -116,6 +116,10 @@ class TcpMessenger:
         self._learned: dict[str, socket.socket] = {}
         self._running = False
         self._listener: socket.socket | None = None
+        self._accept_thread: threading.Thread | None = None
+        #: sockets accepted from peers — closed on shutdown so their
+        #: reader threads exit and the kernel releases the port
+        self._accepted: list[socket.socket] = []
         self._threads: list[threading.Thread] = []
         self._seq = 0
         # cephx hooks (same surface as the in-process messenger)
@@ -147,6 +151,7 @@ class TcpMessenger:
         t = threading.Thread(target=self._accept_loop,
                              name=f"tcp-accept-{self.name}", daemon=True)
         t.start()
+        self._accept_thread = t
         self._threads.append(t)
 
     def poll(self, max_msgs: int = 0) -> int:
@@ -157,19 +162,36 @@ class TcpMessenger:
     def shutdown(self) -> None:
         self._running = False
         with self._lock:
-            socks = list(self._out.values())
+            socks = list(self._out.values()) + self._accepted
             self._out.clear()
+            self._learned.clear()
+            self._accepted = []
             self._sessions.clear()
         for s in socks:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 s.close()
             except OSError:
                 pass
         if self._listener is not None:
+            # wake the thread blocked in accept() FIRST: a close alone
+            # leaves the in-syscall reference holding the socket open
+            # (the port stays in LISTEN and a revived daemon on the
+            # same addr gets EADDRINUSE)
+            try:
+                self._listener.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 self._listener.close()
             except OSError:
                 pass
+            if self._accept_thread is not None and \
+                    self._accept_thread is not threading.current_thread():
+                self._accept_thread.join(timeout=5.0)
 
     # -- send ------------------------------------------------------------
     def _secure_handshake(self, sock) -> object | None:
@@ -321,6 +343,8 @@ class TcpMessenger:
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                self._accepted.append(conn)
             if self._secure_secret is not None:
                 from .secure import SecureConn
                 self._sessions[conn] = SecureConn(self._secure_secret,
@@ -409,6 +433,14 @@ class TcpMessenger:
             except OSError:
                 pass
             self._sessions.pop(conn, None)
+            with self._lock:
+                # prune dead accepted sockets: a long-lived endpoint
+                # (a mon taking beacons across thrash rounds) must
+                # not accumulate one entry per past connection
+                try:
+                    self._accepted.remove(conn)
+                except ValueError:
+                    pass
             if peer is not None:
                 with self._lock:
                     if self._learned.get(peer) is conn:

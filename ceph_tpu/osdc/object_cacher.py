@@ -164,12 +164,16 @@ class ObjectCacher:
             if o.ra_window:
                 fill_pages = list(self._page_range(
                     off, length + o.ra_window))
-                self.stats["readahead_pages"] += \
-                    len(fill_pages) - len(pages)
             if all(p in o.valid for p in pages):
                 self.stats["hit"] += 1
             else:
                 self.stats["miss"] += 1
+                # count only overshoot pages the fill actually
+                # fetches — a full hit (or an overshoot into already-
+                # cached pages) reads nothing ahead
+                self.stats["readahead_pages"] += sum(
+                    1 for p in fill_pages[len(pages):]
+                    if p not in o.valid)
                 self._fill_span(oid, o, fill_pages)
             out = bytearray()
             for p in pages:

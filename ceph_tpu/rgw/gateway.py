@@ -370,6 +370,9 @@ class RGWGateway:
         bucket = parts[0]
         key = parts[1] if len(parts) > 1 else ""
         if not bucket:
+            if q.get("Action") in ("AssumeRole", "CreateRole",
+                                   "DeleteRole", "ListRoles"):
+                return self._sts_op(h, method, q)
             if "Action" in q:
                 return self._topic_op(h, method, q)
             if method != "GET":
@@ -569,6 +572,77 @@ class RGWGateway:
         meta["lifecycle"] = rules
         self._update_bucket_meta(bucket, meta)
         self._respond(h, 200)
+
+    # -- STS Actions (ref: rgw_rest_sts.cc RGWREST_STS dispatch) --------
+    def _sts_op(self, h, method: str, q: dict) -> None:
+        """Action-style STS surface on the service endpoint:
+        AssumeRole mints temp credentials for the authenticated
+        caller; CreateRole/DeleteRole/ListRoles administer the role
+        store (ref: rgw_rest_sts.cc RGWSTSAssumeRole + the role REST
+        ops in rgw_rest_role.cc)."""
+        action = q.get("Action", "")
+        if method != "POST" and action != "ListRoles":
+            raise S3Error(405, "MethodNotAllowed", method)
+        # the acting principal: SigV4-authenticated user when the
+        # gateway runs a keyring, anonymous otherwise
+        caller = getattr(h, "s3_user", None) or "anonymous"
+        try:
+            if action == "AssumeRole":
+                role = q.get("RoleArn", "").rsplit("/", 1)[-1] or \
+                    q.get("RoleName", "")
+                dur = q.get("DurationSeconds")
+                creds = self.sts.assume_role(
+                    caller, role,
+                    duration_s=int(dur) if dur else None)
+                return self._respond(h, 200, (
+                    '<?xml version="1.0"?><AssumeRoleResponse>'
+                    "<AssumeRoleResult><Credentials>"
+                    f"<AccessKeyId>{escape(creds['access_key_id'])}"
+                    "</AccessKeyId>"
+                    "<SecretAccessKey>"
+                    f"{escape(creds['secret_access_key'])}"
+                    "</SecretAccessKey>"
+                    f"<SessionToken>{escape(creds['session_token'])}"
+                    "</SessionToken>"
+                    f"<Expiration>{creds['expiration']:.3f}"
+                    "</Expiration></Credentials><AssumedRoleUser>"
+                    "<Arn>arn:aws:sts:::assumed-role/"
+                    f"{escape(creds['role'])}/{escape(caller)}</Arn>"
+                    "</AssumedRoleUser></AssumeRoleResult>"
+                    "</AssumeRoleResponse>").encode())
+            if action == "CreateRole":
+                name = q.get("RoleName", "")
+                trust = [p for p in q.get("Trust", "*").split(",")
+                         if p]
+                kw = {}
+                if q.get("MaxSessionDuration"):
+                    kw["max_duration"] = int(q["MaxSessionDuration"])
+                self.sts.create_role(name, trust, **kw)
+                return self._respond(h, 200, (
+                    '<?xml version="1.0"?><CreateRoleResponse>'
+                    "<CreateRoleResult><Role><RoleName>"
+                    f"{escape(name)}</RoleName>"
+                    f"<Arn>arn:aws:iam:::role/{escape(name)}</Arn>"
+                    "</Role></CreateRoleResult>"
+                    "</CreateRoleResponse>").encode())
+            if action == "DeleteRole":
+                name = q.get("RoleArn", "").rsplit("/", 1)[-1] or \
+                    q.get("RoleName", "")
+                self.sts.delete_role(name)
+                return self._respond(h, 200, b"<DeleteRoleResponse/>")
+            # ListRoles
+            ents = "".join(
+                f"<member><RoleName>{escape(n)}</RoleName>"
+                f"<Arn>arn:aws:iam:::role/{escape(n)}</Arn></member>"
+                for n in sorted(self.sts.list_roles()))
+            return self._respond(h, 200, (
+                '<?xml version="1.0"?><ListRolesResponse>'
+                f"<ListRolesResult><Roles>{ents}</Roles>"
+                "</ListRolesResult></ListRolesResponse>").encode())
+        except STSError as e:
+            raise S3Error(e.status, e.code, e.msg)
+        except ValueError as e:
+            raise S3Error(400, "ValidationError", str(e))
 
     # -- topics + notification configs (ref: rgw_rest_pubsub.cc) --------
     def _topic_op(self, h, method: str, q: dict) -> None:

@@ -44,6 +44,12 @@ class MiniCluster:
         self._stores: dict[int, object] = {}
         self.mgr = None
         self.clients: list[Rados] = []
+        # MDS fleet (ref: vstart's mds spawning): rank -> daemon (or
+        # the MDSStandby wrapper that promoted into it), plus the
+        # waiting standby pool
+        self.mdss: dict[int, object] = {}
+        self.standbys: dict[str, object] = {}
+        self._standby_seq = 0
         m, w = build_initial(n_osd, osds_per_host=osds_per_host)
         self.mons: dict[int, Monitor] = {}
         for r in ranks:
@@ -113,6 +119,68 @@ class MiniCluster:
 
     def revive_osd(self, osd: int) -> OSDDaemon:
         return self.start_osd(osd)
+
+    # ------------------------------------------------------------- mds
+    def start_mds(self, rank: int = 0, **kw):
+        """Spawn a beaconing rank daemon (threaded mode only)."""
+        from ..fs import MDSDaemon
+        d = MDSDaemon(self.network, self.rados(), rank=rank,
+                      mon=self.mon_names, keyring=self.keyring, **kw)
+        d.init()
+        self.mdss[rank] = d
+        return d
+
+    def start_mds_standby(self, name: str | None = None,
+                          standby_replay_rank: int | None = None):
+        """Add a standby to the promotion pool."""
+        from ..fs import MDSStandby
+        if name is None:
+            self._standby_seq += 1
+            name = f"sb{self._standby_seq}"
+        s = MDSStandby(self.network, self.rados(), name=name,
+                       mon=self.mon_names, keyring=self.keyring,
+                       standby_replay_rank=standby_replay_rank)
+        s.init()
+        self.standbys[name] = s
+        return s
+
+    def kill_mds(self, rank: int) -> None:
+        """Hard-kill a rank daemon: beacons stop, the endpoint
+        vanishes, the journal tail is left unflushed for the
+        successor's replay (qa mds thrasher kill model)."""
+        d = self.mdss.pop(rank, None)
+        if d is not None:
+            d.kill()
+        # a standby that promoted INTO this rank is now dead too
+        for name, s in list(self.standbys.items()):
+            if getattr(s, "rank", None) == rank:
+                del self.standbys[name]
+
+    def adopt_promoted(self) -> None:
+        """Move promoted standbys into the rank table so kill_mds /
+        fs status style helpers see them."""
+        for name, s in list(self.standbys.items()):
+            if getattr(s, "active", None) is not None:
+                self.mdss[s.rank] = s
+                del self.standbys[name]
+
+    def fsmap(self):
+        ldr = self.leader() or self.mon
+        return ldr.mdsmon.fsmap
+
+    def wait_mds_active(self, rank: int = 0,
+                        timeout: float = 30.0) -> None:
+        end = time.monotonic() + timeout
+        while time.monotonic() < end:
+            m = self.fsmap()
+            info = m.ranks.get(rank)
+            if info is not None and info.state == "active":
+                self.adopt_promoted()
+                return
+            time.sleep(0.05)
+        raise TimeoutError(
+            f"mds.{rank} never went active (fsmap e{self.fsmap().epoch}"
+            f" ranks={ {r: i.state for r, i in self.fsmap().ranks.items()} })")
 
     # ------------------------------------------------------------- mgr
     def start_mgr(self, **kw):
@@ -210,6 +278,10 @@ class MiniCluster:
         raise TimeoutError("osds never came up")
 
     def shutdown(self) -> None:
+        for s in list(self.standbys.values()):
+            s.shutdown()
+        for d in list(self.mdss.values()):
+            d.shutdown()
         for c in self.clients:
             c.shutdown()
         if self.mgr is not None:

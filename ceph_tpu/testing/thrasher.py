@@ -1,14 +1,17 @@
-"""OSDThrasher: randomized fault injection against a MiniCluster.
+"""Thrashers: randomized fault injection against a MiniCluster.
 
-Port of the qa thrasher loop (ref: qa/tasks/ceph_manager.py:98
+Port of the qa thrasher loops (ref: qa/tasks/ceph_manager.py:98
 OSDThrasher: choose_action kill/revive/out/in with min-in guards,
-interleaved with client IO, then heal and verify).  Deterministic: a
-seeded RNG picks actions, the harness pumps the network and drives
+interleaved with client IO, then heal and verify; qa/tasks/
+mds_thrash.py MDSThrasher: kill active ranks under metadata load and
+wait for the standby takeover ladder).  Deterministic: a seeded RNG
+picks actions, the harness pumps the network and drives
 heartbeat/mon ticks on simulated time.
 """
 from __future__ import annotations
 
 import random
+import time as _time
 
 from ..common.options import global_config
 from .cluster import MiniCluster
@@ -126,3 +129,84 @@ class OSDThrasher:
             self._tick_rounds(1)   # unwedge map-waiting recoveries
         raise TimeoutError(
             f"cluster never went clean; log: {self.log}")
+
+
+class MDSThrasher:
+    """Kill/revive MDS ranks under live metadata load (ref:
+    qa/tasks/mds_thrash.py MDSThrasher): each round hard-kills an
+    active rank, backfills the standby pool, drives mon ticks past
+    ``mds_beacon_grace`` on simulated time until the monitor promotes
+    a standby through replay to active, and verifies clients keep
+    serving.  Requires a threaded MiniCluster with beaconing MDS
+    daemons (cluster.start_mds / start_mds_standby)."""
+
+    def __init__(self, cluster: MiniCluster, seed: int = 0,
+                 now: float = 50_000.0):
+        self.c = cluster
+        self.rng = random.Random(seed)
+        self.now = now
+        self.log: list[str] = []
+
+    def _active_ranks(self) -> list[int]:
+        return [r for r, i in self.c.fsmap().ranks.items()
+                if i.state == "active"]
+
+    def tick_grace(self, rounds: int = 3) -> None:
+        """Advance simulated time past the beacon grace in sub-grace
+        steps with real sleeps between jumps: live daemons' beacons
+        (stamped with the mon's sim clock) land inside every window,
+        so only genuinely dead gids fall past the grace — the OSD
+        thrasher's grace/2 cadence applied to beacons."""
+        grace = global_config()["mds_beacon_grace"]
+        interval = global_config()["mds_beacon_interval"]
+        for _ in range(rounds):
+            self.now += grace / 2 + 0.1
+            self.c.tick(self.now)
+            _time.sleep(max(0.05, 2 * interval))
+            self.c.tick(self.now)
+
+    def kill_rank(self, rank: int | None = None) -> int:
+        active = self._active_ranks()
+        if not active:
+            raise RuntimeError("no active rank to kill")
+        rank = rank if rank is not None else self.rng.choice(active)
+        self.log.append(f"kill mds.{rank}")
+        self._killed_gid = self.c.fsmap().ranks[rank].gid
+        self.c.adopt_promoted()
+        self.c.kill_mds(rank)
+        return rank
+
+    def backfill_standby(self) -> None:
+        self.log.append("add standby")
+        self.c.start_mds_standby()
+
+    def wait_takeover(self, rank: int, timeout_rounds: int = 40,
+                      old_gid: int | None = None) -> None:
+        """Drive ticks until the rank is active under a NEW gid (the
+        dead holder's entry stays `active` until its beacon lapses,
+        so plain active-ness is not takeover)."""
+        if old_gid is None:
+            old_gid = getattr(self, "_killed_gid", None)
+        interval = global_config()["mds_beacon_interval"]
+        for _ in range(timeout_rounds):
+            info = self.c.fsmap().ranks.get(rank)
+            if info is not None and info.state == "active" and \
+                    (old_gid is None or info.gid != old_gid):
+                self.c.adopt_promoted()
+                return
+            self.tick_grace(1)
+            _time.sleep(max(0.05, interval))
+        raise TimeoutError(
+            f"mds.{rank} takeover never completed; log: {self.log}")
+
+    def do_thrash(self, rounds: int, between=None) -> None:
+        """`between(i)` runs client metadata IO between kills."""
+        for i in range(rounds):
+            if not self.c.standbys:
+                self.backfill_standby()
+                _time.sleep(2 * global_config()
+                            ["mds_beacon_interval"])
+            rank = self.kill_rank()
+            self.wait_takeover(rank)
+            if between is not None:
+                between(i)

@@ -17,6 +17,7 @@ from ..msg import encoding as wire
 
 # make sure every wire struct in the tree is registered before listing
 from ..crush import wrapper as _crush_wrapper    # noqa: F401
+from ..mon import fsmap as _fsmap                # noqa: F401
 from ..msg import messages as _messages          # noqa: F401
 from ..osd import osdmap as _osdmap              # noqa: F401
 from ..osd import pg_types as _pg_types          # noqa: F401

@@ -14,6 +14,7 @@ command language:
     put <pool> <obj> <file|-> | get <pool> <obj> [file]
     rm <pool> <obj> | ls <pool> | stat <pool> <obj>
     balance | balancer status
+    fs status | kill-mds <rank> | add-standby
     kill-osd <id> | revive-osd <id> | tick
     perf dump | status | quit
 
@@ -34,7 +35,8 @@ from ..testing.cluster import MiniCluster
 
 class VstartShell:
     def __init__(self, n_osd: int = 4, osds_per_host: int = 1,
-                 out=sys.stdout, n_mon: int = 1):
+                 out=sys.stdout, n_mon: int = 1, n_mds: int = 0,
+                 n_standby: int = 0):
         self.out = out
         self.cluster = MiniCluster(n_osd=n_osd,
                                    osds_per_host=osds_per_host,
@@ -42,6 +44,15 @@ class VstartShell:
         self.cluster.wait_all_up()
         self.rados = self.cluster.rados()
         self.mgr = self.cluster.start_mgr()
+        # MDS ranks + standby pool (ref: vstart.sh MDS=N spawning +
+        # standbys): ranks beacon to the mon, standbys wait for
+        # promotion
+        for rank in range(n_mds):
+            self.cluster.start_mds(rank)
+        for _ in range(n_standby):
+            self.cluster.start_mds_standby()
+        for rank in range(n_mds):
+            self.cluster.wait_mds_active(rank)
         self._now = 10_000.0
         #: set while commands stream from stdin (put ... - is invalid)
         self.stdin_is_script = False
@@ -146,6 +157,20 @@ class VstartShell:
         if cmd == "balancer" and toks[1:] == ["status"]:
             self._print(json.dumps(self.mgr.status(), indent=1))
             return True
+        if cmd == "fs" and toks[1:] == ["status"]:
+            _r, outs, outb = self.rados.mon_command(
+                {"prefix": "fs status"})
+            self._print(outs)
+            self._print(json.dumps(outb, indent=1))
+            return True
+        if cmd == "kill-mds":
+            self.cluster.kill_mds(int(toks[1]))
+            self._print(f"mds.{toks[1]} killed")
+            return True
+        if cmd == "add-standby":
+            s = self.cluster.start_mds_standby()
+            self._print(f"standby {s.name} (gid {s.gid}) joined")
+            return True
         if cmd == "kill-osd":
             self.cluster.kill_osd(int(toks[1]))
             self._print(f"osd.{toks[1]} killed")
@@ -235,10 +260,15 @@ def main(argv=None) -> int:
     ap.add_argument("--osds-per-host", type=int, default=1)
     ap.add_argument("--mons", type=int, default=1,
                     help="monitor quorum size")
+    ap.add_argument("--mds", type=int, default=0,
+                    help="MDS ranks to spawn")
+    ap.add_argument("--standby-mds", type=int, default=0,
+                    help="standby MDS daemons to spawn")
     ap.add_argument("-c", "--command", action="append", default=[],
                     help="run command and continue (repeatable)")
     args = ap.parse_args(argv)
-    sh = VstartShell(args.osds, args.osds_per_host, n_mon=args.mons)
+    sh = VstartShell(args.osds, args.osds_per_host, n_mon=args.mons,
+                     n_mds=args.mds, n_standby=args.standby_mds)
     try:
         for cmd in args.command:
             if not sh.run_line(cmd):

@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# check_green.sh — the ship gate: run the tier-1 suite and fail on ANY
+# red test (failure, error, or collection error).
+#
+# Round-5 shipped a snapshot with deterministically-red tests because
+# nothing between "tests ran" and "snapshot shipped" asserted green.
+# This script IS that assertion: wire it into any verify/release flow
+# (`bash scripts/check_green.sh`) — exit 0 means every collected
+# tier-1 test passed, anything else means do not ship.
+set -u -o pipefail
+
+cd "$(dirname "$0")/.."
+LOG="${TMPDIR:-/tmp}/check_green.$$.log"
+trap 'rm -f "$LOG"' EXIT
+
+timeout -k 10 870 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee "$LOG"
+rc=${PIPESTATUS[0]}
+
+passed=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)
+echo "DOTS_PASSED=${passed}"
+
+if [ "$rc" -ne 0 ]; then
+    echo "check_green: RED (pytest rc=$rc) — do not ship" >&2
+    exit 1
+fi
+if grep -aqE '^(FAILED|ERROR) ' "$LOG"; then
+    echo "check_green: RED (F/E lines present) — do not ship" >&2
+    exit 1
+fi
+if [ "$passed" -eq 0 ]; then
+    echo "check_green: RED (zero tests passed — collection broke?)" >&2
+    exit 1
+fi
+echo "check_green: GREEN (${passed} passed)"

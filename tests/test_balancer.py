@@ -139,6 +139,7 @@ def test_calc_pg_upmaps_already_balanced_is_noop():
     assert n2 <= max(2, n // 10)
 
 
+@pytest.mark.slow   # jit-compile-heavy on current jax; full-suite only (tier-1 budget)
 def test_calc_pg_upmaps_only_pools_filter():
     m = build_map(n_osd=16, pg_num=128, size=3)
     m.pools[1] = PGPool(pg_num=128, pgp_num=128, size=3)
@@ -216,6 +217,7 @@ def test_calc_pg_upmaps_inc_collections_disjoint():
         assert m2.pg_upmap_items.get(pg) == inc.new_pg_upmap_items[pg]
 
 
+@pytest.mark.slow   # jit-compile-heavy on current jax; full-suite only (tier-1 budget)
 def test_calc_pg_upmaps_survives_weightless_upmap_target():
     """Stale pg_upmap_items pointing at a marked-out osd must not crash
     the run when retracted (the out osd has no crush-weight target)."""
@@ -245,6 +247,7 @@ def test_calc_pg_upmaps_survives_weightless_upmap_target():
     assert counts[15] == 0 or counts[15] < 20
 
 
+@pytest.mark.slow   # jit-compile-heavy on current jax; full-suite only (tier-1 budget)
 def test_balancer_driver_multi_pool():
     m = build_map(n_osd=16, pg_num=128, size=3)
     m.pools[1] = PGPool(pg_num=64, pgp_num=64, size=2)

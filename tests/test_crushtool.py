@@ -157,6 +157,7 @@ def test_decompile_matches_reference_shape():
 
 
 # ---------------------------------------------------------------- tester
+@pytest.mark.slow   # jit-compile-heavy on current jax; full-suite only (tier-1 budget)
 def test_tester_counts_match_scalar_engine():
     w = compiled()
     t = CrushTester(w, min_x=0, max_x=255, rule=0, min_rep=3, max_rep=3)
@@ -180,6 +181,7 @@ def test_tester_counts_match_scalar_engine():
     assert per[5] == per.max()
 
 
+@pytest.mark.slow   # jit-compile-heavy on current jax; full-suite only (tier-1 budget)
 def test_tester_bad_mappings():
     """Asking for more replicas than hosts yields bad-mapping lines for
     firstn (short result) (bad-mappings.t model)."""
@@ -201,6 +203,7 @@ def test_tester_mappings_format():
 
 
 # ------------------------------------------------------------------- CLI
+@pytest.mark.slow   # jit-compile-heavy on current jax; full-suite only (tier-1 budget)
 def test_cli_compile_decompile_test(tmp_path, capsys):
     src = tmp_path / "map.txt"
     src.write_text(MAP_TXT)

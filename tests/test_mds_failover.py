@@ -1,0 +1,357 @@
+"""MDS standby/failover: FSMap + MDSMonitor beacons, rank takeover
+with journal replay, client reconnect + cap recovery, thrashing
+(tentpole PR; ref: src/mon/MDSMonitor.cc, src/mds/FSMap.h, the
+standby-replay daemon states, and qa/tasks/mds_thrash.py)."""
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.common.options import global_config
+from ceph_tpu.fs import CephFS
+from ceph_tpu.fs.mds import CAP_EXCL
+from ceph_tpu.msg.messages import MClientReply
+from ceph_tpu.testing import MiniCluster
+from ceph_tpu.testing.thrasher import MDSThrasher
+
+FAST = {"mds_beacon_interval": 0.2, "mds_beacon_grace": 1.0}
+
+
+@pytest.fixture(autouse=True)
+def fast_beacons():
+    g = global_config()
+    saved = {k: g[k] for k in FAST}
+    for k, v in FAST.items():
+        g.set(k, v)
+    yield
+    for k, v in saved.items():
+        g.set(k, v)
+
+
+def drive_failover(c, th, rank, timeout_rounds=40):
+    """Tick simulated time until the rank is active again."""
+    th.wait_takeover(rank, timeout_rounds=timeout_rounds)
+
+
+@pytest.fixture()
+def cluster():
+    c = MiniCluster(n_osd=3, threaded=True)
+    c.wait_all_up()
+    yield c
+    c.shutdown()
+
+
+# ----------------------------------------------------- fsmap / beacons
+
+def test_fsmap_registration_and_status(cluster):
+    c = cluster
+    c.start_mds(0)
+    c.start_mds_standby()
+    c.wait_mds_active(0)
+    m = c.fsmap()
+    assert m.ranks[0].state == "active"
+    assert m.ranks[0].gid
+    # the standby registered in the pool
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and not c.fsmap().standbys:
+        time.sleep(0.05)
+    assert c.fsmap().standbys
+    # `fs status` through the mon command path
+    r, outs, outb = c.mon.handle_command({"prefix": "fs status"})
+    assert r == 0
+    assert outb["ranks"]["0" if "0" in outb["ranks"] else 0][
+        "state"] == "active"
+    assert len(outb["standbys"]) == 1
+
+
+def test_kill_active_rank_promotes_standby(cluster):
+    """The acceptance scenario, single rank: kill the active MDS
+    under data, standby promotes through replay to active, clients
+    continue without error."""
+    c = cluster
+    c.start_mds(0)
+    c.start_mds_standby()
+    c.wait_mds_active(0)
+    fs = CephFS(c.rados())
+    fs.mkdirs("/d/deep")
+    for i in range(12):
+        fs.write_file(f"/d/deep/f{i}", f"payload-{i}".encode())
+    old_gid = c.fsmap().ranks[0].gid
+    th = MDSThrasher(c)
+    th.kill_rank(0)
+    drive_failover(c, th, 0)
+    assert c.fsmap().ranks[0].gid != old_gid
+    # namespace intact (journal tail replayed), new writes work
+    for i in range(12):
+        assert fs.read_file(f"/d/deep/f{i}") == f"payload-{i}".encode()
+    fs.write_file("/d/after", b"post-takeover")
+    assert fs.read_file("/d/after") == b"post-takeover"
+    assert fs.wait_rank_active(0, timeout=10)
+
+
+def test_inflight_op_replayed_exactly_once(cluster):
+    """An op whose reply died with the MDS is replayed by the client
+    and answered from the promoted rank's completed-request table —
+    not re-executed (ref: Session::completed_requests)."""
+    c = cluster
+    c.start_mds(0)
+    c.start_mds_standby()
+    c.wait_mds_active(0)
+    fs = CephFS(c.rados())
+    fs.mkdirs("/base")
+    # drop every MClientReply the active rank sends: the op lands in
+    # the journal + completed table but the client never hears
+    c.network.filter = lambda src, dst, msg: not (
+        src == "mds.0" and isinstance(msg, MClientReply))
+    result, errors = [], []
+
+    def worker():
+        try:
+            result.append(fs._session.call(
+                "mkdir", {"path": "/base/dropped"}, timeout=60.0))
+        except Exception as ex:      # noqa: BLE001
+            errors.append(ex)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    # wait until the (mute) MDS has applied the mkdir
+    meta = c.rados().open_ioctx("cephfs_metadata")
+    root_ino_obj = "dir.1"
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        vals, _ = meta.get_omap_vals(root_ino_obj)
+        if "base" in vals:
+            base = __import__("json").loads(vals["base"])
+            sub, _ = meta.get_omap_vals(f"dir.{base['ino']:x}")
+            if "dropped" in sub:
+                break
+        time.sleep(0.05)
+    th = MDSThrasher(c)
+    th.kill_rank(0)
+    c.network.filter = None
+    drive_failover(c, th, 0)
+    t.join(timeout=60)
+    assert not t.is_alive(), "replayed op never completed"
+    assert not errors, errors
+    assert result and result[0]["type"] == "d"
+    # exactly one directory, visible through the new rank
+    assert fs.listdir("/base") == ["dropped"]
+
+
+def test_client_cap_recovery_after_reconnect(cluster):
+    """Caps die with the old daemon's session state; the fsmap push
+    triggers a client reconnect that re-acquires them through the new
+    rank (ref: the MDS reconnect phase)."""
+    c = cluster
+    c.start_mds(0)
+    c.start_mds_standby()
+    c.wait_mds_active(0)
+    fs = CephFS(c.rados())
+    fh = fs.open("/capfile", "w")
+    assert fh.caps & CAP_EXCL
+    fh.write(0, b"A" * 2048)
+    th = MDSThrasher(c)
+    th.kill_rank(0)
+    drive_failover(c, th, 0)
+    # the reconnect runs off the fsmap push: wait for cap re-grant
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline and not fh.caps & CAP_EXCL:
+        time.sleep(0.05)
+    assert fh.caps & CAP_EXCL, "caps never recovered after failover"
+    fh.write(2048, b"B" * 100)
+    fh.close()
+    assert fs.read_file("/capfile") == b"A" * 2048 + b"B" * 100
+
+
+def test_multi_mds_rank_failover_under_pins(cluster):
+    """Kill one rank of a multi-MDS cluster: only that rank fails
+    over; the surviving rank and its pinned subtree never blink."""
+    c = cluster
+    c.start_mds(0)
+    c.start_mds(1)
+    c.start_mds_standby()
+    c.wait_mds_active(0)
+    c.wait_mds_active(1)
+    fs = CephFS(c.rados())
+    fs.mkdirs("/t0")
+    fs.mkdirs("/t1")
+    fs.set_pin("/t1", 1)
+    fs.write_file("/t0/a", b"rank0")
+    fs.write_file("/t1/a", b"rank1")
+    gid0 = c.fsmap().ranks[0].gid
+    th = MDSThrasher(c)
+    th.kill_rank(1)
+    drive_failover(c, th, 1)
+    # rank 0 untouched, rank 1 took over and serves its subtree
+    assert c.fsmap().ranks[0].gid == gid0
+    assert fs.read_file("/t1/a") == b"rank1"
+    fs.write_file("/t1/b", b"post-failover")
+    assert fs.read_file("/t1/b") == b"post-failover"
+    assert fs.read_file("/t0/a") == b"rank0"
+
+
+def test_standby_replay_warm_takeover(cluster):
+    """A standby-replay follower tails the target rank's journal
+    while standing by, then takes over (ref: the standby-replay
+    daemon state)."""
+    g = global_config()
+    g.set("mds_standby_replay", True)
+    try:
+        c = cluster
+        c.start_mds(0)
+        sb = c.start_mds_standby(standby_replay_rank=0)
+        c.wait_mds_active(0)
+        fs = CephFS(c.rados())
+        fs.mkdirs("/warm")
+        for i in range(10):
+            fs.write_file(f"/warm/f{i}", b"x" * 32)
+        # the follower observed journal entries while standby
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and sb.tailed == 0:
+            time.sleep(0.1)
+        assert sb.tailed > 0, "standby-replay never tailed the journal"
+        th = MDSThrasher(c)
+        th.kill_rank(0)
+        drive_failover(c, th, 0)
+        assert sb.active is not None and sb.rank == 0
+        assert fs.read_file("/warm/f3") == b"x" * 32
+    finally:
+        g.set("mds_standby_replay", False)
+
+
+def test_beacon_mute_marks_rank_failed_then_rejoin(cluster):
+    """Beacon-lapse detection via muting (the heartbeat_inject_failure
+    analogue): a muted-but-alive rank is marked failed; un-muting
+    re-registers it (no standby in the pool, so no split brain)."""
+    c = cluster
+    d = c.start_mds(0)
+    c.wait_mds_active(0)
+    d.inject_beacon_mute = True
+    th = MDSThrasher(c)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and \
+            c.fsmap().ranks[0].state != "failed":
+        th.tick_grace(1)
+    assert c.fsmap().ranks[0].state == "failed"
+    # un-mute: the daemon's next beacon reclaims the vacant rank
+    d.inject_beacon_mute = False
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and \
+            c.fsmap().ranks[0].state != "active":
+        time.sleep(0.1)
+    assert c.fsmap().ranks[0].state == "active"
+    assert c.fsmap().ranks[0].gid == d.gid
+
+
+def test_mds_thrasher_repeated_kill_revive_under_load(cluster):
+    """The thrasher drives repeated kill/promote cycles over a live
+    multi-MDS cluster with client metadata load between kills."""
+    c = cluster
+    c.start_mds(0)
+    c.start_mds(1)
+    c.start_mds_standby()
+    c.wait_mds_active(0)
+    c.wait_mds_active(1)
+    fs = CephFS(c.rados())
+    fs.mkdirs("/load0")
+    fs.mkdirs("/load1")
+    fs.set_pin("/load1", 1)
+    th = MDSThrasher(c, seed=7)
+
+    def between(i):
+        for j in range(3):
+            fs.write_file(f"/load0/r{i}-{j}", f"{i}:{j}".encode())
+            fs.write_file(f"/load1/r{i}-{j}", f"{i}:{j}".encode())
+
+    th.do_thrash(3, between=between)
+    # every write from every round is durable and readable
+    for i in range(3):
+        for j in range(3):
+            want = f"{i}:{j}".encode()
+            assert fs.read_file(f"/load0/r{i}-{j}") == want
+            assert fs.read_file(f"/load1/r{i}-{j}") == want
+    assert th.log, th.log
+
+
+# ------------------------------------------------------------ TCP E2E
+
+def test_tcp_mds_kill_failover():
+    """The same scenario over real sockets: mon + osds + mds +
+    standby each on its own TCP endpoint, kill the active rank, the
+    standby takes over and the client continues."""
+    from ceph_tpu.client import Rados
+    from ceph_tpu.fs import MDSDaemon, MDSStandby
+    from ceph_tpu.mon.monitor import Monitor, build_initial
+    from ceph_tpu.msg.tcp import TcpNet, pick_free_ports
+    from ceph_tpu.osd.daemon import OSDDaemon
+
+    names = ["mon.0", "osd.0", "osd.1", "mds.0", "mds.sb1", "mds.sb2",
+             "client.950", "client.951", "client.952", "client.953"]
+    ports = pick_free_ports(len(names))
+    net = TcpNet({n: ("127.0.0.1", p) for n, p in zip(names, ports)})
+    m, w = build_initial(2, osds_per_host=1)
+    mon = Monitor(net, initial_map=m, initial_wrapper=w)
+    mon.init()
+    osds = [OSDDaemon(net, i) for i in range(2)]
+    for d in osds:
+        d.init()
+    r_mds = Rados(net, name="client.951").connect(20.0)
+    r_sb = Rados(net, name="client.952").connect(20.0)
+    r_cl = Rados(net, name="client.950").connect(20.0)
+    mds = MDSDaemon(net, r_mds, rank=0, mon="mon.0")
+    mds.init()
+    sb = MDSStandby(net, r_sb, name="sb1", mon="mon.0")
+    sb.init()
+    fs = CephFS(r_cl)
+    try:
+        # mon ticks on the real clock over TCP
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and \
+                mon.mdsmon.fsmap.rank_state(0) != "active":
+            mon.tick()
+            time.sleep(0.1)
+        assert mon.mdsmon.fsmap.rank_state(0) == "active"
+        fs.mkdirs("/tcp")
+        fs.write_file("/tcp/f", b"over sockets")
+        mds.kill()
+        deadline = time.monotonic() + 45
+        while time.monotonic() < deadline:
+            mon.tick()
+            time.sleep(0.1)
+            info = mon.mdsmon.fsmap.ranks.get(0)
+            if info is not None and info.state == "active" and \
+                    info.gid == sb.gid:
+                break
+        assert mon.mdsmon.fsmap.ranks[0].gid == sb.gid
+        assert fs.read_file("/tcp/f") == b"over sockets"
+        fs.write_file("/tcp/g", b"post-kill")
+        assert fs.read_file("/tcp/g") == b"post-kill"
+        # second kill/revive cycle: a fresh standby joins, the
+        # promoted daemon dies, the cycle repeats over the same wire
+        r_sb2 = Rados(net, name="client.953").connect(20.0)
+        sb2 = MDSStandby(net, r_sb2, name="sb2", mon="mon.0")
+        sb2.init()
+        sb.kill()
+        deadline = time.monotonic() + 45
+        while time.monotonic() < deadline:
+            mon.tick()
+            time.sleep(0.1)
+            info = mon.mdsmon.fsmap.ranks.get(0)
+            if info is not None and info.state == "active" and \
+                    info.gid == sb2.gid:
+                break
+        assert mon.mdsmon.fsmap.ranks[0].gid == sb2.gid
+        assert fs.read_file("/tcp/g") == b"post-kill"
+        fs.write_file("/tcp/h", b"second cycle")
+        assert fs.read_file("/tcp/h") == b"second cycle"
+        sb2.kill()
+        r_sb2.shutdown()
+    finally:
+        sb.kill()
+        if not mds.stopped:
+            mds.kill()
+        for c in (r_cl, r_mds, r_sb):
+            c.shutdown()
+        for d in osds:
+            d.shutdown()
+        mon.shutdown()

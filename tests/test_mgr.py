@@ -2,6 +2,7 @@
 distribution improves (ref: src/pybind/mgr/balancer/module.py serve/
 execute loop)."""
 import numpy as np
+import pytest
 
 from ceph_tpu.osd.balancer import Balancer
 from ceph_tpu.testing import MiniCluster
@@ -17,6 +18,7 @@ def make_cluster():
     return c, r
 
 
+@pytest.mark.slow   # jit-compile-heavy on current jax; full-suite only (tier-1 budget)
 def test_mgr_balances_cluster():
     c, r = make_cluster()
     mgr = c.start_mgr(max_deviation=1, max_iterations=60)
@@ -49,6 +51,7 @@ def test_mgr_inactive_noop():
     c.shutdown()
 
 
+@pytest.mark.slow   # jit-compile-heavy on current jax; full-suite only (tier-1 budget)
 def test_mgr_osd_daemons_see_balanced_map():
     """The upmaps the mgr installs actually move PG ownership on the
     OSD daemons (end-to-end through mon publish)."""

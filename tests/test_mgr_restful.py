@@ -102,3 +102,13 @@ def test_api_key_auth(setup):
         srv.delete_key(key)
     st, _ = req(srv, "GET", "/status")   # open again
     assert st == 200
+
+
+def test_bad_osd_id_is_400_not_500(setup):
+    """ADVICE r5 low: a non-integer osd id is a client error, not a
+    500 from the handler's blanket except."""
+    _c, _mgr, srv = setup
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        req(srv, "GET", "/osd/abc")
+    assert ei.value.code == 400
+    assert json.loads(ei.value.read())["error"] == "bad osd id"

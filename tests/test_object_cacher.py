@@ -268,3 +268,23 @@ def test_readahead_disabled_with_zero_max():
         oc.read("o", off, 4096)
     assert oc.stats["readahead_pages"] == 0
     assert b.reads == 16
+
+
+def test_readahead_pages_counted_only_when_fetched():
+    """ADVICE r5 low: `readahead_pages` must count pages the miss
+    path actually fetched — full hits (and overshoot into
+    already-cached pages) read nothing ahead."""
+    b, oc = mk(page=4096, max_readahead=64 << 10)
+    b.objs["o"] = bytearray(b"y" * (1 << 20))
+    for off in range(0, 256 << 10, 4096):        # warm sequentially
+        oc.read("o", off, 4096)
+    fetched = oc.stats["readahead_pages"]
+    assert fetched > 0
+    reads_before = b.reads
+    # re-read the same range sequentially: all hits, no backing IO —
+    # the counter must NOT move (the old code counted the window on
+    # every sequential read, hit or miss)
+    for off in range(0, 256 << 10, 4096):
+        oc.read("o", off, 4096)
+    assert b.reads == reads_before
+    assert oc.stats["readahead_pages"] == fetched

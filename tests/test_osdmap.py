@@ -260,6 +260,7 @@ def test_mapping_matches_scalar(with_affinity):
             assert got == want, f"pg {pg}: {got} != {want}"
 
 
+@pytest.mark.slow   # jit-compile-heavy on current jax; full-suite only (tier-1 budget)
 def test_reverse_map_and_counts():
     m = make_map(n_osd=16, pg_num=64)
     mapping = OSDMapMapping()
@@ -280,6 +281,7 @@ def test_reverse_map_and_counts():
 # osdmaptool CLI (cram-style, ref: src/test/cli/osdmaptool/*.t)
 
 
+@pytest.mark.slow   # jit-compile-heavy on current jax; full-suite only (tier-1 budget)
 def test_osdmaptool_cli(tmp_path, capsys):
     from ceph_tpu.tools import osdmaptool
     mapfile = str(tmp_path / "om.json")

@@ -6,12 +6,15 @@ src/osd/OSDMap.cc:4360 calc_pg_upmaps)."""
 import sys
 import pathlib
 
+import pytest
+
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent
                        / "scripts"))
 
 from placement_bench import run  # noqa: E402
 
 
+@pytest.mark.slow   # jit-compile-heavy on current jax; full-suite only (tier-1 budget)
 def test_placement_bench_reduced_scale():
     out = run(n_osd=500, pg_num=1 << 14, sample=64, balancer_iters=3)
     assert out["metric"] == "crush_mappings_per_s"

@@ -40,6 +40,12 @@ STRIPES = 256                    # objects per dispatch
 REPS = 100                       # scan-chained unique reps per measurement
 #                                  (longer chains average out the axon
 #                                  tunnel's run-to-run timing noise)
+REPEATS = 3                      # timed measurements per kernel: the
+#                                  reported value is the MEDIAN and the
+#                                  stddev rides along, so run-to-run
+#                                  drift (PERF_NOTES r4->r5) is visible
+#                                  in the json instead of silently
+#                                  folded into a single sample
 
 
 def measure_cpu_avx2(mat: np.ndarray, data_rows: list) -> float | None:
@@ -167,13 +173,22 @@ def main() -> None:
         return acc
 
     def measure(fn, arg):
+        """>= REPEATS timed runs (after compile+warm); returns the
+        per-dispatch seconds of every repeat."""
         float(fn(arg))  # compile + warm
-        t0 = time.perf_counter()
-        float(fn(arg))
-        return (time.perf_counter() - t0) / REPS
+        out = []
+        for _ in range(REPEATS):
+            t0 = time.perf_counter()
+            float(fn(arg))
+            out.append((time.perf_counter() - t0) / REPS)
+        return out
 
-    t_enc = measure(chained_encode, data)
-    t_dec = measure(chained_decode, survivors0)
+    import statistics
+
+    enc_times = measure(chained_encode, data)
+    dec_times = measure(chained_decode, survivors0)
+    t_enc = statistics.median(enc_times)
+    t_dec = statistics.median(dec_times)
 
     # honest staging cost (VERDICT r4 weak #7): the survivor gather
     # from the full chunk array into the dense (S, k, N) layout —
@@ -193,7 +208,8 @@ def main() -> None:
                           jnp.arange(REPS, dtype=jnp.uint8))
         return acc
 
-    t_stage = measure(chained_stage, all_chunks)
+    stage_times = measure(chained_stage, all_chunks)
+    t_stage = statistics.median(stage_times)
 
     # --- measured CPU floor -------------------------------------------
     mat = tpu.encode_matrix[K:]
@@ -209,11 +225,19 @@ def main() -> None:
         baseline_name = "ISA-L AVX2 stand-in 5000 MB/s (compile failed)"
 
     total_mb = STRIPES * OBJECT_SIZE / 1e6
-    value = 2 * total_mb / (t_enc + t_dec)   # encode pass + decode pass
+    # per-repeat combined metric (encode pass + decode pass), so the
+    # spread of the HEADLINE number is what gets reported
+    values = [2 * total_mb / (te + td)
+              for te, td in zip(enc_times, dec_times)]
+    value = statistics.median(values)
+    stddev = statistics.pstdev(values)
     print(json.dumps({
         "metric": "ec_encode_decode_MBps_k8m4_1MiB",
         "value": round(value, 1),
         "unit": "MB/s",
+        "repeats": REPEATS,
+        "median": round(value, 1),
+        "stddev": round(stddev, 2),
         "vs_baseline": round(value / baseline, 2),
         "detail": {
             "encode_MBps": round(total_mb / t_enc, 1),
@@ -221,6 +245,16 @@ def main() -> None:
             "stage_MBps": round(total_mb / t_stage, 1),
             "decode_incl_stage_MBps": round(
                 total_mb / (t_dec + t_stage), 1),
+            # per-kernel medians + spread across REPEATS timed runs
+            "encode_MBps_stddev": round(
+                statistics.pstdev([total_mb / t for t in enc_times]),
+                2),
+            "decode_MBps_stddev": round(
+                statistics.pstdev([total_mb / t for t in dec_times]),
+                2),
+            "stage_MBps_stddev": round(
+                statistics.pstdev([total_mb / t for t in stage_times]),
+                2),
             "stripes_per_dispatch": STRIPES,
             "api": "plugin encode_batch/decode_batch (pre-staged "
                    "survivor layout as at reply assembly; cached "

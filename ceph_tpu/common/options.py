@@ -231,6 +231,17 @@ OPTIONS: dict[str, Option] = {opt.name: opt for opt in [
        desc="concurrent backfills an OSD serves (local or remote)"),
     _o("osd_backfill_scan_max", T.UINT, 512, L.ADVANCED, runtime=True,
        desc="objects per ranged backfill scan chunk"),
+    # snaptrim (ref: options.cc osd_max_trimming_pgs,
+    # osd_pg_max_concurrent_snap_trims, osd_snap_trim_sleep)
+    _o("osd_max_trimming_pgs", T.UINT, 2, L.ADVANCED, runtime=True,
+       desc="PGs an OSD will snap-trim concurrently; PGs past the "
+            "cap report snaptrim_wait until a slot frees"),
+    _o("osd_pg_max_concurrent_snap_trims", T.UINT, 2, L.ADVANCED,
+       runtime=True,
+       desc="clone trims in flight per trimming PG"),
+    _o("osd_snap_trim_sleep", T.SECS, 0.0, L.ADVANCED, runtime=True,
+       desc="seconds between clone trims (throttles trim against "
+            "client IO; 0 = unthrottled)"),
     # client-side object cache (ref: options.cc client_oc*, rbd_cache*)
     _o("client_oc", T.BOOL, True, L.ADVANCED,
        desc="cephfs write-back object cache under CAP_EXCL/CAP_CACHE"),

@@ -15,7 +15,10 @@ import threading
 import time
 
 #: states that constitute a long-running data-movement operation
-_TRACKED = ("recovering", "backfilling")
+#: (substring match on the pg state; "snaptrim" also covers
+#: snaptrim_wait/snaptrim_error so queued trim work counts as
+#: remaining — the trim analogue of the backfill event)
+_TRACKED = ("recovering", "backfilling", "snaptrim")
 
 #: completed-event history bound (ref: the module's max completed)
 _MAX_DONE = 50

@@ -128,11 +128,26 @@ class PrometheusExporter:
         if rc == 0:
             b.metric("ceph_pool_objects", "objects per pool")
             b.metric("ceph_pool_bytes", "logical bytes per pool")
+            # snaptrim observability: physical store bytes (heads +
+            # snap clones) vs the pool's logical bytes exposes the
+            # deleted-snapshot space leak, and snaptrim_pgs shows the
+            # reclaim actually running (ref: the pg-state and
+            # pool-stat gauges of mgr/prometheus)
+            b.metric("ceph_pool_store_bytes",
+                     "physical store bytes per pool incl. snap clones")
+            b.metric("ceph_pool_snaptrim_pgs",
+                     "pgs per pool in snaptrim/snaptrim_wait/"
+                     "snaptrim_error")
             for pool, st in sorted(df.get("pools", {}).items()):
                 b.sample("ceph_pool_objects", st["objects"],
                          {"pool": pool})
                 b.sample("ceph_pool_bytes", st["bytes"],
                          {"pool": pool})
+                b.sample("ceph_pool_store_bytes",
+                         st.get("store_bytes", st["bytes"]),
+                         {"pool": pool})
+                b.sample("ceph_pool_snaptrim_pgs",
+                         st.get("snaptrim_pgs", 0), {"pool": pool})
 
         rc, _, counts = self._cmd({"prefix": "log counts"})
         if rc == 0:

@@ -85,7 +85,16 @@ class MDSMonitor(PaxosService):
         self._beacon[gid] = now
 
     def beacon_stale(self, gid: int, now: float, grace: float) -> bool:
-        return now - self._beacon.get(gid, now) > grace
+        last = self._beacon.get(gid)
+        if last is None:
+            # first sighting since this monitor took over (restart or
+            # fresh leader): unknown must not read as fresh-forever —
+            # start the gid's grace window NOW, so a genuinely dead
+            # holder still fails one grace later (ref: MDSMonitor
+            # seeding last_beacon for known gids on election win)
+            self._beacon[gid] = now
+            return False
+        return now - last > grace
 
     def stage_beacon(self, msg, now: float):
         """Stage the fsmap consequences of one beacon (runs inside the

@@ -76,9 +76,18 @@ class PGMap:
         pools: dict[int, dict] = {}
         for pgid, st in pgs.items():
             pool = int(pgid.split(".")[0])
-            p = pools.setdefault(pool, {"objects": 0, "bytes": 0})
+            p = pools.setdefault(pool, {"objects": 0, "bytes": 0,
+                                        "store_bytes": 0,
+                                        "snaptrim_pgs": 0})
             p["objects"] += st.get("num_objects", 0)
             p["bytes"] += st.get("bytes", 0)
+            # physical bytes incl. snap clones (falls back to the
+            # logical count for reports predating the field) — the
+            # snaptrim leak-vs-reclaim trend reads from this
+            p["store_bytes"] += st.get("store_bytes",
+                                       st.get("bytes", 0))
+            if "snaptrim" in st.get("state", ""):
+                p["snaptrim_pgs"] += 1
         return {"total_kb": sum(r.kb_total for r in reps),
                 "used_kb": sum(r.kb_used for r in reps),
                 "avail_kb": sum(r.kb_avail for r in reps),

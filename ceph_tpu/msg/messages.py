@@ -272,6 +272,48 @@ class MOSDPGTemp(Message):
 
 
 @dataclass
+class SnapTrim(Message):
+    """Primary -> replica: apply one clone-trim decision for a removed
+    snapshot (the repop the SnapTrimmer statechart issues, ref:
+    PrimaryLogPG::trim_object building the trim transaction; statechart
+    src/osd/PrimaryLogPG.h:1578).  The receiver drops `snap` from the
+    clone's covers and physically deletes the clone once no covered
+    snap remains — idempotent, so a promoted primary re-driving the
+    tail of a dead primary's round converges instead of erroring."""
+    pgid: Any = None
+    tid: int = 0
+    oid: str = ""
+    snap: int = 0
+    clone: int = 0
+    from_osd: int = -1
+
+
+@dataclass
+class SnapTrimReply(Message):
+    """Replica ack for one SnapTrim (the sub-op reply leg the trim
+    statechart waits on before advancing its cursor)."""
+    pgid: Any = None
+    tid: int = 0
+    from_osd: int = -1
+    committed: bool = True
+
+
+@dataclass
+class SnapTrimPurged(Message):
+    """Primary -> replicas: `snaps` are fully trimmed in this PG —
+    reconcile any local leftovers, then record them in the durable
+    purged_snaps interval set (ref: the purged_snaps update in
+    PrimaryLogPG::snap_trimmer / pg_info_t).  Every shard carries the
+    cursor so ANY of them can resume the subsystem as primary after a
+    failover; the whole purged set travels as one message so the
+    per-interval re-announce costs one send per peer, not one per
+    snap."""
+    pgid: Any = None
+    snaps: list = field(default_factory=list)
+    from_osd: int = -1
+
+
+@dataclass
 class PGPull(Message):
     """Primary requests objects it lacks from a holder
     (ref: src/messages/MOSDPGPull.h)."""

@@ -31,7 +31,8 @@ from ..msg.messages import (BackfillReserve, ECSubRead, ECSubReadReply,
                             PGQuery, PGRemove, PGScan, PGScanReply,
                             Ping, PingReply, RepOpReply, RepOpWrite,
                             ScrubMapReply, ScrubMapRequest,
-                            ScrubReserve)
+                            ScrubReserve, SnapTrim, SnapTrimPurged,
+                            SnapTrimReply)
 from ..msg.mon_client import MonHunter
 from ..msg.messenger import Dispatcher, LocalNetwork, Message, Messenger
 from ..store import MemStore, StoreError, Transaction
@@ -86,6 +87,20 @@ class _PGState:
         # Watch objects on the PG — clients re-establish via linger
         # when the primary moves, ref: src/osd/Watch.cc)
         self.watchers: dict[str, dict[tuple, dict]] = {}
+        # snaptrim statechart (primary only; ref: the SnapTrimmer
+        # states src/osd/PrimaryLogPG.h:1578 — NotTrimming/
+        # WaitReservation/Trimming/...): None | "wait" (queued on the
+        # osd_max_trimming_pgs reserver) | "trimming" | "error".
+        # The durable cursor lives in the shard's snap_mapper, NOT
+        # here — this object dies with the interval and the promoted
+        # primary resumes from the persisted index.
+        self.snaptrim: str | None = None
+        self.snaptrim_state: dict | None = None
+        self.snaptrim_backoff_until = 0.0
+        #: removed-snaps view already verified fully purged — skips
+        #: the per-tick durable-cursor read until the pool's
+        #: removed_snaps set changes (it only ever grows)
+        self.snaptrim_done_for: frozenset | None = None
 
 
 class _ScrubState:
@@ -219,6 +234,17 @@ class OSDDaemon(Dispatcher, MonHunter):
         self.op_queue.set_class(
             "scrub", weight=cfg["osd_mclock_scrub_wgt"],
             limit=cfg["osd_mclock_scrub_lim"])
+        # snaptrim rides the QoS queue too: osd_snap_trim_sleep maps
+        # to a rate limit (1/sleep trims per second, burst 1) so trim
+        # storms are paced against client IO instead of racing it
+        # (ref: the osd_snap_trim_sleep wait in the trimmer statechart)
+        self._apply_snap_trim_sleep(cfg["osd_snap_trim_sleep"])
+        cfg.observe("osd_snap_trim_sleep",
+                    lambda _k, v: self._apply_snap_trim_sleep(v))
+        #: PGs this OSD is actively snap-trimming (the
+        #: osd_max_trimming_pgs reserver; PGs past the cap report
+        #: snaptrim_wait until a slot frees)
+        self._trimming_pgs: set = set()
         self._qos_timer: threading.Timer | None = None
         # op counters (ref: src/osd/osd_perf_counters.cc l_osd_op*);
         # multi-cluster harnesses pass their own collection so two
@@ -570,6 +596,53 @@ class OSDDaemon(Dispatcher, MonHunter):
             with self._lock:
                 self._handle_scrub_reserve(msg)
             return True
+        if isinstance(msg, SnapTrim):
+            # replica leg: apply through the current shard or a
+            # transient store view (map lag must not stall the trim;
+            # the apply is durable either way)
+            with self._lock:
+                ok = self._replicated_view(msg.pgid).apply_snap_trim(
+                    msg.oid, msg.snap, msg.clone)
+            self.ms.connect(msg.src).send_message(SnapTrimReply(
+                pgid=msg.pgid, tid=msg.tid, from_osd=self.whoami,
+                committed=ok))
+            return True
+        if isinstance(msg, SnapTrimReply):
+            with self._lock:
+                self._handle_trim_reply(msg)
+            # an ack unblocks the next queued trim: drain now (or arm
+            # the osd_snap_trim_sleep pacing timer) instead of waiting
+            # a whole heartbeat
+            self._drain_op_queue()
+            return True
+        if isinstance(msg, SnapTrimPurged):
+            with self._lock:
+                shard = self._replicated_view(msg.pgid)
+                if self.store.collection_exists(shard.cid):
+                    # reconcile before recording: a replica that was
+                    # down for the trim round still holds the clones —
+                    # its own index says exactly which, so trim them
+                    # locally (normally a no-op) rather than leaking
+                    # them behind a cursor that claims done.  A snap
+                    # is recorded purged ONLY if every local apply
+                    # succeeded — a failed trim must stay visible to
+                    # a future promotion of this shard.
+                    ps = shard.purged_snaps()
+                    done = []
+                    for snap in msg.snaps:
+                        if snap in ps:
+                            continue        # already reconciled
+                        ok = True
+                        for oid, clone in \
+                                shard.snap_mapper.objects_for_snap(
+                                    snap):
+                            ok = shard.apply_snap_trim(
+                                oid, snap, clone) and ok
+                        if ok:
+                            done.append(snap)
+                    if done:
+                        shard.snap_mapper.mark_purged_many(done)
+            return True
         if isinstance(msg, MLogAck):
             self.clog.handle_ack(msg)
             return True
@@ -710,6 +783,13 @@ class OSDDaemon(Dispatcher, MonHunter):
                     moved_to[oid.name] = ccid
                 if moved_to:
                     self._split_pg_log(PG(pool_id, ps), txn, moved_to)
+                    if replicated:
+                        # snap index + purged cursor follow their
+                        # objects (the snap-mapper leg of
+                        # PG::split_into)
+                        from .snap_mapper import SnapMapper
+                        SnapMapper(self.store, cid).split_keys(
+                            txn, moved_to)
                 if not txn.empty():
                     self.store.queue_transaction(txn)
 
@@ -837,6 +917,10 @@ class OSDDaemon(Dispatcher, MonHunter):
                     # replica slots or they leak past the remap
                     self._release_scrub_slots(pg, old)
                     old.scrub = None
+                    # a trim round dies with its interval too — its
+                    # durable cursor survives in the snap index, so
+                    # the new interval's primary resumes it
+                    self._trimming_pgs.discard(pg)
                     if old.backend is not None:
                         # acting change: abort queued ops so clients
                         # see failures and retry, instead of hanging
@@ -900,6 +984,7 @@ class OSDDaemon(Dispatcher, MonHunter):
                 if st.peering is not None:
                     st.peering.abort()
                 self._release_scrub_slots(pg, st)
+                self._trimming_pgs.discard(pg)
                 if st.backend is not None:
                     st.backend.fail_in_flight()
         # record this interval's acting sets for the NEXT map's
@@ -1467,6 +1552,11 @@ class OSDDaemon(Dispatcher, MonHunter):
                 continue
             if st.recovering or st.backfilling:
                 continue
+            if st.snaptrim == "trimming":
+                # trim mutates clone state mid-walk; a concurrent
+                # scrub would flag transient divergence (the
+                # reference serializes the two the same way)
+                continue
             if st.peering is not None and st.peering.phase != CLEAN:
                 continue
             if now < st.scrub_backoff_until:
@@ -1797,6 +1887,231 @@ class OSDDaemon(Dispatcher, MonHunter):
                 self.clog.warn(
                     f"pg {pg} scrub: {bad} inconsistent")
 
+    # ---------------------------------------------------------- snaptrim
+    # Primary-driven background snapshot reclamation (ref: the
+    # SnapTrimmer statechart src/osd/PrimaryLogPG.h:1578 and
+    # PrimaryLogPG::trim_object).  The durable snap index written
+    # alongside every clone (osd/snap_mapper.py) is walked for each
+    # snapid in pool.removed_snaps not yet in the PG's purged_snaps
+    # interval set; each clone trim is applied locally + fanned to the
+    # acting replicas as one idempotent transaction, so a primary kill
+    # mid-round resumes on the promoted primary exactly where the
+    # index says — no re-deletes, no leaked clones.
+    def _apply_snap_trim_sleep(self, sleep) -> None:
+        lim = (1.0 / float(sleep)) if float(sleep) > 0 else 0.0
+        self.op_queue.set_class("snaptrim", weight=1.0, limit=lim,
+                                burst=1.0 if lim > 0 else 64.0)
+
+    def _sched_snaptrim(self, now: float) -> None:
+        """Scheduler pass from the heartbeat tick: start/queue trim
+        rounds on clean primary PGs with outstanding removed snaps,
+        and re-drive in-flight trims whose acks were lost."""
+        cfg = global_config()
+        from .peering import CLEAN
+        for pg, st in sorted(self.pgs.items()):
+            if st.backend is None or \
+                    not isinstance(st.shard, ReplicatedPGShard):
+                continue
+            if st.snaptrim == "trimming":
+                self._retick_trim(pg, st)
+                continue
+            pool = self.osdmap.pools.get(pg.pool)
+            if pool is None:
+                continue
+            removed = frozenset(pool.removed_snaps)
+            if not removed or removed == st.snaptrim_done_for:
+                if st.snaptrim is not None:
+                    st.snaptrim = None
+                continue
+            if st.peering is None or st.peering.phase != CLEAN or \
+                    st.recovering or st.backfilling or \
+                    st.scrub is not None:
+                continue
+            if st.snaptrim == "error" and \
+                    now < st.snaptrim_backoff_until:
+                continue
+            purged = st.shard.purged_snaps()
+            to_trim = sorted(s for s in removed if s not in purged)
+            if not to_trim:
+                # once per interval (the memo resets with _PGState):
+                # re-announce the purged set — ONE message per peer —
+                # so a replica that was down for a past round
+                # reconciles its leftovers; snap trims write no
+                # pg-log entries, so log-driven recovery alone would
+                # never re-visit them
+                for o in st.acting:
+                    if o >= 0 and o != self.whoami:
+                        self.ms.connect(f"osd.{o}").send_message(
+                            SnapTrimPurged(pgid=pg,
+                                           snaps=sorted(removed),
+                                           from_osd=self.whoami))
+                st.snaptrim = None
+                st.snaptrim_done_for = removed
+                continue
+            if len(self._trimming_pgs) >= cfg["osd_max_trimming_pgs"]:
+                # reservation-gated like backfill: report the queue
+                # position as a PG state instead of stampeding
+                st.snaptrim = "wait"
+                continue
+            self._start_pg_trim(pg, st, to_trim)
+
+    def _retick_trim(self, pg: PG, st: _PGState) -> None:
+        """Lost-ack re-drive: an in-flight trim whose replica ack
+        never arrived (dropped connection, killed peer) is re-sent
+        after a few ticks — the apply is idempotent, and peers that
+        left the map are dropped from the pending set."""
+        ts = st.snaptrim_state
+        if ts is None:
+            return
+        done = []
+        for tid, ent in list(ts["inflight"].items()):
+            if ent["pending"] is None:
+                continue          # still queued behind the throttle
+            ent["ticks"] += 1
+            if ent["ticks"] < 3:
+                continue
+            ent["ticks"] = 0
+            for o in list(ent["pending"]):
+                if not self.osdmap.is_up(o):
+                    ent["pending"].discard(o)
+                    continue
+                self.ms.connect(f"osd.{o}").send_message(SnapTrim(
+                    pgid=pg, tid=tid, oid=ent["oid"],
+                    snap=ent["snap"], clone=ent["clone"],
+                    from_osd=self.whoami))
+            if not ent["pending"]:
+                done.append(tid)
+        for tid in done:
+            ts["inflight"].pop(tid, None)
+        if done:
+            self._trim_advance(pg, st)
+
+    def _start_pg_trim(self, pg: PG, st: _PGState,
+                       to_trim: list[int]) -> None:
+        st.snaptrim = "trimming"
+        self._trimming_pgs.add(pg)
+        st.snaptrim_state = {"pending_snaps": list(to_trim),
+                             "snap": None, "queue": [],
+                             "inflight": {}}
+        dout("osd", 4).write("%s: pg %s snaptrim starts: snaps %s",
+                             self.name, pg, to_trim)
+        self._trim_advance(pg, st)
+
+    def _trim_advance(self, pg: PG, st: _PGState) -> None:
+        """Drain the current snap's work-list (bounded by
+        osd_pg_max_concurrent_snap_trims in flight), record the
+        durable purged mark when a snap's last clone is gone, move to
+        the next snap, finish when none remain."""
+        ts = st.snaptrim_state
+        if ts is None:
+            return
+        cfg = global_config()
+        max_inflight = cfg["osd_pg_max_concurrent_snap_trims"]
+        while True:
+            if ts["snap"] is None:
+                if not ts["pending_snaps"]:
+                    if not ts["inflight"]:
+                        self._finish_pg_trim(pg, st)
+                    return
+                ts["snap"] = ts["pending_snaps"].pop(0)
+                # the index IS the cursor: a resumed round only sees
+                # the entries the dead primary never trimmed
+                ts["queue"] = st.shard.snap_mapper.objects_for_snap(
+                    ts["snap"])
+            while ts["queue"] and len(ts["inflight"]) < max_inflight:
+                oid, clone = ts["queue"].pop(0)
+                self._dispatch_trim(pg, st, ts["snap"], oid, clone)
+            if ts["queue"] or ts["inflight"]:
+                return
+            # snap complete on every acting shard: durable cursor
+            # everywhere, so ANY shard can resume as primary
+            snap = ts["snap"]
+            ts["snap"] = None
+            st.shard.mark_purged(snap)
+            for o in st.acting:
+                if o >= 0 and o != self.whoami:
+                    self.ms.connect(f"osd.{o}").send_message(
+                        SnapTrimPurged(pgid=pg, snaps=[snap],
+                                       from_osd=self.whoami))
+            dout("osd", 4).write("%s: pg %s snap %d purged",
+                                 self.name, pg, snap)
+
+    def _dispatch_trim(self, pg: PG, st: _PGState, snap: int,
+                       oid: str, clone: int) -> None:
+        tid = next(self._tid_gen)
+        st.snaptrim_state["inflight"][tid] = {
+            "snap": snap, "oid": oid, "clone": clone,
+            "pending": None, "ticks": 0}
+        # ride the QoS queue: osd_snap_trim_sleep paces the drain
+        self.op_queue.enqueue(
+            "snaptrim", lambda pg=pg, tid=tid: self._send_trim(pg, tid))
+
+    def _send_trim(self, pg: PG, tid: int) -> None:
+        with self._lock:
+            st = self.pgs.get(pg)
+            if st is None or st.snaptrim_state is None:
+                return          # interval changed while queued
+            ts = st.snaptrim_state
+            ent = ts["inflight"].get(tid)
+            if ent is None:
+                return
+            if not st.shard.apply_snap_trim(ent["oid"], ent["snap"],
+                                            ent["clone"]):
+                self._trim_failed(pg, st)
+                return
+            ent["pending"] = set()
+            for o in st.acting:
+                if o < 0 or o == self.whoami:
+                    continue
+                if self.ms.connect(f"osd.{o}").send_message(SnapTrim(
+                        pgid=pg, tid=tid, oid=ent["oid"],
+                        snap=ent["snap"], clone=ent["clone"],
+                        from_osd=self.whoami)):
+                    ent["pending"].add(o)
+                # unreachable peer: proceed without it — when it
+                # returns, peering recovery adopts the authoritative
+                # clone set (apply_clone_payloads re-indexes), so the
+                # stale clone cannot outlive the reconcile
+            if not ent["pending"]:
+                ts["inflight"].pop(tid, None)
+                self._trim_advance(pg, st)
+
+    def _handle_trim_reply(self, m: SnapTrimReply) -> None:
+        st = self.pgs.get(m.pgid)
+        if st is None or st.snaptrim_state is None:
+            return
+        ts = st.snaptrim_state
+        ent = ts["inflight"].get(m.tid)
+        if ent is None or ent["pending"] is None:
+            return
+        if m.from_osd not in ent["pending"]:
+            return
+        if not m.committed:
+            self._trim_failed(m.pgid, st)
+            return
+        ent["pending"].discard(m.from_osd)
+        if not ent["pending"]:
+            ts["inflight"].pop(m.tid, None)
+            self._trim_advance(m.pgid, st)
+
+    def _trim_failed(self, pg: PG, st: _PGState) -> None:
+        """A shard could not apply a trim: back off and retry a fresh
+        round next tick-window (the durable index means nothing is
+        lost — the retry re-walks exactly the remaining entries)."""
+        st.snaptrim = "error"
+        st.snaptrim_state = None
+        self._trimming_pgs.discard(pg)
+        st.snaptrim_backoff_until = (self._hb_now or 0.0) + \
+            global_config()["osd_heartbeat_grace"]
+        self.clog.error(f"pg {pg} snaptrim failed; backing off")
+
+    def _finish_pg_trim(self, pg: PG, st: _PGState) -> None:
+        st.snaptrim = None
+        st.snaptrim_state = None
+        self._trimming_pgs.discard(pg)
+        dout("osd", 4).write("%s: pg %s snaptrim complete", self.name,
+                             pg)
+
     def _make_send(self, pg: PG):
         def send(shard_idx: int, payload) -> bool:
             st = self.pgs.get(pg)
@@ -1849,6 +2164,10 @@ class OSDDaemon(Dispatcher, MonHunter):
                     st.peering.tick(now)
             self._notify_strays(rebuild=False)
             self._sched_scrub(now)
+            self._sched_snaptrim(now)
+        # trim work the scheduler just enqueued drains through the
+        # QoS queue now (or arms the pacing timer)
+        self._drain_op_queue()
         self.clog.flush()
         grace = global_config()["osd_heartbeat_grace"]
         # clock-domain sanity: if our own ticks stopped for more than a
@@ -1937,13 +2256,23 @@ class OSDDaemon(Dispatcher, MonHunter):
                 state.append("clean")
             if st.scrub is not None:
                 state.append("scrubbing")
-            objs = st.shard.objects()
-            nbytes = sum(st.shard.object_size(o) for o in objs)
+            if st.snaptrim == "trimming":
+                state.append("snaptrim")
+            elif st.snaptrim == "wait":
+                state.append("snaptrim_wait")
+            elif st.snaptrim == "error":
+                state.append("snaptrim_error")
+            # one collection pass per PG: client objects, logical
+            # bytes, and physical store bytes (heads + snap clones +
+            # EC chunk streams — the leak-vs-reclaim gauge feed)
+            n_objs, nbytes, store_b = st.shard.stat_summary()
             order = ["active", "clean", "degraded", "recovering",
-                     "backfilling", "scrubbing"]
+                     "backfilling", "scrubbing", "snaptrim",
+                     "snaptrim_wait", "snaptrim_error"]
             pg_stats[str(pg)] = {
                 "state": "+".join(sorted(state, key=order.index)),
-                "num_objects": len(objs), "bytes": nbytes,
+                "num_objects": n_objs, "bytes": nbytes,
+                "store_bytes": store_b,
                 "acting": list(st.acting), "primary": True}
         fs = self.store.statfs()
         perf = self.perf.dump()

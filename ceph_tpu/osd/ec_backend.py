@@ -164,19 +164,12 @@ class ECPGShard:
         self.pg_log = PGLog(IndexedLog(entries, head=head, tail=tail))
 
     def persist_log(self) -> None:
-        """Rewrite the whole durable log (after a peering merge)."""
-        from ..msg import encoding as wire
-        from .replicated_backend import _TAIL_KEY, _log_key, PGMETA
-        txn = Transaction()
-        if not self.store.collection_exists(self.cid):
-            txn.create_collection(self.cid)
-        txn.touch(self.cid, PGMETA)
-        txn.omap_clear(self.cid, PGMETA)
-        txn.omap_setkeys(self.cid, PGMETA, dict(
-            {_log_key(e.version): wire.encode(e)
-             for e in self.pg_log.log.entries},
-            **{_TAIL_KEY: wire.encode(self.pg_log.log.tail)}))
-        self.store.queue_transaction(txn)
+        """Rewrite the whole durable log (shared transaction builder
+        with ReplicatedPGShard — non-log pgmeta keys survive)."""
+        from .replicated_backend import build_persist_log_txn
+        self.store.queue_transaction(
+            build_persist_log_txn(self.store, self.cid,
+                                  self.pg_log.log))
 
     def log_info(self) -> tuple:
         """(last_update, log_tail) — the pg_info_t core GetInfo
@@ -377,6 +370,57 @@ class ECPGShard:
 
     def shard_inventory(self) -> dict:
         return ec_store_inventory(self.store, self.cid)
+
+    def collection_bytes(self) -> int:
+        """Physical bytes this shard's collection stores (chunk
+        streams) — the store-accounting feed for pg stats."""
+        from .snap_mapper import collection_bytes
+        return collection_bytes(self.store, self.cid)
+
+    def stat_summary(self) -> tuple[int, int, int]:
+        """(client_objects, logical_bytes, store_bytes) in ONE
+        collection pass (same contract as the replicated shard's):
+        an object counts while ANY local shard stream of it is
+        non-whiteout; logical size reads this service's own shard OI
+        like object_size does."""
+        if not self.store.collection_exists(self.cid):
+            return (0, 0, 0)
+        store = 0
+        live: set[str] = set()
+        sizes: dict[str, int] = {}
+        for o in self.store.collection_list(self.cid):
+            try:
+                store += self.store.stat(self.cid, o)["size"]
+            except StoreError:
+                continue
+            if o.name == "pgmeta":
+                continue
+            try:
+                oi = self.store.getattr(self.cid, o, OI_ATTR)
+            except StoreError:
+                oi = {}
+            if not oi.get("whiteout"):
+                live.add(o.name)
+            if o.shard == self.shard:
+                sizes[o.name] = oi.get("size", 0)
+        return (len(live), sum(sizes.get(nm, 0) for nm in live),
+                store)
+
+    # -- fault injection: objectstore_debug_inject_read_err applied to
+    #    EC chunk reads.  The store's marks are per-ObjectId and chunk
+    #    streams are shard-qualified, so this is the hook that lets
+    #    harnesses (thrasher EIO injection) target "this OSD's chunk
+    #    of oid" without knowing the ghobject layout; the EIO then
+    #    surfaces through handle_sub_read -> the primary's
+    #    remaining-shard retry/decode, and through scrub_map ->
+    #    shard rebuild.
+    def inject_read_err(self, oid: str) -> None:
+        self.store.inject_read_err(self.cid,
+                                   ObjectId(oid, shard=self.shard))
+
+    def clear_read_err(self, oid: str) -> None:
+        self.store.clear_read_err(self.cid,
+                                  ObjectId(oid, shard=self.shard))
 
     def exists(self, oid: str) -> bool:
         soid = ObjectId(oid, shard=self.shard)

@@ -36,6 +36,31 @@ def _log_key(v) -> str:
     return _LOG_KEY.format(v.epoch, v.version)
 
 
+def build_persist_log_txn(store, cid: str, log) -> Transaction:
+    """The full durable-log rewrite transaction (after a peering
+    merge, where entries were rewound/replaced, not appended) —
+    shared by the replicated and EC shards.  Non-log pgmeta keys —
+    the snap-mapper index and the purged_snaps cursor — survive the
+    rewrite: wiping them with the stale log keys would silently leak
+    every clone awaiting trim."""
+    from ..msg import encoding as wire
+    txn = Transaction()
+    preserved = {}
+    if not store.collection_exists(cid):
+        txn.create_collection(cid)
+    elif store.exists(cid, PGMETA):
+        preserved = {k: v for k, v in
+                     store.omap_get(cid, PGMETA).items()
+                     if not k.startswith("l.") and k != _TAIL_KEY}
+    txn.touch(cid, PGMETA)
+    txn.omap_clear(cid, PGMETA)
+    txn.omap_setkeys(cid, PGMETA, dict(
+        {_log_key(e.version): wire.encode(e) for e in log.entries},
+        **{_TAIL_KEY: wire.encode(log.tail)},
+        **preserved))
+    return txn
+
+
 class ReplicatedPGShard:
     """Per-OSD service for one replicated PG (primary or replica).
 
@@ -46,10 +71,14 @@ class ReplicatedPGShard:
     log that would force a full backfill."""
 
     def __init__(self, pgid, store, create: bool = True):
+        from .snap_mapper import SnapMapper
         self.pgid = pgid
         self.store = store
         self.cid = pg_cid(pgid)
         self.pg_log = PGLog()
+        #: persistent snap->clone index + purged_snaps cursor, stored
+        #: in the pgmeta omap next to the log (osd/snap_mapper.py)
+        self.snap_mapper = SnapMapper(store, self.cid)
         if create and not store.collection_exists(self.cid):
             store.queue_transaction(
                 Transaction().create_collection(self.cid))
@@ -98,19 +127,11 @@ class ReplicatedPGShard:
         return dropped
 
     def persist_log(self) -> None:
-        """Rewrite the whole durable log (after a peering merge_log,
-        where entries were rewound/replaced, not appended)."""
-        from ..msg import encoding as wire
-        txn = Transaction()
-        if not self.store.collection_exists(self.cid):
-            txn.create_collection(self.cid)
-        txn.touch(self.cid, PGMETA)
-        txn.omap_clear(self.cid, PGMETA)
-        txn.omap_setkeys(self.cid, PGMETA, dict(
-            {_log_key(e.version): wire.encode(e)
-             for e in self.pg_log.log.entries},
-            **{_TAIL_KEY: wire.encode(self.pg_log.log.tail)}))
-        self.store.queue_transaction(txn)
+        """Rewrite the whole durable log (see build_persist_log_txn —
+        non-log pgmeta keys survive)."""
+        self.store.queue_transaction(
+            build_persist_log_txn(self.store, self.cid,
+                                  self.pg_log.log))
 
     def log_info(self) -> tuple:
         """(last_update, log_tail) — the pg_info_t core the peering
@@ -141,10 +162,15 @@ class ReplicatedPGShard:
         head_live = bool(old_oi) and not old_oi.get("whiteout")
         try:
             if clone_snap is not None and head_live:
-                # COW: preserve the pre-write head (data+attrs+omap)
+                # COW: preserve the pre-write head (data+attrs+omap),
+                # and index the clone in the SAME txn so the snap
+                # trimmer can never miss it (ref: SnapMapper::add_oid
+                # riding the repop transaction)
                 txn.clone(self.cid, soid,
                           ObjectId(oid, snap=clone_snap))
                 clones[clone_snap] = list(clone_covers or [])
+                self.snap_mapper.add_clone(txn, oid, clone_snap,
+                                           list(clone_covers or []))
             new_seq = max(old_oi.get("snap_seq", 0), snap_seq)
             if mut.is_delete(muts):
                 if self.store.exists(self.cid, soid):
@@ -378,7 +404,100 @@ class ReplicatedPGShard:
         oi["snap_seq"] = max(oi.get("snap_seq", 0),
                              payload.get("snap_seq", 0))
         txn.setattr(self.cid, ObjectId(oid), OI_ATTR, oi)
+        # re-index atomically with the adopted clone set: the rebuilt
+        # copy must be trimmable exactly like the source was
+        self.snap_mapper.replace_object(txn, oid, clones_map)
         self.store.queue_transaction(txn)
+
+    # -- snaptrim (ref: PrimaryLogPG::trim_object — the per-clone trim
+    #    transaction both the primary and its replicas apply) ---------
+    def apply_snap_trim(self, oid: str, snap: int, clone: int) -> bool:
+        """Drop `snap` from `oid`'s clone `clone`: remove it from the
+        clone's covers, delete the clone object outright once no
+        covered snap remains, and unindex — all one transaction, so
+        the snap index stays an exact cursor of remaining work.
+        Idempotent: re-applying after a primary failover finds the
+        index entry gone and succeeds without touching the store."""
+        if not self.store.collection_exists(self.cid):
+            return True          # nothing here to trim (map lag view)
+        txn = Transaction()
+        oi = self.head_oi(oid)
+        clones = {int(t): list(c)
+                  for t, c in oi.get("clones", {}).items()}
+        try:
+            if clone in clones:
+                covers = [c for c in clones[clone] if c != snap]
+                csoid = ObjectId(oid, snap=clone)
+                if covers:
+                    clones[clone] = covers
+                    self.snap_mapper.rm(txn, snap, oid, clone)
+                else:
+                    old_covers = clones.pop(clone)
+                    if self.store.exists(self.cid, csoid):
+                        txn.remove(self.cid, csoid)
+                    self.snap_mapper.rm_clone(txn, oid, clone,
+                                              old_covers)
+                if self.store.exists(self.cid, ObjectId(oid)):
+                    if not clones and oi.get("whiteout"):
+                        # a deleted head kept alive only by its snap
+                        # history: the last trimmed clone takes the
+                        # whiteout with it (ref: trim_object removing
+                        # the head when the SnapSet empties) — a
+                        # lagging stray still converges via the
+                        # backfill walk's stray-whiteout leg
+                        txn.remove(self.cid, ObjectId(oid))
+                    else:
+                        oi["clones"] = clones
+                        txn.setattr(self.cid, ObjectId(oid), OI_ATTR,
+                                    oi)
+            else:
+                # already trimmed (resumed round / duplicate op):
+                # clear any stale index key and report success
+                self.snap_mapper.rm(txn, snap, oid, clone)
+            if not txn.empty():
+                self.store.queue_transaction(txn)
+            return True
+        except StoreError as err:
+            dout("osd", 0).write("%s snap trim %s@%s failed: %s",
+                                 self.pgid, oid, clone, err)
+            return False
+
+    def purged_snaps(self):
+        return self.snap_mapper.purged_snaps()
+
+    def mark_purged(self, snap: int) -> None:
+        self.snap_mapper.mark_purged(snap)
+
+    def collection_bytes(self) -> int:
+        """Physical bytes this PG stores (heads + snap clones) — the
+        store-accounting feed for pg stats."""
+        from .snap_mapper import collection_bytes
+        return collection_bytes(self.store, self.cid)
+
+    def stat_summary(self) -> tuple[int, int, int]:
+        """(client_objects, logical_bytes, store_bytes) in ONE
+        collection pass — the periodic pg-stat feed (a separate
+        objects() + collection_bytes() pair would walk the
+        collection twice per report)."""
+        if not self.store.collection_exists(self.cid):
+            return (0, 0, 0)
+        n = logical = store = 0
+        for o in self.store.collection_list(self.cid):
+            try:
+                store += self.store.stat(self.cid, o)["size"]
+            except StoreError:
+                continue
+            if o.name == "pgmeta" or o.snap != -2:
+                continue
+            try:
+                oi = self.store.getattr(self.cid, o, OI_ATTR)
+            except StoreError:
+                continue
+            if oi.get("whiteout"):
+                continue
+            n += 1
+            logical += oi.get("size", 0)
+        return (n, logical, store)
 
     def _is_whiteout(self, soid: ObjectId) -> bool:
         try:

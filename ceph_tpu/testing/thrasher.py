@@ -18,8 +18,16 @@ from .cluster import MiniCluster
 
 
 class OSDThrasher:
+    """`ec_pools` + `rados` arm the erasure-coded legs: chunk EIO
+    injection (`objectstore_debug_inject_read_err` applied to EC
+    shard reads — exercises the primary's remaining-shard retry and
+    scrub's shard rebuild) joins the action mix, and min-guards should
+    be sized so >= k shards of every stripe stay live (the caller
+    knows its k+m)."""
+
     def __init__(self, cluster: MiniCluster, seed: int = 0,
-                 min_in: int = 3, min_live: int = 3):
+                 min_in: int = 3, min_live: int = 3,
+                 ec_pools=(), rados=None):
         self.c = cluster
         self.rng = random.Random(seed)
         self.min_in = min_in
@@ -29,6 +37,14 @@ class OSDThrasher:
         self.out: set[int] = set()
         self.now = 10_000.0
         self.log: list[str] = []
+        #: EC pool names eligible for shard-EIO injection
+        self.ec_pools = list(ec_pools)
+        self.r = rados
+        #: live injections: (osd, cid, shard ObjectId)
+        self.injected: list[tuple] = []
+        #: objectstore_debug_inject_read_err value to restore after
+        #: the EIO leg (None = we never flipped it)
+        self._eio_flag_was: bool | None = None
 
     # ------------------------------------------------------------ state
     def _live(self) -> list[int]:
@@ -92,12 +108,73 @@ class OSDThrasher:
         if not self.c.threaded:
             self.c.pump()
 
-    ACTIONS = ("kill_osd", "revive_osd", "out_osd", "in_osd")
+    def inject_shard_eio(self) -> None:
+        """Mark one random EC chunk on a live OSD to fail reads with
+        EIO (the ceph_manager inject_* analogue for shard read
+        errors).  The victim shard's chunk read then errors through
+        ECPGShard.handle_sub_read and the reading primary must
+        reconstruct from the remaining shards."""
+        if not self.ec_pools or self.r is None:
+            return
+        # the store only honors EIO marks while the dev flag is set —
+        # an injection without it would be a silent no-op and the
+        # thrash run would claim EIO coverage it never exercised
+        cfg = global_config()
+        if not cfg["objectstore_debug_inject_read_err"]:
+            if self._eio_flag_was is None:
+                self._eio_flag_was = False
+            cfg.set("objectstore_debug_inject_read_err", True)
+        from ..osd.ec_backend import ECPGShard, pg_cid
+        from ..store import ObjectId
+        pid = self.r.pool_lookup(self.rng.choice(self.ec_pools))
+        live = list(self._live())
+        self.rng.shuffle(live)
+        for osd in live:
+            d = self.c.osds.get(osd)
+            if d is None:
+                continue
+            cands = [(pg, st) for pg, st in sorted(d.pgs.items())
+                     if pg.pool == pid and
+                     isinstance(st.shard, ECPGShard)]
+            self.rng.shuffle(cands)
+            for pg, st in cands:
+                oids = st.shard.objects()
+                if not oids:
+                    continue
+                oid = self.rng.choice(sorted(oids))
+                st.shard.inject_read_err(oid)
+                self.injected.append(
+                    (osd, pg_cid(pg),
+                     ObjectId(oid, shard=st.shard.shard)))
+                self.log.append(f"eio osd.{osd} {pg} {oid}")
+                return
+
+    def clear_shard_eio(self) -> None:
+        """Lift every live injection (stores survive kill/revive, so
+        the exact marked ObjectIds clear even after remaps), and
+        restore the dev flag if the thrasher flipped it."""
+        while self.injected:
+            osd, cid, soid = self.injected.pop()
+            d = self.c.osds.get(osd)
+            store = d.store if d is not None \
+                else self.c._stores.get(osd)
+            if store is not None:
+                store.clear_read_err(cid, soid)
+        if self._eio_flag_was is not None:
+            global_config().set("objectstore_debug_inject_read_err",
+                                self._eio_flag_was)
+            self._eio_flag_was = None
+
+    ACTIONS = ("kill_osd", "revive_osd", "out_osd", "in_osd",
+               "inject_shard_eio", "clear_shard_eio")
 
     def choose_action(self) -> str:
         """(ref: ceph_manager.py choose_action weights)."""
         weights = {"kill_osd": 3, "revive_osd": 3,
                    "out_osd": 2, "in_osd": 2}
+        if self.ec_pools:
+            weights["inject_shard_eio"] = 1
+            weights["clear_shard_eio"] = 1
         names = list(weights)
         return self.rng.choices(names,
                                 weights=[weights[n] for n in names])[0]
@@ -111,8 +188,10 @@ class OSDThrasher:
 
     # ------------------------------------------------------------- heal
     def heal(self, timeout_rounds: int = 50) -> None:
-        """Revive + mark in everything, wait until no PG is
-        recovering (ref: thrasher's final do_join/wait_for_clean)."""
+        """Revive + mark in everything, lift EIO injections, wait
+        until no PG is recovering (ref: thrasher's final
+        do_join/wait_for_clean)."""
+        self.clear_shard_eio()
         for osd in sorted(self.dead):
             self.revive_osd(osd)
         for osd in sorted(self.out):

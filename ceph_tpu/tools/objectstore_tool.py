@@ -16,6 +16,11 @@ layout: `block` + `kv/`):
                                   the disk-swap / PG-rescue flow the
                                   reference tool exists for
     --op remove  --pgid P         delete a PG collection outright
+    --op list-snaps --pgid P      per-object snapshot state: clone
+                                  tags, covered snapids, presence
+    --op dump-snap-index --pgid P the durable snaptrim state: the
+                                  snap->clone index awaiting trim (the
+                                  crash-resume cursor) + purged_snaps
 
 The export blob uses the typed wire codec, so it round-trips the
 exact ObjectIds (snap clones included) and the pg_log omap that
@@ -131,6 +136,53 @@ def import_pg(store, blob: bytes, force: bool = False) -> PG:
     return pg
 
 
+def list_snaps(store, pg: PG) -> list[dict]:
+    """Per-object snapshot state: head snap_seq, clone tags + the
+    snapids each clone covers, and whether the clone object actually
+    exists — the offline view of the SnapSet scrub compares."""
+    from ..osd.ec_backend import OI_ATTR
+    cid = _pg_cid(pg)
+    if not store.collection_exists(cid):
+        raise StoreError("ENOENT", f"pg {pg}")
+    out = []
+    for oid in sorted(store.collection_list(cid),
+                      key=lambda o: (o.name, o.snap)):
+        if oid.name == "pgmeta" or oid.snap != -2:
+            continue
+        try:
+            oi = dict(store.getattr(cid, oid, OI_ATTR))
+        except StoreError:
+            continue
+        clones = {int(t): list(c)
+                  for t, c in oi.get("clones", {}).items()}
+        if not clones and not oi.get("snap_seq"):
+            continue
+        out.append({
+            "oid": oid.name,
+            "snap_seq": oi.get("snap_seq", 0),
+            "whiteout": bool(oi.get("whiteout")),
+            "clones": {
+                str(t): {"covers": c,
+                         "present": store.exists(
+                             cid, ObjectId(oid.name, snap=t))}
+                for t, c in sorted(clones.items())},
+        })
+    return out
+
+
+def dump_snap_index(store, pg: PG) -> dict:
+    """The durable snaptrim state: the snap->clone index entries still
+    awaiting trim (the resume cursor) + the purged_snaps interval set
+    — what a promoted primary would act on."""
+    from ..osd.snap_mapper import SnapMapper
+    cid = _pg_cid(pg)
+    if not store.collection_exists(cid):
+        raise StoreError("ENOENT", f"pg {pg}")
+    sm = SnapMapper(store, cid)
+    return {"pgid": str(pg), "index": sm.dump(),
+            "purged_snaps": sm.purged_snaps().to_list()}
+
+
 def remove_pg(store, pg: PG) -> int:
     cid = _pg_cid(pg)
     if not store.collection_exists(cid):
@@ -150,7 +202,8 @@ def main(argv=None) -> int:
                     help="the STOPPED OSD's store directory")
     ap.add_argument("--op", required=True,
                     choices=["list", "info", "fsck", "export",
-                             "import", "remove"])
+                             "import", "remove", "list-snaps",
+                             "dump-snap-index"])
     ap.add_argument("--pgid", default="",
                     help="pg id as <pool>.<ps-hex>")
     ap.add_argument("--file", default="", help="export/import blob")
@@ -171,6 +224,14 @@ def main(argv=None) -> int:
         elif a.op == "info":
             import json
             print(json.dumps(pg_info(store, _parse_pgid(a.pgid))))
+        elif a.op == "list-snaps":
+            import json
+            for ent in list_snaps(store, _parse_pgid(a.pgid)):
+                print(json.dumps(ent))
+        elif a.op == "dump-snap-index":
+            import json
+            print(json.dumps(dump_snap_index(store,
+                                             _parse_pgid(a.pgid))))
         elif a.op == "fsck":
             errors = store.fsck()
             for e in errors:

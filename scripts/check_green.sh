@@ -7,31 +7,75 @@
 # This script IS that assertion: wire it into any verify/release flow
 # (`bash scripts/check_green.sh`) — exit 0 means every collected
 # tier-1 test passed, anything else means do not ship.
+#
+# Flake gate: `bash scripts/check_green.sh --repeat N [pytest-target...]`
+# runs the given targets (default: the thrash suites) N times
+# consecutively and fails on the FIRST red run — a test that cannot go
+# green N times in a row is flaky and must not gate as green.
 set -u -o pipefail
 
 cd "$(dirname "$0")/.."
-LOG="${TMPDIR:-/tmp}/check_green.$$.log"
-trap 'rm -f "$LOG"' EXIT
 
-timeout -k 10 870 env JAX_PLATFORMS=cpu \
-    python -m pytest tests/ -q -m 'not slow' \
-    --continue-on-collection-errors -p no:cacheprovider \
-    -p no:xdist -p no:randomly 2>&1 | tee "$LOG"
-rc=${PIPESTATUS[0]}
+REPEAT=1
+TARGETS=()
+while [ $# -gt 0 ]; do
+    case "$1" in
+        --repeat)
+            REPEAT="$2"; shift 2
+            # a gate that can be asked to run zero times is not a
+            # gate: refuse anything but a positive integer
+            case "$REPEAT" in
+                ''|*[!0-9]*|0)
+                    echo "check_green: --repeat wants a positive" \
+                         "integer, got '$REPEAT'" >&2
+                    exit 2 ;;
+            esac
+            # repeat mode defaults to the thrash suites (the tests
+            # whose randomized schedules make flakes most likely)
+            ;;
+        *)
+            TARGETS+=("$1"); shift ;;
+    esac
+done
+if [ "$REPEAT" -gt 1 ] && [ ${#TARGETS[@]} -eq 0 ]; then
+    TARGETS=(tests/test_thrasher.py tests/test_thrash_ec.py \
+             tests/test_snaptrim.py)
+fi
+if [ ${#TARGETS[@]} -eq 0 ]; then
+    TARGETS=(tests/)
+fi
 
-passed=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)
-echo "DOTS_PASSED=${passed}"
+run_once() {
+    local log="$1"
+    timeout -k 10 870 env JAX_PLATFORMS=cpu \
+        python -m pytest "${TARGETS[@]}" -q -m 'not slow' \
+        --continue-on-collection-errors -p no:cacheprovider \
+        -p no:xdist -p no:randomly 2>&1 | tee "$log"
+    local rc=${PIPESTATUS[0]}
+    local passed
+    passed=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$log" | tr -cd . | wc -c)
+    echo "DOTS_PASSED=${passed}"
+    if [ "$rc" -ne 0 ]; then
+        echo "check_green: RED (pytest rc=$rc) — do not ship" >&2
+        return 1
+    fi
+    if grep -aqE '^(FAILED|ERROR) ' "$log"; then
+        echo "check_green: RED (F/E lines present) — do not ship" >&2
+        return 1
+    fi
+    if [ "$passed" -eq 0 ]; then
+        echo "check_green: RED (zero tests passed — collection broke?)" >&2
+        return 1
+    fi
+    echo "check_green: GREEN (${passed} passed)"
+    return 0
+}
 
-if [ "$rc" -ne 0 ]; then
-    echo "check_green: RED (pytest rc=$rc) — do not ship" >&2
-    exit 1
-fi
-if grep -aqE '^(FAILED|ERROR) ' "$LOG"; then
-    echo "check_green: RED (F/E lines present) — do not ship" >&2
-    exit 1
-fi
-if [ "$passed" -eq 0 ]; then
-    echo "check_green: RED (zero tests passed — collection broke?)" >&2
-    exit 1
-fi
-echo "check_green: GREEN (${passed} passed)"
+for i in $(seq 1 "$REPEAT"); do
+    LOG="${TMPDIR:-/tmp}/check_green.$$.$i.log"
+    trap 'rm -f "${TMPDIR:-/tmp}"/check_green.$$.*.log' EXIT
+    if [ "$REPEAT" -gt 1 ]; then
+        echo "=== check_green run $i/$REPEAT: ${TARGETS[*]} ==="
+    fi
+    run_once "$LOG" || exit 1
+done

@@ -65,6 +65,45 @@ def test_objectstore_tool_cli_roundtrip(tmp_path):
     st2.umount()
 
 
+def test_objectstore_tool_snap_index_ops(tmp_path):
+    """list-snaps + dump-snap-index expose the durable snaptrim state
+    of a stopped OSD: clone tags/covers, the snap->clone index still
+    awaiting trim, and the purged_snaps cursor."""
+    from ceph_tpu.osd import mutations as mut
+    from ceph_tpu.osd.pg_types import EVersion, MODIFY, PGLogEntry
+    from ceph_tpu.osd.replicated_backend import ReplicatedPGShard
+    st = _mk_store(tmp_path, "osd2")
+    pg = PG(5, 0x1)
+    shard = ReplicatedPGShard(pg, st)
+    shard.apply_write("snappy", 0, b"v1" * 50, False,
+                      EVersion(2, 1),
+                      [PGLogEntry(MODIFY, "snappy", EVersion(2, 1))])
+    # a COW write preserving the head as clone 7 covering snaps {6, 7}
+    shard.apply_mutations(
+        "snappy", [(mut.M_WRITEFULL, b"v2" * 50)],
+        EVersion(2, 2), [PGLogEntry(MODIFY, "snappy", EVersion(2, 2))],
+        clone_snap=7, clone_covers=[6, 7], snap_seq=7)
+    shard.mark_purged(3)
+
+    snaps = objectstore_tool.list_snaps(st, pg)
+    assert len(snaps) == 1 and snaps[0]["oid"] == "snappy"
+    assert snaps[0]["clones"]["7"]["covers"] == [6, 7]
+    assert snaps[0]["clones"]["7"]["present"]
+
+    idx = objectstore_tool.dump_snap_index(st, pg)
+    assert {(e["snap"], e["clone"]) for e in idx["index"]} == \
+        {(6, 7), (7, 7)}
+    assert idx["purged_snaps"] == [[3, 3]]
+    st.umount()
+    # CLI legs
+    assert objectstore_tool.main(
+        ["--data-path", str(tmp_path / "osd2"), "--op", "list-snaps",
+         "--pgid", "5.1"]) == 0
+    assert objectstore_tool.main(
+        ["--data-path", str(tmp_path / "osd2"),
+         "--op", "dump-snap-index", "--pgid", "5.1"]) == 0
+
+
 def test_pg_export_import_rescues_killed_osd(tmp_path):
     """The VERDICT criterion: export a PG from a killed OSD's store,
     import it into a fresh one, revive — the cluster peers from the

@@ -1,0 +1,173 @@
+"""EC thrash suite: a wide k=8,m=4 pool through repeated kills/
+out-in/pg growth under IO, with shard read-error injection
+(ref: qa/tasks/ceph_manager.py OSDThrasher over EC pools +
+qa/standalone/erasure-code/test-erasure-code.sh; the EIO leg models
+objectstore_debug_inject_read_err applied to EC chunk reads, so
+recovery-from-EIO is exercised end to end)."""
+import random
+import time
+
+import pytest
+
+from ceph_tpu.common.options import global_config
+from ceph_tpu.osd.types import PG
+from ceph_tpu.testing import MiniCluster, OSDThrasher
+
+K, M = 8, 4
+
+
+def make_ec_cluster(n_osd=14, pg_num=4, pool="ecp"):
+    c = MiniCluster(n_osd=n_osd, threaded=False)
+    c.pump()
+    c.wait_all_up()
+    r = c.rados()
+    r.mon_command({"prefix": "osd erasure-code-profile set",
+                   "name": "k8m4t",
+                   "profile": {"plugin": "tpu", "k": str(K),
+                               "m": str(M),
+                               "crush-failure-domain": "osd"}})
+    r.pool_create(pool, pg_num=pg_num, pool_type="erasure",
+                  erasure_code_profile="k8m4t")
+    c.pump()
+    return c, r
+
+
+@pytest.fixture()
+def eio_flag():
+    cfg = global_config()
+    old = cfg["objectstore_debug_inject_read_err"]
+    cfg.set("objectstore_debug_inject_read_err", True)
+    yield
+    cfg.set("objectstore_debug_inject_read_err", old)
+
+
+def drain(c, io, futures, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        c.pump()
+        if all(f.done() for f in futures.values()):
+            break
+        time.sleep(0.02)
+    return [o for o, f in futures.items() if not f.done()]
+
+
+def test_ec_shard_eio_read_reconstructs(eio_flag):
+    """A chunk read failing with EIO on one shard must not fail the
+    client read: the primary retries the remaining shards and
+    decodes (ref: ECBackend get_remaining_shards retry)."""
+    c, r = make_ec_cluster(n_osd=13, pg_num=2)
+    try:
+        io = r.open_ioctx("ecp")
+        payload = bytes(random.Random(1).randrange(256)
+                        for _ in range(1 << 14))
+        io.write_full("eobj", payload)
+        c.pump()
+        pid = r.pool_lookup("ecp")
+        m = c.mon.osdmap
+        raw = m.object_locator_to_pg("eobj", pid)
+        pg = m.pools[pid].raw_pg_to_pg(raw)
+        _, _, acting, primary = m.pg_to_up_acting_osds(raw)
+        # hit a DATA shard on a non-primary OSD so the decode path
+        # (not the local fast path) must tolerate the error
+        victim_shard = next(s for s in range(K)
+                            if acting[s] != primary and acting[s] >= 0)
+        victim = acting[victim_shard]
+        st = c.osds[victim].pgs[pg]
+        st.shard.inject_read_err("eobj")
+        assert io.read("eobj") == payload
+        # injection really fires: the victim's own chunk read errors
+        from ceph_tpu.store import StoreError, ObjectId
+        from ceph_tpu.osd.ec_backend import pg_cid
+        with pytest.raises(StoreError):
+            c.osds[victim].store.read(
+                pg_cid(pg), ObjectId("eobj", shard=victim_shard))
+        st.shard.clear_read_err("eobj")
+        assert io.read("eobj") == payload
+    finally:
+        c.shutdown()
+
+
+def test_ec_thrash_kills_eio_and_io_survives(eio_flag):
+    """The full loop over an EC pool: random kill/revive/out/in plus
+    shard-EIO injection with async IO interleaved, then heal and
+    verify every object byte-for-byte."""
+    c, r = make_ec_cluster(n_osd=14, pg_num=4)
+    try:
+        io = r.open_ioctx("ecp")
+        rng = random.Random(42)
+        expected: dict[str, bytes] = {}
+        futures: dict[str, object] = {}
+
+        def do_io(i):
+            for _ in range(2):
+                oid = f"e{rng.randrange(10)}"
+                data = bytes([rng.randrange(256)]) * \
+                    rng.randrange(256, 4096)
+                futures[oid] = io.aio_write_full(oid, data)
+                expected[oid] = data
+            c.pump()
+
+        # >= K+M must stay in/alive so CRUSH keeps full-width
+        # mappings while still letting the thrasher take 2 down
+        t = OSDThrasher(c, seed=7, min_in=12, min_live=12,
+                        ec_pools=["ecp"], rados=r)
+        do_io(-1)
+        t.do_thrash(8, between=do_io)
+        # at least one EIO injection must have occurred in the mix;
+        # force one if the dice never rolled it
+        if not t.injected and not any("eio" in l for l in t.log):
+            t.inject_shard_eio()
+            do_io(99)
+        t.heal()
+        undone = drain(c, io, futures)
+        assert not undone, (undone, t.log)
+        failed = {o: f.errno_name for o, f in futures.items()
+                  if f.result < 0}
+        assert not failed, (failed, t.log)
+        for oid, data in sorted(expected.items()):
+            assert io.read(oid) == data, (oid, t.log)
+        assert all(c.mon.osdmap.is_up(o) and c.mon.osdmap.is_in(o)
+                   for o in range(14)), t.log
+    finally:
+        c.shutdown()
+
+
+def test_ec_pg_growth_under_io():
+    """pg_num/pgp_num growth on a live k=8,m=4 pool: collections
+    split, placements reseed, and every object stays readable."""
+    c, r = make_ec_cluster(n_osd=13, pg_num=4, pool="egrow")
+    try:
+        io = r.open_ioctx("egrow")
+        rng = random.Random(9)
+        expected = {}
+        for i in range(12):
+            data = bytes([rng.randrange(256)]) * rng.randrange(512, 3000)
+            io.write_full(f"g{i}", data)
+            expected[f"g{i}"] = data
+        c.pump()
+        rc, outs, _ = r.mon_command({"prefix": "osd pool set",
+                                     "pool": "egrow", "var": "pg_num",
+                                     "val": "8"})
+        assert rc == 0, outs
+        rc, outs, _ = r.mon_command({"prefix": "osd pool set",
+                                     "pool": "egrow",
+                                     "var": "pgp_num", "val": "8"})
+        assert rc == 0, outs
+        c.pump()
+        now = 30_000.0
+        for _ in range(4):
+            now += 11
+            c.tick(now)
+            c.pump()
+        # writes keep landing post-split
+        for i in range(12, 16):
+            data = bytes([rng.randrange(256)]) * 1024
+            io.write_full(f"g{i}", data)
+            expected[f"g{i}"] = data
+        c.pump()
+        pid = r.pool_lookup("egrow")
+        assert c.mon.osdmap.pools[pid].pg_num == 8
+        for oid, data in sorted(expected.items()):
+            assert io.read(oid) == data, oid
+    finally:
+        c.shutdown()

@@ -174,12 +174,16 @@ def main() -> None:
 
     def measure(fn, arg):
         """>= REPEATS timed runs (after compile+warm); returns the
-        per-dispatch seconds of every repeat."""
-        float(fn(arg))  # compile + warm
+        per-dispatch seconds of every repeat.  The clock stops only
+        after jax.block_until_ready — float() also forces the scalar,
+        but block_until_ready is the EXPLICIT device sync (cephck
+        jax-timing), so the timed region can never silently become
+        dispatch-only if the reduction is refactored away."""
+        jax.block_until_ready(fn(arg))  # compile + warm
         out = []
         for _ in range(REPEATS):
             t0 = time.perf_counter()
-            float(fn(arg))
+            jax.block_until_ready(fn(arg))
             out.append((time.perf_counter() - t0) / REPS)
         return out
 

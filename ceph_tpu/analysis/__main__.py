@@ -1,0 +1,7 @@
+"""CLI entry: ``python -m ceph_tpu.analysis [paths...]``."""
+import sys
+
+from .engine import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,348 @@
+"""cephck rule engine: file walking, suppression baseline, reporting.
+
+Rules are small classes (see rules.py) with an ``id``, a ``doc``
+explaining how to read a finding, and ``check(ctx)`` yielding
+Findings over one parsed file.  The engine owns everything around
+them: collecting files, parsing once, matching findings against the
+suppression baseline and inline ``# cephck: ignore[rule]`` markers,
+and turning the result into an exit code the ship gate can trust.
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import json
+import pathlib
+import sys
+from typing import Iterable, Iterator
+
+#: directories never scanned: caches, VCS internals, and the fixture
+#: corpus (known-bad snippets exist to be red — scanning them would
+#: make the tree permanently red)
+SKIP_PARTS = {"__pycache__", ".git", "fixtures", ".eggs", "build"}
+
+BASELINE_NAME = ".cephck-baseline.json"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+    rule: str
+    path: str          # repo-root-relative posix path
+    line: int
+    symbol: str        # enclosing def/class qualname (or flagged name)
+    message: str
+
+    def render(self) -> str:
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{self.path}:{self.line}: {self.rule}{sym}: {self.message}"
+
+
+class FileContext:
+    """One parsed source file plus the cross-file engine options."""
+
+    def __init__(self, path: pathlib.Path, rel: str, source: str,
+                 tree: ast.Module, options: dict):
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.options = options
+        self._parents: dict[ast.AST, ast.AST] | None = None
+
+    # -- helpers shared by rules ---------------------------------------
+
+    def parents(self) -> dict[ast.AST, ast.AST]:
+        if self._parents is None:
+            self._parents = {}
+            for node in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(node):
+                    self._parents[child] = node
+        return self._parents
+
+    def qualname(self, node: ast.AST) -> str:
+        """Enclosing class/function qualname for a node (best effort)."""
+        parts: list[str] = []
+        parents = self.parents()
+        cur = parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                parts.append(cur.name)
+            cur = parents.get(cur)
+        return ".".join(reversed(parts)) or "<module>"
+
+    def imports_jax(self) -> bool:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                if any(a.name == "jax" or a.name.startswith("jax.")
+                       for a in node.names):
+                    return True
+            elif isinstance(node, ast.ImportFrom):
+                if node.module and (node.module == "jax" or
+                                    node.module.startswith("jax.")):
+                    return True
+        return False
+
+    def finding(self, rule: str, node: ast.AST, message: str,
+                symbol: str | None = None) -> Finding:
+        return Finding(rule=rule, path=self.rel,
+                       line=getattr(node, "lineno", 0),
+                       symbol=symbol if symbol is not None
+                       else self.qualname(node),
+                       message=message)
+
+    def inline_ignored(self, f: Finding) -> bool:
+        """``# cephck: ignore[rule]`` on the finding's line (or the
+        line directly above) waives it — for one-off sites where a
+        baseline entry would outlive the code it excuses."""
+        marker = f"cephck: ignore[{f.rule}]"
+        for ln in (f.line - 1, f.line - 2):
+            if 0 <= ln < len(self.lines) and marker in self.lines[ln]:
+                return True
+        return False
+
+
+def dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of a call target: ``threading.Lock``,
+    ``time.perf_counter``, ``self._loop`` — empty for dynamic funcs."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return f"{base}.{node.attr}" if base else node.attr
+    if isinstance(node, ast.Call):
+        return dotted(node.func)
+    return ""
+
+
+def repo_root(start: pathlib.Path | None = None) -> pathlib.Path:
+    """Nearest ancestor carrying pyproject.toml (falls back to cwd)."""
+    cur = (start or pathlib.Path.cwd()).resolve()
+    if cur.is_file():
+        cur = cur.parent
+    for cand in (cur, *cur.parents):
+        if (cand / "pyproject.toml").exists():
+            return cand
+    return pathlib.Path.cwd()
+
+
+def collect_files(paths: Iterable[str],
+                  root: pathlib.Path) -> list[pathlib.Path]:
+    out: list[pathlib.Path] = []
+    for p in paths:
+        pp = pathlib.Path(p)
+        if not pp.is_absolute():
+            pp = root / pp
+        if pp.is_file() and pp.suffix == ".py":
+            out.append(pp)
+        elif pp.is_dir():
+            for f in sorted(pp.rglob("*.py")):
+                if not SKIP_PARTS.intersection(f.parts):
+                    out.append(f)
+        elif not pp.exists():
+            raise FileNotFoundError(f"cephck: no such path: {p}")
+    return out
+
+
+# ------------------------------------------------------------ baseline
+
+class BaselineError(ValueError):
+    """Malformed baseline — including any entry without a reason."""
+
+
+@dataclasses.dataclass
+class Suppression:
+    rule: str
+    path: str
+    symbol: str        # "" matches any symbol
+    reason: str
+    used: int = 0
+
+    def matches(self, f: Finding) -> bool:
+        # exact repo-relative path only: a suffix match would let a
+        # root "bench.py" entry silently swallow findings from any
+        # future tests/bench.py too
+        if self.rule != f.rule or f.path != self.path:
+            return False
+        return self.symbol in ("", f.symbol)
+
+
+def load_baseline(path: pathlib.Path) -> list[Suppression]:
+    """Load and VALIDATE the baseline: every entry must name a rule,
+    a path, and a one-line human reason.  An unexplained suppression
+    is rejected outright — the baseline is the audit trail for every
+    finding the tree is allowed to keep."""
+    try:
+        data = json.loads(path.read_text())
+    except json.JSONDecodeError as ex:
+        raise BaselineError(f"{path}: invalid JSON: {ex}") from ex
+    entries = data.get("suppressions")
+    if not isinstance(entries, list):
+        raise BaselineError(f"{path}: expected a 'suppressions' list")
+    out = []
+    for i, e in enumerate(entries):
+        reason = str(e.get("reason", "")).strip()
+        rule = str(e.get("rule", "")).strip()
+        rel = str(e.get("path", "")).strip()
+        if not rule or not rel:
+            raise BaselineError(
+                f"{path}: suppression #{i} needs 'rule' and 'path'")
+        if not reason or "\n" in reason:
+            raise BaselineError(
+                f"{path}: suppression #{i} ({rule} @ {rel}) needs a "
+                "one-line 'reason' — unexplained baseline entries are "
+                "not allowed")
+        out.append(Suppression(rule=rule, path=rel,
+                               symbol=str(e.get("symbol", "")).strip(),
+                               reason=reason))
+    return out
+
+
+# -------------------------------------------------------------- engine
+
+class Engine:
+    def __init__(self, rules, root: pathlib.Path,
+                 wire_schema: pathlib.Path | None = None,
+                 suppressions: list[Suppression] | None = None):
+        self.rules = list(rules)
+        self.root = root
+        self.options = {
+            "wire_schema": wire_schema or
+            root / "tests" / "fixtures" / "wire_schema.json",
+        }
+        self.suppressions = suppressions or []
+        self.findings: list[Finding] = []
+        self.suppressed: list[tuple[Finding, Suppression]] = []
+        self.errors: list[str] = []
+        self.scanned: list[str] = []
+
+    def check_file(self, path: pathlib.Path) -> Iterator[Finding]:
+        try:
+            source = path.read_text()
+            tree = ast.parse(source, filename=str(path))
+        except (OSError, SyntaxError) as ex:
+            self.errors.append(f"{path}: {ex}")
+            return
+        try:
+            rel = path.resolve().relative_to(self.root).as_posix()
+        except ValueError:
+            rel = path.as_posix()
+        self.scanned.append(rel)
+        ctx = FileContext(path, rel, source, tree, self.options)
+        for rule in self.rules:
+            for f in rule.check(ctx):
+                if ctx.inline_ignored(f):
+                    continue
+                for s in self.suppressions:
+                    if s.matches(f):
+                        s.used += 1
+                        self.suppressed.append((f, s))
+                        break
+                else:
+                    self.findings.append(f)
+                    yield f
+
+    def run(self, paths: Iterable[str]) -> int:
+        for f in collect_files(paths, self.root):
+            for _ in self.check_file(f):
+                pass
+        return 1 if (self.findings or self.errors) else 0
+
+    def stale_suppressions(self) -> list[Suppression]:
+        """Unused entries whose path was actually scanned — a partial
+        scan (one file) must not cry stale about the rest of the
+        baseline."""
+        return [s for s in self.suppressions
+                if not s.used and s.path in self.scanned]
+
+
+# ----------------------------------------------------------------- CLI
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m ceph_tpu.analysis",
+        description="cephck: project-specific static analysis "
+                    "(exit 0 = clean, 1 = findings, 2 = bad config)")
+    ap.add_argument("paths", nargs="*",
+                    default=["ceph_tpu", "tests", "scripts", "bench.py"],
+                    help="files/dirs to scan (default: the whole tree)")
+    ap.add_argument("--baseline", default=None,
+                    help=f"suppression baseline (default: "
+                         f"<repo-root>/{BASELINE_NAME})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report everything")
+    ap.add_argument("--wire-schema", default=None,
+                    help="wire schema lockfile (default: "
+                         "tests/fixtures/wire_schema.json)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print every rule id + one-line summary")
+    ap.add_argument("--explain", metavar="RULE",
+                    help="print a rule's full doc (how to read and "
+                         "fix its findings)")
+    args = ap.parse_args(argv)
+
+    from .rules import ALL_RULES
+    rules = [cls() for cls in ALL_RULES]
+
+    if args.list_rules:
+        for r in rules:
+            first = (r.doc or "").strip().splitlines()[0]
+            print(f"{r.id:22s} {first}")
+        return 0
+    if args.explain:
+        for r in rules:
+            if r.id == args.explain:
+                print(f"{r.id}\n{'=' * len(r.id)}\n{r.doc.strip()}")
+                return 0
+        print(f"cephck: unknown rule {args.explain!r}", file=sys.stderr)
+        return 2
+
+    root = repo_root()
+    suppressions: list[Suppression] = []
+    if not args.no_baseline:
+        bpath = pathlib.Path(args.baseline) if args.baseline \
+            else root / BASELINE_NAME
+        if bpath.exists():
+            try:
+                suppressions = load_baseline(bpath)
+            except BaselineError as ex:
+                print(f"cephck: {ex}", file=sys.stderr)
+                return 2
+        elif args.baseline:
+            print(f"cephck: baseline not found: {bpath}", file=sys.stderr)
+            return 2
+
+    wire = pathlib.Path(args.wire_schema) if args.wire_schema else None
+    eng = Engine(rules, root, wire_schema=wire, suppressions=suppressions)
+    try:
+        rc = eng.run(args.paths)
+    except FileNotFoundError as ex:
+        print(ex, file=sys.stderr)
+        return 2
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [dataclasses.asdict(f) for f in eng.findings],
+            "suppressed": len(eng.suppressed),
+            "errors": eng.errors,
+        }, indent=1))
+    else:
+        for f in eng.findings:
+            print(f.render())
+        for e in eng.errors:
+            print(f"cephck: parse error: {e}", file=sys.stderr)
+        for s in eng.stale_suppressions():
+            print(f"cephck: warning: stale suppression "
+                  f"({s.rule} @ {s.path}) — remove it from the "
+                  f"baseline", file=sys.stderr)
+        n = len(eng.findings)
+        print(f"cephck: {n} finding(s), {len(eng.suppressed)} "
+              f"suppressed by baseline"
+              + (f", {len(eng.errors)} parse error(s)"
+                 if eng.errors else ""))
+    return rc

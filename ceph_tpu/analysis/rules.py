@@ -1,0 +1,628 @@
+"""cephck rules — each one encodes a bug class this repo has shipped
+(or a hazard the reference gates on).  A rule is deliberately small:
+``id``, a ``doc`` a finder can read, and ``check(ctx)`` yielding
+findings over one parsed file.  Every rule has at least one red and
+one green fixture under tests/fixtures/cephck/ and a test asserting
+both (tests/test_cephck.py) — a rule that can't demonstrate its bug
+is deleted, not kept.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import re
+from typing import Iterator
+
+from .engine import FileContext, Finding, dotted
+
+# --------------------------------------------------------------- No. 1
+
+
+class RawLockRule:
+    id = "raw-lock"
+    doc = """
+Raw threading.Lock/RLock/Condition construction outside
+common/lockdep.py.
+
+Locks must come from ceph_tpu.common.lockdep.make_lock(name): under
+the `lockdep` option (ON for every tier-1 run via tests/conftest.py)
+make_lock returns an order-checked DebugLock, so the lock-order cycle
+detector (ref: src/common/lockdep.cc) sees every acquisition.  A raw
+threading primitive is invisible to it — a deadlock through that lock
+is only found by the unlucky interleaving that actually hangs.
+
+Fix: `from ceph_tpu.common.lockdep import make_lock` and construct
+`make_lock("<subsystem>.<role>")` (name it uniquely enough that a
+reported cycle identifies the site).  Note make_lock is reentrant
+(RLock semantics) — do not rely on self-blocking.
+"""
+    FACTORIES = {"Lock", "RLock", "Condition"}
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if ctx.rel.endswith("common/lockdep.py"):
+            return
+        from_imports = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom) and \
+                    node.module == "threading":
+                for a in node.names:
+                    if a.name in self.FACTORIES:
+                        from_imports.add(a.asname or a.name)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            hit = name.startswith("threading.") and \
+                name.split(".", 1)[1] in self.FACTORIES or \
+                name in from_imports
+            if hit:
+                yield ctx.finding(
+                    self.id, node,
+                    f"raw {name}() — use "
+                    f"common.lockdep.make_lock(name) so the lock-order "
+                    f"sanitizer sees this lock")
+
+
+# --------------------------------------------------------------- No. 2
+
+def _versions_literal(tree: ast.Module) -> dict[str, tuple[int, int]]:
+    """Module-level ``_VERSIONS = {"Name": (v, compat), ...}``."""
+    out: dict[str, tuple[int, int]] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and \
+                any(isinstance(t, ast.Name) and t.id == "_VERSIONS"
+                    for t in node.targets) and \
+                isinstance(node.value, ast.Dict):
+            for k, v in zip(node.value.keys, node.value.values):
+                if isinstance(k, ast.Constant) and \
+                        isinstance(v, ast.Tuple) and len(v.elts) == 2 and \
+                        all(isinstance(e, ast.Constant) for e in v.elts):
+                    out[str(k.value)] = (v.elts[0].value, v.elts[1].value)
+    return out
+
+
+def _message_classes(tree: ast.Module) -> list[ast.ClassDef]:
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and any(
+                dotted(b).split(".")[-1] == "Message"
+                for b in node.bases):
+            out.append(node)
+    return out
+
+
+def _is_dataclass(node: ast.ClassDef) -> bool:
+    for d in node.decorator_list:
+        if dotted(d).split(".")[-1] == "dataclass":
+            return True
+    return False
+
+
+def _norm_type(s: str | None) -> str:
+    return re.sub(r"\s+", "", s or "")
+
+
+class WireSchemaRule:
+    id = "wire-drift"
+    doc = """
+Wire struct drifted from the committed schema lockfile
+(tests/fixtures/wire_schema.json).
+
+The encode contract is ENCODE_START's (ref: src/include/encoding.h):
+field lists are APPEND-ONLY.  Reordering, removing, renaming, or
+retyping a field changes the positional encoding silently — an old
+decoder reads the wrong field into the wrong slot, which is exactly
+the PR 1 mon fork (an encode diverged from its registered version).
+Appending a field is legal ONLY with a `version` bump in _VERSIONS
+(or the wire_struct/register_struct call).  `compat > version` is a
+contradiction — no decoder could ever accept the struct — and is
+rejected here before it can reject every peer at runtime.
+
+Fix: restore the committed field prefix; append new fields at the
+end and bump the version.  For an INTENTIONAL evolution, bump the
+version and regenerate the lockfile:
+`python scripts/gen_wire_schema.py` (then commit the diff).
+"""
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        classes = [c for c in _message_classes(ctx.tree)
+                   if _is_dataclass(c)]
+        if not classes:
+            return
+        schema_path = ctx.options["wire_schema"]
+        try:
+            lock = json.loads(schema_path.read_text())
+        except FileNotFoundError:
+            yield ctx.finding(
+                self.id, ctx.tree,
+                f"wire schema lockfile missing ({schema_path}) — "
+                f"run: python scripts/gen_wire_schema.py", symbol="")
+            return
+        except json.JSONDecodeError as ex:
+            yield ctx.finding(
+                self.id, ctx.tree,
+                f"wire schema lockfile unreadable: {ex}", symbol="")
+            return
+        versions = _versions_literal(ctx.tree)
+        structs = lock.get("structs", {})
+        for cls in classes:
+            v, compat = versions.get(cls.name, (1, 1))
+            if compat > v:
+                yield ctx.finding(
+                    self.id, cls,
+                    f"{cls.name}: compat {compat} > version {v} — no "
+                    f"decoder could ever accept this struct",
+                    symbol=cls.name)
+                continue
+            fields = [(n.target.id, _norm_type(ast.unparse(n.annotation)))
+                      for n in cls.body
+                      if isinstance(n, ast.AnnAssign) and
+                      isinstance(n.target, ast.Name)]
+            pinned = structs.get(cls.name)
+            if pinned is not None:
+                # a redeclared base field (e.g. MClientCaps.seq) keeps
+                # the BASE's wire position, not its class-body one —
+                # compare declared-only fields on both sides
+                inherited = {f["name"] for f in pinned["fields"] or ()
+                             if f.get("inherited")}
+                fields = [f for f in fields if f[0] not in inherited]
+            if pinned is None:
+                yield ctx.finding(
+                    self.id, cls,
+                    f"{cls.name}: not in the wire schema lockfile — "
+                    f"regenerate it (python scripts/gen_wire_schema.py) "
+                    f"to pin the new struct", symbol=cls.name)
+                continue
+            # inherited (Message-base) fields encode first but are not
+            # declared in the class body the AST sees — the runtime
+            # check (tests/test_wire_schema.py) pins those
+            want = [(f["name"], _norm_type(f.get("type")))
+                    for f in pinned["fields"] or ()
+                    if not f.get("inherited")]
+            bad = None
+            for i, (wn, wt) in enumerate(want):
+                if i >= len(fields):
+                    bad = (f"field {wn!r} removed (committed at "
+                           f"position {i}) — wire field lists are "
+                           f"append-only")
+                    break
+                gn, gt = fields[i]
+                if gn != wn:
+                    bad = (f"field {i} is {gn!r} but the lockfile pins "
+                           f"{wn!r} — reorder/rename breaks positional "
+                           f"decode")
+                    break
+                if wt and gt and gt != wt:
+                    bad = (f"field {gn!r} retyped {wt!r} -> {gt!r} — "
+                           f"old decoders read the old type")
+                    break
+            if bad:
+                yield ctx.finding(self.id, cls, f"{cls.name}: {bad}",
+                                  symbol=cls.name)
+                continue
+            if len(fields) > len(want) and v <= int(pinned["version"]):
+                extra = [n for n, _t in fields[len(want):]]
+                yield ctx.finding(
+                    self.id, cls,
+                    f"{cls.name}: field(s) {extra} appended without a "
+                    f"version bump (still v{v}) — old decoders can't "
+                    f"tell the tail is there; bump _VERSIONS and "
+                    f"regenerate the lockfile", symbol=cls.name)
+
+
+# --------------------------------------------------------------- No. 3
+
+
+class UnregisteredMessageRule:
+    id = "unregistered-message"
+    doc = """
+Message subclass that _register_all() will never wire-register.
+
+msg/messages.py registers every module-level *dataclass* Message
+subclass automatically.  A Message subclass that is not a dataclass
+compiles, type-checks, and then raises WireError("not
+wire-registered") the first time it crosses a TCP messenger — or
+worse, never does in tests (the in-process transport skips
+serialization) and only fails in a real deployment.
+
+Fix: decorate the class with @dataclass (fields become the wire
+field list), or register it explicitly via register_struct with
+to_fields/from_fields.
+"""
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for cls in _message_classes(ctx.tree):
+            if not _is_dataclass(cls):
+                yield ctx.finding(
+                    self.id, cls,
+                    f"{cls.name}(Message) is not a dataclass — "
+                    f"_register_all() skips it, so it is NOT "
+                    f"wire-registered and dies with WireError on the "
+                    f"first real (TCP) send", symbol=cls.name)
+
+
+# --------------------------------------------------------------- No. 4
+
+#: Transaction mutators that touch object omaps — the pgmeta bug class
+OMAP_MUTATORS = {"omap_setkeys", "omap_rmkeys", "omap_clear"}
+
+#: receiver names that clearly ARE a transaction
+_TXNISH = re.compile(r"^(txn?\d*|tx\d*|transaction|.*_txn)$")
+
+
+class TxnAtomicityRule:
+    id = "txn-atomicity"
+    doc = """
+omap mutation in osd/ outside a Transaction context.
+
+PR 2's persist_log bug: an omap mutation issued outside the owning
+store Transaction wiped non-log pgmeta keys (the snap index and
+purged_snaps cursor) on every peering merge — state that must move
+atomically with the data didn't.  In osd/ code, omap_setkeys /
+omap_rmkeys / omap_clear must be invoked on a Transaction (named
+txn/t/tx/*_txn, or constructed from Transaction() in the same
+function) that the caller applies as ONE unit with the rest of the
+update.
+
+Fix: thread the owning Transaction into the helper and append the
+omap ops to IT; never apply a private side-transaction for state
+that must be atomic with the caller's.
+"""
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if "osd" not in ctx.rel.split("/"):
+            return
+        # names bound from Transaction() per enclosing function
+        txn_bound: dict[ast.AST, set[str]] = {}
+        parents = ctx.parents()
+
+        def scope_of(node: ast.AST) -> ast.AST:
+            cur = parents.get(node)
+            while cur is not None and not isinstance(
+                    cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Module)):
+                cur = parents.get(cur)
+            return cur or ctx.tree
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    dotted(node.value.func).split(".")[-1] == "Transaction":
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        txn_bound.setdefault(scope_of(node),
+                                             set()).add(t.id)
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and
+                    isinstance(node.func, ast.Attribute) and
+                    node.func.attr in OMAP_MUTATORS):
+                continue
+            recv = node.func.value
+            # chained builder calls: txn.touch(...).omap_setkeys(...)
+            while isinstance(recv, ast.Call) and \
+                    isinstance(recv.func, ast.Attribute):
+                recv = recv.func.value
+            name = dotted(recv).split(".")[-1]
+            if _TXNISH.match(name):
+                continue
+            if isinstance(recv, ast.Call) and \
+                    dotted(recv.func).split(".")[-1] == "Transaction":
+                continue
+            if name in txn_bound.get(scope_of(node), ()):
+                continue
+            yield ctx.finding(
+                self.id, node,
+                f".{node.func.attr}() on {dotted(recv) or '<expr>'!r} — "
+                f"omap state in osd/ must mutate through the owning "
+                f"Transaction (persist_log bug class: non-atomic pgmeta "
+                f"updates)")
+
+
+# --------------------------------------------------------------- No. 5
+
+_LOGGISH = re.compile(
+    r"(dout|derr|print|log|warn|error|exception|fail|append|traceback|"
+    r"put_nowait|set_exception)", re.I)
+
+
+class SilentThreadRule:
+    id = "silent-thread"
+    doc = """
+threading.Thread target that can swallow its own death.
+
+A daemon thread whose body catches Exception (or everything) and
+neither logs nor re-raises dies silently: the heartbeat keeps
+beating, the queue keeps growing, and the first observable symptom
+is a wedged cluster minutes later.  (Python threads don't propagate
+exceptions to their parent — the except handler is the ONLY place
+the failure can surface.)
+
+Fix: in the handler, log through dout/derr (common.log) or collect
+the error somewhere a supervisor checks — or narrow the except to
+the exceptions the loop genuinely expects.
+"""
+    BROAD = {None, "Exception", "BaseException"}
+
+    def _resolve(self, ctx: FileContext,
+                 target: ast.AST) -> ast.FunctionDef | None:
+        if isinstance(target, ast.Name):
+            want, in_class = target.id, False
+        elif isinstance(target, ast.Attribute) and \
+                isinstance(target.value, ast.Name) and \
+                target.value.id == "self":
+            want, in_class = target.attr, True
+        else:
+            return None
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name == want:
+                parent = ctx.parents().get(node)
+                if in_class == isinstance(parent, ast.ClassDef):
+                    return node
+        return None
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        seen: set[ast.AST] = set()
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and
+                    dotted(node.func).split(".")[-1] == "Thread"):
+                continue
+            target = next((kw.value for kw in node.keywords
+                           if kw.arg == "target"), None)
+            if target is None:
+                continue
+            fn = self._resolve(ctx, target)
+            if fn is None or fn in seen:
+                continue
+            seen.add(fn)
+            for h in ast.walk(fn):
+                if not isinstance(h, ast.ExceptHandler):
+                    continue
+                tname = None if h.type is None \
+                    else dotted(h.type).split(".")[-1]
+                if tname not in self.BROAD:
+                    continue
+                ok = any(isinstance(n, ast.Raise)
+                         for n in ast.walk(h)) or any(
+                    isinstance(n, ast.Call) and
+                    _LOGGISH.search(dotted(n.func))
+                    for n in ast.walk(h))
+                if not ok:
+                    yield ctx.finding(
+                        self.id, h,
+                        f"thread target {fn.name}() swallows "
+                        f"{'everything' if tname is None else tname} "
+                        f"without logging or re-raising — the thread "
+                        f"dies silently", symbol=fn.name)
+
+
+# --------------------------------------------------------------- No. 6
+
+#: calls that are legitimate inside a timed region without a sync
+_TIMING_EXEMPT = re.compile(
+    r"(perf_counter|monotonic|time|sleep|ns)$")
+
+
+class JaxTimingRule:
+    id = "jax-timing"
+    doc = """
+time.perf_counter() pair whose timed region can return before the
+device work does.
+
+JAX dispatch is asynchronous: a call that produces a jax.Array
+returns as soon as the work is ENQUEUED.  Stopping the clock without
+jax.block_until_ready() therefore measures dispatch, not compute —
+the exact failure mode called out for the EC hot paths in
+"Accelerating XOR-based Erasure Coding..." (arxiv 2108.02692), where
+mis-timed async dispatch invalidates the perf claim.  float()/
+np.asarray() conversions do force a sync of the converted value, but
+only that value — and they smuggle a device->host copy into the
+timed region; block_until_ready is the only honest stop-the-clock.
+
+The rule fires in jax-importing files when a perf_counter region
+contains a call but no block_until_ready before the closing
+perf_counter read.
+
+Fix: `jax.block_until_ready(result)` (or result.block_until_ready())
+as the LAST statement inside the timed region.  Host-only timed
+regions (pure numpy/ctypes) in jax-importing files are false
+positives: suppress them in .cephck-baseline.json with a reason.
+"""
+
+    def _is_perf_start(self, stmt: ast.stmt) -> str | None:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name) and \
+                isinstance(stmt.value, ast.Call) and \
+                dotted(stmt.value.func).endswith("perf_counter"):
+            return stmt.targets[0].id
+        return None
+
+    def _has_perf_call(self, stmt: ast.stmt) -> bool:
+        return any(isinstance(n, ast.Call) and
+                   dotted(n.func).endswith("perf_counter")
+                   for n in ast.walk(stmt))
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not ctx.imports_jax():
+            return
+        for block in ast.walk(ctx.tree):
+            for body in (getattr(block, "body", None),
+                         getattr(block, "orelse", None),
+                         getattr(block, "finalbody", None)):
+                if not isinstance(body, list):
+                    continue
+                yield from self._check_block(ctx, body)
+
+    def _check_block(self, ctx: FileContext,
+                     body: list[ast.stmt]) -> Iterator[Finding]:
+        i = 0
+        while i < len(body):
+            var = self._is_perf_start(body[i])
+            if var is None:
+                i += 1
+                continue
+            start_line = body[i].lineno
+            j = i + 1
+            while j < len(body) and not self._has_perf_call(body[j]):
+                j += 1
+            region = body[i + 1:j]
+            i = j
+            if not region:
+                continue
+            synced = any(isinstance(n, ast.Call) and
+                         dotted(n.func).endswith("block_until_ready")
+                         for stmt in region for n in ast.walk(stmt))
+            if synced:
+                continue
+            offender = next(
+                (n for stmt in region for n in ast.walk(stmt)
+                 if isinstance(n, ast.Call) and
+                 not _TIMING_EXEMPT.search(dotted(n.func) or "x")),
+                None)
+            if offender is not None:
+                yield ctx.finding(
+                    self.id, offender,
+                    f"timed region (clock started at line "
+                    f"{start_line}) calls "
+                    f"{dotted(offender.func) or '<dynamic>'}() with no "
+                    f"block_until_ready before the clock stops — this "
+                    f"times the DISPATCH, not the compute")
+
+
+# --------------------------------------------------------------- No. 7
+
+_UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+               ast.SetComp, ast.GeneratorExp)
+
+
+def _jit_statics(call: ast.Call) -> tuple[set[int], set[str]] | None:
+    """(static positions, static names) if `call` is jax.jit/jit with
+    static args declared, else None."""
+    if dotted(call.func).split(".")[-1] != "jit":
+        return None
+    nums: set[int] = set()
+    names: set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            vals = kw.value.elts if isinstance(
+                kw.value, (ast.Tuple, ast.List)) else [kw.value]
+            for v in vals:
+                if isinstance(v, ast.Constant) and \
+                        isinstance(v.value, int):
+                    nums.add(v.value)
+        elif kw.arg == "static_argnames":
+            vals = kw.value.elts if isinstance(
+                kw.value, (ast.Tuple, ast.List)) else [kw.value]
+            for v in vals:
+                if isinstance(v, ast.Constant) and \
+                        isinstance(v.value, str):
+                    names.add(v.value)
+    if not nums and not names:
+        return None
+    return nums, names
+
+
+class JitStaticRule:
+    id = "jit-static"
+    doc = """
+Unhashable Python container passed as a jax.jit static argument.
+
+static_argnums/static_argnames values are jit CACHE KEYS: jax hashes
+them to find the compiled executable.  A list/dict/set there raises
+"Non-hashable static arguments" at the first call — or, when the
+call site is only reached on a rare path (error handling, failover),
+at 3am.  Tuples are hashable but a FRESH tuple of varying contents
+recompiles on every distinct value, silently turning the jit cache
+into a compile-per-call.
+
+Fix: pass tuples (stable contents) for static args, or move the
+container into the traced arguments.
+"""
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        # jitted symbols declared in this module, with their statics
+        registry: dict[str, tuple[set[int], set[str]]] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                st = _jit_statics(node.value)
+                if st:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            registry[t.id] = st
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                for d in node.decorator_list:
+                    if isinstance(d, ast.Call):
+                        inner = next(
+                            (a for a in d.args
+                             if isinstance(a, (ast.Name, ast.Attribute))
+                             and dotted(a).split(".")[-1] == "jit"),
+                            None)
+                        if dotted(d.func).split(".")[-1] == "partial" \
+                                and inner is not None:
+                            st = _jit_statics(d)
+                            if st:
+                                registry[node.name] = st
+
+        def flag_call(call: ast.Call, nums: set[int],
+                      names: set[str]) -> Iterator[Finding]:
+            for pos, a in enumerate(call.args):
+                if pos in nums and isinstance(a, _UNHASHABLE):
+                    yield ctx.finding(
+                        self.id, a,
+                        f"unhashable {type(a).__name__.lower()} passed "
+                        f"as static arg {pos} of a jitted function — "
+                        f"static args are jit cache keys and must hash")
+            for kw in call.keywords:
+                if kw.arg in names and isinstance(kw.value, _UNHASHABLE):
+                    yield ctx.finding(
+                        self.id, kw.value,
+                        f"unhashable {type(kw.value).__name__.lower()} "
+                        f"passed as static arg {kw.arg!r} of a jitted "
+                        f"function — static args are jit cache keys "
+                        f"and must hash")
+
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Name) and \
+                    node.func.id in registry:
+                yield from flag_call(node, *registry[node.func.id])
+            elif isinstance(node.func, ast.Call):
+                st = _jit_statics(node.func)
+                if st:
+                    yield from flag_call(node, *st)
+
+
+# --------------------------------------------------------------- No. 8
+
+
+class BareExceptRule:
+    id = "bare-except"
+    doc = """
+Bare `except:` clause.
+
+Bare except catches SystemExit, KeyboardInterrupt, and MemoryError —
+a daemon loop with one becomes unkillable and hides OOM.  The
+reference's C++ has no equivalent hazard; in this Python tree it is
+banned outright.
+
+Fix: catch Exception (plus logging — see silent-thread) or the
+specific exceptions the call can raise; re-raise what you can't
+handle.
+"""
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ExceptHandler) and node.type is None:
+                yield ctx.finding(
+                    self.id, node,
+                    "bare `except:` also catches SystemExit/"
+                    "KeyboardInterrupt — name the exceptions (at "
+                    "minimum `except Exception`)")
+
+
+ALL_RULES = [RawLockRule, WireSchemaRule, UnregisteredMessageRule,
+             TxnAtomicityRule, SilentThreadRule, JaxTimingRule,
+             JitStaticRule, BareExceptRule]

@@ -37,6 +37,7 @@ import os
 import time
 
 from ..common.log import dout
+from ..common.lockdep import make_lock
 from ..msg.messages import MAuthReply, MAuthRequest
 
 SERVICE_ENTITY = "service"           # the shared service-secret slot
@@ -199,7 +200,6 @@ class CephxClient:
 
     def __init__(self, entity: str, secret: str):
         import itertools
-        import threading
         self.entity = entity
         self.secret = secret
         self.nonce = os.urandom(8).hex()
@@ -209,7 +209,7 @@ class CephxClient:
         #: guards the (session_key, ticket) pair: renewal replies land
         #: while other threads sign, and a MAC under the new key paired
         #: with the old ticket would be dropped by every verifier
-        self._lock = threading.Lock()
+        self._lock = make_lock(f"auth.cephx.{entity}")
         #: monotonic signing sequence — receivers use it for replay
         #: freshness (itertools.count is atomic under the GIL)
         self._seq = itertools.count(1)
@@ -322,8 +322,7 @@ class CephxVerifier:
 
     def __init__(self, service_secret: str):
         self.service_secret = service_secret
-        import threading
-        self._lock = threading.Lock()
+        self._lock = make_lock("auth.cephx_verifier")
         #: (entity, ticket_tag) -> (max_seq, seen-set) replay state;
         #: keyed per session so a restarted entity gets a fresh window
         self._sessions: "dict[tuple, tuple[int, set]]" = {}

@@ -12,6 +12,8 @@ from __future__ import annotations
 
 import itertools
 import threading
+
+from ..common.lockdep import make_lock
 from typing import Optional
 
 from ..common.log import dout
@@ -92,7 +94,7 @@ class Objecter(Dispatcher, MonHunter):
         self._init_mons(mon)
         self.osdmap = OSDMap()
         self._map_ev = threading.Event()
-        self._lock = threading.RLock()
+        self._lock = make_lock(f"objecter.{self.name}")
         self._tid = itertools.count(1)
         self.in_flight: dict[int, _Op] = {}
         self.homeless: list[_Op] = []

@@ -20,6 +20,8 @@ import subprocess
 import sys
 import threading
 
+from .lockdep import make_lock
+
 import numpy as np
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
@@ -28,7 +30,7 @@ _SRC = os.path.join(_REPO_ROOT, "native", "crc32c.c")
 _LIB_DIR = os.path.join(_REPO_ROOT, "ceph_tpu", "_native")
 _LIB = os.path.join(_LIB_DIR, "libceph_tpu_native.so")
 
-_lock = threading.Lock()
+_lock = make_lock("crc32c.native")
 _native = None
 _native_tried = False
 

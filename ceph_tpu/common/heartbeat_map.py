@@ -10,6 +10,8 @@ timeouts.
 from __future__ import annotations
 
 import threading
+
+from .lockdep import make_lock
 import time
 from dataclasses import dataclass, field
 
@@ -34,7 +36,7 @@ class SuicideTimeout(RuntimeError):
 class HeartbeatMap:
     def __init__(self, clock=time.monotonic):
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = make_lock("heartbeat_map")
         self._workers: list[HeartbeatHandle] = []
 
     def add_worker(self, name: str, grace: float,

@@ -13,7 +13,6 @@ from __future__ import annotations
 
 import threading
 
-from .log import dout
 from .options import global_config
 
 #: global follows-graph: edge a -> b means "a was held while b was
@@ -66,6 +65,12 @@ class DebugLock:
         stack = _held()
         if self.name not in [n for n, _c in stack]:
             for held_name, _cnt in stack:
+                # fast path: the edge was recorded (and cycle-checked)
+                # by an earlier acquisition — a GIL-atomic read keeps
+                # steady-state nesting off the global graph lock
+                bucket = _graph.get(held_name)
+                if bucket is not None and self.name in bucket:
+                    continue
                 with _graph_lock:
                     if self.name in _graph and \
                             _reaches(self.name, held_name):

@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import logging
 import sys
-import threading
 
 _default_level = 1
 
@@ -19,7 +18,6 @@ _default_level = 1
 #: (ref: subsys.h per-subsystem table; unset subsystems use the
 #: default, which the `log_level` config option drives)
 _levels: dict[str, int] = {}
-_lock = threading.Lock()
 _loggers: dict[str, logging.Logger] = {}
 
 class _StderrHandler(logging.StreamHandler):
@@ -51,6 +49,14 @@ def set_default_level(level: int) -> None:
     driven by the `log_level` config option."""
     global _default_level
     _default_level = level
+
+
+# imported (and _lock constructed) AFTER set_default_level exists:
+# make_lock -> global_config() re-enters this half-initialized module
+# for exactly that symbol while resolving the `log_level` observer
+from .lockdep import make_lock  # noqa: E402
+
+_lock = make_lock("log.registry")
 
 
 def _logger(subsys: str) -> logging.Logger:

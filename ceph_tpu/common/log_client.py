@@ -6,6 +6,8 @@ survive mon failover).
 from __future__ import annotations
 
 import threading
+
+from .lockdep import make_lock
 import time
 from typing import Callable
 
@@ -21,7 +23,7 @@ class LogClient:
     def __init__(self, name: str, send_fn: Callable):
         self.name = name
         self._send = send_fn
-        self._lock = threading.Lock()
+        self._lock = make_lock(f"log_client.{name}")
         self._seq = 0
         self._buf: list[dict] = []      # un-acked, ascending seq
 

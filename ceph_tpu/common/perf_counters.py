@@ -11,6 +11,8 @@ from __future__ import annotations
 
 import json
 import threading
+
+from .lockdep import make_lock
 import time
 from dataclasses import dataclass, field
 
@@ -41,7 +43,7 @@ class PerfCounters:
     def __init__(self, name: str):
         self.name = name
         self._c: dict[str, _Counter] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock(f"perf.{name}")
 
     # -- builder surface (ref: perf_counters.h PerfCountersBuilder) --
     def add_u64_counter(self, key: str, desc: str = "") -> None:
@@ -146,7 +148,7 @@ class PerfCountersCollection:
 
     def __init__(self):
         self._loggers: dict[str, PerfCounters] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("perf.collection")
 
     def create(self, name: str) -> PerfCounters:
         with self._lock:

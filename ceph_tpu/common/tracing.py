@@ -17,6 +17,8 @@ from __future__ import annotations
 
 import os
 import threading
+
+from .lockdep import make_lock
 import time
 from collections import deque
 
@@ -71,7 +73,7 @@ class Tracer:
 
     def __init__(self, service: str = "", keep: int = 256):
         self.service = service
-        self._lock = threading.Lock()
+        self._lock = make_lock("tracer")
         self._done: deque[Span] = deque(maxlen=keep)
 
     def start_span(self, ctx: dict | None, name: str) -> Span | None:

@@ -8,6 +8,8 @@ osd_op_complaint_time.)
 from __future__ import annotations
 
 import threading
+
+from .lockdep import make_lock
 import time
 from collections import deque
 
@@ -41,7 +43,7 @@ class OpTracker:
 
     def __init__(self, history_size: int = 20,
                  complaint_time: float = 30.0):
-        self._lock = threading.Lock()
+        self._lock = make_lock("optracker")
         self._inflight: dict[object, TrackedOp] = {}
         self._historic: deque[TrackedOp] = deque(maxlen=history_size)
         self.complaint_time = complaint_time

@@ -29,6 +29,8 @@ from __future__ import annotations
 
 import threading
 
+from ..common.lockdep import make_lock
+
 import numpy as np
 
 from .mesh_ec import MeshECCoder, make_mesh
@@ -49,7 +51,7 @@ class ICIFabric:
     def __init__(self, n_devices: int | None = None):
         self.n_devices = n_devices
         self.resident: set[int] = set()
-        self._lock = threading.Lock()
+        self._lock = make_lock("dist.fabric")
         self._coders: dict = {}       # (k, m, matrix bytes) -> coder
         self._meshes: dict = {}       # shard_ways-compat k -> mesh
         self._staged: dict = {}       # fabric_key -> staging record

@@ -10,6 +10,8 @@ from __future__ import annotations
 
 import importlib
 import threading
+
+from ..common.lockdep import make_lock
 from typing import Callable
 
 from .interface import ErasureCodeInterface, ErasureCodeProfile, ErasureCodeError
@@ -31,10 +33,10 @@ class ErasureCodePlugin:
 
 class ErasureCodePluginRegistry:
     _instance: "ErasureCodePluginRegistry | None" = None
-    _instance_lock = threading.Lock()
+    _instance_lock = make_lock("ec.registry.instance")
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = make_lock("ec.registry")
         self._plugins: dict[str, ErasureCodePlugin] = {}
         self._lazy: dict[str, tuple[str, str]] = {}  # name -> (module, attr)
         self.disable_dlclose = False  # parity flag; no-op in Python

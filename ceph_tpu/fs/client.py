@@ -16,6 +16,8 @@ from __future__ import annotations
 
 import itertools
 import threading
+
+from ..common.lockdep import make_lock
 import time as _time
 
 from ..client import RadosError
@@ -85,7 +87,7 @@ class _MDSSession(Dispatcher):
         # without letting one wedged revoke (30s MDS call timeout)
         # head-of-line-block every other file's snapc delivery.
         self._capqs: dict[int, list] = {}
-        self._capq_lock = threading.Lock()
+        self._capq_lock = make_lock("fs.client.capq")
         self.ms.add_dispatcher(self)
 
     def _cap_drain(self, ino: int) -> None:
@@ -243,7 +245,7 @@ class FileHandle:
         self._dirty_size = False
         self._rcache: dict[tuple[int, int], bytes] = {}
         self._snapc_seq = -1
-        self._snapc_lock = threading.Lock()
+        self._snapc_lock = make_lock("fs.fh.snapc")
         self._io = fs.rados.open_ioctx(rec["pool"])
         # write-back object cache (ref: ObjectCacher mounted by
         # Client.cc; the caps ARE its coherence protocol: CAP_EXCL
@@ -460,7 +462,7 @@ class CephFS:
         self._caches: dict[int, tuple] = {}
         #: per-inode authoritative (highest-seq) snap context
         self._ino_snapc: dict[int, dict] = {}
-        self._hlock = threading.Lock()
+        self._hlock = make_lock("fs.client.handles")
         #: last gid seen ACTIVE per rank — a gid change on an active
         #: rank means a failover happened and our caps died with the
         #: old daemon's session state

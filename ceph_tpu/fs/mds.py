@@ -56,6 +56,8 @@ import itertools
 import json
 import os
 import threading
+
+from ..common.lockdep import make_lock
 import time
 import zlib
 
@@ -252,7 +254,7 @@ class MDSDaemon(Dispatcher):
         # re-executes; ref: Session::completed_requests)
         self._completed: dict[str, dict[str, object]] = {}
         self._ino_base = rank << INO_RANK_SHIFT
-        self._lock = threading.RLock()
+        self._lock = make_lock(f"mds.{rank}")
         self._seq = 0
         self._next_ino = self._ino_base + ROOT_INO + 1
         self._ops_since_apply = 0
@@ -360,8 +362,9 @@ class MDSDaemon(Dispatcher):
         if self._subtree_watch is not None:
             try:
                 self.meta.unwatch(SUBTREE_OBJ, self._subtree_watch)
-            except Exception:
-                pass
+            except Exception as ex:
+                dout("mds", 10).write(
+                    "kill: unwatch failed (already dead): %s", ex)
             self._subtree_watch = None
         self.ms.shutdown()
 
@@ -1974,8 +1977,10 @@ class MDSStandby(Dispatcher):
                 if d is not None:
                     try:
                         d.kill()
-                    except Exception:      # noqa: BLE001
-                        pass
+                    except Exception as ex:   # noqa: BLE001
+                        dout("mds", 5).write(
+                            "promote: teardown of half-booted rank "
+                            "daemon failed: %s", ex)
                 if time.monotonic() >= deadline:
                     self._promoting = False
                     raise

@@ -19,6 +19,8 @@ import abc
 import os
 import struct
 import threading
+
+from ..common.lockdep import make_lock
 from typing import Any, Iterator
 
 from ..common.crc32c import crc32c
@@ -89,7 +91,7 @@ class MemDB(KeyValueDB):
 
     def __init__(self):
         self._data: dict[tuple[str, str], Any] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("kv.memdb")
 
     def submit_transaction(self, txn: KVTransaction) -> None:
         with self._lock:
@@ -141,7 +143,7 @@ class LogDB(KeyValueDB):
     def __init__(self, path: str, compact_bytes: int = 8 << 20):
         self.path = path
         self.compact_bytes = compact_bytes
-        self._lock = threading.Lock()
+        self._lock = make_lock(f"kv.logdb.{path}")
         self._data: dict[tuple[str, str], Any] = {}
         # persisted values may contain any registered wire struct; the
         # replay must not depend on the caller's import order

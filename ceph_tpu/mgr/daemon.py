@@ -15,6 +15,8 @@ from __future__ import annotations
 import itertools
 import threading
 
+from ..common.lockdep import make_lock
+
 from ..common.log import dout
 from ..common.options import global_config
 from ..msg.messages import (MMap, MMonCommand, MMonCommandAck,
@@ -52,7 +54,7 @@ class MgrDaemon(Dispatcher, MonHunter):
         #: devicehealth module (ref: pybind/mgr/devicehealth); enable
         #: with start_devicehealth(), driven by devicehealth_tick
         self.devicehealth = None
-        self._lock = threading.RLock()
+        self._lock = make_lock(f"mgr.{self.name}")
         self.ms = Messenger.create(network, self.name, threaded=threaded)
         self.ms.add_dispatcher(self)
 

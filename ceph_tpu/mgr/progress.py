@@ -12,6 +12,8 @@ from __future__ import annotations
 
 import itertools
 import threading
+
+from ..common.lockdep import make_lock
 import time
 
 #: states that constitute a long-running data-movement operation
@@ -35,7 +37,7 @@ class ProgressModule:
         self.events: dict[tuple, dict] = {}
         self.completed: list[dict] = []
         #: the prometheus scrape thread reads while the mgr ticks
-        self._lock = threading.Lock()
+        self._lock = make_lock("mgr.progress")
 
     # ------------------------------------------------------------ tick
     def tick(self) -> int:

@@ -23,6 +23,8 @@ the reference's paxos plug.
 from __future__ import annotations
 
 import threading
+
+from ..common.lockdep import make_lock
 import time
 from collections import deque
 
@@ -111,7 +113,7 @@ class Monitor(Dispatcher):
         # cluster statistics digest (ref: src/mon/PGMap.h)
         self.pgmap = PGMap()
         self._down_stamp: dict[int, float] = {}
-        self._lock = threading.RLock()
+        self._lock = make_lock(f"mon.{rank}")
         # ---- quorum state ------------------------------------------
         self.mon_ranks = sorted(mon_ranks) if mon_ranks else [rank]
         self.standalone = len(self.mon_ranks) == 1

@@ -9,6 +9,8 @@ makes replay/replication possible later.
 from __future__ import annotations
 
 import threading
+
+from ..common.lockdep import make_lock
 from dataclasses import dataclass, field
 from typing import Any, Iterator
 
@@ -60,7 +62,7 @@ class MonitorStore:
 
     def __init__(self, db=None) -> None:
         self._data: dict[tuple[str, str], Any] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("mon.store")
         self.db = db
         if db is not None:
             self._data = dict(db.all_items())

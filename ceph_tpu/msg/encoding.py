@@ -123,6 +123,12 @@ class _StructInfo:
     compat: int
     to_fields: Callable[[Any], list]
     from_fields: Callable[[list], Any]
+    #: ordered (field name, declared type or None) pairs when the
+    #: registration exposes them (dataclass / fields=...); None for
+    #: opaque to_fields/from_fields codecs.  The wire schema lockfile
+    #: (scripts/gen_wire_schema.py) and cephck's wire-drift rule pin
+    #: these the way ceph-object-corpus pins encodings.
+    field_schema: tuple | None = None
 
 
 _by_name: dict[str, _StructInfo] = {}
@@ -142,7 +148,9 @@ def register_struct(cls: type, name: str | None = None,
     restored with setattr onto a no-arg-constructed instance (missing
     trailing fields keep the constructor's defaults)."""
     name = name or cls.__name__
+    field_schema: tuple | None = None
     if to_fields is None and fields is not None:
+        field_schema = tuple((n, None, False) for n in fields)
 
         def to_fields(obj, _flds=fields):
             return [getattr(obj, n) for n in _flds]
@@ -156,7 +164,20 @@ def register_struct(cls: type, name: str | None = None,
     if to_fields is None:
         if not dataclasses.is_dataclass(cls):
             raise TypeError(f"{cls} needs explicit to_fields/from_fields")
-        flds = [f.name for f in dataclasses.fields(cls) if f.init]
+        dcf = [f for f in dataclasses.fields(cls) if f.init]
+        flds = [f.name for f in dcf]
+        # a field declared by ANY base keeps the base's wire position
+        # even when a subclass redeclares it (dataclass field-order
+        # rule) — mark those inherited so the static wire-drift check
+        # knows not to expect them at their class-body position
+        base_ann: set = set()
+        for b in cls.__mro__[1:]:
+            base_ann.update(vars(b).get("__annotations__", {}))
+        field_schema = tuple(
+            (f.name, f.type if isinstance(f.type, str)
+             else getattr(f.type, "__name__", repr(f.type)),
+             f.name in base_ann)
+            for f in dcf)
 
         def to_fields(obj, _flds=flds):
             return [getattr(obj, n) for n in _flds]
@@ -164,7 +185,8 @@ def register_struct(cls: type, name: str | None = None,
         def from_fields(vals, _cls=cls, _flds=flds):
             return _cls(**dict(zip(_flds, vals)))
 
-    info = _StructInfo(name, cls, version, compat, to_fields, from_fields)
+    info = _StructInfo(name, cls, version, compat, to_fields, from_fields,
+                       field_schema)
     if name in _by_name and _by_name[name].cls is not cls:
         raise ValueError(f"wire name {name!r} already registered")
     _by_name[name] = info
@@ -182,6 +204,24 @@ def wire_struct(name: str | None = None, version: int = 1,
 
 def registered_types() -> dict[str, type]:
     return {n: i.cls for n, i in sorted(_by_name.items())}
+
+
+def registered_schema() -> dict[str, dict]:
+    """Wire schema of every registered struct — name, (version,
+    compat), and the ordered field list where the registration exposes
+    one.  scripts/gen_wire_schema.py serializes this to the committed
+    lockfile; cephck's wire-drift rule and tests/test_wire_schema.py
+    compare against it."""
+    out: dict[str, dict] = {}
+    for n, i in sorted(_by_name.items()):
+        out[n] = {
+            "version": i.version,
+            "compat": i.compat,
+            "fields": None if i.field_schema is None else
+            [{"name": fn, "type": ft, "inherited": inh}
+             for fn, ft, inh in i.field_schema],
+        }
+    return out
 
 
 def ensure_registered() -> None:

@@ -15,6 +15,8 @@ from __future__ import annotations
 import itertools
 import queue
 import threading
+
+from ..common.lockdep import make_lock
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
@@ -208,7 +210,7 @@ class LocalNetwork:
 
     def __init__(self):
         self._endpoints: dict[EntityName, Messenger] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("msgr.local_network")
         self._routed = 0
         self.dropped: list[tuple[EntityName, EntityName, Message]] = []
         #: optional test hook: (src, dst, msg) -> False to drop

@@ -23,6 +23,8 @@ import socket
 import struct
 import threading
 
+from ..common.lockdep import make_lock
+
 from ..common.log import dout
 from .encoding import WireError, decode_message, encode_message
 from .messenger import Connection, Dispatcher, Message
@@ -107,7 +109,7 @@ class TcpMessenger:
             from ..compressor import registry as _creg
             _creg.create(compress)     # fail fast on unknown algs
         self.dispatchers: list[Dispatcher] = []
-        self._lock = threading.Lock()
+        self._lock = make_lock(f"msgr.tcp.{name}")
         self._out: dict[str, socket.socket] = {}   # peer -> conn
         # connections learned from inbound traffic: lets us answer
         # peers with no monmap address (clients are not in the monmap;

@@ -22,6 +22,8 @@ queue so storms are paced instead of flooding the cluster
 from __future__ import annotations
 
 import threading
+
+from ..common.lockdep import make_lock
 import time
 from collections import deque
 from typing import Callable
@@ -69,7 +71,7 @@ class MClockQueue:
     def __init__(self, clock: Callable[[], float] = time.monotonic):
         self.clock = clock
         self._classes: dict[str, _Class] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("osd.mclock")
 
     def set_class(self, name: str, reservation: float = 0.0,
                   weight: float = 1.0, limit: float = 0.0,

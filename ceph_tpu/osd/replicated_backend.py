@@ -10,6 +10,8 @@ reconstruct path).
 from __future__ import annotations
 
 import threading
+
+from ..common.lockdep import make_lock
 from dataclasses import dataclass, field
 from typing import Callable
 
@@ -674,7 +676,8 @@ class ReplicatedBackend:
         self.backfill_peers: dict[int, str] = {}
         self._tid = 0
         self._tid_gen = tid_gen    # see ECBackend: no tid reuse across
-        self._lock = threading.RLock()      # backend rebuilds
+        self._lock = make_lock(              # backend rebuilds
+            f"osd.{whoami}.repbackend.{pgid}")
         self.in_flight: dict[int, _RepWrite] = {}
         # pool snapshot state (daemon refreshes on every map;
         # ref: pg_pool_t snap_seq/snaps/removed_snaps feeding the

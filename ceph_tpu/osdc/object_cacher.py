@@ -34,6 +34,8 @@ and cephfs (striped file objects):
 from __future__ import annotations
 
 import threading
+
+from ..common.lockdep import make_lock
 from collections import OrderedDict
 from typing import Callable
 
@@ -72,7 +74,7 @@ class ObjectCacher:
         #: ObjectCacher's max_readahead); 0 disables
         self.max_readahead = max_readahead
         self._objs: "OrderedDict[str, _CachedObject]" = OrderedDict()
-        self._lock = threading.RLock()
+        self._lock = make_lock("osdc.object_cacher")
         # O(1) accounting: page counts maintained at every transition
         # (a per-write full scan would sit on the hot path)
         self._n_pages = 0

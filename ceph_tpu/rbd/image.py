@@ -32,6 +32,8 @@ from __future__ import annotations
 
 import json
 import threading
+
+from ..common.lockdep import make_lock
 import time
 
 from ..client.rados import IoCtx, RadosError
@@ -330,7 +332,7 @@ class Image:
         self._refresh_snapc()
         self._open = True
         # exclusive-lock state (ref: librbd/exclusive_lock/ManagedLock)
-        self._iolock = threading.RLock()
+        self._iolock = make_lock(f"rbd.image.{name}")
         self._lock_owned = False
         self._lock_cookie = f"{ioctx.rados.objecter.name}." \
                             f"{id(self):x}"

@@ -43,6 +43,8 @@ from __future__ import annotations
 import hashlib
 import json
 import threading
+
+from ..common.lockdep import make_lock
 import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -210,7 +212,7 @@ class RGWGateway:
         #: window to finish its data read (the reference defers the
         #: same way via rgw gc; immediate deletion 500'd racing GETs)
         self._gc_queue: list[tuple[float, str]] = []
-        self._gc_lock = threading.Lock()
+        self._gc_lock = make_lock("rgw.gc")
         self._gc_stop = threading.Event()
 
     #: seconds an orphaned object outlives its index unlink

@@ -34,6 +34,7 @@ from xml.sax.saxutils import escape
 
 from ..client import RadosError
 from ..cls.rgw import now_str
+from ..common.log import dout
 
 TOPICS_OBJ = ".rgw.topics"
 
@@ -146,10 +147,14 @@ class EventPusher:
             sent = 0
             try:
                 sent = self.tick()
-            except Exception:       # noqa: BLE001 — the pusher is a
+            except Exception as ex:  # noqa: BLE001 — the pusher is a
                 # daemon-lifetime loop; one bad topic/endpoint must
-                # not silently end delivery for every other topic
-                pass
+                # not silently end delivery for every other topic —
+                # but a drain pass dying MUST leave a trace (cephck
+                # silent-thread: an unlogged swallow here hid real
+                # delivery stalls behind "idle backoff")
+                dout("rgw", 1).write("notify pusher tick failed: "
+                                     "%s: %s", type(ex).__name__, ex)
             wait = self.interval if sent else \
                 min(wait * 2, self.MAX_IDLE_INTERVAL)
             self._stop.wait(wait)

@@ -35,6 +35,8 @@ from __future__ import annotations
 import os
 import threading
 
+from ..common.lockdep import make_lock
+
 from .. import compressor as comp_mod
 from ..common.crc32c import crc32c
 from ..common.options import global_config
@@ -77,7 +79,7 @@ class BlueStore(ObjectStore):
         self.compression = compression
         self.comp_min_len = comp_min_len
         self.mounted = False
-        self._lock = threading.RLock()
+        self._lock = make_lock(f"bluestore.{path}")
         self._block = None
         #: device-health feed (ref: the SMART-style error counters
         #: mgr/devicehealth consumes): csum mismatches and read

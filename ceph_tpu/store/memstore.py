@@ -17,6 +17,8 @@ from __future__ import annotations
 import copy
 import threading
 
+from ..common.lockdep import make_lock
+
 from ..common.options import global_config
 from .objectstore import (ObjectId, ObjectStore, StoreError, Transaction,
                           OP_TOUCH, OP_WRITE, OP_ZERO, OP_TRUNCATE,
@@ -54,7 +56,7 @@ class MemStore(ObjectStore):
         self.path = path
         self.colls: dict[str, dict[ObjectId, _Object]] = {}
         self.mounted = False
-        self._lock = threading.RLock()
+        self._lock = make_lock(f"memstore.{path}")
         self._read_err_objs: set[tuple[str, ObjectId]] = set()
 
     # -- lifecycle ------------------------------------------------------

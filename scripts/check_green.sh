@@ -12,14 +12,35 @@
 # runs the given targets (default: the thrash suites) N times
 # consecutively and fails on the FIRST red run — a test that cannot go
 # green N times in a row is flaky and must not gate as green.
+#
+# Static gate: cephck (python -m ceph_tpu.analysis) runs BEFORE the
+# suite on every invocation and fails the gate on any unsuppressed
+# finding — the lint half of the ship gate (suppressions live in
+# .cephck-baseline.json, one justified reason per entry).
+# `bash scripts/check_green.sh --static` runs ONLY the static pass.
 set -u -o pipefail
 
 cd "$(dirname "$0")/.."
 
+run_static() {
+    echo "=== check_green: static analysis (cephck) ==="
+    python -m ceph_tpu.analysis ceph_tpu tests scripts bench.py
+    local rc=$?
+    if [ "$rc" -ne 0 ]; then
+        echo "check_green: RED (cephck rc=$rc — unsuppressed static" \
+             "findings) — do not ship" >&2
+        return 1
+    fi
+    return 0
+}
+
 REPEAT=1
+STATIC_ONLY=0
 TARGETS=()
 while [ $# -gt 0 ]; do
     case "$1" in
+        --static)
+            STATIC_ONLY=1; shift ;;
         --repeat)
             REPEAT="$2"; shift 2
             # a gate that can be asked to run zero times is not a
@@ -37,6 +58,12 @@ while [ $# -gt 0 ]; do
             TARGETS+=("$1"); shift ;;
     esac
 done
+run_static || exit 1
+if [ "$STATIC_ONLY" -eq 1 ]; then
+    echo "check_green: GREEN (static only)"
+    exit 0
+fi
+
 if [ "$REPEAT" -gt 1 ] && [ ${#TARGETS[@]} -eq 0 ]; then
     TARGETS=(tests/test_thrasher.py tests/test_thrash_ec.py \
              tests/test_snaptrim.py)

@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""Generate tests/fixtures/wire_schema.json — the wire SCHEMA lockfile.
+
+Companion to gen_wire_corpus.py (which pins sample ENCODINGS): this
+pins the *shape* of every registered wire struct — name, (version,
+compat), and the ordered field list with declared types.  The
+committed file is the append-only evolution contract the reference
+enforces with ENCODE_START/DECODE_START (ref: src/include/encoding.h)
+and ceph-dencoder's corpus checks:
+
+* cephck's `wire-drift` rule statically compares msg/messages.py
+  field lists against it — reordering/removing/retyping a field, or
+  appending one without a version bump, fails lint;
+* tests/test_wire_schema.py compares the live registry against it at
+  runtime, so non-messages structs (osdmap, crush, fsmap...) are
+  pinned too.
+
+Regenerate ONLY as part of a deliberate wire evolution (append the
+field, bump the type's entry in messages._VERSIONS, rerun this, and
+commit the diff):
+
+    python scripts/gen_wire_schema.py
+"""
+import json
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from ceph_tpu.msg import encoding as wire           # noqa: E402
+
+OUT = pathlib.Path(__file__).resolve().parent.parent / "tests" / \
+    "fixtures" / "wire_schema.json"
+
+
+def main() -> None:
+    wire.ensure_registered()
+    schema = wire.registered_schema()
+    OUT.parent.mkdir(parents=True, exist_ok=True)
+    with open(OUT, "w") as f:
+        json.dump({
+            "_comment": "wire schema lockfile — append-only field "
+                        "lists; regenerate via "
+                        "scripts/gen_wire_schema.py as part of a "
+                        "deliberate version bump only",
+            "structs": schema,
+        }, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {len(schema)} struct schemas to {OUT}")
+
+
+if __name__ == "__main__":
+    main()

@@ -47,9 +47,9 @@ def measure(step, label):
                           jnp.arange(REPS, dtype=jnp.uint8))
         return acc
 
-    float(chained(data))  # compile + warm
+    jax.block_until_ready(chained(data))  # compile + warm
     t0 = time.perf_counter()
-    float(chained(data))
+    jax.block_until_ready(chained(data))
     dt = (time.perf_counter() - t0) / REPS
     gbs = STRIPES * K * CHUNK / dt / 1e9
     print(f"{label:24s} {dt * 1e3:7.2f} ms   {gbs:7.1f} GB/s data-in")

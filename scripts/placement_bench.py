@@ -37,6 +37,8 @@ def build_map(n_osd: int, pg_num: int, osds_per_host: int = 20):
 
 def run(n_osd: int, pg_num: int, sample: int = 256,
         balancer_iters: int = 10, chunk: int = 1 << 16) -> dict:
+    import jax
+
     from ceph_tpu.crush import mapper as scalar
     from ceph_tpu.crush.batch import compile_map
     from ceph_tpu.osd.mapping import OSDMapMapping
@@ -71,6 +73,10 @@ def run(n_osd: int, pg_num: int, sample: int = 256,
     res = map_all()                   # warm: compile + first pass
     t0 = time.perf_counter()
     res = map_all()
+    # map_all converts per-chunk via np.asarray (a sync), but the
+    # explicit barrier keeps the measurement honest if that ever
+    # changes (cephck jax-timing)
+    jax.block_until_ready(res)
     dt = time.perf_counter() - t0
     mappings_per_s = pg_num / dt
 

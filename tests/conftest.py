@@ -10,6 +10,18 @@ must override the config value before any backend initializes.
 """
 import os
 
+# Every tier-1 run is a deadlock-sanitizer run: lockdep ON before any
+# ceph_tpu import, because make_lock reads the option at CONSTRUCTION
+# time (module-level locks are built at import).  The env layer also
+# propagates to subprocess daemons (tools/daemon_main), so TCP
+# multi-process tests run order-checked too.  A lock-order cycle
+# anywhere under test raises LockOrderError on the FIRST interleaving
+# that could deadlock — not the unlucky run that does (ref:
+# src/common/lockdep.cc).  Force-set (not setdefault): an ambient
+# CEPH_TPU_LOCKDEP=0 in a dev shell must not silently turn the
+# sanitizer off for the whole suite.
+os.environ["CEPH_TPU_LOCKDEP"] = "1"
+
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:

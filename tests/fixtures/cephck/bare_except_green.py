@@ -1,0 +1,8 @@
+"""green: named exceptions only."""
+
+
+def drain(q):
+    try:
+        return q.pop()
+    except (IndexError, KeyError):
+        return None

@@ -1,0 +1,8 @@
+"""red: bare except catches SystemExit/KeyboardInterrupt too."""
+
+
+def drain(q):
+    try:
+        return q.pop()
+    except:                         # noqa: E722
+        return None

@@ -1,0 +1,17 @@
+"""green: block_until_ready before the clock stops."""
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def kernel(x):
+    return (x @ x).sum()
+
+
+def bench(x):
+    jax.block_until_ready(kernel(x))     # warm
+    t0 = time.perf_counter()
+    jax.block_until_ready(kernel(x))
+    return time.perf_counter() - t0

@@ -1,0 +1,17 @@
+"""red: the clock stops at dispatch, not compute."""
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def kernel(x):
+    return (x @ x).sum()
+
+
+def bench(x):
+    kernel(x)                       # warm
+    t0 = time.perf_counter()
+    kernel(x)                       # returns when ENQUEUED
+    return time.perf_counter() - t0

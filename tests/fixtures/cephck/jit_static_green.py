@@ -1,0 +1,15 @@
+"""green: static args are hashable tuples."""
+from functools import partial
+
+import jax
+
+f = jax.jit(lambda x, shape: x.reshape(shape), static_argnums=(1,))
+out = f(data, (8, 16))
+
+
+@partial(jax.jit, static_argnames=("axes",))
+def reduce(x, axes=None):
+    return x.sum(axes)
+
+
+out2 = reduce(data, axes=(0, 1))

@@ -1,0 +1,15 @@
+"""red: unhashable containers as jit static args (cache keys)."""
+from functools import partial
+
+import jax
+
+f = jax.jit(lambda x, shape: x.reshape(shape), static_argnums=(1,))
+out = f(data, [8, 16])                      # list as cache key
+
+
+@partial(jax.jit, static_argnames=("axes",))
+def reduce(x, axes=None):
+    return x.sum(axes)
+
+
+out2 = reduce(data, axes=[0, 1])

@@ -1,0 +1,14 @@
+"""green: omap state rides the owning Transaction."""
+from ceph_tpu.store.objectstore import Transaction
+
+
+def persist_log(txn, cid, entries):
+    txn.omap_setkeys(cid, "pgmeta", {"log": b"..."})
+    txn.omap_rmkeys(cid, "pgmeta", ["cursor"])
+
+
+def fresh(store, cid):
+    # a locally-built transaction handed to apply as ONE unit is fine
+    t = Transaction()
+    t.omap_setkeys(cid, "pgmeta", {"k": b"v"})
+    store.apply_transaction(t)

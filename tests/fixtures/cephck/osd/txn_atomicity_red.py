@@ -1,0 +1,9 @@
+"""red: the persist_log bug class — omap mutations applied outside
+the owning transaction (a private side-txn or a raw store call
+breaks atomicity with the caller's update)."""
+
+
+def persist_log(store, cid, entries):
+    # mutating through something that is not the caller's Transaction
+    store.omap_setkeys(cid, "pgmeta", {"log": b"..."})
+    store.omap_rmkeys(cid, "pgmeta", ["cursor"])

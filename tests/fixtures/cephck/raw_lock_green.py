@@ -1,0 +1,5 @@
+"""green: locks come from the lockdep factory."""
+from ceph_tpu.common.lockdep import make_lock
+
+a = make_lock("fixture.a")
+b = make_lock("fixture.b")

@@ -1,0 +1,8 @@
+"""red: raw lock constructions the sanitizer can't see."""
+import threading
+from threading import Lock
+
+a = threading.Lock()
+b = threading.RLock()
+c = threading.Condition()
+d = Lock()

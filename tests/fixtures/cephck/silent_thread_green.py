@@ -1,0 +1,19 @@
+"""green: the handler leaves a trace before the loop continues."""
+import threading
+
+from ceph_tpu.common.log import dout
+
+
+def _loop():
+    while True:
+        try:
+            work()
+        except Exception as ex:
+            dout("osd", 1).write("worker failed: %s", ex)
+
+
+def work():
+    raise RuntimeError
+
+
+t = threading.Thread(target=_loop, daemon=True)

@@ -1,0 +1,17 @@
+"""red: a daemon loop that swallows its own death."""
+import threading
+
+
+def _loop():
+    while True:
+        try:
+            work()
+        except Exception:
+            pass          # the thread dies silently
+
+
+def work():
+    raise RuntimeError
+
+
+t = threading.Thread(target=_loop, daemon=True)

@@ -1,0 +1,13 @@
+"""green: dataclass Message subclasses register automatically."""
+from dataclasses import dataclass
+from typing import Any
+
+from ceph_tpu.msg.messenger import Message
+
+
+@dataclass
+class SnapTrimReply(Message):
+    pgid: Any = None
+    tid: int = 0
+    from_osd: int = -1
+    committed: bool = True

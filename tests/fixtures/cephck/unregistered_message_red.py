@@ -1,0 +1,8 @@
+"""red: a Message subclass _register_all() will never see."""
+from ceph_tpu.msg.messenger import Message
+
+
+class MOrphan(Message):
+    """Not a dataclass: compiles fine, dies with WireError on the
+    first TCP send."""
+    epoch: int = 0

@@ -1,0 +1,23 @@
+"""green: field lists byte-identical to the committed schema."""
+from dataclasses import dataclass
+from typing import Any
+
+from ceph_tpu.msg.messenger import Message
+
+
+@dataclass
+class SnapTrim(Message):
+    pgid: Any = None
+    tid: int = 0
+    oid: str = ""
+    snap: int = 0
+    clone: int = 0
+    from_osd: int = -1
+
+
+@dataclass
+class SnapTrimReply(Message):
+    pgid: Any = None
+    tid: int = 0
+    from_osd: int = -1
+    committed: bool = True

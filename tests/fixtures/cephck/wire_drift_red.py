@@ -1,0 +1,37 @@
+"""red: three ways to drift from the wire schema lockfile.
+
+SnapTrim here is missing the committed `clone` field (removal),
+SnapTrimReply retypes `committed`, and SnapTrimPurged's _VERSIONS
+entry declares compat > version.
+"""
+from dataclasses import dataclass
+from typing import Any
+
+from ceph_tpu.msg.messenger import Message
+
+_VERSIONS = {"SnapTrimPurged": (1, 2)}
+
+
+@dataclass
+class SnapTrim(Message):
+    pgid: Any = None
+    tid: int = 0
+    oid: str = ""
+    snap: int = 0
+    from_osd: int = -1
+
+
+@dataclass
+class SnapTrimReply(Message):
+    pgid: Any = None
+    tid: int = 0
+    from_osd: int = -1
+    committed: int = 1
+
+
+@dataclass
+class SnapTrimPurged(Message):
+    pgid: Any = None
+    snaps: Any = None
+    purged: Any = None
+    from_osd: int = -1

@@ -1,0 +1,201 @@
+"""cephck engine + rule tests.
+
+Every rule must demonstrate its bug: at least one red fixture it
+flags and one green fixture it stays silent on
+(tests/fixtures/cephck/).  On top of the corpus, the whole tree must
+scan clean under the committed baseline — the same gate
+scripts/check_green.sh --static ships on.
+"""
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from ceph_tpu.analysis import ALL_RULES
+from ceph_tpu.analysis.engine import (BaselineError, Engine,
+                                      load_baseline, repo_root)
+
+ROOT = repo_root(pathlib.Path(__file__).resolve())
+FIXTURES = ROOT / "tests" / "fixtures" / "cephck"
+
+#: rule id -> fixture stem (red = must flag, green = must not)
+RULE_FIXTURES = {
+    "raw-lock": "raw_lock",
+    "wire-drift": "wire_drift",
+    "unregistered-message": "unregistered_message",
+    "txn-atomicity": "osd/txn_atomicity",
+    "silent-thread": "silent_thread",
+    "jax-timing": "jax_timing",
+    "jit-static": "jit_static",
+    "bare-except": "bare_except",
+}
+
+
+def scan(path: pathlib.Path, baseline=None) -> list:
+    eng = Engine([cls() for cls in ALL_RULES], ROOT,
+                 suppressions=baseline or [])
+    return list(eng.check_file(path)), eng
+
+
+def rules_hit(path: pathlib.Path) -> set:
+    findings, _ = scan(path)
+    return {f.rule for f in findings}
+
+
+def test_every_rule_has_fixtures():
+    assert {r.id for r in (cls() for cls in ALL_RULES)} == \
+        set(RULE_FIXTURES)
+
+
+@pytest.mark.parametrize("rule", sorted(RULE_FIXTURES))
+def test_red_fixture_flags(rule):
+    red = FIXTURES / f"{RULE_FIXTURES[rule]}_red.py"
+    assert rule in rules_hit(red), f"{red.name} must trip {rule}"
+
+
+@pytest.mark.parametrize("rule", sorted(RULE_FIXTURES))
+def test_green_fixture_passes(rule):
+    green = FIXTURES / f"{RULE_FIXTURES[rule]}_green.py"
+    assert rule not in rules_hit(green), \
+        f"{green.name} must NOT trip {rule}"
+
+
+def test_red_fixtures_are_otherwise_clean():
+    """A red fixture demonstrates ITS bug, not a pile of them — any
+    other rule firing on it means the fixture (or a rule) drifted."""
+    for rule, stem in RULE_FIXTURES.items():
+        extra = rules_hit(FIXTURES / f"{stem}_red.py") - {rule}
+        assert not extra, f"{stem}_red.py also trips {extra}"
+
+
+def test_green_fixtures_are_fully_clean():
+    for stem in RULE_FIXTURES.values():
+        hit = rules_hit(FIXTURES / f"{stem}_green.py")
+        assert not hit, f"{stem}_green.py trips {hit}"
+
+
+# ------------------------------------------------------- rule details
+
+def test_wire_drift_catches_removal_retype_and_compat():
+    findings, _ = scan(FIXTURES / "wire_drift_red.py")
+    msgs = {f.symbol: f.message for f in findings
+            if f.rule == "wire-drift"}
+    # dropping a mid-list field shifts every later one: reported as a
+    # positional mismatch at the first diverging slot
+    assert "breaks positional decode" in msgs["SnapTrim"]
+    assert "retyped" in msgs["SnapTrimReply"]
+    assert "compat" in msgs["SnapTrimPurged"]
+
+
+def test_wire_drift_append_needs_version_bump(tmp_path):
+    """Appending a field is the LEGAL evolution — but only with a
+    version bump; same-version append is drift."""
+    src = (FIXTURES / "wire_drift_green.py").read_text()
+    appended = src.replace("    from_osd: int = -1\n",
+                           "    from_osd: int = -1\n"
+                           "    extra: int = 0\n", 1)
+    bad = tmp_path / "append_same_version.py"
+    bad.write_text(appended)
+    findings, _ = scan(bad)
+    assert any(f.rule == "wire-drift" and "version bump" in f.message
+               for f in findings)
+    good = tmp_path / "append_bumped.py"
+    good.write_text(appended + '\n_VERSIONS = {"SnapTrim": (2, 1)}\n')
+    findings, _ = scan(good)
+    assert not [f for f in findings if f.rule == "wire-drift"]
+
+
+def test_inline_ignore_waives_a_finding(tmp_path):
+    p = tmp_path / "ign.py"
+    p.write_text("try:\n    pass\n"
+                 "except:  # cephck: ignore[bare-except]\n    pass\n")
+    findings, _ = scan(p)
+    assert not findings
+
+
+# --------------------------------------------------- baseline contract
+
+def test_baseline_requires_reasons(tmp_path):
+    b = tmp_path / "b.json"
+    b.write_text(json.dumps({"suppressions": [
+        {"rule": "raw-lock", "path": "x.py"}]}))
+    with pytest.raises(BaselineError):
+        load_baseline(b)
+    b.write_text(json.dumps({"suppressions": [
+        {"rule": "raw-lock", "path": "x.py", "reason": "why\nnot"}]}))
+    with pytest.raises(BaselineError):
+        load_baseline(b)
+
+
+def test_committed_baseline_is_valid():
+    entries = load_baseline(ROOT / ".cephck-baseline.json")
+    assert all(e.reason for e in entries)
+
+
+def test_baseline_suppresses(tmp_path):
+    red = FIXTURES / "bare_except_red.py"
+    baseline = load_baseline_from({"suppressions": [
+        {"rule": "bare-except",
+         "path": "tests/fixtures/cephck/bare_except_red.py",
+         "reason": "fixture exercise"}]}, tmp_path)
+    findings, eng = scan(red, baseline)
+    assert not findings and len(eng.suppressed) == 1
+
+
+def load_baseline_from(data, tmp_path):
+    p = tmp_path / "baseline.json"
+    p.write_text(json.dumps(data))
+    return load_baseline(p)
+
+
+# ------------------------------------------------------ the ship gate
+
+def test_tree_scans_clean():
+    """The acceptance gate itself: the full-tree scan is clean under
+    the committed baseline (unsuppressed findings fail the build via
+    scripts/check_green.sh --static).  In-process — the CLI wrapper
+    is covered separately by test_cli_exit_codes."""
+    eng = Engine([cls() for cls in ALL_RULES], ROOT,
+                 suppressions=load_baseline(
+                     ROOT / ".cephck-baseline.json"))
+    rc = eng.run(["ceph_tpu", "tests", "scripts", "bench.py"])
+    assert rc == 0, "\n".join(f.render() for f in eng.findings)
+    assert not eng.errors, eng.errors
+    assert not eng.stale_suppressions(), [
+        (s.rule, s.path) for s in eng.stale_suppressions()]
+
+
+def test_cli_exit_codes():
+    """CLI contract: 1 on findings, 0 on a clean file."""
+    red = FIXTURES / "bare_except_red.py"
+    green = FIXTURES / "bare_except_green.py"
+    proc = subprocess.run(
+        [sys.executable, "-m", "ceph_tpu.analysis", str(red)],
+        cwd=ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "bare-except" in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, "-m", "ceph_tpu.analysis", str(green)],
+        cwd=ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_no_raw_locks_outside_lockdep():
+    """Belt + suspenders for the acceptance criterion: zero raw
+    threading.Lock/RLock/Condition constructions outside
+    common/lockdep.py (grep-level, independent of the rule code)."""
+    import re
+    pat = re.compile(r"threading\.(R?Lock|Condition)\(")
+    offenders = []
+    for d in ("ceph_tpu", "tests", "scripts"):
+        for f in (ROOT / d).rglob("*.py"):
+            if "fixtures" in f.parts or "__pycache__" in f.parts:
+                continue
+            if f.name == "lockdep.py":
+                continue
+            for i, line in enumerate(f.read_text().splitlines(), 1):
+                if pat.search(line):
+                    offenders.append(f"{f}:{i}")
+    assert not offenders, offenders

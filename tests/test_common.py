@@ -38,7 +38,11 @@ def test_config_get_set_defaults():
     assert cfg.get("osd_pool_default_size") == 3
     cfg.set("osd_pool_default_size", "5")
     assert cfg["osd_pool_default_size"] == 5
-    assert cfg.diff() == {"osd_pool_default_size": 5}
+    diff = cfg.diff()
+    # env layer: tier-1's conftest exports CEPH_TPU_LOCKDEP=1, which
+    # every fresh Config legitimately reports as changed-from-default
+    diff.pop("lockdep", None)
+    assert diff == {"osd_pool_default_size": 5}
     with pytest.raises(KeyError):
         cfg.set("nonexistent_option", 1)
 
@@ -174,12 +178,31 @@ def test_lockdep_detects_order_cycle():
     for _ in range(3):
         with x, y, z:
             pass
-    # factory is config-gated
+    # factory is config-gated: plain RLock with the option OFF,
+    # DebugLock with it ON (tier-1 runs with lockdep ON via conftest,
+    # so force both states explicitly and restore)
+    import _thread
     g = global_config()
-    assert isinstance(make_lock("n"), type(threading.RLock()))
-    g.set("lockdep", True)
+    prev = g["lockdep"]
     try:
+        g.set("lockdep", False)
+        assert isinstance(make_lock("n"), _thread.RLock)
+        g.set("lockdep", True)
         assert isinstance(make_lock("n"), DebugLock)
     finally:
-        g.set("lockdep", False)
+        g.set("lockdep", prev)
     lockdep.reset()
+
+
+def test_lockdep_on_under_tier1():
+    """tests/conftest.py exports CEPH_TPU_LOCKDEP=1 before any
+    ceph_tpu import, so EVERY tier-1 run is a lock-order-sanitizer
+    run: make_lock hands out DebugLocks tree-wide."""
+    import os
+
+    from ceph_tpu.common.lockdep import DebugLock, make_lock
+    from ceph_tpu.common.options import global_config
+
+    assert os.environ.get("CEPH_TPU_LOCKDEP") == "1"
+    assert global_config()["lockdep"] is True
+    assert isinstance(make_lock("tier1.probe"), DebugLock)

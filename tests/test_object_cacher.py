@@ -2,6 +2,8 @@
 and CephFS (VERDICT r3 #6; ref: src/osdc/ObjectCacher.cc)."""
 import threading
 
+from ceph_tpu.common.lockdep import make_lock
+
 import pytest
 
 from ceph_tpu.osdc.object_cacher import ObjectCacher
@@ -14,7 +16,7 @@ class Backing:
         self.objs: dict[str, bytearray] = {}
         self.reads = 0
         self.writes = 0
-        self.lock = threading.Lock()
+        self.lock = make_lock("test.backing")
 
     def read(self, oid, off, length):
         with self.lock:

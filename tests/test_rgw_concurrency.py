@@ -3,6 +3,8 @@ the OSD (cls/rgw.py), so two radosgw processes over one pool can race
 without losing records — the reference's cls_rgw contract
 (ref: src/cls/rgw/cls_rgw.cc; VERDICT r4 weak #4)."""
 import threading
+
+from ceph_tpu.common.lockdep import make_lock
 import urllib.request
 from xml.etree import ElementTree as ET
 
@@ -52,7 +54,7 @@ def test_racing_versioned_puts_lose_nothing(two_gateways):
     req(g1, "PUT", "/race?versioning", VERS_ON)
     n_threads, per_thread = 8, 6
     vids, errs = [], []
-    lock = threading.Lock()
+    lock = make_lock("test.rgw_conc.puts")
 
     def worker(i):
         gw = (g1, g2)[i % 2]
@@ -115,7 +117,7 @@ def test_delete_vs_put_race_stays_consistent(two_gateways):
     req(g1, "PUT", "/race3?versioning", VERS_ON)
     req(g1, "PUT", "/race3/obj", b"seed")
     put_vids, dm_vids = [], []
-    lock = threading.Lock()
+    lock = make_lock("test.rgw_conc.race3")
 
     def putter():
         for j in range(5):

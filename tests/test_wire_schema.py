@@ -1,0 +1,64 @@
+"""Wire schema lockfile contract (ref: ceph-dencoder +
+ceph-object-corpus pinning encodings across releases).
+
+tests/fixtures/wire_schema.json pins name/(version, compat)/field
+lists for every registered wire struct.  The static half (cephck
+wire-drift) catches drift at lint time in msg/messages.py; this is
+the runtime half: the LIVE registry must match the lockfile exactly,
+for every struct — including the non-message ones (osdmap, crush,
+fsmap) the AST rule can't see.
+"""
+import json
+import pathlib
+
+import pytest
+
+from ceph_tpu.msg import encoding as wire
+from ceph_tpu.msg.messages import SnapTrim, SnapTrimPurged, SnapTrimReply
+
+LOCKFILE = pathlib.Path(__file__).resolve().parent / "fixtures" / \
+    "wire_schema.json"
+
+
+@pytest.fixture(scope="module")
+def lockfile() -> dict:
+    wire.ensure_registered()
+    return json.loads(LOCKFILE.read_text())["structs"]
+
+
+def test_registry_matches_lockfile(lockfile):
+    live = wire.registered_schema()
+    assert set(live) == set(lockfile), (
+        "registered struct set drifted from the lockfile — for an "
+        "INTENTIONAL wire change run scripts/gen_wire_schema.py and "
+        "commit the diff")
+    for name, got in live.items():
+        assert got == lockfile[name], (
+            f"{name}: schema drifted from the lockfile "
+            f"(got {got}, pinned {lockfile[name]}) — bump the version "
+            f"and regenerate via scripts/gen_wire_schema.py if this "
+            f"evolution is deliberate")
+
+
+def test_compat_never_exceeds_version(lockfile):
+    for name, s in lockfile.items():
+        assert s["compat"] <= s["version"], name
+
+
+@pytest.mark.parametrize("msg", [
+    SnapTrim(pgid=(3, 7), tid=42, oid="rbd_data.1", snap=5, clone=4,
+             from_osd=2),
+    SnapTrimReply(pgid=(3, 7), tid=42, from_osd=1, committed=True),
+    SnapTrimPurged(pgid=(3, 7), snaps=[4, 5], from_osd=0),
+], ids=lambda m: type(m).__name__)
+def test_snaptrim_messages_roundtrip_and_match_lockfile(msg, lockfile):
+    """The PR 2 snaptrim trio: frame round-trip is byte-faithful and
+    the encoded field order is exactly the lockfile's."""
+    back = wire.decode_message(wire.encode_message(msg))
+    assert type(back) is type(msg)
+    pinned = [f["name"] for f in lockfile[type(msg).__name__]["fields"]]
+    for name in pinned:
+        assert getattr(back, name) == getattr(msg, name), name
+    # and the live registration exposes that same order
+    live = wire.registered_schema()[type(msg).__name__]
+    assert [f["name"] for f in live["fields"]] == pinned

@@ -18,9 +18,10 @@ from typing import Optional
 
 from ..common.log import dout
 from ..common.options import global_config
-from ..msg.messages import (MAuthReply, MMap, MMonCommand,
-                            MMonCommandAck, MMonSubscribe,
-                            MWatchNotify, OSDOp, OSDOpReply)
+from ..msg.messages import (MAuthReply, MGR_UNAVAILABLE_EAGAIN, MMap,
+                            MMonCommand, MMonCommandAck,
+                            MMonSubscribe, MWatchNotify, OSDOp,
+                            OSDOpReply)
 from ..msg.mon_client import MonHunter
 from ..msg.messenger import Dispatcher, LocalNetwork, Message, Messenger
 from ..osd.osdmap import OSDMap
@@ -504,9 +505,15 @@ class Objecter(Dispatcher, MonHunter):
         an election in flight, or a forward that raced leadership
         away — are retried until the deadline: the reference
         MonClient resends commands after an election rather than
-        surfacing the churn to every caller."""
+        surfacing the churn to every caller.  Mgr-unavailable EAGAINs
+        (MGR_UNAVAILABLE_EAGAIN outs) get only a short grace: it
+        absorbs the fire-and-forget `mgr register` racing a command
+        issued right after mgr start, but a cluster with no mgr at
+        all must answer fast, not spin out the whole deadline."""
         import time
-        deadline = time.monotonic() + timeout
+        now = time.monotonic()
+        deadline = now + timeout
+        mgr_deadline = now + min(timeout, 1.0)
         while True:
             tid = next(self._tid)
             ev = threading.Event()
@@ -520,9 +527,14 @@ class Objecter(Dispatcher, MonHunter):
                     ev=ev):
                 raise TimeoutError(
                     f"mon command {cmd.get('prefix')} timed out")
-            if slot["r"] == -11 and time.monotonic() < deadline:
-                time.sleep(0.25)
-                continue
+            if slot["r"] == -11:
+                retry_until = deadline
+                if str(slot["outs"] or "").startswith(
+                        MGR_UNAVAILABLE_EAGAIN):
+                    retry_until = mgr_deadline
+                if time.monotonic() < retry_until:
+                    time.sleep(0.1)
+                    continue
             return slot["r"], slot["outs"], slot["outb"]
 
     def _handle_command_ack(self, msg: MMonCommandAck) -> bool:

@@ -205,6 +205,24 @@ OPTIONS: dict[str, Option] = {opt.name: opt for opt in [
        runtime=True),
     _o("mon_osd_stale_report_grace", T.SECS, 60.0, L.ADVANCED,
        desc="flag osds whose last pg-stat report is older than this"),
+    _o("mon_mgr_health_grace", T.SECS, 60.0, L.ADVANCED, runtime=True,
+       desc="expire mgr-module health checks (RECENT_CRASH, "
+            "DEVICE_HEALTH...) not re-reported within this window — a "
+            "dead mgr's last report must not warn forever (0 = never "
+            "expire)"),
+    # mgr observability modules (ref: options mgr/crash
+    # warn_recent_interval; mgr/insights health history)
+    _o("mgr_crash_warn_recent_interval", T.SECS, 14 * 24 * 3600.0,
+       L.ADVANCED, runtime=True,
+       desc="unarchived crashes newer than this raise RECENT_CRASH "
+            "(ref: mgr/crash warn_recent_interval)"),
+    _o("mgr_insights_window", T.SECS, 3600.0, L.ADVANCED, runtime=True,
+       desc="time window the insights report summarizes (health "
+            "history, osdmap churn, cluster-log counts)"),
+    _o("osd_debug_inject_crash_tick", T.BOOL, False, L.DEV,
+       runtime=True,
+       desc="inject an unhandled exception into the OSD's next "
+            "heartbeat tick (crash-capture exerciser)"),
     # balancer (ref: OSDMap.cc calc_pg_upmaps knobs)
     _o("upmap_max_deviation", T.UINT, 5, L.BASIC, runtime=True,
        desc="target max PG-count deviation per OSD"),

@@ -65,7 +65,7 @@ from ..client import RadosError, WriteOp
 from ..common.log import dout
 from ..journal import Journaler
 from ..msg.messages import (MClientCaps, MClientReply, MClientRequest,
-                            MFSMap, MMDSBeacon)
+                            MFSMap, MMDSBeacon, MMonCommandAck)
 from ..msg.messenger import Dispatcher, Message, Messenger
 
 ROOT_INO = 1
@@ -200,7 +200,8 @@ class MDSDaemon(Dispatcher):
                  metadata_pool: str = "cephfs_metadata",
                  data_pool: str = "cephfs_data",
                  threaded: bool = True, keyring=None,
-                 mon=None, gid: int | None = None):
+                 mon=None, gid: int | None = None,
+                 crash_dir: str | None = None):
         self.name = f"mds.{rank}"
         self.rank = rank
         self.rados = rados
@@ -299,6 +300,28 @@ class MDSDaemon(Dispatcher):
             from ..auth import attach_cephx
             attach_cephx(self.ms, self.name, keyring)
         self.ms.add_dispatcher(self)
+        # crash capture: dispatch-thread exceptions serialize into the
+        # mon crash table, spooled to crash_dir until the mon's ack
+        # (the table dedups crash_id, so spool+post lands once)
+        from ..common.crash import CrashReporter
+        self.crash_reporter = CrashReporter(
+            self.name, crash_dir=crash_dir,
+            post=self._post_crash_meta)
+        self.ms.crash_hook = self.crash_reporter.capture
+        #: crash-post targets; defaults to the beacon mons but is
+        #: settable independently — a standalone MDS (no beacons, no
+        #: fsmap) still reports crashes to the cluster
+        self.crash_mons = list(self.mons)
+
+    def _post_crash_meta(self, meta: dict) -> None:
+        from ..msg.messages import MMonCommand
+        tid = self.crash_reporter.alloc_tid(meta["crash_id"])
+        for m in self.crash_mons:
+            if self.ms.connect(m).send_message(MMonCommand(
+                    tid=tid,
+                    cmd={"prefix": "crash post", "meta": meta})):
+                return
+        self.crash_reporter.forget_tid(tid)   # nothing sent: no ack
 
     def init(self) -> None:
         self.ms.start()
@@ -1798,6 +1821,11 @@ class MDSDaemon(Dispatcher):
     def ms_dispatch(self, msg: Message) -> bool:
         if isinstance(msg, MFSMap):
             self._handle_fsmap(msg)
+            return True
+        if isinstance(msg, MMonCommandAck):
+            # only crash posts ride the command channel from an MDS;
+            # a successful ack retires the spooled copy
+            self.crash_reporter.on_ack(msg.tid, msg.result)
             return True
         if isinstance(msg, MClientCaps):
             self.handle_caps(msg)

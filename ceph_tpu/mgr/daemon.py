@@ -19,7 +19,8 @@ from ..common.lockdep import make_lock
 
 from ..common.log import dout
 from ..common.options import global_config
-from ..msg.messages import (MMap, MMonCommand, MMonCommandAck,
+from ..msg.messages import (MMap, MMgrCommand, MMgrCommandReply,
+                            MMonCommand, MMonCommandAck,
                             MMonSubscribe)
 from ..msg.mon_client import MonHunter
 from ..msg.messenger import Dispatcher, LocalNetwork, Message, Messenger
@@ -54,13 +55,30 @@ class MgrDaemon(Dispatcher, MonHunter):
         #: devicehealth module (ref: pybind/mgr/devicehealth); enable
         #: with start_devicehealth(), driven by devicehealth_tick
         self.devicehealth = None
+        #: observability modules (ref: pybind/mgr/crash, telemetry,
+        #: insights); enable with start_crash()/start_telemetry()/
+        #: start_insights(), driven by observability_tick
+        self.crash = None
+        self.telemetry = None
+        self.insights = None
+        #: per-module health-check slices, merged into ONE volatile
+        #: `mgr health report` so modules never clobber each other
+        self._health_reports: dict[str, dict] = {}
         self._lock = make_lock(f"mgr.{self.name}")
         self.ms = Messenger.create(network, self.name, threaded=threaded)
         self.ms.add_dispatcher(self)
+        # own-crash capture: the mgr posts its reports over the wire
+        # like any other daemon
+        from ..common.crash import CrashReporter
+        self.crash_reporter = CrashReporter(
+            self.name, post=self._post_crash_meta)
+        self.ms.crash_hook = self.crash_reporter.capture
 
     def _hunt_greeting(self) -> list:
         return [MMonSubscribe(what="osdmap",
-                              start=self.osdmap.epoch + 1)]
+                              start=self.osdmap.epoch + 1),
+                MMonCommand(tid=0, cmd={"prefix": "mgr register",
+                                        "name": self.name})]
 
     def ms_handle_reset(self, peer: str) -> None:
         self._maybe_hunt(peer)
@@ -70,6 +88,20 @@ class MgrDaemon(Dispatcher, MonHunter):
         self.ms.start()
         self.ms.connect(self.mon).send_message(
             MMonSubscribe(what="osdmap", start=1))
+        self._register_mgr()
+
+    def _register_mgr(self) -> None:
+        """Announce ourselves as the active mgr to EVERY mon — module
+        commands (telemetry/insights) may arrive at any of them and
+        each proxies from its own volatile registration (re-sent every
+        observability tick; ref: MgrMonitor beacons)."""
+        for m in self.mons:
+            self.ms.connect(m).send_message(MMonCommand(
+                tid=0, cmd={"prefix": "mgr register",
+                            "name": self.name}))
+
+    def _post_crash_meta(self, meta: dict) -> None:
+        self._command({"prefix": "crash post", "meta": meta})
 
     def shutdown(self) -> None:
         if self.prometheus is not None:
@@ -100,7 +132,41 @@ class MgrDaemon(Dispatcher, MonHunter):
                             outb=msg.outb)
                 ev.set()
             return True
+        if isinstance(msg, MMgrCommand):
+            # mon-proxied module command; answer the MON (it relays to
+            # the client).  Handlers run on the dispatch thread, so
+            # they answer from module-cached state only — a sync
+            # mon_command here would deadlock on our own ack.
+            r, outs, outb = self._handle_module_command(msg.cmd)
+            self.ms.connect(msg.src).send_message(MMgrCommandReply(
+                tid=msg.tid, result=r, outs=outs, outb=outb))
+            return True
         return False
+
+    def _handle_module_command(self, cmd: dict
+                               ) -> tuple[int, str, object]:
+        pfx = str(cmd.get("prefix", ""))
+        root = pfx.split(" ", 1)[0]
+        try:
+            if root == "telemetry":
+                if self.telemetry is None:
+                    return -2, "telemetry module not enabled", None
+                return self.telemetry.handle_command(cmd)
+            if root == "insights":
+                if self.insights is None:
+                    return -2, "insights module not enabled", None
+                return self.insights.handle_command(cmd)
+        except (KeyError, ValueError, TypeError) as ex:
+            return -22, f"invalid command arguments: {ex}", None
+        except Exception as ex:
+            # a broken module handler must still ANSWER: with no reply
+            # the client blocks out its 30s deadline and the mon's
+            # _mgr_proxy entry for this tid leaks until our connection
+            # resets
+            dout("mgr", 0).write("%s: module command %r failed: %s",
+                                 self.name, pfx, ex)
+            return -5, f"module command failed: {ex}", None
+        return -22, f"unknown mgr command {pfx!r}", None
 
     def mon_command(self, cmd: dict,
                     timeout: float = 30.0) -> tuple[int, str, object]:
@@ -151,6 +217,54 @@ class MgrDaemon(Dispatcher, MonHunter):
         if self.progress is None:
             return 0
         return self.progress.tick()
+
+    def start_crash(self, **kw):
+        """Crash-report health agent (ref: pybind/mgr/crash)."""
+        from .crash import CrashModule
+        self.crash = CrashModule(self, **kw)
+        return self.crash
+
+    def start_telemetry(self, **kw):
+        """Anonymized cluster report (ref: pybind/mgr/telemetry)."""
+        from .telemetry import TelemetryModule
+        self.telemetry = TelemetryModule(self, **kw)
+        return self.telemetry
+
+    def start_insights(self, **kw):
+        """Time-windowed cluster snapshot (ref: pybind/mgr/insights)."""
+        from .insights import InsightsModule
+        self.insights = InsightsModule(self, **kw)
+        return self.insights
+
+    def set_health_checks(self, module: str, checks: dict) -> None:
+        """Replace one module's health-check slice and push the MERGED
+        report to the mon (ref: MgrModule.set_health_checks — each
+        module owns its slice; the wholesale `mgr health report` wire
+        contract stays intact)."""
+        with self._lock:
+            if checks:
+                self._health_reports[module] = dict(checks)
+            else:
+                self._health_reports.pop(module, None)
+            merged: dict = {}
+            for part in self._health_reports.values():
+                merged.update(part)
+        self.mon_command({"prefix": "mgr health report",
+                          "checks": merged})
+
+    def observability_tick(self, now: float | None = None) -> None:
+        """One observability round: refresh the volatile mgr
+        registration on every mon, then tick crash (RECENT_CRASH
+        health), insights (history rings), and telemetry (report
+        compile) — the serve-loop slice the reference modules run in
+        their own threads."""
+        self._register_mgr()
+        if self.crash is not None:
+            self.crash.tick(now)
+        if self.insights is not None:
+            self.insights.tick(now)
+        if self.telemetry is not None:
+            self.telemetry.tick(now)
 
     def start_prometheus(self, port: int = 0):
         """Serve /metrics (ref: pybind/mgr/prometheus).  Exports

@@ -72,9 +72,9 @@ class DeviceHealth:
                 "summary": f"{len(checks_detail)} devices reporting "
                            "media errors",
                 "detail": checks_detail}
-        # replace-wholesale: recovered devices clear their check
-        self.mgr.mon_command({"prefix": "mgr health report",
-                              "checks": checks})
+        # replace this module's slice: recovered devices clear their
+        # check; other modules' slices (RECENT_CRASH) stay intact
+        self.mgr.set_health_checks("devicehealth", checks)
 
     def _on_new_unhealthy(self, dev: str, daemon: str, errors: int,
                           life: str) -> None:

@@ -149,6 +149,15 @@ class PrometheusExporter:
                 b.sample("ceph_pool_snaptrim_pgs",
                          st.get("snaptrim_pgs", 0), {"pool": pool})
 
+        rc, _, crashes = self._cmd({"prefix": "crash ls"})
+        if rc == 0 and isinstance(crashes, list):
+            new = sum(1 for c in crashes if not c.get("archived"))
+            b.metric("ceph_crash_reports",
+                     "daemon crash reports by archive state")
+            b.sample("ceph_crash_reports", new, {"status": "new"})
+            b.sample("ceph_crash_reports", len(crashes) - new,
+                     {"status": "archived"})
+
         rc, _, counts = self._cmd({"prefix": "log counts"})
         if rc == 0:
             b.metric("ceph_cluster_log_messages",

@@ -1,0 +1,180 @@
+"""mgr telemetry module: the anonymized cluster report
+(ref: src/pybind/mgr/telemetry/module.py — channel-gated report of
+cluster shape, crash summaries, and perf aggregates, with an explicit
+anonymization contract: hashed cluster id, NO hostnames, NO raw
+filesystem paths, NO entity names, NO pool names).
+
+Channels (ref: telemetry's basic/crash/device/perf/ident):
+  basic — daemon/pool/pg counts, EC profile parameters
+  crash — crash summaries (entity TYPE only, path-stripped backtrace)
+  perf  — cluster-wide perf-counter sums (no per-daemon breakdown)
+  ident — OFF by default: entity names (the only channel allowed to
+          carry them; everything else must stay anonymous)
+
+The report compiles on the mgr tick from cached inputs, so the
+`telemetry show` command handler (which runs on the mgr dispatch
+thread) never issues a synchronous mon command.
+"""
+from __future__ import annotations
+
+import hashlib
+import time
+
+from ..common.crash import sanitize_backtrace, utc_iso
+from ..osd.types import POOL_TYPE_ERASURE
+
+REPORT_VERSION = 1
+
+DEFAULT_CHANNELS = ("basic", "crash", "perf")
+ALL_CHANNELS = ("basic", "crash", "perf", "ident")
+
+_EPERM = 1
+_EAGAIN = 11
+_EINVAL = 22
+
+
+class TelemetryModule:
+    """(ref: telemetry/module.py Module)."""
+
+    def __init__(self, mgr, enabled: bool = True,
+                 channels: tuple | None = None):
+        self.mgr = mgr
+        #: starting the module is the operator's opt-in (the reference
+        #: gates on `telemetry on`; `telemetry off` still disables)
+        self.enabled = enabled
+        self.channels = {c: c in (channels or DEFAULT_CHANNELS)
+                         for c in ALL_CHANNELS}
+        self.last_report: dict | None = None
+        self.last_report_stamp: float | None = None
+        #: tick-cached perf aggregate (compile never hits the mon)
+        self._perf_totals: dict[str, float] = {}
+
+    # -------------------------------------------------- anonymization
+    def cluster_id(self) -> str:
+        """Stable hashed cluster identity: the mon set IS the cluster
+        (ref: telemetry hashing the fsid — reversible identity never
+        leaves the cluster)."""
+        ident = ",".join(sorted(self.mgr.mons))
+        return hashlib.sha256(ident.encode()).hexdigest()[:32]
+
+    # ------------------------------------------------------------ tick
+    def tick(self, now: float | None = None) -> None:
+        now = time.time() if now is None else now
+        if not self.enabled:
+            return
+        if self.channels.get("perf"):
+            rc, _, perf = self.mgr.mon_command(
+                {"prefix": "osd perf dump"})
+            if rc == 0 and isinstance(perf, dict):
+                totals: dict[str, float] = {}
+                for counters in perf.values():
+                    for key, val in counters.items():
+                        if isinstance(val, (int, float)):
+                            totals[key] = totals.get(key, 0.0) \
+                                + float(val)
+                self._perf_totals = totals
+        self.last_report = self.compile_report(now)
+        self.last_report_stamp = now
+
+    def compile_report(self, now: float | None = None) -> dict:
+        """Assemble the channel-gated report from mgr-local state
+        (the subscribed osdmap + module caches)."""
+        now = time.time() if now is None else now
+        report: dict = {
+            "report_version": REPORT_VERSION,
+            "report_timestamp": utc_iso(now),
+            "cluster_id": self.cluster_id(),
+            "channels": sorted(c for c, on in self.channels.items()
+                               if on),
+        }
+        m = self.mgr.osdmap
+        if self.channels.get("basic"):
+            up = sum(1 for o in range(m.max_osd) if m.is_up(o))
+            n_in = sum(1 for o in range(m.max_osd) if m.is_in(o))
+            exists = sum(1 for o in range(m.max_osd) if m.exists(o))
+            ec_profiles = []
+            for pool in m.pools.values():
+                if pool.type != POOL_TYPE_ERASURE:
+                    continue
+                prof = m.erasure_code_profiles.get(
+                    pool.erasure_code_profile, {})
+                ec_profiles.append({
+                    "k": int(prof.get("k", 0)),
+                    "m": int(prof.get("m", 0)),
+                    "plugin": str(prof.get("plugin", ""))})
+            report["basic"] = {
+                "n_mons": len(self.mgr.mons),
+                "osds": {"total": exists, "up": up, "in": n_in},
+                "osdmap_epoch": m.epoch,
+                "pools": {
+                    "count": len(m.pools),
+                    "by_type": {
+                        "erasure": sum(1 for p in m.pools.values()
+                                       if p.type == POOL_TYPE_ERASURE),
+                        "replicated": sum(
+                            1 for p in m.pools.values()
+                            if p.type != POOL_TYPE_ERASURE)},
+                    "pg_num_total": sum(p.pg_num
+                                        for p in m.pools.values()),
+                    "ec_profiles": ec_profiles},
+            }
+        if self.channels.get("crash") and self.mgr.crash is not None:
+            crashes = self.mgr.crash.last_crashes
+            report["crash"] = {
+                "summary": self.mgr.crash.summary(),
+                "reports": [{
+                    "entity_type": c.get("entity_type", "?"),
+                    "timestamp": c.get("timestamp", ""),
+                    "exc_type": c.get("exc_type", ""),
+                    "backtrace": sanitize_backtrace(
+                        list(c.get("backtrace", []))),
+                    "archived": bool(c.get("archived")),
+                } for c in crashes],
+            }
+        if self.channels.get("perf"):
+            report["perf"] = {"cluster": dict(self._perf_totals)}
+        if self.channels.get("ident"):
+            # the ONLY channel carrying entity identity
+            report["ident"] = {"mons": sorted(self.mgr.mons),
+                               "mgr": self.mgr.name}
+        return report
+
+    # -------------------------------------------------------- commands
+    def status(self) -> dict:
+        return {"enabled": self.enabled,
+                "channels": dict(self.channels),
+                "last_report_timestamp":
+                    None if self.last_report_stamp is None
+                    else utc_iso(self.last_report_stamp)}
+
+    def handle_command(self, cmd: dict) -> tuple[int, str, object]:
+        """Mon-proxied CLI verbs — answers from cached state only
+        (dispatch-thread safe)."""
+        pfx = str(cmd.get("prefix", ""))
+        if pfx == "telemetry status":
+            return 0, "", self.status()
+        if pfx == "telemetry on":
+            self.enabled = True
+            return 0, "telemetry enabled", None
+        if pfx == "telemetry off":
+            self.enabled = False
+            self.last_report = None
+            self.last_report_stamp = None
+            return 0, "telemetry disabled", None
+        if pfx == "telemetry channel":
+            name = str(cmd.get("name", ""))
+            if name not in self.channels:
+                return -_EINVAL, \
+                    f"unknown channel {name!r} (of {ALL_CHANNELS})", \
+                    None
+            self.channels[name] = bool(cmd.get("enabled", True))
+            return 0, "", None
+        if pfx == "telemetry show":
+            if not self.enabled:
+                return -_EPERM, "telemetry is off — enable with " \
+                    "`telemetry on`", None
+            if self.last_report is None:
+                return -_EAGAIN, "no report compiled yet — the next " \
+                    "mgr tick builds one", None
+            return 0, "", self.last_report
+        return -_EINVAL, f"unknown telemetry command {pfx!r}", None

@@ -22,6 +22,7 @@ the reference's paxos plug.
 """
 from __future__ import annotations
 
+import itertools
 import threading
 
 from ..common.lockdep import make_lock
@@ -32,7 +33,9 @@ from ..common.log import dout
 from ..common.options import global_config
 from ..msg.messages import (MAuthRequest, MConfig, MFSMap, MLog,
                             MLogAck,
-                            MMap, MMDSBeacon, MMonCommand,
+                            MMap, MMDSBeacon, MMgrCommand,
+                            MMgrCommandReply,
+                            MGR_UNAVAILABLE_EAGAIN, MMonCommand,
                             MMonCommandAck,
                             MMonElection, MMonForward, MMonLease,
                             MMonLeaseAck, MMonSubscribe, MOSDBoot,
@@ -43,6 +46,7 @@ from ..msg.messages import (MAuthRequest, MConfig, MFSMap, MLog,
 from ..msg.messenger import Dispatcher, LocalNetwork, Message, Messenger
 from ..osd.osdmap import CEPH_OSD_AUTOOUT, CEPH_OSD_IN, OSDMap
 from .config_monitor import ConfigMonitor
+from .crash_service import CrashService
 from .log_monitor import LogMonitor
 from .elector import Elector
 from .mds_monitor import MDSMonitor
@@ -81,7 +85,8 @@ class Monitor(Dispatcher):
                  initial_map: OSDMap | None = None,
                  initial_wrapper=None, store: MonitorStore | None = None,
                  threaded: bool = True, clock=time.monotonic,
-                 mon_ranks: list[int] | None = None, keyring=None):
+                 mon_ranks: list[int] | None = None, keyring=None,
+                 crash_dir: str | None = None):
         self.name = f"mon.{rank}"
         self.rank = rank
         #: injectable clock so harnesses can run the failure/auto-out
@@ -93,7 +98,15 @@ class Monitor(Dispatcher):
         self.configmon = ConfigMonitor(self.paxos)
         self.logmon = LogMonitor(self.paxos)
         self.mdsmon = MDSMonitor(self.paxos)
+        self.crashmon = CrashService(self.paxos)
         self.ms = Messenger.create(network, self.name, threaded=threaded)
+        # own-crash capture: a mon IS the crash sink, so its reports
+        # stage straight into the local crash table (spool covers the
+        # window where paxos can't commit yet)
+        from ..common.crash import CrashReporter
+        self.crash_reporter = CrashReporter(
+            self.name, crash_dir=crash_dir, post=self._post_own_crash)
+        self.ms.crash_hook = self.crash_reporter.capture
         # cephx: the mon runs the key server and gates inbound traffic
         # (ref: AuthMonitor + CephxServiceHandler)
         self.cephx = None
@@ -110,6 +123,17 @@ class Monitor(Dispatcher):
         self._fsmap_subs: dict[str, int] = {}
         # failure reports: target osd -> {reporter: stamp}
         self._failure_reports: dict[int, dict[int, float]] = {}
+        # active mgr (volatile, re-registered every mgr tick): the
+        # routing target for mgr-module commands (ref: MgrMonitor's
+        # active mgr tracking)
+        self._active_mgr: str | None = None
+        # in-flight mgr-proxied commands: tid -> client reply callback
+        self._mgr_proxy: dict[int, object] = {}
+        self._proxy_tids = itertools.count(1)
+        # volatile mgr-module health + its report stamp (expired after
+        # mon_mgr_health_grace so a dead mgr's warnings don't persist)
+        self._module_health: dict[str, dict] = {}
+        self._module_health_stamp: float | None = None
         # cluster statistics digest (ref: src/mon/PGMap.h)
         self.pgmap = PGMap()
         self._down_stamp: dict[int, float] = {}
@@ -142,10 +166,48 @@ class Monitor(Dispatcher):
         self.configmon.init()
         self.logmon.init()
         self.mdsmon.init()
+        self.crashmon.init()
         self.ms.start()
         if not self.standalone:
+            # quorum members drain once the election settles (_on_win/
+            # _on_lose) — committing or forwarding here would EAGAIN
             self.elector.start()
             self._persist_elector()
+        else:
+            self._drain_crash_spool()
+
+    def _drain_crash_spool(self) -> None:
+        """Re-post every spooled own-crash report (next boot, or a
+        fresh quorum).  A spool file is deleted only when the commit
+        or the leader's ack lands; the table dedups by crash_id, so
+        re-draining after a failed round is safe."""
+        if not self.crash_reporter.crash_dir:
+            return
+        for meta in self.crash_reporter.spooled():
+            self._post_own_crash(meta)
+
+    def _post_own_crash(self, meta: dict) -> None:
+        """Ship one of OUR crash reports to the crash table: the
+        leader (or a standalone mon) commits it locally; a peon
+        forwards it to the leader like a client command and retires
+        the spool copy on the MMonCommandAck.  Mid-election (no
+        leader yet) the spool keeps the durable copy until the
+        post-election drain."""
+        cid = meta["crash_id"]
+        with self._lock:
+            if self.is_leader:
+                self._submit_change(
+                    lambda: self.crashmon.prepare_command(
+                        {"prefix": "crash post", "meta": dict(meta)}),
+                    reply_cb=lambda r, outs, outb: (
+                        self.crash_reporter.mark_delivered(cid)
+                        if r == 0 else None),
+                    svc=self.crashmon)
+            elif self.leader_rank is not None:
+                tid = self.crash_reporter.alloc_tid(cid)
+                self._send_rank(self.leader_rank, MMonForward(
+                    tid=tid, client=self.name,
+                    cmd={"prefix": "crash post", "meta": dict(meta)}))
 
     def shutdown(self) -> None:
         if getattr(self, "asok", None) is not None:
@@ -212,9 +274,12 @@ class Monitor(Dispatcher):
         self.logmon.create_pending()
         self.mdsmon.update_from_paxos()
         self.mdsmon.create_pending()
+        self.crashmon.update_from_paxos()
+        self.crashmon.create_pending()
         self._persist_elector()
         self._broadcast_lease()
         self._publish()
+        self._drain_crash_spool()
 
     def _on_lose(self, epoch: int, leader: int,
                  quorum: list[int]) -> None:
@@ -231,6 +296,7 @@ class Monitor(Dispatcher):
         # catch up on anything we missed while electing
         self._send_rank(leader, MPaxosSyncReq(
             version=self.paxos.last_committed, rank=self.rank))
+        self._drain_crash_spool()
 
     def _fail_queued(self, errno_name: str) -> None:
         # the in-flight proposal's client must get a fast EAGAIN too —
@@ -261,6 +327,7 @@ class Monitor(Dispatcher):
         self.configmon.update_from_paxos()
         self.logmon.update_from_paxos()
         self.mdsmon.update_from_paxos()
+        self.crashmon.update_from_paxos()
         self._publish()
 
     # -------------------------------------------------------- dispatch
@@ -382,6 +449,18 @@ class Monitor(Dispatcher):
                         self._catchup_pending = set()
                         self._pump_changes()
                 return True
+            if isinstance(msg, MMgrCommandReply):
+                cb = self._mgr_proxy.pop(msg.tid, None)
+                if cb is not None:
+                    cb(msg.result, msg.outs, msg.outb)
+                return True
+            if isinstance(msg, MMonCommandAck):
+                # the leader acked an own-crash post we forwarded as
+                # a peon: retire the spool copy (a non-zero result —
+                # e.g. leadership raced away — leaves it for the next
+                # post-election drain)
+                self.crash_reporter.on_ack(msg.tid, msg.result)
+                return True
             if isinstance(msg, MMonForward):
                 if self.is_leader:
                     self._handle_wire_command(msg.cmd, msg.client,
@@ -401,6 +480,14 @@ class Monitor(Dispatcher):
 
     def ms_handle_reset(self, peer: str) -> None:
         with self._lock:
+            if peer and peer == self._active_mgr:
+                # the active mgr died: fail its in-flight proxied
+                # commands fast instead of letting clients time out
+                self._active_mgr = None
+                for tid in list(self._mgr_proxy):
+                    cb = self._mgr_proxy.pop(tid)
+                    cb(-11, MGR_UNAVAILABLE_EAGAIN
+                       + "active mgr went away", None)
             if not self.standalone and peer.startswith("mon.") and \
                     self.leader_rank is not None and \
                     peer == f"mon.{self.leader_rank}" and \
@@ -440,6 +527,8 @@ class Monitor(Dispatcher):
             return self.logmon
         if pfx.startswith(("fs ", "mds ")) or pfx in ("fs", "mds"):
             return self.mdsmon
+        if pfx == "crash" or pfx.startswith("crash "):
+            return self.crashmon
         return self.osdmon
 
     def _dispatch_command(self, cmdmap: dict, reply_cb,
@@ -448,7 +537,13 @@ class Monitor(Dispatcher):
         (leader) or forward them to it (peon,
         ref: Monitor::forward_request_leader).  The prefix routes to
         the owning PaxosService (ref: Monitor::dispatch_op's service
-        fan-out)."""
+        fan-out).  Mgr-module prefixes (telemetry/insights) proxy to
+        the registered active mgr instead (ref: the MgrMonitor routing
+        of module commands)."""
+        pfx = str(cmdmap.get("prefix", ""))
+        if pfx.split(" ", 1)[0] in ("telemetry", "insights"):
+            self._proxy_to_mgr(cmdmap, reply_cb)
+            return
         res = self._preprocess_mon_command(cmdmap)
         if res is not None:
             reply_cb(*res)
@@ -473,6 +568,26 @@ class Monitor(Dispatcher):
         self._submit_change(
             lambda: svc.prepare_command(cmdmap), reply_cb, svc)
 
+    def _proxy_to_mgr(self, cmdmap: dict, reply_cb) -> None:
+        """Relay a mgr-module command to the active mgr; its reply
+        (MMgrCommandReply) comes back HERE and we ack the client over
+        our learned connection — the mgr may have no route to an
+        ad-hoc client entity (ref: MgrMonitor + MCommand routing)."""
+        if self._active_mgr is None:
+            reply_cb(-11, MGR_UNAVAILABLE_EAGAIN + "no active mgr",
+                     None)
+            return
+        tid = next(self._proxy_tids)
+        self._mgr_proxy[tid] = reply_cb
+        ok = self.ms.connect(self._active_mgr).send_message(
+            MMgrCommand(tid=tid, cmd=dict(cmdmap)))
+        # a failed send resets synchronously (ms_handle_reset already
+        # failed every proxied tid, including this one)
+        if not ok and self._mgr_proxy.pop(tid, None) is not None:
+            self._active_mgr = None
+            reply_cb(-11, MGR_UNAVAILABLE_EAGAIN
+                     + "active mgr unreachable", None)
+
     # ------------------------------------------- cluster-level commands
     # (ref: Monitor::handle_command's mon-level table — `ceph -s`
     #  Monitor.cc get_cluster_status, health get_health, df from PGMap)
@@ -483,15 +598,24 @@ class Monitor(Dispatcher):
 
     def _preprocess_mon_command(self, cmdmap: dict):
         prefix = cmdmap.get("prefix", "")
+        if prefix == "mgr register":
+            # the active mgr announces itself (volatile; re-sent every
+            # mgr tick) — the routing target for telemetry/insights
+            # command proxying (ref: MgrMonitor beacon handling)
+            self._active_mgr = str(cmdmap.get("name", "")) or None
+            return 0, "", None
         if prefix == "mgr health report":
             # volatile module health (devicehealth etc.) — replaces
-            # the previous report wholesale so cleared checks vanish
+            # the previous report wholesale so cleared checks vanish,
+            # and STAMPED so a dead mgr's last report expires after
+            # mon_mgr_health_grace instead of warning forever
             self._module_health = {
                 str(k): {"severity": str(v.get("severity",
                                                "HEALTH_WARN")),
                          "summary": str(v.get("summary", "")),
                          "detail": list(v.get("detail", []))}
                 for k, v in dict(cmdmap.get("checks", {})).items()}
+            self._module_health_stamp = self.clock()
             return 0, "", None
         if prefix == "osd perf dump":
             # per-daemon counters as last reported (the mgr's
@@ -513,11 +637,17 @@ class Monitor(Dispatcher):
             self.osdmap, self.pgmap, self.quorum(), self.mon_ranks,
             now, stale_after=global_config()
             ["mon_osd_stale_report_grace"], pgs=pgs)
-        # mgr-module health reports (devicehealth etc.) merge in
+        # mgr-module health reports (devicehealth/crash etc.) merge in
         # (ref: MgrStatMonitor's health contributions — volatile here
         # rather than paxos'd: the mgr re-reports every tick, so a
-        # failed-over mon repopulates within one period)
-        checks.update(getattr(self, "_module_health", {}))
+        # failed-over mon repopulates within one period).  A report
+        # older than mon_mgr_health_grace is a dead mgr's leftovers:
+        # it must not warn forever (0 = never expire).
+        grace = global_config()["mon_mgr_health_grace"]
+        if self._module_health_stamp is not None and \
+                (grace <= 0 or
+                 now - self._module_health_stamp <= grace):
+            checks.update(self._module_health)
         if prefix in ("health", "health detail"):
             out = {"status": health_status(checks),
                    "checks": {k: {"severity": v["severity"],
@@ -914,7 +1044,16 @@ class Monitor(Dispatcher):
     # -------------------------------------------------------------- tick
     def tick(self, now: float | None = None) -> None:
         """Periodic: auto-out down OSDs; leases/re-election in a
-        quorum (ref: OSDMonitor.cc:4965 tick; Monitor.cc tick)."""
+        quorum (ref: OSDMonitor.cc:4965 tick; Monitor.cc tick).
+        Crash-capturing entry: an unhandled tick exception lands in
+        the crash table before propagating."""
+        try:
+            self._tick(now)
+        except Exception as exc:
+            self.crash_reporter.capture(exc)
+            raise
+
+    def _tick(self, now: float | None = None) -> None:
         with self._lock:
             now = self.clock() if now is None else now
             if not self.standalone:

@@ -660,6 +660,36 @@ class MMonForward(Message):
     cmd: dict = field(default_factory=dict)
 
 
+# outs prefix on every -11 the mon emits for mgr-module commands when
+# no live mgr can serve them ("no active mgr" / "went away" /
+# "unreachable").  Unlike election-churn EAGAINs there is no quorum
+# event the client can wait out, so the objecter gives these only a
+# short registration grace instead of its full command deadline.
+MGR_UNAVAILABLE_EAGAIN = "EAGAIN(mgr): "
+
+
+@dataclass
+class MMgrCommand(Message):
+    """Mon -> active mgr: a client command owned by a mgr module
+    (telemetry/insights), proxied by the mon that received it (ref:
+    src/messages/MCommand.h routed via the MgrMonitor's active mgr).
+    The mgr answers the MON (MMgrCommandReply) which relays the ack to
+    the client over its learned connection — the mgr may have no route
+    of its own to an ad-hoc client entity."""
+    tid: int = 0
+    cmd: dict = field(default_factory=dict)
+
+
+@dataclass
+class MMgrCommandReply(Message):
+    """Active mgr -> proxying mon: module command result
+    (ref: src/messages/MCommandReply.h)."""
+    tid: int = 0
+    result: int = 0
+    outs: str = ""
+    outb: Any = None
+
+
 @dataclass
 class MLog(Message):
     """Daemon -> mon cluster-log batch (ref: src/messages/MLog.h

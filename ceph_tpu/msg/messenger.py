@@ -90,6 +90,10 @@ class Messenger:
         # incoming (None = auth off; ref: ms_verify_authorizer)
         self.auth_signer = None
         self.auth_verifier = None
+        # crash capture: called with the exception when a dispatcher
+        # blows up on the dispatch thread (the daemon's CrashReporter;
+        # ref: the global handle_fatal_signal crash dump path)
+        self.crash_hook = None
 
     # -- factory (ref: Messenger.cc:21 Messenger::create) ---------------
     @staticmethod
@@ -175,11 +179,19 @@ class Messenger:
                 break
             try:
                 self._deliver(msg)
-            except Exception:        # dispatcher bug: log, keep serving
+            except Exception as ex:   # dispatcher bug: log, keep serving
                 import traceback
                 dout("ms", 0).write(
                     "dispatch error on %s: %s", self.name,
                     traceback.format_exc())
+                if self.crash_hook is not None:
+                    try:
+                        self.crash_hook(ex)
+                    except Exception as hex_:
+                        # capture must never re-crash the loop
+                        dout("ms", 0).write(
+                            "%s: crash hook failed: %s", self.name,
+                            hex_)
 
     def _deliver(self, msg: Message) -> None:
         if self.auth_verifier is not None and \

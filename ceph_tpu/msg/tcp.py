@@ -127,6 +127,8 @@ class TcpMessenger:
         # cephx hooks (same surface as the in-process messenger)
         self.auth_signer = None
         self.auth_verifier = None
+        # crash capture (same surface as the in-process messenger)
+        self.crash_hook = None
 
     # -- messenger surface ----------------------------------------------
     def add_dispatcher(self, d: Dispatcher) -> None:
@@ -455,10 +457,18 @@ class TcpMessenger:
             try:
                 if d.ms_dispatch(msg):
                     return
-            except Exception:
+            except Exception as ex:
                 import traceback
                 dout("ms", 0).write("dispatch error on %s: %s",
                                     self.name, traceback.format_exc())
+                if self.crash_hook is not None:
+                    try:
+                        self.crash_hook(ex)
+                    except Exception as hex_:
+                        # capture must never re-crash the reader
+                        dout("ms", 0).write(
+                            "%s: crash hook failed: %s", self.name,
+                            hex_)
                 return
         dout("ms", 1).write("%s: unhandled message %s from %s",
                             self.name, msg.type_name, msg.src)

@@ -23,7 +23,8 @@ from ..common.options import global_config
 from ..ec import registry as ec_registry
 from ..msg.messages import (BackfillReserve, ECSubRead, ECSubReadReply,
                             ECSubWrite, ECSubWriteReply, MConfig, MMap,
-                            MLogAck, MOSDBoot, MMonSubscribe,
+                            MLogAck, MMonCommand, MMonCommandAck,
+                            MOSDBoot, MMonSubscribe,
                             MOSDFailure,
                             MOSDPGTemp, MPGStats, MWatchNotify, OSDOp,
                             OSDOpReply, PGLogPush, PGLogReq,
@@ -134,7 +135,8 @@ class OSDDaemon(Dispatcher, MonHunter):
     def __init__(self, network: LocalNetwork, whoami: int,
                  store: Optional[MemStore] = None, mon="mon.0",
                  threaded: bool = False, perf_collection=None,
-                 keyring=None, fabric=None):
+                 keyring=None, fabric=None,
+                 crash_dir: str | None = None):
         self.whoami = whoami
         self.name = f"osd.{whoami}"
         #: ICIFabric this OSD is device-mesh co-resident on (None =
@@ -262,6 +264,18 @@ class OSDDaemon(Dispatcher, MonHunter):
             from ..auth import attach_cephx
             attach_cephx(self.ms, self.name, keyring)
         self.ms.add_dispatcher(self)
+        # crash capture (ref: mgr/crash ingest + the ceph-crash spool
+        # agent): unhandled tick/dispatch exceptions serialize into
+        # crash metadata, spool to crash_dir (if any), and post to the
+        # mon's crash table; the ack retires the spool copy
+        from ..common.crash import CrashReporter
+        self.crash = CrashReporter(self.name, crash_dir=crash_dir,
+                                   post=self._post_crash_meta)
+        self.ms.crash_hook = self.crash.capture
+        #: fault hook: raise out of the next heartbeat tick (the
+        #: osd_debug_inject_crash_tick analogue, settable per-daemon)
+        self.inject_crash_tick = \
+            bool(global_config()["osd_debug_inject_crash_tick"])
 
     # ------------------------------------------------------------ setup
     def init(self) -> None:
@@ -271,6 +285,14 @@ class OSDDaemon(Dispatcher, MonHunter):
             MMonSubscribe(what="osdmap", start=1))
         self.ms.connect(self.mon).send_message(
             MMonSubscribe(what="config"))
+        # next-boot spool drain: crashes captured while the mon was
+        # unreachable post now (the table dedups by crash_id)
+        self.crash.drain()
+
+    def _post_crash_meta(self, meta: dict) -> None:
+        tid = self.crash.alloc_tid(meta["crash_id"])
+        self.ms.connect(self.mon).send_message(MMonCommand(
+            tid=tid, cmd={"prefix": "crash post", "meta": meta}))
 
     def shutdown(self) -> None:
         if self.asok is not None:
@@ -339,6 +361,11 @@ class OSDDaemon(Dispatcher, MonHunter):
             return True
         if isinstance(msg, MConfig):
             self._apply_config(msg)
+            return True
+        if isinstance(msg, MMonCommandAck):
+            # only crash posts ride the command channel from an OSD;
+            # a successful ack retires the spooled copy
+            self.crash.on_ack(msg.tid, msg.result)
             return True
         if isinstance(msg, OSDOp):
             self.op_tracker.start(
@@ -2150,7 +2177,23 @@ class OSDDaemon(Dispatcher, MonHunter):
         """Ping peers; report silent ones to the mon after the grace
         window (ref: OSD.cc heartbeat() + heartbeat_check :4583).
         `now` may be simulated time for deterministic tests; stamps
-        echo through PingReply so the clocks stay consistent."""
+        echo through PingReply so the clocks stay consistent.
+
+        Crash-capturing entry: an unhandled exception (or the
+        inject_crash_tick fault) serializes into a crash report —
+        posted to the mon while the messenger still lives — and then
+        propagates, so the harness reaps the daemon like an abort()."""
+        try:
+            if self.inject_crash_tick:
+                self.inject_crash_tick = False
+                raise RuntimeError(
+                    "injected crash (osd_debug_inject_crash_tick)")
+            self._heartbeat_tick(now)
+        except Exception as exc:
+            self.crash.capture(exc)
+            raise
+
+    def _heartbeat_tick(self, now: float | None = None) -> None:
         import time as _time
         self._drain_op_queue()      # paced recovery/scrub backlog
         now = _time.monotonic() if now is None else now

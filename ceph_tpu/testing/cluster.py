@@ -19,7 +19,8 @@ from ..osd.daemon import OSDDaemon
 class MiniCluster:
     def __init__(self, n_osd: int = 6, osds_per_host: int = 1,
                  threaded: bool = True, n_mon: int = 1,
-                 auth: str = "none", fabric=None):
+                 auth: str = "none", fabric=None,
+                 mon_crash_dirs: dict[int, str] | None = None):
         import copy
         self.network = LocalNetwork()
         self.threaded = threaded
@@ -42,6 +43,8 @@ class MiniCluster:
         self.mon_names = [f"mon.{r}" for r in ranks]
         self.osds: dict[int, OSDDaemon] = {}
         self._stores: dict[int, object] = {}
+        #: per-osd crash-spool dirs, sticky across kill/revive
+        self._crash_dirs: dict[int, str] = {}
         self.mgr = None
         self.clients: list[Rados] = []
         # MDS fleet (ref: vstart's mds spawning): rank -> daemon (or
@@ -51,6 +54,9 @@ class MiniCluster:
         self.standbys: dict[str, object] = {}
         self._standby_seq = 0
         m, w = build_initial(n_osd, osds_per_host=osds_per_host)
+        #: per-rank mon crash-spool dirs (tests of the post-election
+        #: spool drain); also honored by revive_mon
+        self._mon_crash_dirs = dict(mon_crash_dirs or {})
         self.mons: dict[int, Monitor] = {}
         for r in ranks:
             self.mons[r] = Monitor(
@@ -59,7 +65,8 @@ class MiniCluster:
                 initial_wrapper=copy.deepcopy(w),
                 threaded=threaded, clock=self._clock,
                 mon_ranks=ranks if n_mon > 1 else None,
-                keyring=self.keyring)
+                keyring=self.keyring,
+                crash_dir=self._mon_crash_dirs.get(r))
             self.mons[r].init()
         self.mon = self.mons[0]      # rank 0 wins elections when alive
         if not threaded and n_mon > 1:
@@ -90,7 +97,8 @@ class MiniCluster:
         mn = Monitor(self.network, rank=rank, store=store,
                      threaded=self.threaded, clock=self._clock,
                      mon_ranks=[int(n.split(".")[1])
-                                for n in self.mon_names])
+                                for n in self.mon_names],
+                     crash_dir=self._mon_crash_dirs.get(rank))
         mn.init()
         self.mons[rank] = mn
         if not self.threaded:
@@ -98,13 +106,17 @@ class MiniCluster:
         return mn
 
     # ------------------------------------------------------------ osds
-    def start_osd(self, osd: int) -> OSDDaemon:
+    def start_osd(self, osd: int,
+                  crash_dir: str | None = None) -> OSDDaemon:
         store = self._stores.get(osd)
+        if crash_dir is not None:
+            self._crash_dirs[osd] = crash_dir
         d = OSDDaemon(self.network, osd, store=store,
                       threaded=self.threaded,
                       perf_collection=self.perf_collection,
                       mon=self.mon_names, keyring=self.keyring,
-                      fabric=self.fabric)
+                      fabric=self.fabric,
+                      crash_dir=self._crash_dirs.get(osd))
         self._stores[osd] = d.store
         d.init()
         self.osds[osd] = d
@@ -119,6 +131,14 @@ class MiniCluster:
 
     def revive_osd(self, osd: int) -> OSDDaemon:
         return self.start_osd(osd)
+
+    def crash_osd(self, osd: int, now: float | None = None) -> None:
+        """Inject a fault into the OSD's next tick: it captures a
+        crash report (backtrace + metadata), posts it to the mon's
+        crash table, and leaves the cluster like an aborted process
+        (store kept for revive_osd)."""
+        self.osds[osd].inject_crash_tick = True
+        self.tick(now)
 
     # ------------------------------------------------------------- mds
     def start_mds(self, rank: int = 0, **kw):
@@ -253,11 +273,22 @@ class MiniCluster:
 
     def tick(self, now: float | None = None) -> None:
         """One heartbeat round on every live OSD + a mon tick; pumps
-        in non-threaded mode so the exchange completes."""
+        in non-threaded mode so the exchange completes.  An OSD whose
+        tick raises has already crash-captured (osd.daemon
+        heartbeat_tick) — the harness reaps it like an aborted
+        process: off the wire, store kept for a revive."""
         if now is not None:
             self._sim_now = now
-        for d in self.osds.values():
-            d.heartbeat_tick(now)
+        for osd, d in list(self.osds.items()):
+            try:
+                d.heartbeat_tick(now)
+            except Exception as ex:
+                from ..common.log import dout
+                dout("cluster", 0).write(
+                    "osd.%d crashed in tick (%s: %s) — reaped",
+                    osd, type(ex).__name__, ex)
+                del self.osds[osd]
+                d.shutdown()
         if not self.threaded:
             self.pump()
         for mn in self.mons.values():

@@ -49,6 +49,17 @@ def make_net(mm: dict, keyring) -> "TcpNet":
     return TcpNet(mm["addrs"], secure_secret=secret,
                   compress=mm.get("ms_compress"))
 
+def _crash_dir(args) -> str | None:
+    """Spool dir for crash reports: --crash-dir, else <data-dir>/crash
+    (the /var/lib/ceph/crash layout), else none (post-only)."""
+    if getattr(args, "crash_dir", ""):
+        return args.crash_dir
+    if getattr(args, "data_dir", ""):
+        import os
+        return os.path.join(args.data_dir, "crash")
+    return None
+
+
 def run_mon(args) -> int:
     from ..mon.monitor import Monitor, build_initial
     from ..msg.tcp import TcpNet
@@ -71,7 +82,8 @@ def run_mon(args) -> int:
     mon = Monitor(net, rank=args.rank, initial_map=m, initial_wrapper=w,
                   store=store,
                   mon_ranks=ranks if len(ranks) > 1 else None,
-                  keyring=keyring)
+                  keyring=keyring, crash_dir=_crash_dir(args))
+    mon.crash_reporter.install_excepthook()
     mon.init()
     if args.asok:
         mon.start_admin_socket(args.asok)
@@ -105,7 +117,9 @@ def run_osd(args) -> int:
         from ..auth import KeyRing
         keyring = KeyRing.load(args.keyring)
     net = make_net(mm, keyring)
-    d = OSDDaemon(net, args.id, mon=mons, store=store, keyring=keyring)
+    d = OSDDaemon(net, args.id, mon=mons, store=store, keyring=keyring,
+                  crash_dir=_crash_dir(args))
+    d.crash.install_excepthook()
     d.init()
     if args.asok:
         d.start_admin_socket(args.asok)
@@ -142,13 +156,31 @@ def run_mds(args) -> int:
         attach_cephx(r.objecter.ms, f"mds.{args.rank}", keyring,
                      verifier=False)
     r.connect()
-    mds = MDSDaemon(net, r, rank=args.rank, keyring=keyring)
+    mds = MDSDaemon(net, r, rank=args.rank, keyring=keyring,
+                    crash_dir=_crash_dir(args))
+    # crash posts go to the mons even though this MDS runs standalone
+    # (no beacons/fsmap — crash_mons is independent of `mon=`)
+    mds.crash_mons = [f"mon.{k}" for k in mm.get("mon_ranks", [0])]
+    rep = mds.crash_reporter
+    rep.install_excepthook()
     mds.init()
+    # next-boot spool drain: crashes captured while the mons were
+    # unreachable post now (the table dedups by crash_id; the ack
+    # retires each spool copy)
+    rep.drain()
     print(f"mds.{args.rank}: serving on "
           f"{mm['addrs'][f'mds.{args.rank}']}", flush=True)
-    # the tick drives the load balancer (heat decay, load publication,
-    # hot-subtree export) — without it the mds_bal_* machinery is dead
-    _serve(lambda: mds.tick(), interval=1.0)
+
+    def _tick():
+        # the tick drives the load balancer (heat decay, load
+        # publication, hot-subtree export); crash-capture wraps it
+        # like the osd/mon tick entries
+        try:
+            mds.tick()
+        except Exception as exc:
+            rep.capture(exc)
+            raise
+    _serve(_tick, interval=1.0)
     mds.shutdown()
     r.shutdown()
     return 0
@@ -183,6 +215,9 @@ def main(argv=None) -> int:
                     help="admin socket path (`ceph daemon` endpoint)")
     pm.add_argument("--keyring", default="",
                     help="cephx keyring JSON (enables auth)")
+    pm.add_argument("--crash-dir", default="",
+                    help="crash-report spool dir (default: "
+                         "<data-dir>/crash when --data-dir is set)")
     po = sub.add_parser("osd")
     po.add_argument("--id", type=int, required=True)
     po.add_argument("--monmap", required=True)
@@ -196,11 +231,16 @@ def main(argv=None) -> int:
                     help="admin socket path (`ceph daemon` endpoint)")
     po.add_argument("--keyring", default="",
                     help="cephx keyring JSON (enables auth)")
+    po.add_argument("--crash-dir", default="",
+                    help="crash-report spool dir (default: "
+                         "<data-dir>/crash when --data-dir is set)")
     pd = sub.add_parser("mds")
     pd.add_argument("--rank", type=int, default=0)
     pd.add_argument("--monmap", required=True)
     pd.add_argument("--keyring", default="",
                     help="cephx keyring JSON (auth/secure clusters)")
+    pd.add_argument("--crash-dir", default="",
+                    help="crash-report spool dir")
     args = ap.parse_args(argv)
     return {"mon": run_mon, "osd": run_osd,
             "mds": run_mds}[args.role](args)

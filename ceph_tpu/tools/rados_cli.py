@@ -128,6 +128,60 @@ def cmd_listomapvals(r, a, out):
         print(vals[k].decode(errors="replace"), file=out)
 
 
+# ------------------------------------------- observability (mgr/mon)
+# (ref: src/ceph.in routing `ceph crash|telemetry|insights ...` to the
+#  mon, which serves crash from its table and proxies the mgr-module
+#  verbs to the active mgr)
+
+def _mon_verb(r, cmd: dict, out) -> int:
+    import json
+    rc, outs, outb = r.mon_command(cmd)
+    if rc < 0:
+        print(f"error: {outs}", file=sys.stderr)
+        return 1
+    if outb is not None:
+        print(json.dumps(outb, indent=1, sort_keys=True), file=out)
+    elif outs:
+        print(outs, file=out)
+    return 0
+
+
+def cmd_crash(r, a, out):
+    cmd = {"prefix": f"crash {a.verb}"}
+    if a.verb in ("info", "archive"):
+        if not a.arg:
+            print(f"error: crash {a.verb} wants a crash id",
+                  file=sys.stderr)
+            return 1
+        cmd["id"] = a.arg
+    elif a.verb == "prune":
+        # an omitted keep-days must NOT default to 0 — that means
+        # "drop every archived report"
+        try:
+            cmd["keep"] = float(a.arg)
+        except (TypeError, ValueError):
+            print("error: crash prune wants <keep-days> (a number)",
+                  file=sys.stderr)
+            return 1
+    return _mon_verb(r, cmd, out)
+
+
+def cmd_telemetry(r, a, out):
+    cmd = {"prefix": f"telemetry {a.verb}"}
+    if a.verb == "channel":
+        if not a.name:
+            print("error: telemetry channel wants <name> [on|off]",
+                  file=sys.stderr)
+            return 1
+        cmd["name"] = a.name
+        cmd["enabled"] = a.state != "off"
+    return _mon_verb(r, cmd, out)
+
+
+def cmd_insights(r, a, out):
+    return _mon_verb(r, {"prefix": "insights"}, out)
+
+
 # ---------------------------------------------------------------- bench
 # (ref: src/common/obj_bencher.cc ObjBencher::write_bench /
 #  seq_read_bench: fixed-depth aio pipeline, per-op latency tracking,
@@ -231,6 +285,18 @@ def main(argv=None, rados=None, out=None) -> int:
     p.add_argument("key"), p.add_argument("value")
     p = sub.add_parser("listomapvals")
     p.add_argument("pool"), p.add_argument("obj")
+    p = sub.add_parser("crash")
+    p.add_argument("verb", choices=["ls", "ls-new", "stat", "info",
+                                    "archive", "archive-all", "prune"])
+    p.add_argument("arg", nargs="?",
+                   help="crash id (info/archive) or keep-days (prune)")
+    p = sub.add_parser("telemetry")
+    p.add_argument("verb", nargs="?", default="show",
+                   choices=["show", "status", "on", "off", "channel"])
+    p.add_argument("name", nargs="?", help="channel name")
+    p.add_argument("state", nargs="?", default="on",
+                   choices=["on", "off"])
+    p = sub.add_parser("insights")
     p = sub.add_parser("bench")
     p.add_argument("pool")
     p.add_argument("seconds", type=float)
@@ -250,13 +316,16 @@ def main(argv=None, rados=None, out=None) -> int:
         try:
             if a.cmd == "bench":
                 return _bench(rados, a, out) or 0
-            {"lspools": cmd_lspools, "mkpool": cmd_mkpool,
-             "rmpool": cmd_rmpool, "ls": cmd_ls, "put": cmd_put,
-             "get": cmd_get, "rm": cmd_rm, "stat": cmd_stat,
-             "setxattr": cmd_setxattr, "getxattr": cmd_getxattr,
-             "listxattr": cmd_listxattr, "setomapval": cmd_setomapval,
-             "listomapvals": cmd_listomapvals}[a.cmd](rados, a, out)
-            return 0
+            rc = {"lspools": cmd_lspools, "mkpool": cmd_mkpool,
+                  "rmpool": cmd_rmpool, "ls": cmd_ls, "put": cmd_put,
+                  "get": cmd_get, "rm": cmd_rm, "stat": cmd_stat,
+                  "setxattr": cmd_setxattr, "getxattr": cmd_getxattr,
+                  "listxattr": cmd_listxattr,
+                  "setomapval": cmd_setomapval,
+                  "listomapvals": cmd_listomapvals,
+                  "crash": cmd_crash, "telemetry": cmd_telemetry,
+                  "insights": cmd_insights}[a.cmd](rados, a, out)
+            return rc or 0
         except RadosError as e:
             print(f"error: {e}", file=sys.stderr)
             return 1

@@ -15,7 +15,9 @@ command language:
     rm <pool> <obj> | ls <pool> | stat <pool> <obj>
     balance | balancer status
     fs status | kill-mds <rank> | add-standby
-    kill-osd <id> | revive-osd <id> | tick
+    kill-osd <id> | revive-osd <id> | crash-osd <id> | tick
+    crash [ls|ls-new|stat|info <id>|archive <id>|archive-all|prune <d>]
+    telemetry [show|status|on|off] | insights
     perf dump | status | quit
 
 Example:
@@ -44,6 +46,12 @@ class VstartShell:
         self.cluster.wait_all_up()
         self.rados = self.cluster.rados()
         self.mgr = self.cluster.start_mgr()
+        # observability modules (ref: vstart.sh enabling mgr modules):
+        # crash health, anonymized telemetry, windowed insights
+        self.mgr.start_crash()
+        self.mgr.start_telemetry()
+        self.mgr.start_insights()
+        self.mgr.observability_tick()
         # MDS ranks + standby pool (ref: vstart.sh MDS=N spawning +
         # standbys): ranks beacon to the mon, standbys wait for
         # promotion
@@ -175,6 +183,47 @@ class VstartShell:
             self.cluster.kill_osd(int(toks[1]))
             self._print(f"osd.{toks[1]} killed")
             return True
+        if cmd == "crash-osd":
+            # inject a fault: the OSD posts a crash report and dies
+            self.cluster.crash_osd(int(toks[1]))
+            self.mgr.observability_tick()
+            self._print(f"osd.{toks[1]} crashed (see `crash ls`)")
+            return True
+        if cmd == "crash":
+            verb = toks[1] if len(toks) > 1 else "ls"
+            c = {"prefix": f"crash {verb}"}
+            if verb in ("info", "archive"):
+                c["id"] = toks[2]
+            elif verb == "prune":
+                # an omitted keep-days must NOT default to 0 — that
+                # means "drop every archived report"
+                try:
+                    c["keep"] = float(toks[2])
+                except (IndexError, ValueError):
+                    self._print("crash prune wants <keep-days>"
+                                " (a number)")
+                    return True
+            _r, outs, outb = self.rados.mon_command(c)
+            self._print(outs if outb is None
+                        else json.dumps(outb, indent=1))
+            if verb.startswith("archive"):
+                self.mgr.observability_tick()   # clears RECENT_CRASH
+            return True
+        if cmd == "telemetry":
+            verb = toks[1] if len(toks) > 1 else "show"
+            self.mgr.observability_tick()       # fresh report
+            _r, outs, outb = self.rados.mon_command(
+                {"prefix": f"telemetry {verb}"})
+            self._print(outs if outb is None
+                        else json.dumps(outb, indent=1))
+            return True
+        if cmd == "insights":
+            self.mgr.observability_tick()
+            _r, outs, outb = self.rados.mon_command(
+                {"prefix": "insights"})
+            self._print(outs if outb is None
+                        else json.dumps(outb, indent=1))
+            return True
         if cmd == "revive-osd":
             self.cluster.revive_osd(int(toks[1]))
             self._print(f"osd.{toks[1]} revived")
@@ -190,6 +239,7 @@ class VstartShell:
                 # next round's grace check, else live peers race past
                 # the window and get falsely reported
                 time.sleep(0.1)
+            self.mgr.observability_tick()
             self._print(f"ticked; {self.rados.mon_command({'prefix': 'osd stat'})[1]}")
             return True
         if cmd == "perf" and toks[1:] == ["dump"]:

@@ -18,6 +18,11 @@
 # finding — the lint half of the ship gate (suppressions live in
 # .cephck-baseline.json, one justified reason per entry).
 # `bash scripts/check_green.sh --static` runs ONLY the static pass.
+#
+# Crash-capture smoke: scripts/crash_smoke.py spawns a daemon,
+# injects a raise, and asserts the report lands in the crash table
+# (and RECENT_CRASH raises/clears) — the observability half of the
+# gate, run before the suite on every full invocation.
 set -u -o pipefail
 
 cd "$(dirname "$0")/.."
@@ -58,11 +63,25 @@ while [ $# -gt 0 ]; do
             TARGETS+=("$1"); shift ;;
     esac
 done
+run_crash_smoke() {
+    echo "=== check_green: crash-capture smoke ==="
+    timeout -k 10 180 env JAX_PLATFORMS=cpu \
+        python scripts/crash_smoke.py
+    local rc=$?
+    if [ "$rc" -ne 0 ]; then
+        echo "check_green: RED (crash smoke rc=$rc — crash capture" \
+             "broken) — do not ship" >&2
+        return 1
+    fi
+    return 0
+}
+
 run_static || exit 1
 if [ "$STATIC_ONLY" -eq 1 ]; then
     echo "check_green: GREEN (static only)"
     exit 0
 fi
+run_crash_smoke || exit 1
 
 if [ "$REPEAT" -gt 1 ] && [ ${#TARGETS[@]} -eq 0 ]; then
     TARGETS=(tests/test_thrasher.py tests/test_thrash_ec.py \

@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""Crash-capture smoke — the check_green.sh observability step.
+
+Spawn a daemon, inject a raise, assert the report lands: boots a
+2-OSD MiniCluster, trips osd_debug_inject_crash_tick on osd.1, and
+asserts the crash table holds exactly one report with a real
+backtrace, that RECENT_CRASH is raised through the mgr crash module,
+and that `crash archive-all` clears it.  Exit 0 = the capture path
+works end to end; anything else = do not ship.
+"""
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    from ceph_tpu.testing import MiniCluster
+    c = MiniCluster(n_osd=2, threaded=True)
+    try:
+        c.wait_all_up()
+        r = c.rados()
+        mgr = c.start_mgr()
+        mgr.start_crash()
+        c.crash_osd(1)
+        crashes = []
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            _, _, crashes = r.mon_command({"prefix": "crash ls"})
+            if crashes:
+                break
+            time.sleep(0.05)
+        assert len(crashes) == 1, f"want 1 crash report, got {crashes}"
+        meta = crashes[0]
+        assert meta["entity_name"] == "osd.1", meta
+        assert any("heartbeat_tick" in ln for ln in meta["backtrace"]), \
+            "backtrace lacks the raising frame"
+        mgr.observability_tick()
+        _, _, health = r.mon_command({"prefix": "health"})
+        assert "RECENT_CRASH" in health["checks"], health
+        rc, outs, _ = r.mon_command({"prefix": "crash archive-all"})
+        assert rc == 0, outs
+        mgr.observability_tick()
+        _, _, health = r.mon_command({"prefix": "health"})
+        assert "RECENT_CRASH" not in health["checks"], health
+        print("crash_smoke: OK (1 report, RECENT_CRASH raised and "
+              "cleared)")
+        return 0
+    finally:
+        c.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,597 @@
+"""Observability subsystem: daemon crash capture -> mon crash table ->
+RECENT_CRASH health -> anonymized telemetry -> windowed insights
+(ref: src/pybind/mgr/crash/, telemetry/, insights/).
+
+Acceptance (ISSUE 4): killing an OSD with an injected fault produces a
+`crash ls` entry with a real backtrace, `ceph health` shows
+RECENT_CRASH, `crash archive-all` clears it, and `telemetry show`
+returns an anonymized report including the crash summary — with
+exactly ONE report per crash even when the spool and the live post
+both deliver it."""
+import io as iomod
+import json
+import os
+import time
+
+import pytest
+
+from ceph_tpu.common.crash import (CrashReporter, crash_meta,
+                                   sanitize_backtrace)
+from ceph_tpu.msg.messenger import LocalNetwork
+from ceph_tpu.mon.monitor import Monitor, build_initial
+from ceph_tpu.testing import MiniCluster
+
+
+def _boom():
+    raise ValueError("synthetic fault for crash capture")
+
+
+def _exc():
+    try:
+        _boom()
+    except ValueError as ex:
+        return ex
+
+
+# ------------------------------------------------------ capture library
+
+def test_crash_meta_fields():
+    meta = crash_meta("osd.7", _exc(), stamp=1_700_000_000.25)
+    assert meta["crash_id"].endswith("_osd.7")
+    assert meta["crash_id"].startswith(meta["timestamp"])
+    assert meta["entity_name"] == "osd.7"
+    assert meta["entity_type"] == "osd"
+    assert meta["exc_type"] == "ValueError"
+    assert "synthetic fault" in meta["exc_msg"]
+    # a REAL backtrace: the raising frame is in there
+    assert any("_boom" in ln for ln in meta["backtrace"])
+    assert meta["archived"] is None
+    assert meta["stamp"] == 1_700_000_000.25
+    assert "Z" in meta["timestamp"]
+
+
+def test_sanitize_backtrace_strips_paths():
+    meta = crash_meta("osd.1", _exc())
+    clean = sanitize_backtrace(meta["backtrace"])
+    assert any("test_crash_telemetry.py" in ln for ln in clean)
+    assert not any("/" in ln or "\\" in ln
+                   for ln in clean if 'File "' in ln), clean
+    # the final traceback line is the exception MESSAGE — OSError et
+    # al. embed the offending path there, and telemetry ships the
+    # whole backtrace: the dir prefix must go
+    try:
+        open("/var/lib/ceph-tpu-nope/osd.3/store")
+    except OSError as ex:
+        leaky = ex
+    clean = sanitize_backtrace(crash_meta("osd.3", leaky)["backtrace"])
+    assert not any("/var/lib" in ln for ln in clean), clean
+    assert any("'store'" in ln for ln in clean), clean
+
+
+def test_reporter_spool_drain_lifecycle(tmp_path):
+    posted = []
+    rep = CrashReporter("osd.3", crash_dir=str(tmp_path / "crash"),
+                        post=posted.append)
+    meta = rep.capture(_exc())
+    assert posted == [meta]
+    # spooled under <crash_dir>/<safe id>/meta.json
+    assert rep.spooled() == [meta]
+    spool_files = list((tmp_path / "crash").rglob("meta.json"))
+    assert len(spool_files) == 1
+    # next-boot drain re-posts; the file stays until the ack
+    assert rep.drain() == 1
+    assert len(posted) == 2
+    rep.mark_delivered(meta["crash_id"])
+    assert rep.spooled() == []
+    assert rep.drain() == 0
+
+
+def test_reporter_throttles_repeat_signature():
+    """A persistently failing survive-loop tick must not storm the
+    crash table: identical signatures inside the window are dropped."""
+    posted = []
+    rep = CrashReporter("osd.0", post=posted.append)
+    assert rep.capture(_exc())
+    assert rep.capture(_exc()) == {}
+    assert len(posted) == 1
+    # a DIFFERENT exception captures immediately
+    assert rep.capture(RuntimeError("other fault"))
+    assert len(posted) == 2
+
+
+# ----------------------------------------------------- mon crash table
+
+def make_mon():
+    net = LocalNetwork()
+    m, w = build_initial(4)
+    mon = Monitor(net, initial_map=m, initial_wrapper=w, threaded=False)
+    mon.init()
+    return mon
+
+
+def test_crash_service_dedup_archive_prune():
+    mon = make_mon()
+    meta = crash_meta("osd.2", _exc(), stamp=time.time())
+    for _ in range(2):   # spool+post double delivery
+        rc, outs, _ = mon.handle_command(
+            {"prefix": "crash post", "meta": meta})
+        assert rc == 0
+    rc, outs, crashes = mon.handle_command({"prefix": "crash ls"})
+    assert rc == 0 and len(crashes) == 1
+    assert crashes[0]["crash_id"] == meta["crash_id"]
+    rc, _, stat = mon.handle_command({"prefix": "crash stat"})
+    assert stat == {"total": 1, "new": 1}
+    # info round-trips the full meta
+    rc, _, info = mon.handle_command(
+        {"prefix": "crash info", "id": meta["crash_id"]})
+    assert rc == 0 and info["backtrace"] == meta["backtrace"]
+    rc, outs, _ = mon.handle_command(
+        {"prefix": "crash info", "id": "nope"})
+    assert rc == -2
+    # archive one -> ls-new empties, ls still shows it
+    rc, _, _ = mon.handle_command(
+        {"prefix": "crash archive", "id": meta["crash_id"]})
+    assert rc == 0
+    rc, _, new = mon.handle_command({"prefix": "crash ls-new"})
+    assert new == []
+    rc, _, crashes = mon.handle_command({"prefix": "crash ls"})
+    assert len(crashes) == 1 and crashes[0]["archived"]
+    # prune keep=0 days drops archived reports
+    rc, _, _ = mon.handle_command({"prefix": "crash prune", "keep": 0})
+    assert rc == 0
+    rc, _, crashes = mon.handle_command({"prefix": "crash ls"})
+    assert crashes == []
+    # malformed post is rejected
+    rc, outs, _ = mon.handle_command(
+        {"prefix": "crash post", "meta": {"crash_id": "x"}})
+    assert rc == -22 and "missing" in outs
+    mon.shutdown()
+
+
+def test_crash_table_survives_mon_restart():
+    """The table is a PaxosService: a revived mon still answers
+    `crash ls` (the cluster-log persistence property)."""
+    c = MiniCluster(n_osd=2, threaded=True)
+    try:
+        c.wait_all_up()
+        c.crash_osd(1)
+        r = c.rados()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if r.mon_command({"prefix": "crash ls"})[2]:
+                break
+            time.sleep(0.05)
+        store = c.mon.store
+        cid = r.mon_command({"prefix": "crash ls"})[2][0]["crash_id"]
+        mon2 = Monitor(LocalNetwork(), store=store, threaded=False)
+        mon2.init()
+        rc, _, crashes = mon2.handle_command({"prefix": "crash ls"})
+        assert rc == 0 and [m["crash_id"] for m in crashes] == [cid]
+        mon2.shutdown()
+    finally:
+        c.shutdown()
+
+
+# --------------------------------------------------------- e2e cluster
+
+def test_osd_crash_e2e_recent_crash_and_dedup(tmp_path):
+    """The acceptance path: OSD under IO + injected fault -> exactly
+    one crash report (live post + spool drain on revive), RECENT_CRASH
+    raised, archived away, telemetry carries the summary."""
+    crash_dir = str(tmp_path / "osd1-crash")
+    c = MiniCluster(n_osd=3, threaded=True)
+    try:
+        c.wait_all_up()
+        # osd.1 spools as well as posts
+        c.kill_osd(1)
+        c.start_osd(1, crash_dir=crash_dir)
+        c.wait_all_up()
+        r = c.rados()
+        r.pool_create("cp", pg_num=8)
+        io = r.open_ioctx("cp")
+        for i in range(8):
+            io.write_full(f"o{i}", b"x" * 64)
+        mgr = c.start_mgr()
+        mgr.start_crash()
+        mgr.start_telemetry()
+        mgr.observability_tick()
+        rc, _, health = r.mon_command({"prefix": "health"})
+        assert "RECENT_CRASH" not in health["checks"]
+
+        c.crash_osd(1)
+        assert 1 not in c.osds          # reaped like an aborted process
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            _, _, crashes = r.mon_command({"prefix": "crash ls"})
+            if crashes:
+                break
+            time.sleep(0.05)
+        assert len(crashes) == 1, crashes
+        meta = crashes[0]
+        assert meta["entity_name"] == "osd.1"
+        assert "injected crash" in meta["exc_msg"]
+        assert any("heartbeat_tick" in ln for ln in meta["backtrace"])
+        # the report was spooled before the post (the daemon died
+        # before the ack could retire it, or the ack already did —
+        # either way the revive below converges the lifecycle)
+        # RECENT_CRASH via the mgr module-health merge path
+        mgr.observability_tick()
+        rc, outs, health = r.mon_command({"prefix": "health"})
+        assert health["status"] == "HEALTH_WARN"
+        assert "RECENT_CRASH" in health["checks"], health
+        rc, _, detail = r.mon_command({"prefix": "health detail"})
+        assert any("osd.1 crashed" in d for d in
+                   detail["checks"]["RECENT_CRASH"]["detail"])
+
+        # revive with the SAME crash dir: any unacked spool copy
+        # drains on boot, the table dedups, and the ack retires the
+        # spool file — exactly one report, empty spool, either way
+        c.start_osd(1, crash_dir=crash_dir)
+        c.wait_all_up()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if not list(tmp_path.rglob("meta.json")):
+                break
+            time.sleep(0.05)
+        assert not list(tmp_path.rglob("meta.json")), \
+            "spool copy never retired by the ack"
+        _, _, crashes = r.mon_command({"prefix": "crash ls"})
+        assert len(crashes) == 1, "spool+post delivered a duplicate"
+
+        # telemetry report includes the crash summary, anonymized
+        mgr.observability_tick()
+        rc, outs, rep = r.mon_command({"prefix": "telemetry show"})
+        assert rc == 0, outs
+        assert rep["crash"]["summary"]["total"] == 1
+        assert rep["crash"]["reports"][0]["entity_type"] == "osd"
+
+        # archiving clears the health check on the next tick
+        rc, _, _ = r.mon_command({"prefix": "crash archive-all"})
+        assert rc == 0
+        mgr.observability_tick()
+        rc, _, health = r.mon_command({"prefix": "health"})
+        assert "RECENT_CRASH" not in health["checks"], health
+
+        # prometheus exposes the archive-state gauge
+        text = mgr.start_prometheus(port=0).collect()
+        assert 'ceph_crash_reports{status="archived"} 1' in text
+        assert 'ceph_crash_reports{status="new"} 0' in text
+        mgr.prometheus.shutdown()
+    finally:
+        c.shutdown()
+
+
+def test_quorum_mons_drain_crash_spool(tmp_path):
+    """A QUORUM member's spool drains once the election settles: the
+    leader commits its reports locally, a peon forwards them to the
+    leader and retires each spool file on the ack (standalone-only
+    drain left spools stranded forever on multi-mon deployments)."""
+    dirs = {r: str(tmp_path / f"mon{r}-crash") for r in (0, 2)}
+    for r in (0, 2):   # rank 0 wins the election; rank 2 stays a peon
+        rep = CrashReporter(f"mon.{r}", crash_dir=dirs[r])
+        rep.spool(crash_meta(f"mon.{r}", _exc(), stamp=time.time()))
+    c = MiniCluster(n_osd=2, n_mon=3, threaded=False,
+                    mon_crash_dirs=dirs)
+    try:
+        for _ in range(10):
+            c.pump()
+        assert c.mon.is_leader
+        rc, _, crashes = c.mon.handle_command({"prefix": "crash ls"})
+        assert rc == 0
+        assert sorted(m["entity_name"] for m in crashes) == \
+            ["mon.0", "mon.2"], crashes
+        assert not list(tmp_path.rglob("meta.json")), \
+            "spool copies never retired by the commit/ack"
+    finally:
+        c.shutdown()
+
+
+def test_mgr_module_exception_still_replies():
+    """A module handler that raises an UNEXPECTED exception must still
+    answer: without the reply the client spins out its 30s deadline
+    and the mon's _mgr_proxy entry for the tid leaks forever."""
+    c = MiniCluster(n_osd=2, threaded=True)
+    try:
+        c.wait_all_up()
+        r = c.rados()
+        mgr = c.start_mgr()
+        mgr.start_telemetry()
+        mgr.telemetry.handle_command = lambda cmd: (_ for _ in ()) \
+            .throw(AttributeError("broken module"))
+        t0 = time.monotonic()
+        rc, outs, _ = r.mon_command({"prefix": "telemetry show"})
+        assert rc == -5 and "broken module" in outs
+        assert time.monotonic() - t0 < 10.0
+        assert c.mon._mgr_proxy == {}, "proxy entry leaked"
+        # the other module still answers through the same proxy
+        ins = mgr.start_insights()
+        ins.tick(now=1.0)
+        assert r.mon_command({"prefix": "insights"})[0] == 0
+    finally:
+        c.shutdown()
+
+
+def test_mds_crash_spool_retired_on_ack(tmp_path):
+    """MDS crash posts carry real tids: the mon's ack retires the
+    spool copy (tid=0 fire-and-forget left spool dirs growing by one
+    per crash forever)."""
+    c = MiniCluster(n_osd=2, threaded=True)
+    try:
+        c.wait_all_up()
+        mds = c.start_mds(0, crash_dir=str(tmp_path / "mds-crash"))
+        mds.crash_reporter.capture(RuntimeError("mds fault"))
+        r = c.rados()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if not list(tmp_path.rglob("meta.json")):
+                break
+            time.sleep(0.05)
+        assert not list(tmp_path.rglob("meta.json")), \
+            "MDS spool copy never retired by the ack"
+        _, _, crashes = r.mon_command({"prefix": "crash ls"})
+        assert [m["entity_name"] for m in crashes] == ["mds.0"]
+    finally:
+        c.shutdown()
+
+
+def test_module_health_expires_after_mgr_death():
+    """Satellite bugfix: a dead mgr's last `mgr health report` must
+    not warn forever — entries are stamped and expire after
+    mon_mgr_health_grace (sim-clock driven)."""
+    c = MiniCluster(n_osd=2, threaded=True)
+    try:
+        c.wait_all_up()
+        r = c.rados()
+        c.tick(1000.0)               # enter the simulated clock domain
+        rc, _, _ = r.mon_command({
+            "prefix": "mgr health report",
+            "checks": {"FAKE_MODULE_WARN": {
+                "severity": "HEALTH_WARN", "summary": "module warn",
+                "detail": []}}})
+        assert rc == 0
+        rc, _, health = r.mon_command({"prefix": "health"})
+        assert "FAKE_MODULE_WARN" in health["checks"]
+        # inside the grace the check persists
+        c.tick(1030.0)
+        rc, _, health = r.mon_command({"prefix": "health"})
+        assert "FAKE_MODULE_WARN" in health["checks"]
+        # past mon_mgr_health_grace (60s) with no re-report: expired
+        c.tick(1100.0)
+        rc, _, health = r.mon_command({"prefix": "health"})
+        assert "FAKE_MODULE_WARN" not in health["checks"], health
+        # a live mgr re-reporting repopulates within one period
+        rc, _, _ = r.mon_command({
+            "prefix": "mgr health report",
+            "checks": {"FAKE_MODULE_WARN": {
+                "severity": "HEALTH_WARN", "summary": "module warn",
+                "detail": []}}})
+        rc, _, health = r.mon_command({"prefix": "health"})
+        assert "FAKE_MODULE_WARN" in health["checks"]
+    finally:
+        c.shutdown()
+
+
+def test_health_slices_merge_across_modules():
+    """set_health_checks: devicehealth and crash slices coexist in one
+    report instead of clobbering each other."""
+    c = MiniCluster(n_osd=2, threaded=True)
+    try:
+        c.wait_all_up()
+        r = c.rados()
+        mgr = c.start_mgr()
+        mgr.start_crash()
+        mgr.start_devicehealth()
+        # simulated clock so the 5s pg-stat report interval elapses
+        c.tick(10.0)
+        c.osds[0].store.media_errors = {"csum_errors": 3,
+                                        "read_errors": 0}
+        c.crash_osd(1, now=20.0)     # stat report + injected fault
+        time.sleep(0.2)
+        mgr.observability_tick()     # RECENT_CRASH slice
+        mgr.devicehealth_tick()      # DEVICE_HEALTH slice
+        rc, _, health = r.mon_command({"prefix": "health"})
+        assert "RECENT_CRASH" in health["checks"], health
+        assert "DEVICE_HEALTH" in health["checks"], health
+    finally:
+        c.shutdown()
+
+
+# ----------------------------------------------------------- telemetry
+
+def test_telemetry_anonymized_and_schema_stable():
+    c = MiniCluster(n_osd=4, threaded=True)
+    try:
+        c.wait_all_up()
+        r = c.rados()
+        r.pool_create("tp", pg_num=8)
+        mgr = c.start_mgr()
+        mgr.start_crash()
+        tm = mgr.start_telemetry()
+        c.crash_osd(2)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if r.mon_command({"prefix": "crash ls"})[2]:
+                break
+            time.sleep(0.05)
+        mgr.observability_tick()
+        rc, _, rep = r.mon_command({"prefix": "telemetry show"})
+        assert rc == 0
+        # stable JSON schema: two compiles agree on the key structure
+        rep2 = tm.compile_report()
+        assert sorted(rep) == sorted(rep2)
+        assert sorted(rep["basic"]) == sorted(rep2["basic"])
+        js = json.dumps(rep)
+        # anonymization contract: hashed id, no hostnames, no raw
+        # paths, no entity names, no pool names
+        import socket
+        host = socket.gethostname()
+        assert host not in js
+        assert "/" not in js.replace("\\/", ""), js
+        assert "osd.2" not in js and "tp" not in \
+            json.dumps(rep["basic"])
+        assert len(rep["cluster_id"]) == 32
+        assert rep["basic"]["osds"]["total"] == 4
+        assert rep["basic"]["pools"]["count"] == 1
+        assert rep["crash"]["reports"][0]["entity_type"] == "osd"
+        assert all('File "' not in ln or "/" not in ln
+                   for ln in rep["crash"]["reports"][0]["backtrace"])
+        # ident channel is OFF by default; enabling it adds names
+        rc, _, st = r.mon_command({"prefix": "telemetry status"})
+        assert st["channels"]["ident"] is False
+        rc, _, _ = r.mon_command({"prefix": "telemetry channel",
+                                  "name": "ident", "enabled": True})
+        assert rc == 0
+        mgr.observability_tick()
+        rc, _, rep = r.mon_command({"prefix": "telemetry show"})
+        assert rep["ident"]["mons"] == ["mon.0"]
+        # crash channel off removes the section
+        rc, _, _ = r.mon_command({"prefix": "telemetry channel",
+                                  "name": "crash", "enabled": False})
+        mgr.observability_tick()
+        rc, _, rep = r.mon_command({"prefix": "telemetry show"})
+        assert "crash" not in rep
+        # off gates show
+        rc, _, _ = r.mon_command({"prefix": "telemetry off"})
+        rc, outs, _ = r.mon_command({"prefix": "telemetry show"})
+        assert rc == -1 and "telemetry is off" in outs
+        rc, _, _ = r.mon_command({"prefix": "telemetry on"})
+        mgr.observability_tick()
+        assert r.mon_command({"prefix": "telemetry show"})[0] == 0
+    finally:
+        c.shutdown()
+
+
+def test_mgr_proxy_without_mgr_is_fast_eagain():
+    c = MiniCluster(n_osd=2, threaded=True)
+    try:
+        c.wait_all_up()
+        r = c.rados()
+        t0 = time.monotonic()
+        rc, outs, _ = r.mon_command({"prefix": "telemetry show"})
+        assert rc == -11 and "no active mgr" in outs
+        rc, outs, _ = r.mon_command({"prefix": "insights"})
+        assert rc == -11
+        # "fast" means the short mgr-register grace, not the client's
+        # full 30s-per-command EAGAIN retry deadline
+        assert time.monotonic() - t0 < 10.0
+        # a registered mgr without the module enabled: ENOENT, not hang
+        c.start_mgr()
+        rc, outs, _ = r.mon_command({"prefix": "telemetry show"})
+        assert rc == -2 and "not enabled" in outs
+    finally:
+        c.shutdown()
+
+
+# ------------------------------------------------------------ insights
+
+def test_insights_window_math():
+    c = MiniCluster(n_osd=2, threaded=True)
+    try:
+        c.wait_all_up()
+        r = c.rados()
+        mgr = c.start_mgr()
+        mgr.start_crash()
+        ins = mgr.start_insights(window=100.0)
+        ins.tick(now=1000.0)
+        r.pool_create("ip", pg_num=8)   # osdmap epoch bump
+        ins.tick(now=1050.0)
+        ins.tick(now=1200.0)
+        rep = ins.report(now=1200.0)
+        # only samples in (1100, 1200] count
+        assert rep["health"]["samples"] == 1
+        assert rep["window_seconds"] == 100.0
+        rep_all = ins.report(now=1050.0)
+        assert rep_all["health"]["samples"] == 2
+        # epoch delta spans the pool create within the window
+        assert rep_all["osdmap"]["epoch_delta"] >= 1
+        assert rep_all["osdmap"]["last_epoch"] > \
+            rep_all["osdmap"]["first_epoch"]
+        # prune-health drops old samples
+        assert ins.prune_health(1100.0) == 2
+        assert ins.report(now=1050.0)["health"]["samples"] == 0
+        # crashes ride the report, windowed by their stamp
+        c.crash_osd(1)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if r.mon_command({"prefix": "crash ls"})[2]:
+                break
+            time.sleep(0.05)
+        mgr.observability_tick()
+        now = time.time()
+        rep = ins.report(now=now)
+        assert [cr["entity_name"] for cr in rep["crashes"]] == ["osd.1"]
+        assert ins.report(now=now + 1000.0)["crashes"] == []
+    finally:
+        c.shutdown()
+
+
+# ----------------------------------------------------------------- CLI
+
+def test_vstart_observability_verbs():
+    """The vstart shell tour of the new subsystem: crash-osd ->
+    crash ls -> health warns -> archive clears -> telemetry/insights
+    render."""
+    from ceph_tpu.tools.vstart import VstartShell
+    out = iomod.StringIO()
+    sh = VstartShell(n_osd=3, osds_per_host=1, out=out)
+    try:
+        for line in ["crash ls", "crash-osd 2", "crash ls", "health",
+                     "crash archive-all", "health", "telemetry show",
+                     "insights", "crash prune 0", "crash ls"]:
+            assert sh.run_line(line)
+        text = out.getvalue()
+        assert "osd.2 crashed" in text
+        assert '"entity_name": "osd.2"' in text
+        assert "RECENT_CRASH" in text                 # pre-archive
+        assert "HEALTH_OK" in text                    # post-archive
+        assert '"cluster_id"' in text                 # telemetry
+        assert '"window_seconds"' in text             # insights
+    finally:
+        sh.close()
+
+
+def test_observability_cli_verbs():
+    c = MiniCluster(n_osd=3, threaded=True)
+    try:
+        c.wait_all_up()
+        r = c.rados()
+        mgr = c.start_mgr()
+        mgr.start_crash()
+        mgr.start_telemetry()
+        mgr.start_insights()
+        c.crash_osd(1)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if r.mon_command({"prefix": "crash ls"})[2]:
+                break
+            time.sleep(0.05)
+        mgr.observability_tick()
+        from ceph_tpu.tools.rados_cli import main
+
+        def run(*argv):
+            out = iomod.StringIO()
+            rc = main(list(argv), rados=r, out=out)
+            return rc, out.getvalue()
+
+        rc, out = run("crash", "ls")
+        assert rc == 0 and "osd.1" in out
+        cid = json.loads(out)[0]["crash_id"]
+        rc, out = run("crash", "info", cid)
+        assert rc == 0 and "backtrace" in out
+        assert run("crash", "info")[0] == 1          # id required
+        rc, out = run("telemetry", "status")
+        assert rc == 0 and '"enabled": true' in out
+        rc, out = run("telemetry")                    # default: show
+        assert rc == 0 and json.loads(out)["cluster_id"]
+        rc, out = run("insights")
+        assert rc == 0 and "window_seconds" in out
+        rc, out = run("crash", "archive", cid)
+        assert rc == 0
+        rc, out = run("crash", "ls-new")
+        assert rc == 0 and json.loads(out) == []
+        rc, out = run("crash", "prune", "0")
+        assert rc == 0
+        rc, out = run("crash", "ls")
+        assert json.loads(out) == []
+    finally:
+        c.shutdown()

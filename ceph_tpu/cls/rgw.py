@@ -22,34 +22,95 @@ Methods return the data objects orphaned by the operation in
 "removed" — the gateway deletes those AFTER the index commit, the
 same order the reference uses (index transaction first, data gc
 second) so a crash leaves garbage, never a dangling index entry.
+
+**Datalog (multisite)**: when the caller passes `log={"trace": [...]}`
+every mutating method also appends a change record to the shard's
+datalog — omap keys `.dl.<seq>` on the SAME index object, queued in
+the SAME mutation batch as the index write, so the log entry and the
+index entry commit as one transaction (ref: cls_rgw's bilog —
+bucket_complete_op writes the bi log entry inside the index op; the
+separate-object data log of rgw_datalog.cc would lose the atomicity
+that PR 2's persist_log bug taught us to demand).  `trace` lists the
+zones the mutation has already been applied at — sync agents skip
+entries whose trace contains their own zone, which is what stops
+replication loops.
 """
 from __future__ import annotations
 
+import calendar
 import json
 import time
 
-from . import CLS_METHOD_WR, ClsError, cls_method
+from . import CLS_METHOD_RD, CLS_METHOD_WR, ClsError, cls_method
 
 #: the one timestamp format for index entries — shared with the
 #: gateway (rgw/gateway.py imports these; a format drift between
-#: writer and OSD-side trimmer would misage every version)
-MTIME_FMT = "%Y-%m-%dT%H:%M:%S.000Z"
+#: writer and OSD-side trimmer would misage every version).  now_str
+#: appends real milliseconds (fixed width, so the string comparisons
+#: the conflict rules use stay lexicographic): at 1s resolution every
+#: same-second pair of writes was a cross-zone ordering tie.
+MTIME_FMT = "%Y-%m-%dT%H:%M:%S"
 
 
 def now_str() -> str:
-    return time.strftime(MTIME_FMT, time.gmtime())
+    t = time.time()
+    return time.strftime(MTIME_FMT, time.gmtime(t)) + \
+        ".%03dZ" % int(t % 1 * 1000)
 
 
 def parse_mtime(s: str) -> float:
     try:
-        return time.mktime(time.strptime(s, MTIME_FMT)) - time.timezone
+        base, _, frac = s.partition(".")
+        # timegm, not mktime: the stamp is UTC — local interpretation
+        # shifted every parse by the DST hour
+        return calendar.timegm(time.strptime(base, MTIME_FMT)) + \
+            int(frac.rstrip("Z") or 0) / 1000.0
     except ValueError:
         return 0.0
 
 
-def _load(ctx, key: str) -> dict | None:
-    raw = ctx.omap_get().get(key)
-    return json.loads(raw) if raw else None
+#: datalog key namespace inside the index shard omap.  Listings and
+#: emptiness checks filter these the way they filter `.upload.` keys.
+DL_PREFIX = ".dl."
+#: omap key holding the shard's datalog head sequence
+DL_META = ".dlmeta"
+
+
+def dl_key(seq: int) -> str:
+    """Zero-padded so lexicographic omap order == sequence order."""
+    return f"{DL_PREFIX}{seq:016d}"
+
+
+def is_dl_key(key: str) -> bool:
+    return key.startswith(DL_PREFIX) or key == DL_META
+
+
+def _dl_head(raw: dict) -> int:
+    meta = raw.get(DL_META)
+    return json.loads(meta)["seq"] if meta else 0
+
+
+def _dl_append(ctx, d: dict, op: str, key: str,
+               raw: dict | None = None, **fields) -> None:
+    """Queue a datalog record in the SAME mutation batch as the index
+    write (the whole point: a crash commits both or neither).  No-op
+    unless the caller opted in with d["log"].  `raw` reuses the
+    caller's omap snapshot — queued mutations never touch it, and a
+    second full-shard fetch per write is the hot path's biggest
+    cost."""
+    log = d.get("log")
+    if not log:
+        return
+    seq = _dl_head(ctx.omap_get() if raw is None else raw) + 1
+    ent = {"seq": seq, "key": key, "op": op,
+           "trace": list(log.get("trace") or ()), **fields}
+    ctx.omap_set({DL_META: json.dumps({"seq": seq}).encode(),
+                  dl_key(seq): json.dumps(ent).encode()})
+
+
+def _load(ctx, key: str, raw: dict | None = None) -> dict | None:
+    v = (ctx.omap_get() if raw is None else raw).get(key)
+    return json.loads(v) if v else None
 
 
 def _fold(ent: dict | None, plain_obj: str | None) -> list:
@@ -92,13 +153,16 @@ def obj_store(ctx, d):
     reader (or a version stack that appeared meanwhile) still needs.
     """
     key, mode = d["key"], d.get("mode", "plain")
-    ent = _load(ctx, key)
+    raw = ctx.omap_get()
+    ent = _load(ctx, key, raw)
     if mode == "plain":
         if ent is not None and ent.get("versions") is not None:
             # versioning got enabled (and a version committed) after
             # the caller read the bucket meta — a plain overwrite
             # would erase that stack.  Caller retries as versioned.
             raise ClsError("ECANCELED", key)
+        d = dict(d, mtime=_bump_mtime(
+            ent["mtime"] if ent is not None else None, d["mtime"]))
         removed = []
         old = (ent.get("obj") or d.get("plain_obj")) \
             if ent is not None else None
@@ -107,8 +171,13 @@ def obj_store(ctx, d):
         ctx.omap_set({key: json.dumps(
             {"size": d["size"], "etag": d["etag"],
              "mtime": d["mtime"], "obj": d["obj"]}).encode()})
+        _dl_append(ctx, d, "put", key, raw=raw, mode="plain",
+                   vid=None, size=d["size"], etag=d["etag"],
+                   mtime=d["mtime"])
         return {"vid": None, "removed": removed}
     versions = _fold(ent, d.get("plain_obj"))
+    d = dict(d, mtime=_bump_mtime(
+        versions[0]["mtime"] if versions else None, d["mtime"]))
     rec = {"vid": d["vid"], "size": d["size"], "etag": d["etag"],
            "mtime": d["mtime"], "dm": False, "obj": d["obj"]}
     removed = []
@@ -121,8 +190,11 @@ def obj_store(ctx, d):
         rec["vid"] = "null"
     elif mode != "enabled":
         raise ClsError("EINVAL", f"mode {mode}")
-    versions.insert(0, rec)
+    _insert_version(versions, rec)
     _store(ctx, key, versions)
+    _dl_append(ctx, d, "put", key, raw=raw, mode=mode,
+               vid=rec["vid"], size=d["size"], etag=d["etag"],
+               mtime=d["mtime"])
     return {"vid": rec["vid"], "removed": removed}
 
 
@@ -141,7 +213,8 @@ def obj_delete_marker(ctx, d):
     mtime moves.
     """
     key = d["key"]
-    versions = _fold(_load(ctx, key), d.get("plain_obj"))
+    raw = ctx.omap_get()
+    versions = _fold(_load(ctx, key, raw), d.get("plain_obj"))
     if "if_head_vid" in d:
         head = versions[0]["vid"] if versions else None
         if head != d["if_head_vid"]:
@@ -150,15 +223,21 @@ def obj_delete_marker(ctx, d):
         head_mtime = versions[0]["mtime"] if versions else None
         if head_mtime != d["if_mtime"]:
             raise ClsError("ECANCELED", key)
+    d = dict(d, mtime=_bump_mtime(
+        versions[0]["mtime"] if versions else None, d["mtime"]))
     removed = []
     if d.get("replace_null"):
         for v in versions:
             if v["vid"] == "null" and not v.get("dm") and v.get("obj"):
                 removed.append(v["obj"])
         versions = [v for v in versions if v["vid"] != "null"]
-    versions.insert(0, {"vid": d["vid"], "size": 0, "etag": "",
-                        "mtime": d["mtime"], "dm": True, "obj": None})
+    _insert_version(versions, {"vid": d["vid"], "size": 0, "etag": "",
+                               "mtime": d["mtime"], "dm": True,
+                               "obj": None})
     _store(ctx, key, versions)
+    _dl_append(ctx, d, "dm", key, raw=raw, vid=d["vid"],
+               mtime=d["mtime"],
+               replace_null=bool(d.get("replace_null")))
     return {"vid": d["vid"], "removed": removed}
 
 
@@ -168,7 +247,8 @@ def obj_delete_version(ctx, d):
     CLS_RGW_OP_UNLINK_INSTANCE).  ENOENT when the vid isn't in the
     stack; an emptied stack removes the index entry."""
     key = d["key"]
-    ent = _load(ctx, key)
+    raw = ctx.omap_get()
+    ent = _load(ctx, key, raw)
     if ent is None:
         raise ClsError("ENOENT", key)
     versions = _fold(ent, d.get("plain_obj"))
@@ -179,6 +259,7 @@ def obj_delete_version(ctx, d):
                if v["vid"] == d["vid"] and v.get("obj")
                and not v.get("dm")]
     _store(ctx, key, keep)
+    _dl_append(ctx, d, "rmver", key, raw=raw, vid=d["vid"])
     return {"removed": removed}
 
 
@@ -189,7 +270,8 @@ def obj_delete_plain(ctx, d):
     stack — the caller re-runs the versioned delete path.
     if_mtime: optional guard for lifecycle (see obj_delete_marker)."""
     key = d["key"]
-    ent = _load(ctx, key)
+    raw = ctx.omap_get()
+    ent = _load(ctx, key, raw)
     if ent is None:
         return {"removed": []}
     if ent.get("versions") is not None:
@@ -198,7 +280,211 @@ def obj_delete_plain(ctx, d):
         raise ClsError("ECANCELED", key)
     ctx.omap_rmkeys([key])
     dead = ent.get("obj") or d.get("plain_obj")
+    # bump past the entry's (possibly future-bumped) mtime like the
+    # write paths: a wall-clock stamp could be OLDER than the head a
+    # same-millisecond put left behind, and the replica's newer-wins
+    # rule would then keep an object the origin dropped
+    _dl_append(ctx, d, "del", key, raw=raw,
+               mtime=_bump_mtime(ent.get("mtime"),
+                                 d.get("mtime") or now_str()))
     return {"removed": [dead] if dead else []}
+
+
+def _bump_mtime(existing: str | None, mtime: str) -> str:
+    """Strictly-after the key's current head: sequential same-key
+    writes must order by mtime even inside one millisecond, or the
+    tie falls to the vid/etag break and read-your-writes fails on the
+    origin.  Only LOCAL write paths bump — sync applies preserve the
+    origin's stamps."""
+    if existing is None or mtime > existing:
+        return mtime
+    base, _, frac = existing.partition(".")
+    ms = int(frac.rstrip("Z") or 0) + 1
+    if ms < 1000:
+        return f"{base}.{ms:03d}Z"
+    t = calendar.timegm(time.strptime(base, MTIME_FMT)) + 1
+    return time.strftime(MTIME_FMT, time.gmtime(t)) + ".000Z"
+
+
+def _insert_version(versions: list, rec: dict) -> None:
+    """Place rec by (mtime, vid), newest first — ONE ordering rule
+    for local writes AND sync applies.  If the origin inserted by
+    arrival while replicas ordered by (mtime, vid), two writes in the
+    same millisecond would stack differently per zone; sequential
+    writes carry distinct millisecond mtimes, so the vid tie-break
+    only ever decides genuinely concurrent pairs."""
+    at = len(versions)
+    for i, v in enumerate(versions):
+        if (v["mtime"], v.get("vid") or "") <= \
+                (rec["mtime"], rec.get("vid") or ""):
+            at = i              # before the first not-newer version
+            break
+    versions.insert(at, rec)
+
+
+def _newer(a_mtime: str, a_etag: str, b_mtime: str, b_etag: str) -> bool:
+    """Deterministic cross-zone ordering: later mtime wins; equal
+    mtimes (1s format resolution) tie-break on etag so BOTH zones pick
+    the same winner regardless of arrival order."""
+    return (a_mtime, a_etag) > (b_mtime, b_etag)
+
+
+@cls_method("rgw", "obj_sync_apply", CLS_METHOD_WR)
+def obj_sync_apply(ctx, d):
+    """Apply one replicated mutation from a peer zone's datalog —
+    idempotently and deterministically (ref: rgw_data_sync.cc's
+    RGWObjFetchCR + the squash map; versioned-epoch conflict rules of
+    rgw multisite).
+
+    d: {key, op, vid, size, etag, mtime, mode, obj, log:{trace}}
+    where "obj" names the LOCAL staged data object for puts (written
+    by the caller before this call; unlinked staging is the caller's
+    to gc when not applied).
+
+    Rules (the convergence contract tests/test_rgw_multisite.py
+    thrashes):
+      * put/plain: newest (mtime, etag) wins; identical pair = the
+        entry was already applied -> skip.
+      * put/versioned + dm: dedupe by vid (a replay after a marker
+        rewind must not duplicate a version); insert before the first
+        version that is not newer, so same-second replays keep datalog
+        order and stacks converge.
+      * del: wins ties (on the origin the delete happened after the
+        put it removed); absent entry = already applied.
+      * rmver: remove if present; absent = already applied.
+
+    Applied mutations re-log to the LOCAL datalog with the caller's
+    extended trace so further zones can pull them; skipped ones do not
+    (nothing changed).  Returns {"applied", "vid", "removed"}.
+    """
+    key, op = d["key"], d["op"]
+    raw = ctx.omap_get()
+    ent = _load(ctx, key, raw)
+    removed: list[str] = []
+
+    def skip():
+        return {"applied": False, "vid": d.get("vid"),
+                "removed": removed}
+
+    if op == "put" and d.get("mode", "plain") == "plain":
+        if ent is not None and ent.get("versions") is not None:
+            return skip()       # local entry grew a version stack
+        if ent is not None and not _newer(d["mtime"], d["etag"],
+                                          ent["mtime"], ent["etag"]):
+            return skip()       # local state is newer (or identical)
+        if ent is not None and ent.get("obj"):
+            removed.append(ent["obj"])
+        ctx.omap_set({key: json.dumps(
+            {"size": d["size"], "etag": d["etag"],
+             "mtime": d["mtime"], "obj": d["obj"]}).encode()})
+        _dl_append(ctx, d, "put", key, raw=raw, mode="plain",
+                   vid=None, size=d["size"], etag=d["etag"],
+                   mtime=d["mtime"])
+        return {"applied": True, "vid": None, "removed": removed}
+
+    if op == "del":
+        if ent is None or ent.get("versions") is not None:
+            return skip()
+        if ent["mtime"] > d["mtime"]:
+            return skip()       # a local write outran the delete.
+            # Ties go to the delete: a same-second put-then-delete on
+            # the origin replays in datalog order, and the delete must
+            # win or the replica keeps an object the origin dropped.
+        ctx.omap_rmkeys([key])
+        if ent.get("obj"):
+            removed.append(ent["obj"])
+        _dl_append(ctx, d, "del", key, raw=raw, mtime=d["mtime"])
+        return {"applied": True, "vid": None, "removed": removed}
+
+    versions = _fold(ent, None)
+
+    if op == "rmver":
+        keep = [v for v in versions if v["vid"] != d["vid"]]
+        if len(keep) == len(versions):
+            return skip()
+        removed.extend(v["obj"] for v in versions
+                       if v["vid"] == d["vid"] and v.get("obj")
+                       and not v.get("dm"))
+        _store(ctx, key, keep)
+        _dl_append(ctx, d, "rmver", key, raw=raw, vid=d["vid"])
+        return {"applied": True, "vid": d["vid"], "removed": removed}
+
+    if op not in ("put", "dm"):
+        raise ClsError("EINVAL", f"sync op {op}")
+
+    is_dm = op == "dm"
+    for v in versions:
+        if v["vid"] == d["vid"] and bool(v.get("dm")) == is_dm \
+                and d["vid"] != "null":
+            # replayed entry: version already here.  "null" is exempt —
+            # every suspended-mode overwrite reuses vid "null", so
+            # presence alone cannot tell a replay from a genuinely
+            # newer overwrite; the rank rule below decides those.
+            return skip()
+    if d["vid"] == "null" or (not is_dm and
+                              d.get("mode") == "suspended"):
+        # null-version semantics: at most one 'null' in the stack.
+        # Winner by (mtime, dm, etag): at equal mtimes the marker
+        # outranks the put (same tie rule as plain 'del' — on the
+        # origin the delete happened after the put), so both zones
+        # settle identically regardless of arrival order, and an
+        # identical replay compares equal and skips.
+        olds = [v for v in versions if v["vid"] == "null"]
+        rank = (d["mtime"], is_dm, "" if is_dm else d.get("etag", ""))
+        if olds and (olds[0]["mtime"], bool(olds[0].get("dm")),
+                     olds[0].get("etag", "")) >= rank:
+            return skip()       # local null is newer (or identical)
+        removed.extend(v["obj"] for v in olds
+                       if v.get("obj") and not v.get("dm"))
+        versions = [v for v in versions if v["vid"] != "null"]
+    rec = {"vid": d["vid"], "size": 0 if is_dm else d["size"],
+           "etag": "" if is_dm else d["etag"], "mtime": d["mtime"],
+           "dm": is_dm, "obj": None if is_dm else d["obj"]}
+    _insert_version(versions, rec)
+    _store(ctx, key, versions)
+    if is_dm:
+        _dl_append(ctx, d, "dm", key, raw=raw, vid=d["vid"],
+                   mtime=d["mtime"])
+    else:
+        _dl_append(ctx, d, "put", key, raw=raw,
+                   mode=d.get("mode", "enabled"), vid=d["vid"],
+                   size=d["size"], etag=d["etag"], mtime=d["mtime"])
+    return {"applied": True, "vid": d["vid"], "removed": removed}
+
+
+@cls_method("rgw", "dl_list", CLS_METHOD_RD)
+def dl_list(ctx, d):
+    """List datalog entries with seq > marker (cursor-based incremental
+    read; ref: rgw datalog list_entries + its marker).  Returns the
+    shard head too so callers can measure lag with one call."""
+    raw = ctx.omap_get()
+    lo = dl_key(int(d.get("marker", 0)))
+    limit = int(d.get("max", 64))
+    ents = []
+    # filter to datalog keys BEFORE sorting and stop at the limit:
+    # this runs per shard per peer on every sync poll, and the shard's
+    # omap is dominated by index entries, not log records
+    for k in sorted(k for k in raw if k.startswith(DL_PREFIX)):
+        if k <= lo:
+            continue
+        if len(ents) >= limit:
+            break               # max=0 head probes return NO entries
+        ents.append(json.loads(raw[k]))
+    return {"entries": ents, "head": _dl_head(raw)}
+
+
+@cls_method("rgw", "dl_trim", CLS_METHOD_WR)
+def dl_trim(ctx, d):
+    """Drop datalog entries with seq <= upto (ref: rgw datalog trim —
+    driven by an admin once every peer's marker has passed them; the
+    head counter survives so sequences never regress)."""
+    raw = ctx.omap_get()
+    upto = dl_key(int(d["upto"]))
+    dead = [k for k in raw
+            if k.startswith(DL_PREFIX) and k <= upto]
+    if dead:
+        ctx.omap_rmkeys(dead)
+    return {"trimmed": len(dead)}
 
 
 @cls_method("rgw", "obj_trim_noncurrent", CLS_METHOD_WR)

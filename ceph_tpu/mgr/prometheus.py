@@ -158,6 +158,26 @@ class PrometheusExporter:
             b.sample("ceph_crash_reports", len(crashes) - new,
                      {"status": "archived"})
 
+        # rgw multisite sync lag: every in-process sync agent
+        # self-registers (ceph_tpu.rgw.multisite._AGENTS) so the
+        # scrape sees zone replication state without a daemon-graph
+        # dependency — lag_entries returning to 0 IS "caught up"
+        from ..rgw.multisite import sync_status_all
+        rows = sync_status_all()
+        if rows:
+            b.metric("ceph_rgw_sync_lag_entries",
+                     "datalog entries the zone has not yet applied "
+                     "from its source zone")
+            b.metric("ceph_rgw_sync_behind_shards",
+                     "datalog shards with unapplied entries per "
+                     "(zone, source)")
+            for row in rows:
+                lbl = {"zone": row["zone"], "source": row["source"]}
+                b.sample("ceph_rgw_sync_lag_entries",
+                         row["lag_entries"], lbl)
+                b.sample("ceph_rgw_sync_behind_shards",
+                         row["behind_shards"], lbl)
+
         rc, _, counts = self._cmd({"prefix": "log counts"})
         if rc == 0:
             b.metric("ceph_cluster_log_messages",

@@ -7,13 +7,25 @@ spec).  Here S3 access keys ARE cephx entities: access_key_id is the
 entity name (e.g. "client.s3user"), the secret key is its keyring
 secret — one credential store for the whole cluster, the way radosgw
 users live in the cluster's auth database.
+
+`KeystoneEngine` is the second, config-gated engine: OpenStack-token
+validation against an external keystone endpoint (ref:
+src/rgw/rgw_auth_keystone.cc TokenEngine) — a gateway constructed
+with `keystone_url` accepts `X-Auth-Token` requests, everyone else
+never takes the branch.
 """
 from __future__ import annotations
 
+import calendar as _calendar
 import hashlib
 import hmac
+import json as _json
 import time as _time
+import urllib.error
+import urllib.request
 from urllib.parse import urlparse
+
+from ..common.lockdep import make_lock
 
 ALGORITHM = "AWS4-HMAC-SHA256"
 UNSIGNED = "UNSIGNED-PAYLOAD"
@@ -44,8 +56,12 @@ def signing_key(secret: str, date: str, region: str,
 def _parse_amz_date(s: str) -> float:
     """X-Amz-Date/x-amz-date -> epoch seconds; SigV4Error on junk."""
     try:
-        return _time.mktime(_time.strptime(s, "%Y%m%dT%H%M%SZ")) \
-            - _time.timezone
+        # timegm, not mktime-timezone: the stamp is UTC, and mktime
+        # applies DST — every signed request (including all peer sync
+        # traffic) would be RequestTimeTooSkewed by 3600s for half
+        # the year on a DST-observing host
+        return float(_calendar.timegm(
+            _time.strptime(s, "%Y%m%dT%H%M%SZ")))
     except ValueError:
         raise SigV4Error("AccessDenied", "malformed amz date")
 
@@ -263,3 +279,124 @@ def sign_request(method: str, path: str, headers: dict, body: bytes,
         f"{ALGORITHM} Credential={access_key}/{scope}, "
         f"SignedHeaders={';'.join(signed)}, Signature={sig}")
     return out
+
+
+# -- keystone (ref: src/rgw/rgw_auth_keystone.cc TokenEngine) ----------
+
+class KeystoneError(Exception):
+    """Token rejection carrying the HTTP status + S3 error code the
+    gateway should surface (401 InvalidToken for bad tokens, 403
+    AccessDenied — the EACCES analogue — for expired ones, 503 when
+    keystone itself is unreachable)."""
+
+    def __init__(self, status: int, code: str, msg: str = ""):
+        self.status = status
+        self.code = code
+        self.msg = msg or code
+        super().__init__(self.msg)
+
+
+def _keystone_expiry(raw) -> float | None:
+    """expires_at -> epoch seconds.  The stub keystone in tests speaks
+    epoch floats; real keystone speaks ISO8601 Z — accept both."""
+    if raw is None:
+        return None
+    try:
+        return float(raw)
+    except (TypeError, ValueError):
+        pass
+    try:
+        iso = str(raw).rstrip("Z").split(".")[0]
+        return float(_calendar.timegm(_time.strptime(
+            iso, "%Y-%m-%dT%H:%M:%S")))
+    except ValueError:
+        raise KeystoneError(503, "ServiceUnavailable",
+                            f"keystone sent unparsable expiry {raw!r}")
+
+
+class KeystoneEngine:
+    """Validate OpenStack tokens against a keystone endpoint.
+
+    The reference asks keystone `GET /v3/auth/tokens` with the
+    candidate in `X-Subject-Token` and caches accepted tokens
+    (rgw_keystone_token_cache_size) so every S3 request does not pay a
+    round trip; expiry is enforced locally on each use — a cached
+    token that has since expired is EACCES, not a free pass.
+    """
+
+    #: accepted tokens are revalidated against keystone after this —
+    #: the cache bounds latency, the expires_at bound stays exact
+    CACHE_TTL_S = 10.0
+    #: distinct tokens cached (ref: rgw_keystone_token_cache_size);
+    #: short-lived per-session tokens would otherwise grow the dict
+    #: for the gateway's lifetime
+    CACHE_MAX = 1024
+
+    def __init__(self, url: str, timeout: float = 5.0):
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+        #: token -> (revalidate_after_monotonic, user, expires_epoch)
+        self._cache: dict[str, tuple[float, str, float | None]] = {}
+        self._lock = make_lock("rgw.keystone")
+
+    def _check_expiry(self, expires: float | None,
+                      token: str | None = None) -> None:
+        if expires is not None and _time.time() >= expires:
+            if token is not None:
+                with self._lock:
+                    self._cache.pop(token, None)    # dead weight: an
+                    # expired token can never validate again
+            raise KeystoneError(403, "AccessDenied",
+                                "token expired (EACCES)")
+
+    def validate(self, token: str) -> str:
+        """-> the token's user name, or KeystoneError."""
+        if not token:
+            raise KeystoneError(401, "InvalidToken",
+                                "missing X-Auth-Token")
+        now = _time.monotonic()
+        with self._lock:
+            hit = self._cache.get(token)
+        if hit and now < hit[0]:
+            self._check_expiry(hit[2], token)
+            return hit[1]
+        req = urllib.request.Request(
+            f"{self.url}/v3/auth/tokens", method="GET",
+            headers={"X-Subject-Token": token})
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=self.timeout) as resp:
+                body = _json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            if e.code in (401, 404):
+                raise KeystoneError(401, "InvalidToken",
+                                    "keystone rejected the token")
+            raise KeystoneError(503, "ServiceUnavailable",
+                                f"keystone answered {e.code}")
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            raise KeystoneError(503, "ServiceUnavailable",
+                                f"keystone unreachable: {e}")
+        except ValueError:
+            raise KeystoneError(503, "ServiceUnavailable",
+                                "keystone sent bad JSON")
+        tok = body.get("token") or {}
+        user = (tok.get("user") or {}).get("name") or ""
+        if not user:
+            raise KeystoneError(401, "InvalidToken",
+                                "token has no user")
+        expires = _keystone_expiry(tok.get("expires_at"))
+        self._check_expiry(expires)
+        wall = _time.time()
+        with self._lock:
+            if len(self._cache) >= self.CACHE_MAX:
+                # reap expired + revalidation-stale entries first;
+                # fall back to dropping the oldest insertion
+                self._cache = {
+                    t: v for t, v in self._cache.items()
+                    if now < v[0] and
+                    (v[2] is None or wall < v[2])}
+                while len(self._cache) >= self.CACHE_MAX:
+                    self._cache.pop(next(iter(self._cache)))
+            self._cache[token] = (now + self.CACHE_TTL_S, user,
+                                  expires)
+        return user

@@ -36,7 +36,19 @@ surface:
 signature whose access key is a cephx entity (ref: src/rgw/
 rgw_auth_s3.cc) — either the Authorization header or the query-string
 presigned-URL form (X-Amz-Signature, ref: rgw_auth_s3.h); without a
-keyring the gateway is anonymous (test mode).
+keyring the gateway is anonymous (test mode).  With `keystone_url`
+set, S3 requests may instead carry an OpenStack token in
+`X-Auth-Token`, validated against the keystone endpoint (ref:
+rgw_auth_keystone.cc; config-gated the same way).
+
+**Multisite** (ref: src/rgw/rgw_data_sync.cc; model in
+rgw/multisite.py): a gateway constructed with `zone=` becomes a zone
+member — every index mutation also appends a datalog record in the
+same OSD transaction, a `SyncAgent` thread pulls peer zones' datalogs
+and applies them idempotently, `/admin/*` REST ops expose the period,
+bucket index dumps, datalog cursors and sync status, and replicated
+writes carry an `x-rgw-zone-trace` so they neither loop nor re-fire
+bucket notifications.
 """
 from __future__ import annotations
 
@@ -46,6 +58,8 @@ import threading
 
 from ..common.lockdep import make_lock
 import time
+import urllib.error
+import urllib.request
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, quote, unquote, urlparse
@@ -53,12 +67,17 @@ from xml.etree import ElementTree as ET
 from xml.sax.saxutils import escape
 
 from ..client import RadosError, WriteOp
-from .auth import (SigV4Error, verify as sigv4_verify,
+from .auth import (KeystoneEngine, KeystoneError, SigV4Error,
+                   sign_request,
+                   verify as sigv4_verify,
                    verify_presigned as presigned_verify)
-from ..cls.rgw import now_str, parse_mtime
-from .notify import (EventPusher, TopicStore, _queue_obj,
-                     event_matches, make_event, notification_xml,
-                     parse_notification_xml)
+from ..cls.rgw import DL_META, DL_PREFIX, now_str, parse_mtime
+from .datalog import DataLog, is_dl_key, shard_obj, shard_of_key
+from .notify import (EventPusher, TopicStore, ZONE_TRACE_HEADER,
+                     _queue_obj, event_matches, format_zone_trace,
+                     make_event, notification_xml,
+                     parse_notification_xml, parse_zone_trace,
+                     suppress_for_trace)
 from .sts import AKID_PREFIX, STSEngine, STSError
 
 #: omap object holding the bucket registry (name -> creation meta)
@@ -67,16 +86,11 @@ BUCKETS_OBJ = ".rgw.buckets.list"
 DEFAULT_INDEX_SHARDS = 8
 
 
-def _shard_of(key: str, nshards: int) -> int:
-    """Stable key -> shard placement (ref: rgw_shard_id — hash mod)."""
-    if nshards <= 1:
-        return 0
-    h = hashlib.md5(key.encode()).digest()
-    return int.from_bytes(h[:4], "big") % nshards
+_shard_of = shard_of_key
 
 
 def _index_obj(bucket: str, shard: int = 0) -> str:
-    return f".rgw.index.{bucket}.{shard}"
+    return shard_obj(bucket, shard)
 
 
 def _data_obj(bucket: str, key: str) -> str:
@@ -96,13 +110,32 @@ class RGWGateway:
 
     def __init__(self, rados, pool: str = "rgw",
                  host: str = "127.0.0.1", port: int = 0,
-                 keyring=None, index_shards: int = DEFAULT_INDEX_SHARDS):
+                 keyring=None, index_shards: int = DEFAULT_INDEX_SHARDS,
+                 zone: str | None = None, sync_interval: float = 0.1,
+                 system_key: tuple[str, str] | None = None,
+                 keystone_url: str | None = None):
         self.rados = rados
+        self.pool = pool
         #: cephx keyring doubling as the S3 credential store
         #: (ref: radosgw users in the cluster auth database); None =
         #: anonymous gateway
         self.keyring = keyring
         self.index_shards = index_shards
+        #: per-request zone trace (the parsed x-rgw-zone-trace header,
+        #: one slot per handler thread): which zones this mutation has
+        #: already applied at — drives datalog trace extension, the
+        #: notification guard, and forward-loop suppression
+        self._reqctx = threading.local()
+        #: multisite identity: the zone this gateway serves (None =
+        #: standalone gateway, no datalog, no sync agent)
+        self.zone = zone
+        #: (access_key, secret) this gateway signs sync/forwarded
+        #: requests to peers with (ref: the multisite system user)
+        self.system_key = system_key
+        #: config-gated keystone token validation (satellite of the
+        #: multisite PR; ref: rgw_auth_keystone.cc)
+        self.keystone = KeystoneEngine(keystone_url) \
+            if keystone_url else None
         try:
             rados.pool_lookup(pool)
         except RadosError:
@@ -132,7 +165,36 @@ class RGWGateway:
                         # The boundary matters: bucket "swift" with
                         # key "v1.txt" is an S3 path, not Swift.
                         return gw._run_swift(self, method, u)
-                    if gw.keyring is not None:
+                    ks_token = self.headers.get("x-auth-token")
+                    authz = self.headers.get("Authorization") or ""
+                    if gw.keystone is not None and \
+                            gw.keyring is None and not ks_token and \
+                            gw.system_key is not None and \
+                            f"Credential={gw.system_key[0]}/" in authz:
+                        # peer sync/forward traffic signs SigV4 as the
+                        # multisite system user and has no token to
+                        # offer: a keystone-only zone member must
+                        # verify that signature, not fail it closed —
+                        # or the zone never receives sync traffic
+                        try:
+                            self.s3_user = sigv4_verify(
+                                method, self.path, self.headers, body,
+                                lambda n, _k=gw.system_key:
+                                    _k[1] if n == _k[0] else None)
+                        except SigV4Error as e:
+                            raise S3Error(403, e.code, str(e))
+                    elif gw.keystone is not None and \
+                            (ks_token or gw.keyring is None):
+                        # keystone path: token present, or tokens are
+                        # the ONLY configured auth — a missing token
+                        # then fails closed (config-gated: gateways
+                        # without keystone_url never take this branch)
+                        try:
+                            self.s3_user = gw.keystone.validate(
+                                ks_token or "")
+                        except KeystoneError as e:
+                            raise S3Error(e.status, e.code, e.msg)
+                    elif gw.keyring is not None:
                         def lookup(name, _h=self.headers):
                             # STS-prefixed access keys resolve their
                             # signing secret from the temp-credential
@@ -160,7 +222,22 @@ class RGWGateway:
                             raise S3Error(403, e.code, str(e))
                         except STSError as e:
                             raise S3Error(e.status, e.code, e.msg)
-                    gw._route(self, method)
+                    raw_trace = self.headers.get(ZONE_TRACE_HEADER, "")
+                    if raw_trace and (gw.keyring is not None or
+                                      gw.keystone is not None) and \
+                            (gw.system_key is None or
+                             getattr(self, "s3_user", None) !=
+                             gw.system_key[0]):
+                        # only the multisite system user speaks for
+                        # other zones on a secured gateway: a client
+                        # spoofing the trace would suppress its own
+                        # write's replication + notifications
+                        raw_trace = ""
+                    gw._reqctx.trace = parse_zone_trace(raw_trace)
+                    try:
+                        gw._route(self, method)
+                    finally:
+                        gw._reqctx.trace = []
                 except S3Error as e:
                     body = (f'<?xml version="1.0"?><Error><Code>'
                             f"{e.code}</Code><Message>{escape(e.msg)}"
@@ -205,6 +282,14 @@ class RGWGateway:
         self.topics = TopicStore(self.io)
         self.pusher = EventPusher(self.io, self.topics)
         self.sts = STSEngine(self.io)
+        self.datalog = DataLog(self.io)
+        #: period view + sync agent, only for zone members
+        self.multisite = None
+        self.sync = None
+        if zone is not None:
+            from .multisite import MultisiteState, SyncAgent
+            self.multisite = MultisiteState(self.io, zone)
+            self.sync = SyncAgent(self, interval=sync_interval)
         from .swift import SwiftFrontend
         self.swift = SwiftFrontend(self)
         #: deferred GC of data objects orphaned by index commits —
@@ -264,8 +349,14 @@ class RGWGateway:
         self.pusher.start()
         threading.Thread(target=self._gc_loop, name="rgw-gc",
                          daemon=True).start()
+        if self.sync is not None:
+            self.sync.start()
 
     def shutdown(self) -> None:
+        if self.sync is not None:
+            # agent first: its in-flight batch is abandoned before the
+            # marker persists — the restart replays it (idempotent)
+            self.sync.stop()
         self.pusher.stop()
         self._gc_stop.set()
         self.httpd.shutdown()
@@ -276,12 +367,22 @@ class RGWGateway:
     # -- notifications (ref: src/rgw/rgw_pubsub.cc) ----------------------
     def _notify_event(self, bucket: str, key: str, event: str,
                       size: int, etag: str, vid: str | None = None,
-                      bmeta: dict | None = None) -> None:
+                      bmeta: dict | None = None,
+                      trace: list | None = None) -> None:
         """Publish an event to every topic whose bucket config
         matches.  The append goes through cls queue.enqueue so the
         OSD assigns the sequence — concurrent gateways publishing to
         one topic keep a single total order (ref: rgw_notify.cc
-        persistent notifications over cls_2pc_queue)."""
+        persistent notifications over cls_2pc_queue).
+
+        A write carrying a zone trace was replicated here (sync apply
+        or a forwarded metadata op): the origin zone already notified,
+        so the replica must NOT re-fire (ref: rgw_notify.cc skipping
+        system requests) — the x-rgw-zone-trace-aware guard."""
+        if trace is None:
+            trace = self._request_trace()
+        if suppress_for_trace(trace):
+            return
         if bmeta is None:
             bmeta = self._buckets().get(bucket) or {}
         cfgs = bmeta.get("notifications") or []
@@ -301,9 +402,20 @@ class RGWGateway:
                 pass            # lost event beats failed client op
 
     # -- helpers ---------------------------------------------------------
-    def _buckets(self) -> dict[str, dict]:
+    def _request_trace(self) -> list[str]:
+        """Zones the current request's mutation has already applied at
+        ([] outside a handler thread / on a direct client write)."""
+        return list(getattr(self._reqctx, "trace", ()) or ())
+
+    def _buckets_raw(self) -> dict[str, dict]:
+        """Registry incl. deletion tombstones ({"deleted": mtime}) —
+        the sync surface.  Client-facing paths use _buckets()."""
         vals, _ = self.io.get_omap_vals(BUCKETS_OBJ)
         return {k: json.loads(v) for k, v in vals.items()}
+
+    def _buckets(self) -> dict[str, dict]:
+        return {k: v for k, v in self._buckets_raw().items()
+                if "deleted" not in v}
 
     def _require_bucket(self, bucket: str) -> dict:
         b = self._buckets().get(bucket)
@@ -327,6 +439,9 @@ class RGWGateway:
             except RadosError:
                 continue
             for k, v in vals.items():
+                if is_dl_key(k):
+                    continue    # datalog records share the omap but
+                    # are not index entries (multisite change feed)
                 out[k] = json.loads(v)
         return out
 
@@ -335,8 +450,14 @@ class RGWGateway:
         if nshards is None:
             nshards = self._nshards(bucket)
         shard = _shard_of(key, nshards)
-        vals = self.io.get_omap_vals_by_keys(
-            _index_obj(bucket, shard), [key])
+        try:
+            vals = self.io.get_omap_vals_by_keys(
+                _index_obj(bucket, shard), [key])
+        except RadosError as e:
+            if e.errno_name == "ENOENT":
+                return None     # shard object never written: the key
+                # cannot have an entry (same contract as _index)
+            raise
         return json.loads(vals[key]) if key in vals else None
 
     @staticmethod
@@ -371,6 +492,11 @@ class RGWGateway:
         parts = unquote(u.path).lstrip("/").split("/", 1)
         bucket = parts[0]
         key = parts[1] if len(parts) > 1 else ""
+        if bucket == "admin":
+            # reserved admin/sync surface (ref: rgw's /admin REST
+            # resources — the bucket namespace likewise loses the
+            # name to the control plane)
+            return self._admin_op(h, method, key, q)
         if not bucket:
             if q.get("Action") in ("AssumeRole", "CreateRole",
                                    "DeleteRole", "ListRoles"):
@@ -408,9 +534,18 @@ class RGWGateway:
         if "notification" in q:
             return self._notification_op(h, method, bucket)
         if method == "PUT":
-            self._create_bucket(bucket)
-            return self._respond(h, 200,
-                                 headers={"Location": f"/{bucket}"})
+            fwd = self._forward_to_master(h, "PUT", f"/{quote(bucket)}")
+            # adopt the master's created stamp: per-zone stamps would
+            # make the generation guards (sync_reset_bucket) unable to
+            # recognize the SAME incarnation across zones
+            self._create_bucket(
+                bucket,
+                created=fwd[1].get("x-rgw-created") if fwd else None)
+            return self._respond(h, 200, headers={
+                "Location": f"/{bucket}",
+                "x-rgw-created":
+                    self._buckets_raw().get(bucket, {})
+                        .get("created", "")})
         self._require_bucket(bucket)
         if method in ("GET", "HEAD"):
             if method == "HEAD":
@@ -421,29 +556,50 @@ class RGWGateway:
         if method == "DELETE":
             if self._index(bucket):
                 raise S3Error(409, "BucketNotEmpty", bucket)
+            # master first: once it drops the bucket from its
+            # registry, sync stops resurrecting it here (zones beyond
+            # the two involved keep theirs — deletion propagation to
+            # third zones is an open follow-up)
+            self._forward_to_master(h, "DELETE", f"/{quote(bucket)}")
             self._delete_bucket(bucket)
             return self._respond(h, 204)
         raise S3Error(405, "MethodNotAllowed", method)
 
-    def _create_bucket(self, bucket: str) -> bool:
+    def _create_bucket(self, bucket: str,
+                       created: str | None = None) -> bool:
         """Shared by the S3 and Swift frontends — ONE place defines
         bucket meta and index layout.  Returns False when the bucket
         already existed (idempotent re-create must NOT rebuild the
-        meta: that would silently wipe versioning/lifecycle state)."""
+        meta: that would silently wipe versioning/lifecycle state).
+        `created` adopts the metadata master's stamp on a forwarded
+        create — every zone must agree on the incarnation stamp."""
         if bucket in self._buckets():
             return False
-        meta = json.dumps({"created": self._now_str(),
+        meta = json.dumps({"created": created or self._now_str(),
                            "shards": self.index_shards}).encode()
         self.io.operate(BUCKETS_OBJ, WriteOp().set_omap({bucket: meta}))
         for shard in range(self.index_shards):
             self.io.create(_index_obj(bucket, shard))
         return True
 
-    def _delete_bucket(self, bucket: str) -> None:
+    def _delete_bucket(self, bucket: str,
+                       deleted_at: str | None = None,
+                       tombstone: bool = True) -> None:
         """Emptiness is the caller's check (protocols differ on the
-        error shape)."""
+        error shape).  Zone members leave a registry tombstone (the
+        origin's deletion time, so created-vs-deleted comparisons
+        propagate) — removing the key outright made any peer's next
+        listing resurrect the bucket.  tombstone=False drops the key
+        anyway (sync_reset_bucket: the new incarnation's created
+        stamp predates any deletion time we could write)."""
         nshards = self._nshards(bucket)
-        self.io.remove_omap_keys(BUCKETS_OBJ, [bucket])
+        if self.zone is not None and tombstone:
+            self.io.operate(BUCKETS_OBJ, WriteOp().set_omap(
+                {bucket: json.dumps(
+                    {"deleted": deleted_at or self._now_str()}
+                ).encode()}))
+        else:
+            self.io.remove_omap_keys(BUCKETS_OBJ, [bucket])
         for shard in range(nshards):
             try:
                 self.io.remove(_index_obj(bucket, shard))
@@ -470,6 +626,12 @@ class RGWGateway:
         if status not in ("Enabled", "Suspended"):
             raise S3Error(400, "IllegalVersioningConfigurationException",
                           str(status))
+        # bucket config is master-owned metadata: relay so the change
+        # radiates to every zone instead of being reverted by the next
+        # sync round's master-copy adoption
+        self._forward_to_master(h, "PUT",
+                                f"/{quote(bucket)}?versioning",
+                                self._read_body(h))
         meta["versioning"] = status
         self._update_bucket_meta(bucket, meta)
         self._respond(h, 200)
@@ -536,6 +698,8 @@ class RGWGateway:
                 '<?xml version="1.0"?><LifecycleConfiguration>'
                 f"{''.join(ents)}</LifecycleConfiguration>").encode())
         if method == "DELETE":
+            self._forward_to_master(h, "DELETE",
+                                    f"/{quote(bucket)}?lifecycle")
             meta.pop("lifecycle", None)
             self._update_bucket_meta(bucket, meta)
             return self._respond(h, 204)
@@ -571,6 +735,9 @@ class RGWGateway:
                 raise S3Error(400, "MalformedXML",
                               "rule needs an expiration")
             rules.append(r)
+        self._forward_to_master(h, "PUT",
+                                f"/{quote(bucket)}?lifecycle",
+                                self._read_body(h))
         meta["lifecycle"] = rules
         self._update_bucket_meta(bucket, meta)
         self._respond(h, 200)
@@ -692,6 +859,8 @@ class RGWGateway:
             return self._respond(h, 200, notification_xml(
                 meta.get("notifications") or []))
         if method == "DELETE":
+            self._forward_to_master(h, "DELETE",
+                                    f"/{quote(bucket)}?notification")
             meta.pop("notifications", None)
             self._update_bucket_meta(bucket, meta)
             return self._respond(h, 204)
@@ -705,9 +874,291 @@ class RGWGateway:
             if self.topics.get(cfg["topic"]) is None:
                 raise S3Error(400, "InvalidArgument",
                               f"no such topic {cfg['topic']}")
+        self._forward_to_master(h, "PUT",
+                                f"/{quote(bucket)}?notification",
+                                self._read_body(h))
         meta["notifications"] = cfgs
         self._update_bucket_meta(bucket, meta)
         self._respond(h, 200)
+
+    # -- multisite (ref: rgw_data_sync.cc; model in multisite.py) -------
+    def shard_of(self, bucket: str, key: str) -> int:
+        return _shard_of(key, self._nshards(bucket))
+
+    def peer_request(self, endpoint: str, method: str, path: str,
+                     body: bytes | None = None,
+                     headers: dict | None = None,
+                     timeout: float = 10.0):
+        """HTTP to a peer zone's gateway -> (status, headers, body).
+        Signed with the multisite system user's key when one is
+        configured (ref: the system user's SigV4 on every sync/forward
+        request) so secured peers accept it through the normal auth
+        gate."""
+        url = endpoint.rstrip("/") + path
+        hdrs = dict(headers or {})
+        if self.system_key is not None:
+            u = urlparse(url)
+            hdrs.setdefault("host", u.netloc)
+            signed_path = u.path + (f"?{u.query}" if u.query else "")
+            hdrs = sign_request(method, signed_path, hdrs, body or b"",
+                                *self.system_key)
+        req = urllib.request.Request(url, data=body, method=method,
+                                     headers=hdrs)
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+
+    def _forward_to_master(self, h, method: str, path: str,
+                           body: bytes = b""):
+        """Metadata ops are master-owned (ref: rgw's forward_request_
+        to_master): a secondary relays the op to the master zone with
+        its zone in the trace — the master will not forward it back
+        (trace non-empty) and will not re-fire notifications.  Returns
+        the master's (status, headers, body) reply, or None when no
+        forward applies (standalone gateway / already the master /
+        replicated request)."""
+        if self.multisite is None or self.multisite.is_master():
+            return None
+        if self._request_trace():
+            return None         # forwarded/replicated op: terminal hop
+        endpoint = self.multisite.master_endpoint()
+        if not endpoint:
+            return None
+        try:
+            return self.peer_request(
+                endpoint, method, path, body or None,
+                headers={ZONE_TRACE_HEADER:
+                         format_zone_trace([self.zone])})
+        except urllib.error.HTTPError as e:
+            # the master answered and refused: relay its real verdict —
+            # a 409 BucketNotEmpty on a forwarded bucket DELETE is a
+            # permanent S3 error, not a retryable "master unreachable"
+            code, msg = "InternalError", f"metadata master: HTTP {e.code}"
+            try:
+                root = ET.fromstring(e.read())
+                code = root.findtext("Code") or code
+                msg = root.findtext("Message") or msg
+            except ET.ParseError:
+                pass
+            raise S3Error(e.code, code, msg)
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            raise S3Error(503, "ServiceUnavailable",
+                          f"metadata master unreachable: {e}")
+
+    def _admin_op(self, h, method: str, op: str, q: dict) -> None:
+        """The /admin/* control surface the sync agent and the CLI
+        speak: period (GET/adopt), bucket registry + index dumps,
+        datalog cursor reads, sync status (ref: rgw's RESTful admin
+        resources + the data-log REST ops in rgw_rest_log.cc).  On a
+        secured gateway only the multisite system user may speak it —
+        any tenant could otherwise forge a period adopt or dump
+        another tenant's bucket index (same gate as the zone-trace
+        header)."""
+        if (self.keyring is not None or self.keystone is not None) \
+                and (self.system_key is None or
+                     getattr(h, "s3_user", None) != self.system_key[0]):
+            raise S3Error(403, "AccessDenied",
+                          "admin surface is system-user only")
+        def respond_json(obj, status: int = 200):
+            self._respond(h, status, json.dumps(obj).encode(),
+                          "application/json")
+
+        if op == "period":
+            if self.multisite is None:
+                raise S3Error(404, "NoSuchKey", "not a zone member")
+            if method == "GET":
+                return respond_json(self.multisite.admin.period_get())
+            if method == "POST":
+                # period push (ref: RGWPeriod push to peers /
+                # `radosgw-admin period pull`): adopt if newer
+                try:
+                    period = json.loads(self._read_body(h))
+                except ValueError:
+                    raise S3Error(400, "InvalidArgument", "bad JSON")
+                adopted = self.multisite.admin.period_adopt(period)
+                if adopted:
+                    self.multisite.refresh(force=True)
+                return respond_json(
+                    {"adopted": adopted,
+                     "epoch": self.multisite.epoch})
+            raise S3Error(405, "MethodNotAllowed", method)
+        if op == "buckets" and method == "GET":
+            # raw: peers need the deletion tombstones too
+            return respond_json(self._buckets_raw())
+        if op == "bucket" and method == "GET":
+            name = q.get("name", "")
+            if name not in self._buckets():
+                raise S3Error(404, "NoSuchBucket", name)
+            # in-flight multipart bookkeeping (.upload.*) shares the
+            # index omap but is not object state — a peer's full sync
+            # must see objects only
+            return respond_json(
+                {k: v for k, v in self._index(name).items()
+                 if not k.startswith(".upload.")})
+        if op == "log" and method == "POST":
+            try:
+                d = json.loads(self._read_body(h))
+                markers = {int(s): int(m)
+                           for s, m in d.get("markers", {}).items()}
+            except (ValueError, AttributeError):
+                raise S3Error(400, "InvalidArgument", "bad JSON")
+            bucket = d.get("bucket", "")
+            if bucket not in self._buckets():
+                raise S3Error(404, "NoSuchBucket", bucket)
+            batch = int(d.get("max", 64))
+            shards = {}
+            for s, marker in markers.items():
+                entries, head = self.datalog.list(bucket, s, marker,
+                                                  batch)
+                shards[str(s)] = {"entries": entries, "head": head}
+            return respond_json({"shards": shards})
+        if op == "sync-status" and method == "GET":
+            if self.sync is None:
+                raise S3Error(404, "NoSuchKey", "not a zone member")
+            return respond_json(self.sync.status())
+        raise S3Error(404, "NoSuchKey", f"admin/{op}")
+
+    def sync_ensure_bucket(self, bucket: str, meta: dict,
+                           from_master: bool = False,
+                           registry: dict | None = None) -> None:
+        """Make the peer's bucket exist here with the peer's shard
+        layout; config fields (versioning/lifecycle/notifications)
+        follow the metadata master's copy — metadata ops are
+        master-owned, so only the master's view overwrites ours.
+        `registry` is the caller's one-read-per-round snapshot of
+        _buckets_raw() (the sync agent calls this for every peer
+        bucket every tick — N fresh registry fetches per round
+        otherwise)."""
+        cur = (registry if registry is not None
+               else self._buckets_raw()).get(bucket)
+        if cur is not None and "deleted" in cur:
+            if meta.get("created", "") > cur["deleted"]:
+                cur = None      # recreated since our tombstone
+            else:
+                return          # we know it was deleted; the peer's
+                # live copy is the stale side
+        if cur is None:
+            rec = {"created": meta.get("created", self._now_str()),
+                   "shards": int(meta.get("shards",
+                                          self.index_shards))}
+            for fld in ("versioning", "lifecycle", "notifications"):
+                if fld in meta:
+                    rec[fld] = meta[fld]
+            self._update_bucket_meta(bucket, rec)
+            for shard in range(rec["shards"]):
+                try:
+                    self.io.create(_index_obj(bucket, shard))
+                except RadosError:
+                    pass
+            return
+        if not from_master:
+            return
+        changed = False
+        for fld in ("versioning", "lifecycle", "notifications"):
+            if meta.get(fld) != cur.get(fld):
+                if fld in meta:
+                    cur[fld] = meta[fld]
+                else:
+                    cur.pop(fld, None)
+                changed = True
+        if changed:
+            self._update_bucket_meta(bucket, cur)
+
+    def sync_drop_bucket(self, bucket: str, meta: dict,
+                         registry: dict | None = None) -> bool:
+        """Apply a peer's bucket-deletion tombstone: drop the local
+        bucket — including any objects our copy still holds.  The
+        origin could only delete an EMPTY bucket, and deleting it
+        destroyed its index shards and their datalogs, so the final
+        object deletes can never replicate: waiting for them would
+        wedge a lagging replica forever while reporting caught up.
+        The converged state IS empty — discard and gc.  Returns True
+        when the local registry reflects the deletion."""
+        cur = (registry if registry is not None
+               else self._buckets_raw()).get(bucket)
+        if cur is None or "deleted" in cur:
+            return True
+        if cur.get("created", "") > meta.get("deleted", ""):
+            return False        # recreated since: the tombstone is
+            # the stale side
+        objs = self._bucket_data_objs(bucket)
+        self._delete_bucket(bucket, deleted_at=meta.get("deleted"))
+        if objs:
+            self._remove_objs(objs, defer=True)
+        return True
+
+    def _bucket_data_objs(self, bucket: str) -> list[str]:
+        """Every live data object the bucket's index references — the
+        gc list when a whole local copy is discarded (tombstone drop,
+        incarnation reset)."""
+        objs = []
+        for ent in self._index(bucket).values():
+            if ent.get("versions") is not None:
+                objs += [v["obj"] for v in ent["versions"]
+                         if v.get("obj") and not v.get("dm")]
+            elif ent.get("obj"):
+                objs.append(ent["obj"])
+        return objs
+
+    def sync_reset_bucket(self, bucket: str, meta: dict,
+                          registry: dict | None = None) -> None:
+        """The peer's bucket is a NEW incarnation (its created stamp
+        changed while we held the old copy: a delete + recreate we
+        slept through).  The old incarnation's datalog died with its
+        bucket, so its object deletes can never replicate — our stale
+        objects would be served and listed here forever while deleted
+        cluster-wide.  Same resolution as sync_drop_bucket: discard
+        the old copy, the caller's full sync rebuilds from the new
+        incarnation's listing.  No-op when our copy already IS the
+        new incarnation (its creation propagated here normally)."""
+        reg = registry if registry is not None else self._buckets_raw()
+        cur = reg.get(bucket)
+        if cur is None or "deleted" in cur or \
+                cur.get("created", "") == meta.get("created", ""):
+            return
+        objs = self._bucket_data_objs(bucket)
+        self._delete_bucket(bucket, tombstone=False)
+        reg.pop(bucket, None)
+        if objs:
+            self._remove_objs(objs, defer=True)
+
+    def sync_apply(self, bucket: str, ent: dict, data: bytes | None,
+                   src: str, nshards: int | None = None) -> bool:
+        """Apply one replicated datalog entry: stage the bytes (puts),
+        then run the idempotent obj_sync_apply index transaction with
+        the trace extended by the source + this zone — the re-logged
+        entry lets further zones pull the change without looping.
+        Returns whether local state changed.  `nshards` (the local
+        layout) saves a per-entry registry fetch on catch-up."""
+        key = ent["key"]
+        trace = list(ent.get("trace") or ())
+        for z in (src, self.zone):
+            if z and z not in trace:
+                trace.append(z)
+        mode = ent.get("mode", "plain")
+        obj = None
+        obj_unique = False
+        if ent["op"] == "put":
+            # same staging discipline as _store_object: fresh object,
+            # linked (or dropped) by the index transaction's verdict
+            gen = uuid.uuid4().hex
+            if not ent.get("vid"):
+                obj, obj_unique = f"{bucket}/{key}#{gen}", True
+            elif ent["vid"] == "null":
+                obj, obj_unique = f"{bucket}/{key}@null.{gen}", True
+            else:
+                # deterministic name: a replay restages the SAME
+                # object an earlier apply linked — never gc it on skip
+                obj = f"{bucket}/{key}@{ent['vid']}"
+            self.io.write_full(obj, data or b"")
+        out = self._index_exec(bucket, key, "obj_sync_apply", {
+            "op": ent["op"], "vid": ent.get("vid"),
+            "size": ent.get("size", 0), "etag": ent.get("etag", ""),
+            "mtime": ent.get("mtime", ""), "mode": mode, "obj": obj,
+            "log": {"trace": trace}}, nshards=nshards)
+        if not out.get("applied") and obj_unique:
+            # never linked, no reader can hold it: collect now
+            self._remove_objs([obj], defer=False)
+        return bool(out.get("applied"))
 
     @staticmethod
     def _parse_mtime(s: str) -> float:
@@ -825,9 +1276,22 @@ class RGWGateway:
             f"<IsTruncated>{str(truncated).lower()}</IsTruncated>"
             f"{nxt}{ents}</ListBucketResult>").encode())
 
+    #: index-omap namespaces the gateway owns — a client object with
+    #: one of these names would be parsed as bookkeeping (a PUT named
+    #: `.dlmeta` wedges the shard's datalog head)
+    RESERVED_KEY_PREFIXES = (DL_PREFIX, DL_META, ".upload.", ".part.")
+
     # -- object level ----------------------------------------------------
     def _object_op(self, h, method: str, bucket: str, key: str,
                    q: dict) -> None:
+        if key.startswith(self.RESERVED_KEY_PREFIXES):
+            if method in ("PUT", "POST", "DELETE"):
+                raise S3Error(400, "InvalidArgument",
+                              f"reserved key namespace: {key}")
+            # reads: the bookkeeping record is not an object — serving
+            # it would crash on the missing etag/size fields (500/
+            # connection reset instead of a clean miss)
+            raise S3Error(404, "NoSuchKey", key)
         bmeta = self._require_bucket(bucket)
         nshards = int(bmeta.get("shards", 1))
         if method == "POST" and "uploads" in q:
@@ -992,6 +1456,13 @@ class RGWGateway:
         gateway-local _vlock which could not protect two processes."""
         if nshards is None:
             nshards = self._nshards(bucket)
+        if self.zone is not None and "log" not in indata:
+            # zone member: every index mutation also appends its
+            # datalog record — in the SAME cls transaction.  The trace
+            # is the request's (forwarded/replicated writes carry one)
+            # extended with this zone.
+            indata = dict(indata, log={
+                "trace": self._request_trace() + [self.zone]})
         iobj = _index_obj(bucket, _shard_of(key, nshards))
         out = self.io.exec(iobj, "rgw", method,
                            dict(indata, key=key)) or {}
@@ -1073,6 +1544,10 @@ class RGWGateway:
         if "/" not in src:
             raise S3Error(400, "InvalidArgument", src)
         s_bucket, s_key = src.split("/", 1)
+        if s_key.startswith(self.RESERVED_KEY_PREFIXES):
+            # bookkeeping records are not copyable objects (serving
+            # one would crash on its missing etag/size fields)
+            raise S3Error(404, "NoSuchKey", s_key)
         self._require_bucket(s_bucket)
         s_meta = self._index_entry(s_bucket, s_key)
         if s_meta is None:

@@ -43,6 +43,31 @@ def _queue_obj(topic: str) -> str:
     return f".rgw.queue.{topic}"
 
 
+#: writes replicated between zones carry the zones they already
+#: applied at (comma-separated) in this header / datalog field —
+#: both the loop guard and the notification guard key off it
+#: (ref: rgw's RGW_SYS_PARAM_PREFIX zone trace; rgw_notify.cc skips
+#: publishing for system/replication requests)
+ZONE_TRACE_HEADER = "x-rgw-zone-trace"
+
+
+def parse_zone_trace(value: str) -> list[str]:
+    """Header value -> zone list ('' -> [])."""
+    return [z for z in (value or "").split(",") if z.strip()]
+
+
+def format_zone_trace(trace) -> str:
+    return ",".join(trace or ())
+
+
+def suppress_for_trace(trace) -> bool:
+    """True when the mutation was applied by sync / forwarded from
+    another zone: the ORIGIN zone already fired the bucket
+    notification — re-firing on every replica would hand consumers
+    one event per zone per write."""
+    return bool(trace)
+
+
 def event_matches(cfg: dict, event: str, key: str) -> bool:
     """S3 event-name matching incl. trailing-* wildcard + prefix and
     suffix filters (ref: rgw_pubsub.cc match(); S3 supports

@@ -224,6 +224,13 @@ class SwiftFrontend:
                    q: dict) -> None:
         gw = self.gw
         from .gateway import S3Error
+        if obj.startswith(gw.RESERVED_KEY_PREFIXES):
+            # same guard as the S3 path: these names are index
+            # bookkeeping, not objects (a PUT named .dlmeta wedges
+            # the shard's datalog head; reads crash on the record's
+            # missing etag/size)
+            raise SwiftError(400 if method in ("PUT", "POST", "DELETE")
+                             else 404, obj)
         bmeta = gw._buckets().get(container)
         if bmeta is None:
             raise SwiftError(404, container)
@@ -280,6 +287,8 @@ class SwiftFrontend:
     def _read_object(self, container: str, obj: str) -> bytes:
         from .gateway import S3Error
         gw = self.gw
+        if obj.startswith(gw.RESERVED_KEY_PREFIXES):
+            raise SwiftError(404, f"{container}/{obj}")
         if container not in gw._buckets():
             raise SwiftError(404, container)
         ent = gw._index_entry(container, obj)

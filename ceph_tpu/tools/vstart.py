@@ -242,6 +242,8 @@ class VstartShell:
             self.mgr.observability_tick()
             self._print(f"ticked; {self.rados.mon_command({'prefix': 'osd stat'})[1]}")
             return True
+        if cmd == "rgw":
+            return self._rgw(toks[1:])
         if cmd == "perf" and toks[1:] == ["dump"]:
             self._print(json.dumps(
                 self.cluster.perf_collection.perf_dump(), indent=1,
@@ -285,6 +287,66 @@ class VstartShell:
                    "var": toks[3]}
         r, outs, outb = self.rados.mon_command(cmd)
         self._print(outs if outs else json.dumps(outb, default=str))
+        return True
+
+    def _rgw(self, toks: list[str]) -> bool:
+        """rgw multisite verbs (ref: vstart.sh RGW=n + the
+        radosgw-admin sync/period surface):
+          rgw start [zoneA zoneB ...]  — multisite gateways (first
+                                         zone is the metadata master)
+          rgw sync-status [zone]       — per-source lag / caught-up
+          rgw period [zone]            — the zone's committed period
+          rgw put <zone> <bucket> <key> <value>
+          rgw get <zone> <bucket> <key>
+        """
+        import urllib.request
+        if not hasattr(self, "rgw_zones"):
+            self.rgw_zones: dict[str, object] = {}
+        if not toks:
+            self._print("rgw start|sync-status|period|put|get ...")
+            return True
+        sub, rest = toks[0], toks[1:]
+        if sub == "start":
+            zones = rest or ["z1", "z2"]
+            for gw in self.cluster.rgw_multisite(zones):
+                self.rgw_zones[gw.zone] = gw
+                role = "master" if gw.multisite.is_master() \
+                    else "secondary"
+                self._print(f"rgw zone {gw.zone} ({role}) "
+                            f"on :{gw.port} pool rgw-{gw.zone}")
+            return True
+        if sub in ("sync-status", "period"):
+            for zone in (rest or sorted(self.rgw_zones)):
+                gw = self.rgw_zones[zone]
+                if sub == "period":
+                    self._print(f"{zone}: "
+                                f"{json.dumps(gw.multisite.period)}")
+                    continue
+                from ..rgw.multisite import render_sync_status
+                for line in render_sync_status(gw.sync.status()):
+                    self._print(line)
+            return True
+        if sub in ("put", "get"):
+            want = 4 if sub == "put" else 3
+            if len(rest) != want:
+                self._print(f"Error: rgw {sub} wants {want} args")
+                return True
+            gw = self.rgw_zones[rest[0]]
+            url = (f"http://127.0.0.1:{gw.port}"
+                   f"/{rest[1]}/{rest[2]}")
+            if sub == "put":
+                urllib.request.urlopen(urllib.request.Request(
+                    f"http://127.0.0.1:{gw.port}/{rest[1]}",
+                    method="PUT"), timeout=30).read()
+                urllib.request.urlopen(urllib.request.Request(
+                    url, data=rest[3].encode(), method="PUT"),
+                    timeout=30).read()
+                self._print("ok")
+            else:
+                with urllib.request.urlopen(url, timeout=30) as r:
+                    self._print(r.read().decode(errors="replace"))
+            return True
+        self._print(f"Error: unknown rgw verb {sub}")
         return True
 
     def _pg(self, toks: list[str]) -> bool:

@@ -23,6 +23,11 @@
 # injects a raise, and asserts the report lands in the crash table
 # (and RECENT_CRASH raises/clears) — the observability half of the
 # gate, run before the suite on every full invocation.
+#
+# Multisite smoke: scripts/multisite_smoke.py boots a two-zone vstart
+# (z1 master, z2 secondary), PUTs on the master and asserts the GET
+# converges on the secondary with `sync status` caught up — the
+# replication half of the gate.
 set -u -o pipefail
 
 cd "$(dirname "$0")/.."
@@ -76,16 +81,30 @@ run_crash_smoke() {
     return 0
 }
 
+run_multisite_smoke() {
+    echo "=== check_green: rgw multisite smoke ==="
+    timeout -k 10 180 env JAX_PLATFORMS=cpu \
+        python scripts/multisite_smoke.py
+    local rc=$?
+    if [ "$rc" -ne 0 ]; then
+        echo "check_green: RED (multisite smoke rc=$rc — zone" \
+             "replication broken) — do not ship" >&2
+        return 1
+    fi
+    return 0
+}
+
 run_static || exit 1
 if [ "$STATIC_ONLY" -eq 1 ]; then
     echo "check_green: GREEN (static only)"
     exit 0
 fi
 run_crash_smoke || exit 1
+run_multisite_smoke || exit 1
 
 if [ "$REPEAT" -gt 1 ] && [ ${#TARGETS[@]} -eq 0 ]; then
     TARGETS=(tests/test_thrasher.py tests/test_thrash_ec.py \
-             tests/test_snaptrim.py)
+             tests/test_snaptrim.py tests/test_rgw_multisite.py)
 fi
 if [ ${#TARGETS[@]} -eq 0 ]; then
     TARGETS=(tests/)

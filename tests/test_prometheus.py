@@ -52,3 +52,44 @@ def test_metrics_endpoint():
 
 
 import urllib.error  # noqa: E402  (used in the test above)
+
+
+def test_rgw_sync_lag_gauges():
+    """Multisite observability (ISSUE 5 satellite): the exporter
+    carries per-(zone, source) sync gauges, and after convergence the
+    lag returns to 0 — the acceptance's 'caught up' read for an
+    operator who only has the scrape."""
+    import time
+
+    c = MiniCluster(n_osd=3, threaded=True)
+    try:
+        c.wait_all_up()
+        g1, g2 = c.rgw_multisite(zones=("pz1", "pz2"))
+
+        def put(gw, path, data=None):
+            urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{gw.port}{path}", data=data,
+                method="PUT"), timeout=30).read()
+        put(g1, "/pmb")
+        for i in range(4):
+            put(g1, f"/pmb/o{i}", b"x%d" % i)
+        end = time.monotonic() + 30
+        while time.monotonic() < end and not (
+                g1.sync.caught_up() and g2.sync.caught_up()):
+            time.sleep(0.05)
+        assert g2.sync.caught_up() and g1.sync.caught_up()
+        mgr = c.start_mgr()
+        exp = mgr.start_prometheus()
+        text = _scrape(exp.port)
+        assert "# HELP ceph_rgw_sync_lag_entries" in text
+        assert "# HELP ceph_rgw_sync_behind_shards" in text
+        lines = dict(
+            l.rsplit(" ", 1) for l in text.splitlines()
+            if l and not l.startswith("#"))
+        # one row per (zone, source) direction, all caught up
+        for zone, src in (("pz2", "pz1"), ("pz1", "pz2")):
+            lbl = f'{{source="{src}",zone="{zone}"}}'
+            assert lines[f"ceph_rgw_sync_lag_entries{lbl}"] == "0"
+            assert lines[f"ceph_rgw_sync_behind_shards{lbl}"] == "0"
+    finally:
+        c.shutdown()

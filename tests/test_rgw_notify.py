@@ -184,3 +184,40 @@ def test_versioned_events_carry_version_id(gw, receiver):
     assert _wait(lambda: len(receiver.events) >= 1)
     assert receiver.events[0]["Records"][0]["s3"]["object"][
         "versionId"] == vid
+
+
+def test_zone_trace_suppresses_notifications(gw, receiver):
+    """The multisite guard: a mutation carrying x-rgw-zone-trace was
+    applied by the sync agent or forwarded from another zone — the
+    ORIGIN zone already fired the event, so this gateway must not
+    re-fire it (one event per write, not one per zone; ISSUE 5
+    satellite, ref: rgw_notify.cc skipping system requests)."""
+    from ceph_tpu.rgw.notify import (ZONE_TRACE_HEADER,
+                                     format_zone_trace,
+                                     parse_zone_trace,
+                                     suppress_for_trace)
+    assert parse_zone_trace("z1,z2") == ["z1", "z2"]
+    assert parse_zone_trace("") == []
+    assert format_zone_trace(["a", "b"]) == "a,b"
+    assert suppress_for_trace(["z1"]) and not suppress_for_trace([])
+
+    _setup(gw, receiver, "nbz")
+
+    def traced(method, path, data=None):
+        r = urllib.request.Request(
+            f"http://127.0.0.1:{gw.port}{path}", data=data,
+            method=method, headers={ZONE_TRACE_HEADER: "other-zone"})
+        with urllib.request.urlopen(r, timeout=30) as resp:
+            resp.read()
+
+    # replicated-looking writes: both created AND removed events stay
+    # silent despite the bucket config matching them
+    traced("PUT", "/nbz/replicated", b"from-peer")
+    traced("DELETE", "/nbz/replicated")
+    # an origin write on the same bucket still fires — the guard is
+    # per-request, not a bucket-wide mute
+    req(gw, "PUT", "/nbz/origin", b"local")
+    assert _wait(lambda: len(receiver.events) >= 1)
+    time.sleep(0.3)     # grace: a wrongly queued traced event would
+    # have drained by now
+    assert receiver.keys() == ["origin"]

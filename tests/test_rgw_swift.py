@@ -165,3 +165,21 @@ def test_token_valid_across_gateways(auth_gw, cluster):
         assert st == 201
     finally:
         g2.shutdown()
+
+
+def test_reserved_key_namespace_guarded(gw):
+    """The index bookkeeping namespaces are not objects through Swift
+    either: a PUT named .dlmeta on a zone member would wedge the
+    shard's datalog head, and reads crash on the record's missing
+    fields (regression: the guard lived only in the S3 router)."""
+    req(gw, "PUT", "/swift/v1/resv")
+    for key in (".dlmeta", ".dl.0000000000000001", ".upload.x"):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            req(gw, "PUT", f"/swift/v1/resv/{key}", b"z")
+        assert ei.value.code == 400, key
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            req(gw, "GET", f"/swift/v1/resv/{key}")
+        assert ei.value.code == 404, key
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            req(gw, "DELETE", f"/swift/v1/resv/{key}")
+        assert ei.value.code == 400, key

@@ -75,6 +75,9 @@ class _Op:
         self.target_osd = -1
         self.attempts = 0
         self.trace: Optional[dict] = None
+        self.span = None          # the client-side span (trace root
+        # unless a frontend scoped an ambient parent)
+        self.parent_ctx: Optional[dict] = None
 
 
 class Objecter(Dispatcher, MonHunter):
@@ -114,6 +117,12 @@ class Objecter(Dispatcher, MonHunter):
         #: non-threaded harnesses set this to a network pump callable;
         #: synchronous waits then drive the cluster instead of blocking
         self.pump_hook = None
+        # client-side span sink: the objecter roots (or, under an
+        # ambient frontend scope, parents) one span per traced op, so
+        # an assembled trace shows the submit->reply client leg too
+        # (ref: the Objecter's op trace in src/osdc/Objecter.cc)
+        from ..common.tracing import Tracer
+        self.tracer = Tracer(self.name)
         self.ms = Messenger.create(network, self.name, threaded=threaded)
         self.ms.add_dispatcher(self)
 
@@ -310,6 +319,11 @@ class Objecter(Dispatcher, MonHunter):
         fut = OpFuture()
         o = _Op(next(self._tid), pool, oid, op, offset, length, data,
                 fut, pg_ps=pg_ps, args=args)
+        # capture the frontend's ambient trace NOW: a queued op may
+        # launch later from the dispatch thread, where the submitting
+        # handler's scope is gone
+        from ..common.tracing import current_trace
+        o.parent_ctx = current_trace()
         with self._lock:
             if self.osdmap.epoch > 0 and pool not in self.osdmap.pools:
                 # pool does not exist in the current map: fail fast
@@ -354,6 +368,11 @@ class Objecter(Dispatcher, MonHunter):
         Drains with a loop: a recursive single step strands waiters
         behind an op that completes without ever becoming active
         (e.g. ENOENT on a deleted pool)."""
+        if op.span is not None:
+            op.span.event("reply" if reply.result == 0
+                          else f"error:{reply.errno_name}")
+            self.tracer.finish(op.span)
+            op.span = None
         op.future._complete(reply)
         key = self._obj_key(op)
         if key is None or self._obj_active.get(key) != op.tid:
@@ -390,13 +409,26 @@ class Objecter(Dispatcher, MonHunter):
             args["snapc"] = {"seq": pool.snap_seq,
                              "snaps": sorted(pool.snaps)}
         if op.trace is None and global_config()["blkin_trace_all"]:
-            from ..common.tracing import new_trace
-            op.trace = new_trace()
+            from ..common.tracing import child_of, new_trace
+            parent = op.parent_ctx
+            # root a fresh trace, or continue the frontend's (RGW/MDS
+            # request handlers scope theirs ambient) — either way the
+            # objecter leg gets its OWN span and the wire carries a
+            # child context, so resend attempts each show up as
+            # distinct OSD spans under this one
+            op.trace = child_of(parent) if parent else new_trace()
+            op.span = self.tracer.start_span(
+                op.trace, f"objecter_op:{op.op}")
+            op.span.event(f"oid={op.oid}")
+        if op.span is not None:
+            op.span.event(
+                f"send attempt={op.attempts} osd.{op.target_osd}")
+        from ..common.tracing import child_of as _child_of
         self.ms.connect(f"osd.{op.target_osd}").send_message(OSDOp(
             pgid=op.pg, oid=op.oid, op=op.op, tid=op.tid,
             epoch=self.osdmap.epoch, offset=op.offset,
             length=op.length, data=op.data, args=args,
-            trace=op.trace))
+            trace=_child_of(op.trace)))
 
     # ---------------------------------------------------- watch/notify
     # (ref: Objecter linger ops + librados watch/notify API)
@@ -536,6 +568,11 @@ class Objecter(Dispatcher, MonHunter):
                     time.sleep(0.1)
                     continue
             return slot["r"], slot["outs"], slot["outb"]
+
+    def dump_traces(self, trace_id: str | None = None) -> list[dict]:
+        """The client's finished-span ring (the daemon-side analogue
+        is the admin-socket `dump_traces`)."""
+        return self.tracer.dump(trace_id)
 
     def _handle_command_ack(self, msg: MMonCommandAck) -> bool:
         entry = self._pending_cmds.pop(msg.tid, None)

@@ -282,6 +282,20 @@ OPTIONS: dict[str, Option] = {opt.name: opt for opt in [
     _o("objectstore_debug_inject_read_err", T.BOOL, False, L.DEV,
        runtime=True,
        desc="make MemStore reads of marked objects fail with EIO"),
+    # op tracking / slow-op health (ref: options.cc
+    # osd_op_complaint_time, osd_op_history_size)
+    _o("osd_op_complaint_time", T.SECS, 30.0, L.ADVANCED, runtime=True,
+       desc="in-flight op age that counts as slow: feeds each "
+            "daemon's dump_blocked_ops and the cluster SLOW_OPS "
+            "health warning"),
+    _o("osd_op_history_size", T.UINT, 20, L.ADVANCED,
+       desc="completed ops kept for dump_historic_ops (and the slow "
+            "subset for dump_historic_slow_ops)"),
+    # telemetry upload (ref: the telemetry module's endpoint url)
+    _o("mgr_telemetry_url", T.STR, "", L.ADVANCED, runtime=True,
+       desc="sink the compiled telemetry report posts to on each "
+            "mgr tick: file://<path> appends JSON lines, "
+            "http(s)://... POSTs; empty = compile only, never send"),
     # logging
     _o("blkin_trace_all", T.BOOL, False, L.DEV, runtime=True,
        desc="trace every client op with distributed spans"),

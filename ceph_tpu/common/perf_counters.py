@@ -22,6 +22,8 @@ GAUGE = "gauge"        # settable level
 LONGRUNAVG = "avg"     # (sum, count) pair -> average
 TIME = "time"          # seconds accumulated (float)
 HISTOGRAM = "hist"     # fixed power-of-two buckets
+LATHIST = "lathist"    # latency buckets + sum/count (prometheus
+                       # histogram family shape: _bucket/_sum/_count)
 
 
 @dataclass
@@ -39,6 +41,12 @@ class PerfCounters:
 
     #: histogram bucket upper bounds (power-of-two byte/latency buckets)
     HIST_BOUNDS = [2 ** i for i in range(1, 33)]
+    #: latency histogram upper bounds in seconds — the SLO buckets the
+    #: prometheus exporter publishes as a real histogram family (one
+    #: implicit +Inf bucket rides at the end)
+    LAT_BOUNDS = [0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                  0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                  10.0, 30.0, 60.0]
 
     def __init__(self, name: str):
         self.name = name
@@ -67,6 +75,16 @@ class PerfCounters:
     def add_histogram(self, key: str, desc: str = "") -> None:
         self._c[key] = _Counter(
             HISTOGRAM, desc, buckets=[0] * (len(self.HIST_BOUNDS) + 1))
+
+    def add_latency_histogram(self, key: str, desc: str = "") -> None:
+        """Latency histogram over LAT_BOUNDS with sum+count — the
+        per-op-class SLO metric kind.  Idempotent like
+        add_u64_counter: a restarted daemon reusing its name must not
+        zero live samples."""
+        if key not in self._c:
+            self._c[key] = _Counter(
+                LATHIST, desc,
+                buckets=[0] * (len(self.LAT_BOUNDS) + 1))
 
     # -- update surface --
     def inc(self, key: str, amount: float = 1) -> None:
@@ -105,6 +123,18 @@ class PerfCounters:
                     return
             c.buckets[-1] += 1
 
+    def hobs(self, key: str, seconds: float) -> None:
+        """Observe one latency sample into a LATHIST counter."""
+        with self._lock:
+            c = self._c[key]
+            c.sum += seconds
+            c.count += 1
+            for i, bound in enumerate(self.LAT_BOUNDS):
+                if seconds <= bound:
+                    c.buckets[i] += 1
+                    return
+            c.buckets[-1] += 1
+
     def time_block(self, key: str):
         """Context manager timing a block into a time/avg counter."""
         pc = self
@@ -127,6 +157,10 @@ class PerfCounters:
                     "avg": c.sum / c.count if c.count else 0.0}
         if c.kind == HISTOGRAM:
             return list(c.buckets)
+        if c.kind == LATHIST:
+            return {"bounds": list(self.LAT_BOUNDS),
+                    "buckets": list(c.buckets),
+                    "sum": c.sum, "count": c.count}
         return c.value
 
     def dump(self) -> dict:

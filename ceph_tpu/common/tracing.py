@@ -15,6 +15,8 @@ options in src/common/options.cc).
 """
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import os
 import threading
 
@@ -38,6 +40,31 @@ def child_of(ctx: dict | None) -> dict | None:
         return None
     return {"trace_id": ctx["trace_id"], "span": _new_id(),
             "parent": ctx["span"]}
+
+
+#: ambient trace context for the current thread of execution — a
+#: frontend (RGW request handler, MDS op dispatch) roots a trace and
+#: scopes it here so the layers below (objecter submit) parent their
+#: own spans under it without every intermediate API growing a trace
+#: parameter (the OpRequest::pg_trace plumbing the reference threads
+#: explicitly through call signatures).
+_current_ctx: contextvars.ContextVar[dict | None] = \
+    contextvars.ContextVar("ceph_tpu_trace_ctx", default=None)
+
+
+def current_trace() -> dict | None:
+    """The ambient trace context, if a frontend scoped one."""
+    return _current_ctx.get()
+
+
+@contextlib.contextmanager
+def trace_scope(ctx: dict | None):
+    """Scope `ctx` as the ambient parent for nested op submissions."""
+    token = _current_ctx.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _current_ctx.reset(token)
 
 
 class Span:
@@ -93,3 +120,46 @@ class Tracer:
             spans = list(self._done)
         return [s.dump() for s in spans
                 if trace_id is None or s.trace_id == trace_id]
+
+
+# ------------------------------------------------- trace assembly
+# Stitching a cross-daemon trace back together = collect every
+# daemon's `dump_traces` ring, filter by trace_id, and rebuild the
+# parent/child tree (the blkin/zipkin UI's job; here a CLI one).
+
+def span_tree(spans: list[dict]) -> list[dict]:
+    """Group dumped spans into root trees: each node is the span dict
+    plus a "children" list.  Spans whose parent is not in the set
+    (e.g. a daemon's ring already evicted it) surface as roots so
+    partial traces still render."""
+    nodes = {s["span_id"]: dict(s, children=[]) for s in spans}
+    roots = []
+    for sid, node in nodes.items():
+        parent = node.get("parent")
+        if parent is not None and parent in nodes and parent != sid:
+            nodes[parent]["children"].append(node)
+        else:
+            roots.append(node)
+    for node in nodes.values():
+        node["children"].sort(key=lambda n: (n["service"], n["name"]))
+    roots.sort(key=lambda n: (n["service"], n["name"]))
+    return roots
+
+
+def format_tree(spans: list[dict]) -> list[str]:
+    """Indented one-span-per-line rendering of an assembled trace."""
+    lines: list[str] = []
+
+    def walk(node: dict, depth: int) -> None:
+        lines.append("{}{} [{}] {:.6f}s".format(
+            "  " * depth, node["name"], node["service"],
+            node["duration"]))
+        for ev in node.get("events", []):
+            lines.append("{}  @{:.6f} {}".format(
+                "  " * depth, ev["t"], ev["event"]))
+        for child in node["children"]:
+            walk(child, depth + 1)
+
+    for root in span_tree(spans):
+        walk(root, 0)
+    return lines

@@ -1,9 +1,13 @@
 """OpTracker: in-flight op tracking with per-stage timestamps.
 
 (ref: src/common/TrackedOp.{h,cc} — TrackedOp::mark_event history,
-OpTracker::dump_ops_in_flight / dump_historic_ops served through the
-admin socket; the slow-op age warning mirrors
-osd_op_complaint_time.)
+OpTracker::dump_ops_in_flight / dump_historic_ops /
+dump_historic_slow_ops served through the admin socket; the slow-op
+age warning mirrors osd_op_complaint_time.)
+
+Every daemon type owns one (the reference constructs an OpTracker in
+OSD, mon, mds and rgw alike); aged in-flight ops feed the cluster's
+SLOW_OPS health warning through each daemon's report path.
 """
 from __future__ import annotations
 
@@ -39,14 +43,30 @@ class TrackedOp:
 
 
 class OpTracker:
-    """(ref: TrackedOp.h:64 OpTracker)."""
+    """(ref: TrackedOp.h:64 OpTracker).
+
+    `complaint_time=None` reads the live `osd_op_complaint_time`
+    option per check, so `config set` retunes every daemon's slow-op
+    threshold at runtime (the reference observes the same option)."""
 
     def __init__(self, history_size: int = 20,
-                 complaint_time: float = 30.0):
+                 complaint_time: float | None = None):
         self._lock = make_lock("optracker")
         self._inflight: dict[object, TrackedOp] = {}
         self._historic: deque[TrackedOp] = deque(maxlen=history_size)
+        #: completed ops whose total duration exceeded the complaint
+        #: threshold (ref: OpTracker's historic_slow ring behind
+        #: dump_historic_slow_ops)
+        self._historic_slow: deque[TrackedOp] = deque(
+            maxlen=history_size)
         self.complaint_time = complaint_time
+
+    @property
+    def complaint(self) -> float:
+        if self.complaint_time is not None:
+            return self.complaint_time
+        from .options import global_config
+        return global_config()["osd_op_complaint_time"]
 
     def start(self, key, desc: str) -> TrackedOp:
         op = TrackedOp(desc, time.monotonic())
@@ -60,15 +80,21 @@ class OpTracker:
         if op is not None:
             op.mark_event(event)
 
-    def finish(self, key, event: str = "done") -> None:
+    def finish(self, key, event: str = "done") -> float | None:
+        """Retire one op into history; returns its total duration (the
+        per-op-class latency histogram feed) or None when untracked."""
         with self._lock:
             op = self._inflight.pop(key, None)
             if op is None:
-                return
+                return None
             now = time.monotonic()
             op.events.append((now, event))
             op.done_at = now
             self._historic.append(op)
+            dur = now - op.start
+            if dur > self.complaint:
+                self._historic_slow.append(op)
+            return dur
 
     # -- dumps (ref: OpTracker::dump_ops_in_flight :282) ----------------
     def dump_in_flight(self) -> dict:
@@ -83,10 +109,30 @@ class OpTracker:
             ops = [op.dump(now) for op in self._historic]
         return {"num_ops": len(ops), "ops": ops}
 
+    def dump_historic_slow(self) -> dict:
+        """(ref: OpTracker::dump_historic_slow_ops)."""
+        now = time.monotonic()
+        with self._lock:
+            ops = [op.dump(now) for op in self._historic_slow]
+        return {"num_ops": len(ops), "ops": ops}
+
     def slow_ops(self) -> list[dict]:
         """Ops older than the complaint threshold
         (ref: OpTracker::check_ops_in_flight)."""
         now = time.monotonic()
+        limit = self.complaint
         with self._lock:
             return [op.dump(now) for op in self._inflight.values()
-                    if now - op.start > self.complaint_time]
+                    if now - op.start > limit]
+
+    def slow_summary(self) -> dict:
+        """{count, oldest_age} of aged in-flight ops — the SLOW_OPS
+        health feed each daemon ships on its stat report / beacon
+        (cleared the moment the ops drain: count 0)."""
+        now = time.monotonic()
+        limit = self.complaint
+        with self._lock:
+            ages = [now - op.start for op in self._inflight.values()
+                    if now - op.start > limit]
+        return {"count": len(ages),
+                "oldest_age": round(max(ages), 3) if ages else 0.0}

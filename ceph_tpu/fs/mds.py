@@ -312,6 +312,32 @@ class MDSDaemon(Dispatcher):
         #: settable independently — a standalone MDS (no beacons, no
         #: fsmap) still reports crashes to the cluster
         self.crash_mons = list(self.mons)
+        # op tracking + span ring (ref: MDSDaemon's op_tracker +
+        # OpRequest tracing): every client request is tracked, aged
+        # ones ride the beacon as the SLOW_OPS feed, and traced
+        # requests root a span whose journal/objecter legs nest under
+        # the ambient scope
+        from ..common.options import global_config
+        from ..common.tracked_op import OpTracker
+        from ..common.tracing import Tracer
+        self.op_tracker = OpTracker(
+            history_size=global_config()["osd_op_history_size"])
+        self.tracer = Tracer(self.name)
+        self.asok = None
+
+    def start_admin_socket(self, path: str) -> None:
+        """`ceph daemon mds.N <cmd>` endpoint (ref:
+        MDSDaemon::asok_command)."""
+        from ..common.admin_socket import AdminSocket
+        from ..common.obs import register_obs_commands
+        a = AdminSocket(path)
+        register_obs_commands(a, self.op_tracker, self.tracer)
+        a.register("status", "daemon status",
+                   lambda c: (0, {"whoami": self.rank,
+                                  "state": self._mds_state,
+                                  "gid": self.gid}))
+        a.start()
+        self.asok = a
 
     def _post_crash_meta(self, meta: dict) -> None:
         from ..msg.messages import MMonCommand
@@ -352,7 +378,10 @@ class MDSDaemon(Dispatcher):
             return
         msg = MMDSBeacon(gid=self.gid, name=self.name, rank=self.rank,
                          state=self._mds_state,
-                         seq=next(self._beacon_seq))
+                         seq=next(self._beacon_seq),
+                         # SLOW_OPS feed: aged in-flight client
+                         # requests; count 0 clears the mon's entry
+                         slow_ops=self.op_tracker.slow_summary())
         for m in self.mons:
             if self.ms.connect(m).send_message(msg):
                 return
@@ -382,6 +411,9 @@ class MDSDaemon(Dispatcher):
         thrasher uses)."""
         self.stopped = True
         self._beacon_stop.set()
+        if self.asok is not None:
+            self.asok.shutdown()
+            self.asok = None
         if self._subtree_watch is not None:
             try:
                 self.meta.unwatch(SUBTREE_OBJ, self._subtree_watch)
@@ -394,6 +426,9 @@ class MDSDaemon(Dispatcher):
     def shutdown(self) -> None:
         self.stopped = True
         self._beacon_stop.set()
+        if self.asok is not None:
+            self.asok.shutdown()
+            self.asok = None
         with self._lock:
             self._persist_applied()
         if self._subtree_watch is not None:
@@ -1840,15 +1875,29 @@ class MDSDaemon(Dispatcher):
             return True
         if not isinstance(msg, MClientRequest):
             return False
+        from ..common.options import global_config
+        from ..common.tracing import new_trace, trace_scope
+        opkey = (msg.src, msg.tid)
+        self.op_tracker.start(
+            opkey, f"client_request({msg.src} tid={msg.tid} "
+                   f"{msg.op})")
+        # frontend trace root: a traced metadata op's journal/objecter
+        # writes nest under this span via the ambient scope
+        ctx = new_trace() if msg.trace is None and \
+            global_config()["blkin_trace_all"] else msg.trace
+        sp = self.tracer.start_span(ctx, f"mds_op:{msg.op}")
         try:
-            args = dict(msg.args)
-            args["__client"] = msg.src
-            out = self.handle_op(msg.op, args)
+            with trace_scope(ctx):
+                args = dict(msg.args)
+                args["__client"] = msg.src
+                out = self.handle_op(msg.op, args)
             reply = MClientReply(tid=msg.tid, result=0, out=out)
         except _CrossRankRename as x:
             # two-phase protocol runs off the dispatch thread (the
             # slave reply would otherwise deadlock this thread); the
             # worker sends the client reply itself
+            self.op_tracker.finish(opkey, "cross_rank_deferred")
+            self.tracer.finish(sp)
             threading.Thread(
                 target=self._cross_rank_rename,
                 args=(msg, dict(msg.args), x.dst_rank),
@@ -1866,6 +1915,13 @@ class MDSDaemon(Dispatcher):
                                  errno_name="EINVAL")
             dout("mds", 1).write("%s: bad request %s: %s", self.name,
                                  msg.op, e)
+        self.op_tracker.finish(
+            opkey, "replied" if reply.result == 0
+            else f"error:{reply.errno_name}")
+        if sp is not None:
+            sp.event("replied" if reply.result == 0
+                     else f"error:{reply.errno_name}")
+            self.tracer.finish(sp)
         # drain cap revokes queued by the op AFTER the reply so the
         # EAGAIN lands first (ref: Locker issues revokes async)
         with self._lock:

@@ -65,6 +65,17 @@ class MgrDaemon(Dispatcher, MonHunter):
         #: `mgr health report` so modules never clobber each other
         self._health_reports: dict[str, dict] = {}
         self._lock = make_lock(f"mgr.{self.name}")
+        # op tracking + span ring: module commands proxied from the
+        # mon are tracked like any daemon's ops (ref: the mgr's
+        # DaemonServer op tracking), and the mgr serves the shared
+        # dump_ops_in_flight/dump_traces admin surface
+        from ..common.options import global_config
+        from ..common.tracked_op import OpTracker
+        from ..common.tracing import Tracer
+        self.op_tracker = OpTracker(
+            history_size=global_config()["osd_op_history_size"])
+        self.tracer = Tracer(self.name)
+        self.asok = None
         self.ms = Messenger.create(network, self.name, threaded=threaded)
         self.ms.add_dispatcher(self)
         # own-crash capture: the mgr posts its reports over the wire
@@ -108,7 +119,21 @@ class MgrDaemon(Dispatcher, MonHunter):
             self.prometheus.shutdown()
         if getattr(self, "restful", None) is not None:
             self.restful.shutdown()
+        if self.asok is not None:
+            self.asok.shutdown()
+            self.asok = None
         self.ms.shutdown()
+
+    def start_admin_socket(self, path: str) -> None:
+        """`ceph daemon mgr.N <cmd>` endpoint."""
+        from ..common.admin_socket import AdminSocket
+        from ..common.obs import register_obs_commands
+        a = AdminSocket(path)
+        register_obs_commands(a, self.op_tracker, self.tracer)
+        a.register("status", "daemon status",
+                   lambda c: (0, self.status()))
+        a.start()
+        self.asok = a
 
     # -------------------------------------------------------- dispatch
     def ms_dispatch(self, msg: Message) -> bool:
@@ -137,7 +162,13 @@ class MgrDaemon(Dispatcher, MonHunter):
             # the client).  Handlers run on the dispatch thread, so
             # they answer from module-cached state only — a sync
             # mon_command here would deadlock on our own ack.
+            opkey = (msg.src, msg.tid)
+            self.op_tracker.start(
+                opkey, f"module_command({msg.src} tid={msg.tid} "
+                       f"{msg.cmd.get('prefix', '?')})")
             r, outs, outb = self._handle_module_command(msg.cmd)
+            self.op_tracker.finish(opkey,
+                                   "replied" if r == 0 else f"r={r}")
             self.ms.connect(msg.src).send_message(MMgrCommandReply(
                 tid=msg.tid, result=r, outs=outs, outb=outb))
             return True

@@ -34,6 +34,24 @@ class _Builder:
                 + "}"
         self.lines.append(f"{name}{lbl} {float(value):g}")
 
+    def histogram(self, name: str, hist: dict,
+                  labels: dict | None = None):
+        """One labeled series of a histogram family: cumulative
+        _bucket samples over the explicit bounds plus +Inf, then _sum
+        and _count (the prometheus exposition histogram contract).
+        Declare the family once with metric(name, ..., "histogram")
+        before the first series."""
+        labels = dict(labels or {})
+        cum = 0
+        for bound, n in zip(hist["bounds"], hist["buckets"]):
+            cum += n
+            self.sample(f"{name}_bucket", cum,
+                        {**labels, "le": f"{bound:g}"})
+        cum += hist["buckets"][-1]          # overflow bucket
+        self.sample(f"{name}_bucket", cum, {**labels, "le": "+Inf"})
+        self.sample(f"{name}_sum", hist["sum"], labels)
+        self.sample(f"{name}_count", hist["count"], labels)
+
     def render(self) -> str:
         return "\n".join(self.lines) + "\n"
 
@@ -177,6 +195,16 @@ class PrometheusExporter:
                          row["lag_entries"], lbl)
                 b.sample("ceph_rgw_sync_behind_shards",
                          row["behind_shards"], lbl)
+        from ..rgw.multisite import sync_apply_hists
+        hists = sync_apply_hists()
+        if hists:
+            b.metric("ceph_rgw_sync_apply_latency_seconds",
+                     "cross-zone fetch + apply latency per "
+                     "replicated entry (the sync op class)",
+                     "histogram")
+            for zone, hist in sorted(hists.items()):
+                b.histogram("ceph_rgw_sync_apply_latency_seconds",
+                            hist, {"zone": zone})
 
         rc, _, counts = self._cmd({"prefix": "log counts"})
         if rc == 0:
@@ -192,6 +220,19 @@ class PrometheusExporter:
             totals: dict[str, float] = {}
             for daemon, counters in sorted(perf.items()):
                 for key, val in sorted(counters.items()):
+                    if isinstance(val, dict) and "buckets" in val:
+                        # per-op-class latency histograms export as
+                        # REAL prometheus histogram families
+                        # (_bucket/_sum/_count with cumulative le
+                        # labels), one series per daemon
+                        name = f"ceph_daemon_{key}_seconds"
+                        if name not in emitted:
+                            emitted.add(name)
+                            b.metric(name,
+                                     f"per-daemon latency {key}",
+                                     "histogram")
+                        b.histogram(name, val, {"daemon": daemon})
+                        continue
                     is_avg = isinstance(val, dict)
                     if is_avg:                  # long-run averages
                         val = val.get("avg", 0.0)

@@ -33,6 +33,13 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 
+class _Html(str):
+    """Marker type: a route returning _Html is served as text/html.
+    An explicit declaration, not content sniffing — a plain string
+    payload that happens to start with '<' must still go out as
+    JSON."""
+
+
 class RestfulServer:
     """One HTTP listener bound to a mgr (anything with mon_command)."""
 
@@ -56,6 +63,16 @@ class RestfulServer:
                 if self.command != "HEAD":
                     self.wfile.write(body)
 
+            def _html(self, status: int, markup: str) -> None:
+                body = markup.encode()
+                self.send_response(status)
+                self.send_header("Content-Type",
+                                 "text/html; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if self.command != "HEAD":
+                    self.wfile.write(body)
+
             def _run(self, method: str) -> None:
                 try:
                     if not srv._authorized(self.headers):
@@ -64,6 +81,9 @@ class RestfulServer:
                     body = json.loads(self.rfile.read(n)) if n else {}
                     status, payload = srv._route(method,
                                                  self.path, body)
+                    if isinstance(payload, _Html):
+                        self._html(status, str(payload))
+                        return
                     self._json(status, payload)
                 except Exception as e:      # noqa: BLE001 — admin API:
                     # every failure must come back as JSON, not a
@@ -124,9 +144,12 @@ class RestfulServer:
         if not parts:
             return 200, {"endpoints": [
                 "/status", "/health", "/df", "/osd", "/osd/<id>",
-                "/osd/<id>/command", "/pool", "/pool/<name>", "/pg"]}
+                "/osd/<id>/command", "/pool", "/pool/<name>", "/pg",
+                "/dashboard", "/dashboard?format=html"]}
         head = parts[0]
         if method == "GET":
+            if head == "dashboard":
+                return self._dashboard(path)
             if head == "status":
                 return 200, self._mon({"prefix": "status"})
             if head == "health":
@@ -200,3 +223,102 @@ class RestfulServer:
                        "yes_i_really_really_mean_it": True})
             return 200, {"ok": True}
         return 404, {"error": f"no route {method} {path}"}
+
+    # -- dashboard (read-only status view; ref: the mgr dashboard
+    # module's landing page, src/pybind/mgr/dashboard — collapsed to
+    # one JSON document with an HTML rendering over the same data) --
+    def _dashboard(self, path: str):
+        from urllib.parse import parse_qs, urlparse
+        q = {k: v[0] for k, v in
+             parse_qs(urlparse(path).query).items()}
+        data = self.dashboard_data()
+        if q.get("format") == "html":
+            return 200, _Html(self._dashboard_html(data))
+        return 200, data
+
+    def dashboard_data(self) -> dict:
+        """One read-only cluster summary: health, usage, pg states,
+        multisite sync lag, recent crashes, slow ops."""
+        status = self._mon({"prefix": "status"})
+        health = self._mon({"prefix": "health detail"})
+        df = self._mon({"prefix": "df"})
+        try:
+            crashes = self._mon({"prefix": "crash ls-new"}) or []
+        except RuntimeError:
+            crashes = []
+        from ..rgw.multisite import sync_status_all
+        slow = health.get("checks", {}).get("SLOW_OPS", {})
+        return {
+            "health": {"status": health.get("status"),
+                       "checks": health.get("checks", {})},
+            "osdmap": status.get("osdmap", {}),
+            "pg_states": status.get("pgmap", {})
+            .get("pgs_by_state", {}),
+            "usage": {"total_kb": df.get("total_kb", 0),
+                      "used_kb": df.get("used_kb", 0),
+                      "avail_kb": df.get("avail_kb", 0),
+                      "pools": df.get("pools", {})},
+            "sync": sync_status_all(),
+            "recent_crashes": [
+                {"crash_id": c.get("crash_id"),
+                 "entity": c.get("entity"),
+                 "timestamp": c.get("timestamp")}
+                for c in crashes],
+            "slow_ops": {"summary": slow.get("summary", ""),
+                         "detail": slow.get("detail", [])},
+        }
+
+    @staticmethod
+    def _dashboard_html(data: dict) -> str:
+        """Server-rendered read-only view — no scripts, one page."""
+        from html import escape
+
+        def rows(pairs):
+            return "".join(
+                f"<tr><th>{escape(str(k))}</th>"
+                f"<td>{escape(str(v))}</td></tr>" for k, v in pairs)
+
+        checks = data["health"]["checks"]
+        h = ["<!DOCTYPE html><html><head><title>ceph-tpu dashboard"
+             "</title><style>body{font-family:monospace}"
+             "table{border-collapse:collapse;margin:8px 0}"
+             "th,td{border:1px solid #999;padding:2px 8px;"
+             "text-align:left}</style></head><body>",
+             f"<h1>cluster: {escape(str(data['health']['status']))}"
+             "</h1>"]
+        if checks:
+            h.append("<h2>health checks</h2><table>" + rows(
+                (k, v.get("summary", "") if isinstance(v, dict)
+                 else v) for k, v in sorted(checks.items()))
+                + "</table>")
+        h.append("<h2>osds</h2><table>"
+                 + rows(sorted(data["osdmap"].items())) + "</table>")
+        h.append("<h2>pg states</h2><table>"
+                 + rows(sorted(data["pg_states"].items()))
+                 + "</table>")
+        u = data["usage"]
+        h.append("<h2>usage</h2><table>" + rows(
+            [("total_kb", u["total_kb"]), ("used_kb", u["used_kb"]),
+             ("avail_kb", u["avail_kb"])] +
+            [(f"pool {p}", f"{st.get('objects', 0)} objects, "
+              f"{st.get('bytes', 0)} bytes")
+             for p, st in sorted(u["pools"].items())]) + "</table>")
+        if data["sync"]:
+            h.append("<h2>multisite sync</h2><table>" + rows(
+                (f"{r['zone']} <- {r['source']}",
+                 f"lag {r['lag_entries']} entries, "
+                 f"{r['behind_shards']} shards behind")
+                for r in data["sync"]) + "</table>")
+        if data["recent_crashes"]:
+            h.append("<h2>recent crashes</h2><table>" + rows(
+                (c.get("crash_id", "?"), c.get("entity", "?"))
+                for c in data["recent_crashes"]) + "</table>")
+        if data["slow_ops"]["summary"]:
+            h.append("<h2>slow ops</h2><p>"
+                     + escape(data["slow_ops"]["summary"]) + "</p>"
+                     "<ul>" + "".join(
+                         f"<li>{escape(str(d))}</li>"
+                         for d in data["slow_ops"]["detail"])
+                     + "</ul>")
+        h.append("</body></html>")
+        return "".join(h)

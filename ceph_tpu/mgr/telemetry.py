@@ -48,6 +48,10 @@ class TelemetryModule:
         self.last_report_stamp: float | None = None
         #: tick-cached perf aggregate (compile never hits the mon)
         self._perf_totals: dict[str, float] = {}
+        #: upload bookkeeping (ref: telemetry's last_upload /
+        #: send failure surfacing in `telemetry status`): stamp, the
+        #: sink url, success flag, and the error text on failure
+        self.last_send: dict | None = None
 
     # -------------------------------------------------- anonymization
     def cluster_id(self) -> str:
@@ -75,6 +79,48 @@ class TelemetryModule:
                 self._perf_totals = totals
         self.last_report = self.compile_report(now)
         self.last_report_stamp = now
+        self.maybe_send(now)
+
+    # --------------------------------------------------------- upload
+    def maybe_send(self, now: float | None = None) -> bool:
+        """Post the compiled report to the configured sink
+        (mgr_telemetry_url; ref: the telemetry module's POST to
+        telemetry.ceph.com).  file://<path> appends one JSON line
+        per send (a local spool/test sink), http(s):// POSTs the
+        JSON body.  Failures land in `telemetry status` as
+        last_send.ok=False rather than raising into the tick."""
+        from ..common.options import global_config
+        url = str(global_config()["mgr_telemetry_url"] or "")
+        if not url or self.last_report is None:
+            return False
+        now = time.time() if now is None else now
+        import json
+        body = json.dumps(self.last_report, sort_keys=True)
+        try:
+            if url.startswith("file://"):
+                with open(url[len("file://"):], "a") as f:
+                    f.write(body + "\n")
+            elif url.startswith(("http://", "https://")):
+                import urllib.request
+                req = urllib.request.Request(
+                    url, data=body.encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST")
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    resp.read()
+            else:
+                raise ValueError(
+                    f"unsupported telemetry sink {url!r} "
+                    "(file:// or http(s):// only)")
+        except Exception as ex:  # noqa: BLE001 — an unreachable sink
+            # must not kill the mgr tick; the failure IS the status
+            self.last_send = {"stamp": utc_iso(now), "url": url,
+                              "ok": False,
+                              "error": f"{type(ex).__name__}: {ex}"}
+            return False
+        self.last_send = {"stamp": utc_iso(now), "url": url,
+                          "ok": True, "error": None}
+        return True
 
     def compile_report(self, now: float | None = None) -> dict:
         """Assemble the channel-gated report from mgr-local state
@@ -141,11 +187,15 @@ class TelemetryModule:
 
     # -------------------------------------------------------- commands
     def status(self) -> dict:
+        from ..common.options import global_config
         return {"enabled": self.enabled,
                 "channels": dict(self.channels),
                 "last_report_timestamp":
                     None if self.last_report_stamp is None
-                    else utc_iso(self.last_report_stamp)}
+                    else utc_iso(self.last_report_stamp),
+                "url": str(global_config()["mgr_telemetry_url"]
+                           or "") or None,
+                "last_send": self.last_send}
 
     def handle_command(self, cmd: dict) -> tuple[int, str, object]:
         """Mon-proxied CLI verbs — answers from cached state only
@@ -177,4 +227,24 @@ class TelemetryModule:
                 return -_EAGAIN, "no report compiled yet — the next " \
                     "mgr tick builds one", None
             return 0, "", self.last_report
+        if pfx == "telemetry send":
+            # force an upload of the last compiled report NOW (the
+            # tick also sends; this is the operator's retry knob)
+            if not self.enabled:
+                return -_EPERM, "telemetry is off — enable with " \
+                    "`telemetry on`", None
+            if self.last_report is None:
+                return -_EAGAIN, "no report compiled yet — the next " \
+                    "mgr tick builds one", None
+            from ..common.options import global_config
+            if not str(global_config()["mgr_telemetry_url"] or ""):
+                # check the live option, not last_send: a url cleared
+                # after an earlier success must not surface the stale
+                # success record as "send failed: None"
+                return -_EINVAL, "no mgr_telemetry_url configured", \
+                    None
+            ok = self.maybe_send()
+            return (0, "report sent", self.last_send) if ok else \
+                (-_EAGAIN, f"send failed: {self.last_send['error']}",
+                 self.last_send)
         return -_EINVAL, f"unknown telemetry command {pfx!r}", None

@@ -137,6 +137,18 @@ class Monitor(Dispatcher):
         # cluster statistics digest (ref: src/mon/PGMap.h)
         self.pgmap = PGMap()
         self._down_stamp: dict[int, float] = {}
+        # op tracking + span ring: the mon serves the same
+        # dump_ops_in_flight/dump_traces surface as every other daemon
+        # (ref: Monitor.cc's op_tracker member)
+        from ..common.tracked_op import OpTracker
+        from ..common.tracing import Tracer
+        self.op_tracker = OpTracker(
+            history_size=global_config()["osd_op_history_size"])
+        self.tracer = Tracer(self.name)
+        #: per-MDS slow-op summaries off beacons: name -> {stamp,
+        #: count, oldest_age} (volatile like _beacon; cleared when a
+        #: beacon reports count 0)
+        self._mds_slow: dict[str, dict] = {}
         self._lock = make_lock(f"mon.{rank}")
         # ---- quorum state ------------------------------------------
         self.mon_ranks = sorted(mon_ranks) if mon_ranks else [rank]
@@ -234,6 +246,8 @@ class Monitor(Dispatcher):
                        f"mon {p}", _via_preprocess(p))
         a.register("config show", "live config",
                    lambda c: (0, global_config().dump()))
+        from ..common.obs import register_obs_commands
+        register_obs_commands(a, self.op_tracker, self.tracer)
         a.start()
         self.asok = a
 
@@ -374,7 +388,7 @@ class Monitor(Dispatcher):
                     osd=msg.osd, epoch=msg.epoch, stamp=msg.stamp,
                     pg_stats=msg.pg_stats, kb_total=msg.kb_total,
                     kb_used=msg.kb_used, kb_avail=msg.kb_avail,
-                    perf=msg.perf))
+                    perf=msg.perf, slow_ops=dict(msg.slow_ops or {})))
                 # mirror OSD-originated reports to the other mons so
                 # status/health/df answer the same from any rank (the
                 # reference replicates the digest via MgrStatMonitor)
@@ -511,7 +525,17 @@ class Monitor(Dispatcher):
     # -------------------------------------------------------- commands
     def _handle_wire_command(self, cmdmap: dict, client: str,
                              tid: int) -> None:
+        # track the command like the OSD tracks client ops: a command
+        # stuck behind a dead mgr / wedged paxos round ages into the
+        # mon's dump_blocked_ops and the SLOW_OPS health feed
+        self.op_tracker.start(
+            (client, tid),
+            f"mon_command({client} tid={tid} "
+            f"{cmdmap.get('prefix', '?')})")
+
         def reply(r, outs, outb):
+            self.op_tracker.finish((client, tid),
+                                   "replied" if r == 0 else f"r={r}")
             self.ms.connect(client).send_message(MMonCommandAck(
                 tid=tid, result=r, outs=outs, outb=outb))
 
@@ -561,7 +585,9 @@ class Monitor(Dispatcher):
             if self.leader_rank is None or not client:
                 reply_cb(-11, "EAGAIN: not the quorum leader", None)
                 return
-            # forward; the leader acks the client directly
+            # forward; the leader acks the client directly (so OUR
+            # tracked op is done — it must not age into SLOW_OPS)
+            self.op_tracker.finish((client, tid), "forwarded")
             self._send_rank(self.leader_rank, MMonForward(
                 tid=tid, client=client, cmd=cmdmap))
             return
@@ -633,10 +659,19 @@ class Monitor(Dispatcher):
         up = {o for o in range(self.osdmap.max_osd)
               if self.osdmap.is_up(o)}
         pgs = self.pgmap.primary_pgs(up)    # one digest per command
+        # non-OSD slow-op feeds: MDS beacons (expired with the beacon
+        # grace so a dead daemon's last report doesn't warn forever)
+        # and the mon's own command tracker
+        grace_mds = global_config()["mds_beacon_grace"]
+        slow = {name: s for name, s in self._mds_slow.items()
+                if now - s.get("stamp", now) <= grace_mds}
+        own = self.op_tracker.slow_summary()
+        if own["count"]:
+            slow[self.name] = own
         checks = health_checks(
             self.osdmap, self.pgmap, self.quorum(), self.mon_ranks,
             now, stale_after=global_config()
-            ["mon_osd_stale_report_grace"], pgs=pgs)
+            ["mon_osd_stale_report_grace"], pgs=pgs, slow_ops=slow)
         # mgr-module health reports (devicehealth/crash etc.) merge in
         # (ref: MgrStatMonitor's health contributions — volatile here
         # rather than paxos'd: the mgr re-reports every tick, so a
@@ -928,6 +963,15 @@ class Monitor(Dispatcher):
         the current map so it learns assignments/standdowns without a
         separate subscription."""
         self.mdsmon.note_beacon(msg.gid, self.clock())
+        # SLOW_OPS feed, MDS half: the beacon piggybacks the daemon's
+        # op-tracker summary; count 0 clears the entry (drained)
+        sl = dict(msg.slow_ops or {})
+        if msg.name:
+            if int(sl.get("count", 0)) > 0:
+                self._mds_slow[msg.name] = dict(sl,
+                                                stamp=self.clock())
+            else:
+                self._mds_slow.pop(msg.name, None)
         # reply to the daemon's ENTITY name, not msg.src: a beacon
         # relayed through a peon arrives with the peon's src
         src = msg.name or msg.src

@@ -28,6 +28,9 @@ class OSDStatReport:
     kb_used: int = 0
     kb_avail: int = 0
     perf: dict = field(default_factory=dict)
+    #: {count, oldest_age} of aged in-flight ops from the daemon's
+    #: OpTracker (the SLOW_OPS health feed)
+    slow_ops: dict = field(default_factory=dict)
 
 
 class PGMap:
@@ -104,7 +107,8 @@ class PGMap:
 def health_checks(osdmap, pgmap: PGMap, quorum: list[int],
                   mon_ranks: list[int], now: float,
                   stale_after: float = 60.0,
-                  pgs: dict | None = None) -> dict[str, dict]:
+                  pgs: dict | None = None,
+                  slow_ops: dict | None = None) -> dict[str, dict]:
     """name -> {severity, summary} (ref: health_check_map_t,
     src/mon/health_check.h; producers OSDMap::check_health
     src/osd/OSDMap.cc:5623 and PGMap::get_health_checks)."""
@@ -140,6 +144,31 @@ def health_checks(osdmap, pgmap: PGMap, quorum: list[int],
             "summary": f"Degraded data redundancy: "
                        f"{len(degraded)} pgs degraded",
             "detail": [f"pg {p} is degraded" for p in sorted(degraded)]}
+    # SLOW_OPS: any daemon reporting aged in-flight ops (ref: the
+    # health_check OSDMap/MDSMonitor derive from per-daemon op
+    # trackers under osd_op_complaint_time; cleared the moment every
+    # reporter's count drains to 0).  `slow_ops` merges the feeds:
+    # OSDs via their MPGStats report, MDSs via beacons, the mon's own
+    # tracker directly.
+    slow = {ent: s for ent, s in (slow_ops or {}).items()
+            if int(s.get("count", 0)) > 0}
+    osd_slow = {f"osd.{o}": r.slow_ops
+                for o, r in pgmap.osd_reports.items()
+                if osdmap.is_up(o)
+                and int(r.slow_ops.get("count", 0)) > 0}
+    slow.update(osd_slow)
+    if slow:
+        total = sum(int(s["count"]) for s in slow.values())
+        oldest = max(float(s.get("oldest_age", 0.0))
+                     for s in slow.values())
+        checks["SLOW_OPS"] = {
+            "severity": "HEALTH_WARN",
+            "summary": f"{total} slow ops, oldest one blocked for "
+                       f"{oldest:.0f} sec, daemons "
+                       f"{sorted(slow)} have slow ops.",
+            "detail": [f"{ent}: {s['count']} ops blocked, oldest "
+                       f"{float(s.get('oldest_age', 0.0)):.1f}s"
+                       for ent, s in sorted(slow.items())]}
     stale = {o: now - r.stamp for o, r in pgmap.osd_reports.items()
              if osdmap.is_up(o) and now - r.stamp > stale_after}
     if stale:

@@ -415,6 +415,11 @@ class MPGStats(Message):
     #: daemon perf counters (the MMgrReport payload in the reference —
     #: piggybacked on the stat report here)
     perf: dict = field(default_factory=dict)
+    # --- v2: slow-op summary {count, oldest_age} from the daemon's
+    # OpTracker — the mon raises SLOW_OPS while any report carries a
+    # non-zero count (ref: the health_checks slice DaemonServer
+    # derives from per-daemon op trackers)
+    slow_ops: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -494,6 +499,10 @@ class MMDSBeacon(Message):
     #: standby-replay target rank (-1 = plain standby; ref:
     #: mds_standby_replay / MDSMap::DAEMON_STATE standby-replay)
     standby_replay_rank: int = -1
+    # --- v2: slow-op summary {count, oldest_age} riding the beacon —
+    # the MDS half of the SLOW_OPS health feed (the OSD's rides
+    # MPGStats)
+    slow_ops: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -739,6 +748,8 @@ _VERSIONS: dict[str, tuple[int, int]] = {
     "PGQuery": (2, 1),          # v2: EC pool-type flag
     "PGNotify": (2, 1),         # v2: held EC shard indexes
     "PGLogReq": (2, 1),         # v2: EC shard-log view flag
+    "MPGStats": (2, 1),         # v2: slow-op summary (SLOW_OPS feed)
+    "MMDSBeacon": (2, 1),       # v2: slow-op summary (SLOW_OPS feed)
 }
 
 

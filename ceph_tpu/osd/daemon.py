@@ -204,7 +204,8 @@ class OSDDaemon(Dispatcher, MonHunter):
         self._last_stat_report = 0.0
         # in-flight/historic op tracking (ref: src/common/TrackedOp.h)
         from ..common.tracked_op import OpTracker
-        self.op_tracker = OpTracker()
+        self.op_tracker = OpTracker(
+            history_size=global_config()["osd_op_history_size"])
         self.asok = None
         # blkin-style span sink (ref: OpRequest::pg_trace plumbing)
         from ..common.tracing import Tracer
@@ -259,6 +260,12 @@ class OSDDaemon(Dispatcher, MonHunter):
                     "subop_w", "recovery_push", "recovery_pull",
                     "map_epochs"):
             self.perf.add_u64_counter(key)
+        # per-op-class latency histograms (ref: the l_osd_op_*_lat
+        # family + mClock op classes): exported by mgr/prometheus as
+        # real histogram families (_bucket/_sum/_count)
+        for key in ("op_lat_client", "op_lat_recovery",
+                    "op_lat_snaptrim"):
+            self.perf.add_latency_histogram(key)
         self.ms = Messenger.create(network, self.name, threaded=threaded)
         if keyring is not None:
             from ..auth import attach_cephx
@@ -320,15 +327,8 @@ class OSDDaemon(Dispatcher, MonHunter):
             global_config().set(c["var"], c["val"])
             return 0, "success"
         a.register("config set", "set one option", _config_set)
-        a.register("dump_ops_in_flight", "ops currently executing",
-                   lambda c: (0, self.op_tracker.dump_in_flight()))
-        a.register("dump_historic_ops", "recently completed ops",
-                   lambda c: (0, self.op_tracker.dump_historic()))
-        a.register("dump_blocked_ops", "ops over the complaint age",
-                   lambda c: (0, self.op_tracker.slow_ops()))
-        a.register("dump_traces", "finished blkin spans "
-                   "(optionally trace_id=...)",
-                   lambda c: (0, self.tracer.dump(c.get("trace_id"))))
+        from ..common.obs import register_obs_commands
+        register_obs_commands(a, self.op_tracker, self.tracer)
 
         def _status(c):
             with self._lock:
@@ -422,6 +422,7 @@ class OSDDaemon(Dispatcher, MonHunter):
             return True
         if isinstance(msg, ECSubRead):
             from .ec_backend import pg_cid
+            rsp = self.tracer.start_span(msg.trace, "ec_sub_read")
             st = self.pgs.get(msg.pgid)
             if st is not None and isinstance(st.shard, ECPGShard) and \
                     st.shard.shard == msg.shard:
@@ -442,6 +443,10 @@ class OSDDaemon(Dispatcher, MonHunter):
                     pgid=msg.pgid, tid=msg.tid, shard=msg.shard,
                     errors={oid: "ESTALE"
                             for oid, _off, _len in msg.to_read})
+            if rsp is not None:
+                rsp.event(f"shard={msg.shard} "
+                          f"errors={len(reply.errors)}")
+                self.tracer.finish(rsp)
             self.ms.connect(msg.src).send_message(reply)
             return True
         if isinstance(msg, ECSubWriteReply):
@@ -980,6 +985,9 @@ class OSDDaemon(Dispatcher, MonHunter):
                             epoch=m.epoch, tid_gen=self._tid_gen,
                             fabric=self.fabric,
                             send_osd=self._make_send_osd())
+                        # kernel spans (encode/decode) land in the
+                        # primary daemon's ring
+                        st.backend.tracer = self.tracer
                 else:
                     st.shard = ReplicatedPGShard(pg, self.store)
                     if acting_p == self.whoami:
@@ -1156,6 +1164,7 @@ class OSDDaemon(Dispatcher, MonHunter):
         shard.apply_clone_payloads(oid, clones or {})
 
     def _handle_push(self, msg: PGPush) -> None:
+        import time as _time
         with self._lock:
             st = self.pgs.get(msg.pgid)
             if st is None or not isinstance(st.shard,
@@ -1163,11 +1172,16 @@ class OSDDaemon(Dispatcher, MonHunter):
                 # a delayed push for a PG we no longer own must not
                 # write into the store (a later scan would report it)
                 return
+            t0 = _time.perf_counter()
             self._apply_push(st.shard, msg.oid, msg.data, msg.version,
                              msg.whiteout, force=msg.force,
                              attrs=msg.attrs, omap=msg.omap,
                              omap_hdr=msg.omap_hdr, clones=msg.clones,
                              backfill=msg.backfill)
+            # recovery-class latency: the apply of one push (pure
+            # store work — no jax values in the timed region)
+            self.perf.hobs("op_lat_recovery",
+                           _time.perf_counter() - t0)
             if msg.version:
                 # clear any missing-set entry this push satisfied (the
                 # replica side of recovery bookkeeping)
@@ -2065,10 +2079,11 @@ class OSDDaemon(Dispatcher, MonHunter):
 
     def _dispatch_trim(self, pg: PG, st: _PGState, snap: int,
                        oid: str, clone: int) -> None:
+        import time as _time
         tid = next(self._tid_gen)
         st.snaptrim_state["inflight"][tid] = {
             "snap": snap, "oid": oid, "clone": clone,
-            "pending": None, "ticks": 0}
+            "pending": None, "ticks": 0, "t0": _time.monotonic()}
         # ride the QoS queue: osd_snap_trim_sleep paces the drain
         self.op_queue.enqueue(
             "snaptrim", lambda pg=pg, tid=tid: self._send_trim(pg, tid))
@@ -2101,6 +2116,7 @@ class OSDDaemon(Dispatcher, MonHunter):
                 # stale clone cannot outlive the reconcile
             if not ent["pending"]:
                 ts["inflight"].pop(tid, None)
+                self._trim_done_lat(ent)
                 self._trim_advance(pg, st)
 
     def _handle_trim_reply(self, m: SnapTrimReply) -> None:
@@ -2119,7 +2135,17 @@ class OSDDaemon(Dispatcher, MonHunter):
         ent["pending"].discard(m.from_osd)
         if not ent["pending"]:
             ts["inflight"].pop(m.tid, None)
+            self._trim_done_lat(ent)
             self._trim_advance(m.pgid, st)
+
+    def _trim_done_lat(self, ent: dict) -> None:
+        """snaptrim-class latency: dispatch -> every shard committed
+        (includes the QoS-queue pacing, which IS the interesting part
+        of trim latency under osd_snap_trim_sleep)."""
+        import time as _time
+        t0 = ent.get("t0")
+        if t0 is not None:
+            self.perf.hobs("op_lat_snaptrim", _time.monotonic() - t0)
 
     def _trim_failed(self, pg: PG, st: _PGState) -> None:
         """A shard could not apply a trim: back off and retry a fresh
@@ -2328,16 +2354,22 @@ class OSDDaemon(Dispatcher, MonHunter):
             pg_stats=pg_stats, kb_total=fs["total"] // 1024,
             kb_used=fs["used"] // 1024,
             kb_avail=fs["available"] // 1024,
-            perf=perf))
+            perf=perf,
+            # SLOW_OPS feed: aged in-flight ops (count + oldest age);
+            # a drained tracker reports count 0, clearing the warning
+            # on the mon within one report interval
+            slow_ops=self.op_tracker.slow_summary()))
 
     # ---------------------------------------------------- client ops
     def _reply(self, msg, result: int, errno_name: str = "",
                data: bytes = b"", attrs: dict | None = None) -> None:
         if msg is None:
             return      # scheduler-initiated op: no client to answer
-        self.op_tracker.finish((msg.src, msg.tid),
-                               "commit_sent" if result == 0
-                               else f"error:{errno_name}")
+        dur = self.op_tracker.finish((msg.src, msg.tid),
+                                     "commit_sent" if result == 0
+                                     else f"error:{errno_name}")
+        if dur is not None:
+            self.perf.hobs("op_lat_client", dur)
         sp = self._op_spans.pop((msg.src, msg.tid), None)
         if sp is not None:
             sp.event("reply_sent" if result == 0
@@ -2716,4 +2748,5 @@ class OSDDaemon(Dispatcher, MonHunter):
                 self.perf.inc("op_r_bytes", len(data))
                 self._reply(m, 0, data=data)
 
-        b.objects_read_and_reconstruct({msg.oid: window}, on_complete)
+        b.objects_read_and_reconstruct({msg.oid: window}, on_complete,
+                                       trace=msg.trace)

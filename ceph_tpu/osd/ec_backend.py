@@ -30,6 +30,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Iterable, Optional
 
 from ..common.log import dout
+from ..common.tracing import child_of
 from ..msg.messages import (ECSubRead, ECSubReadReply, ECSubWrite,
                             ECSubWriteReply)
 from ..store import ObjectId, StoreError, Transaction
@@ -519,6 +520,7 @@ class _Read:
     retried: bool = False
     #: oid -> (chunk_off, chunk_len, logical_base); (0,0,0)=full stream
     chunk_windows: dict = field(default_factory=dict)
+    trace: Optional[dict] = None      # blkin context for decode spans
 
 
 class ECBackend:
@@ -579,6 +581,10 @@ class ECBackend:
         self._recheck = False
         self.tid_to_op: dict[int, _Write] = {}
         self.in_flight_reads: dict[int, _Read] = {}
+        #: span sink for the Pallas encode/decode kernel regions —
+        #: the owning daemon points this at its Tracer; None (library
+        #: use, tracing off) costs nothing on the hot path
+        self.tracer = None
 
     # -- utilities ------------------------------------------------------
     def _next_tid(self) -> int:
@@ -832,7 +838,6 @@ class ECBackend:
         else:
             shards, shard_txns, new_size = self._encode_write(op)
         op.pending_shards = set(shard_txns)
-        from ..common.tracing import child_of
         for s, txn in shard_txns.items():
             msg = ECSubWrite(pgid=self.pgid, tid=op.tid, shard=s,
                              txn=txn, log_entries=[op.log_entry],
@@ -927,7 +932,19 @@ class ECBackend:
             return self._encode_write_fabric(op, kind, bytes(seg),
                                              start, chunk_off,
                                              old_size, new_size)
+        # kernel span, only when this op is traced: ecutil.encode
+        # returns host bytes, so the device dispatch is fully forced
+        # (block_until_ready-equivalent) by the time the span closes —
+        # the staged-encode cost shows up as its own span instead of
+        # hiding inside the osd_op (ref: the ECBackend.cc:1508 trace
+        # events around the encode)
+        ksp = None if self.tracer is None else \
+            self.tracer.start_span(child_of(op.trace),
+                                   "ec_encode_kernel")
         shards = ecutil.encode(sinfo, self.ec, bytes(seg))
+        if ksp is not None:
+            ksp.event(f"bytes={len(seg)} k={self.k} m={self.m}")
+            self.tracer.finish(ksp)
 
         # cumulative hinfo only survives pure stripe-aligned appends:
         # start is stripe-aligned, so start == old_size iff the old
@@ -1067,13 +1084,14 @@ class ECBackend:
     def objects_read_and_reconstruct(
             self, reads: dict, on_complete: Callable,
             for_recovery: bool = False,
-            want_attrs: bool = False) -> None:
+            want_attrs: bool = False,
+            trace: dict | None = None) -> None:
         with self._lock:
             tid = self._next_tid()
             rd = _Read(tid=tid, reads=dict(reads),
                        on_complete=on_complete,
                        for_recovery=for_recovery,
-                       want_attrs=want_attrs)
+                       want_attrs=want_attrs, trace=trace)
             # translate each logical window into a per-shard chunk
             # window so a small read never pulls whole shard streams
             # (ref: ECBackend.cc:1590 builds per-shard offset/len
@@ -1120,7 +1138,8 @@ class ECBackend:
         return ECSubRead(
             pgid=self.pgid, tid=rd.tid, shard=s,
             to_read=[(oid,) + rd.chunk_windows[oid][:2] for oid in oids],
-            attrs_to_read=list(oids) if rd.want_attrs else [])
+            attrs_to_read=list(oids) if rd.want_attrs else [],
+            trace=child_of(rd.trace))
 
     def _dispatch_read(self, rd: _Read, s: int, msg: ECSubRead) -> None:
         if self.acting[s] == self.whoami:
@@ -1195,7 +1214,19 @@ class ECBackend:
                 errors[oid] = "EIO"
                 continue
             base = rd.chunk_windows[oid][2]   # logical offset of bufs[0]
+            # kernel span when the read is traced: decode_concat's
+            # output is host bytes, so survivor staging (the host-side
+            # gather/stack that dominates decode_incl_stage in
+            # BENCH_r05) AND the device decode are both inside the
+            # span when it closes
+            ksp = None if self.tracer is None or rd.trace is None \
+                else self.tracer.start_span(child_of(rd.trace),
+                                            "ec_decode_kernel")
             logical = ecutil.decode_concat(self.sinfo, self.ec, bufs)
+            if ksp is not None:
+                ksp.event(f"shards={len(bufs)} "
+                          f"bytes={len(logical)}")
+                self.tracer.finish(ksp)
             size = self._oi_size(rd, oid)
             # highest valid logical byte we can serve from this read
             limit = base + len(logical) if size is None \

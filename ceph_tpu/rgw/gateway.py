@@ -53,10 +53,12 @@ bucket notifications.
 from __future__ import annotations
 
 import hashlib
+import itertools
 import json
 import threading
 
 from ..common.lockdep import make_lock
+from ..common.log import dout
 import time
 import urllib.error
 import urllib.request
@@ -145,6 +147,19 @@ class RGWGateway:
             self.io.create(BUCKETS_OBJ)
         except RadosError:
             pass
+        # op tracking + span ring (ref: rgw's req tracking behind
+        # `radosgw-admin ... ops` + the rgw blkin trace roots): every
+        # HTTP request is tracked; traced ones root a span the
+        # objecter legs nest under (gateway -> objecter -> OSD ->
+        # shards in one assembled tree)
+        from ..common.options import global_config as _gc
+        from ..common.tracked_op import OpTracker
+        from ..common.tracing import Tracer
+        self.op_tracker = OpTracker(
+            history_size=_gc()["osd_op_history_size"])
+        self.tracer = Tracer(f"rgw.{zone or pool}")
+        self.asok = None
+        self._req_ids = itertools.count(1)
         gw = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -154,6 +169,22 @@ class RGWGateway:
                 pass
 
             def _run(self, method):
+                from ..common.tracing import new_trace, trace_scope
+                opkey = (threading.get_ident(), next(gw._req_ids))
+                gw.op_tracker.start(
+                    opkey, f"http_req({method} {self.path})")
+                ctx = new_trace() \
+                    if _gc()["blkin_trace_all"] else None
+                sp = gw.tracer.start_span(
+                    ctx, f"rgw_op:{method} {self.path.split('?')[0]}")
+                try:
+                    with trace_scope(ctx):
+                        self._run_inner(method)
+                finally:
+                    gw.op_tracker.finish(opkey)
+                    gw.tracer.finish(sp)
+
+            def _run_inner(self, method):
                 try:
                     body = gw._read_body(self)
                     self._body = body
@@ -299,6 +330,10 @@ class RGWGateway:
         self._gc_queue: list[tuple[float, str]] = []
         self._gc_lock = make_lock("rgw.gc")
         self._gc_stop = threading.Event()
+        #: serializes in-process registry mutations: a tombstone
+        #: prune's read-then-remove racing a handler thread's bucket
+        #: recreate must not remove the fresh live entry
+        self._registry_lock = make_lock("rgw.registry")
 
     #: seconds an orphaned object outlives its index unlink
     GC_GRACE_S = 2.0
@@ -357,12 +392,82 @@ class RGWGateway:
             # agent first: its in-flight batch is abandoned before the
             # marker persists — the restart replays it (idempotent)
             self.sync.stop()
+        if self.asok is not None:
+            self.asok.shutdown()
+            self.asok = None
         self.pusher.stop()
         self._gc_stop.set()
         self.httpd.shutdown()
         self.httpd.server_close()
         # no requests can race us anymore: collect everything pending
         self._gc_tick(everything=True)
+
+    def start_admin_socket(self, path: str) -> None:
+        """`ceph daemon rgw.<zone> <cmd>` endpoint — the same
+        op-tracker/trace surface every other daemon serves."""
+        from ..common.admin_socket import AdminSocket
+        from ..common.obs import register_obs_commands
+        a = AdminSocket(path)
+        register_obs_commands(a, self.op_tracker, self.tracer)
+        a.register("status", "gateway status",
+                   lambda c: (0, {"zone": self.zone, "pool": self.pool,
+                                  "port": self.port}))
+        a.start()
+        self.asok = a
+
+    def prune_registry_tombstones(self, peer_views: dict) -> int:
+        """Drop bucket-deletion tombstones every peer has confirmed
+        past (ref: the reference trims metadata logs by the minimum
+        peer marker).  `peer_views` maps source zone -> (fetch stamp,
+        that zone's raw registry dump) from THIS round.  A tombstone
+        may go once, for every peer, the view POSTDATES the deletion
+        (a snapshot taken before it proves nothing — a bucket deleted
+        mid-round would be pruned off stale absence evidence) and the
+        peer either (a) carries the same deletion (its sync applied
+        it), (b) has no entry at all (never replicated the bucket, or
+        already pruned its own tombstone), or (c) recreated the
+        bucket after the deletion — a peer still holding a LIVE
+        pre-deletion copy keeps the tombstone, since our next listing
+        pull would resurrect the bucket without it.  Returns the
+        number pruned; bounded registry growth is the point."""
+        candidates: dict[str, str] = {}
+        for bucket, meta in self._buckets_raw().items():
+            if "deleted" not in meta:
+                continue
+            dt = meta["deleted"]
+            ok = True
+            for stamp, view in peer_views.values():
+                if stamp <= dt:
+                    ok = False      # evidence predates the deletion
+                    break
+                ent = view.get(bucket)
+                if ent is None:
+                    continue                       # (b)
+                if "deleted" in ent and ent["deleted"] >= dt:
+                    continue                       # (a)
+                if ent.get("created", "") > dt:
+                    continue                       # (c)
+                ok = False
+                break
+            if ok:
+                candidates[bucket] = dt
+        if not candidates:
+            return 0
+        with self._registry_lock:
+            # ONE locked re-read covering every candidate: a handler
+            # thread may have recreated a bucket since the snapshot —
+            # removing its key then would delete the LIVE entry
+            cur = self._buckets_raw()
+            drop = [b for b, dt in candidates.items()
+                    if cur.get(b, {}).get("deleted") == dt]
+            if drop:
+                self.io.remove_omap_keys(BUCKETS_OBJ, drop)
+        for b in drop:
+            dout("rgw", 4).write(
+                "%s: pruned tombstone for bucket %r (deleted %s, "
+                "all %d peers past it)", self.zone, b, candidates[b],
+                len(peer_views))
+        return len(drop)
 
     # -- notifications (ref: src/rgw/rgw_pubsub.cc) ----------------------
     def _notify_event(self, bucket: str, key: str, event: str,
@@ -573,11 +678,13 @@ class RGWGateway:
         meta: that would silently wipe versioning/lifecycle state).
         `created` adopts the metadata master's stamp on a forwarded
         create — every zone must agree on the incarnation stamp."""
-        if bucket in self._buckets():
-            return False
-        meta = json.dumps({"created": created or self._now_str(),
-                           "shards": self.index_shards}).encode()
-        self.io.operate(BUCKETS_OBJ, WriteOp().set_omap({bucket: meta}))
+        with self._registry_lock:
+            if bucket in self._buckets():
+                return False
+            meta = json.dumps({"created": created or self._now_str(),
+                               "shards": self.index_shards}).encode()
+            self.io.operate(BUCKETS_OBJ,
+                            WriteOp().set_omap({bucket: meta}))
         for shard in range(self.index_shards):
             self.io.create(_index_obj(bucket, shard))
         return True

@@ -278,6 +278,17 @@ def sync_status_all() -> list[dict]:
     return rows
 
 
+def sync_apply_hists() -> dict[str, dict]:
+    """zone -> sync-apply latency histogram dump (the "sync" op class
+    of the cluster SLO histograms; scraped by mgr/prometheus)."""
+    out: dict[str, dict] = {}
+    for agent in list(_AGENTS):
+        if agent._stop.is_set():
+            continue
+        out[agent.zone] = agent.perf.get("op_lat_sync")
+    return out
+
+
 class SyncAgent:
     """Per-zone replication worker: one thread, pull-based, durable
     cursors (ref: RGWDataSyncProcessorThread + RGWRemoteDataLog)."""
@@ -320,6 +331,12 @@ class SyncAgent:
         self.entries_skipped = 0
         self.full_syncs = 0
         self._loaded_sources: set[str] = set()
+        # sync-class apply latency (fetch + local apply per replicated
+        # entry) — the fourth op-class SLO histogram next to the OSD's
+        # client/recovery/snaptrim
+        from ..common.perf_counters import PerfCounters
+        self.perf = PerfCounters(f"rgw.sync.{self.zone}")
+        self.perf.add_latency_histogram("op_lat_sync")
         _AGENTS.add(self)
 
     # -- lifecycle ----------------------------------------------------
@@ -352,13 +369,17 @@ class SyncAgent:
         self.gw.multisite.refresh()
         applied = 0
         now = time.monotonic()
-        for peer in self.gw.multisite.peers():
+        peers = self.gw.multisite.peers()
+        #: this round's per-peer registry dumps — the tombstone-prune
+        #: evidence (only a round that reached EVERY peer may prune)
+        views: dict[str, dict] = {}
+        for peer in peers:
             src = peer["zone"]
             fails, next_ok = self._backoff.get(src, (0, 0.0))
             if now < next_ok:
                 continue
             try:
-                applied += self._sync_peer(peer)
+                applied += self._sync_peer(peer, views)
                 self._backoff[src] = (0, 0.0)
                 self._peer_ok[src] = True
             except PeerError as ex:
@@ -373,9 +394,20 @@ class SyncAgent:
                 dout("rgw", 4).write(
                     "sync %s<-%s unreachable (%s), backoff %.2fs",
                     self.zone, src, ex, delay)
+        if peers and len(views) == len(peers) and \
+                not self._stop.is_set():
+            # every peer answered this round: registry delete-
+            # tombstones every peer's sync has demonstrably passed
+            # (their registries carry the deletion, or dropped it)
+            # can go — bounded tombstone growth.  `peers` non-empty is
+            # load-bearing: a transient no-peer window (period refresh
+            # mid-adopt) must not approve every tombstone with zero
+            # evidence
+            self.gw.prune_registry_tombstones(views)
         return applied
 
-    def _sync_peer(self, peer: dict) -> int:
+    def _sync_peer(self, peer: dict,
+                   views: dict[str, dict] | None = None) -> int:
         src, endpoint = peer["zone"], peer["endpoint"]
         if src not in self._loaded_sources:
             self._load_state(src)
@@ -385,7 +417,11 @@ class SyncAgent:
         if period.get("epoch", 0) > self.gw.multisite.epoch:
             self.gw.multisite.admin.period_adopt(period)
             self.gw.multisite.refresh(force=True)
+        from ..cls.rgw import now_str
+        fetch_stamp = now_str()
         buckets = self._fetch_json(endpoint, "GET", "/admin/buckets")
+        if views is not None:
+            views[src] = (fetch_stamp, buckets)
         local = self.gw._buckets_raw()  # one registry read per round
         applied = 0
         pending_full = 0
@@ -669,6 +705,7 @@ class SyncAgent:
             for op in self._ops_of_entry(ent["key"], cur):
                 n += self._apply(src, endpoint, bucket, op, ln)
             return n
+        t0 = time.perf_counter()
         data = None
         if ent["op"] == "put":
             fetched = self._fetch_object(endpoint, bucket, ent)
@@ -680,6 +717,10 @@ class SyncAgent:
         applied = self.gw.sync_apply(bucket, ent, data, src,
                                      nshards=ln)
         if applied:
+            # sync-class latency: cross-zone fetch + local apply of
+            # one replicated entry (skips are free, not latency)
+            self.perf.hobs("op_lat_sync",
+                           time.perf_counter() - t0)
             self.entries_applied += 1
             return 1
         self.entries_skipped += 1
@@ -824,6 +865,7 @@ class SyncAgent:
                 "entries_applied": self.entries_applied,
                 "entries_skipped": self.entries_skipped,
                 "full_syncs": self.full_syncs,
+                "apply_lat": self.perf.get("op_lat_sync"),
                 "sources": sources}
 
     def caught_up(self) -> bool:

@@ -182,6 +182,45 @@ def cmd_insights(r, a, out):
     return _mon_verb(r, {"prefix": "insights"}, out)
 
 
+def cmd_trace(r, a, out):
+    """Assemble one cross-daemon trace: query every daemon's
+    `dump_traces` ring (admin sockets under --asok-dir) by trace_id
+    and print ONE indented span tree with per-span durations (the
+    blkin/zipkin-UI job as a CLI verb)."""
+    import glob
+
+    from ..common.admin_socket import admin_command
+    from ..common.tracing import format_tree, span_tree
+
+    if not a.asok_dir:
+        print("error: trace wants --asok-dir <dir of *.asok>",
+              file=sys.stderr)
+        return 1
+    spans, asked = [], 0
+    for p in sorted(glob.glob(os.path.join(a.asok_dir, "*.asok"))):
+        try:
+            rc, got = admin_command(
+                p, {"prefix": "dump_traces", "trace_id": a.trace_id})
+        except OSError as e:
+            print(f"warning: {p}: {e}", file=sys.stderr)
+            continue
+        asked += 1
+        if rc == 0 and isinstance(got, list):
+            spans.extend(got)
+    if not asked:
+        print(f"error: no *.asok under {a.asok_dir}", file=sys.stderr)
+        return 1
+    if not spans:
+        print(f"no spans found for trace {a.trace_id} "
+              f"({asked} daemons asked)", file=out)
+        return 1
+    print(f"trace {a.trace_id}: {len(spans)} spans from {asked} "
+          f"daemons, {len(span_tree(spans))} root(s)", file=out)
+    for line in format_tree(spans):
+        print(line, file=out)
+    return 0
+
+
 # ------------------------------------------------- rgw multisite admin
 # (ref: src/rgw/rgw_admin.cc realm/zonegroup/zone/period/datalog verbs
 #  + `radosgw-admin sync status`)
@@ -401,6 +440,11 @@ def main(argv=None, rados=None, out=None) -> int:
     p.add_argument("state", nargs="?", default="on",
                    choices=["on", "off"])
     p = sub.add_parser("insights")
+    p = sub.add_parser("trace")
+    p.add_argument("trace_id", help="trace id to assemble")
+    p.add_argument("--asok-dir", default="",
+                   help="directory of daemon admin sockets (*.asok) "
+                        "to query dump_traces on")
     p = sub.add_parser("rgw")
     p.add_argument("verb", choices=["realm", "zonegroup", "zone",
                                     "period", "datalog",
@@ -434,6 +478,9 @@ def main(argv=None, rados=None, out=None) -> int:
     p.add_argument("--no-cleanup", action="store_true")
     a = ap.parse_args(argv)
 
+    if a.cmd == "trace":
+        # pure admin-socket verb: needs no cluster connection
+        return cmd_trace(None, a, out) or 0
     own = rados is None
     if own:
         if not a.monmap:

@@ -18,6 +18,7 @@ command language:
     kill-osd <id> | revive-osd <id> | crash-osd <id> | tick
     crash [ls|ls-new|stat|info <id>|archive <id>|archive-all|prune <d>]
     telemetry [show|status|on|off] | insights
+    trace on|off | trace ls | trace <trace_id>
     perf dump | status | quit
 
 Example:
@@ -244,6 +245,8 @@ class VstartShell:
             return True
         if cmd == "rgw":
             return self._rgw(toks[1:])
+        if cmd == "trace":
+            return self._trace(toks[1:])
         if cmd == "perf" and toks[1:] == ["dump"]:
             self._print(json.dumps(
                 self.cluster.perf_collection.perf_dump(), indent=1,
@@ -347,6 +350,51 @@ class VstartShell:
                     self._print(r.read().decode(errors="replace"))
             return True
         self._print(f"Error: unknown rgw verb {sub}")
+        return True
+
+    def _trace(self, toks: list[str]) -> bool:
+        """Distributed tracing verbs:
+          trace on|off         — toggle blkin_trace_all
+          trace ls             — recent trace ids (client roots)
+          trace <trace_id>     — assemble ONE cross-daemon span tree
+        """
+        from ..common.options import global_config
+        from ..common.tracing import format_tree
+        if not toks:
+            self._print("trace on|off|ls|<trace_id>")
+            return True
+        if toks[0] in ("on", "off"):
+            global_config().set("blkin_trace_all", toks[0] == "on")
+            self._print(f"tracing {toks[0]}")
+            return True
+        if toks[0] == "ls":
+            seen = []
+            for s in self.rados.objecter.dump_traces():
+                if s["trace_id"] not in seen:
+                    seen.append(s["trace_id"])
+            for t in seen[-20:]:
+                self._print(t)
+            return True
+        tid = toks[0]
+        spans = list(self.rados.objecter.dump_traces(tid))
+        for c in self.cluster.clients:
+            if c is not self.rados:
+                spans += c.objecter.dump_traces(tid)
+        daemons = list(self.cluster.mons.values()) \
+            + list(self.cluster.osds.values()) \
+            + list(self.cluster.mdss.values()) \
+            + list(getattr(self, "rgw_zones", {}).values())
+        if self.mgr is not None:
+            daemons.append(self.mgr)
+        for d in daemons:
+            tr = getattr(d, "tracer", None)
+            if tr is not None:
+                spans += tr.dump(tid)
+        if not spans:
+            self._print(f"no spans for trace {tid}")
+            return True
+        for line in format_tree(spans):
+            self._print(line)
         return True
 
     def _pg(self, toks: list[str]) -> bool:

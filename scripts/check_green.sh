@@ -94,6 +94,31 @@ run_multisite_smoke() {
     return 0
 }
 
+# Trace smoke: one traced S3 PUT must assemble into a cross-daemon
+# span tree with every tier (rgw/objecter/osd/sub-op) present, and a
+# traced EC op must land shard + kernel spans; then the quick SLO
+# report must find the same stages end to end.
+run_trace_smoke() {
+    echo "=== check_green: distributed-trace smoke ==="
+    timeout -k 10 180 env JAX_PLATFORMS=cpu \
+        python scripts/trace_smoke.py
+    local rc=$?
+    if [ "$rc" -ne 0 ]; then
+        echo "check_green: RED (trace smoke rc=$rc — tracing" \
+             "broken) — do not ship" >&2
+        return 1
+    fi
+    timeout -k 10 240 env JAX_PLATFORMS=cpu \
+        python scripts/slo_report.py --quick > /dev/null
+    rc=$?
+    if [ "$rc" -ne 0 ]; then
+        echo "check_green: RED (slo_report --quick rc=$rc — SLO" \
+             "assembly broken) — do not ship" >&2
+        return 1
+    fi
+    return 0
+}
+
 run_static || exit 1
 if [ "$STATIC_ONLY" -eq 1 ]; then
     echo "check_green: GREEN (static only)"
@@ -101,6 +126,7 @@ if [ "$STATIC_ONLY" -eq 1 ]; then
 fi
 run_crash_smoke || exit 1
 run_multisite_smoke || exit 1
+run_trace_smoke || exit 1
 
 if [ "$REPEAT" -gt 1 ] && [ ${#TARGETS[@]} -eq 0 ]; then
     TARGETS=(tests/test_thrasher.py tests/test_thrash_ec.py \
@@ -141,6 +167,13 @@ for i in $(seq 1 "$REPEAT"); do
     trap 'rm -f "${TMPDIR:-/tmp}"/check_green.$$.*.log' EXIT
     if [ "$REPEAT" -gt 1 ]; then
         echo "=== check_green run $i/$REPEAT: ${TARGETS[*]} ==="
+        # flake gate includes the SLO assembly: trace stitching that
+        # only works some of the time must not gate as green
+        timeout -k 10 240 env JAX_PLATFORMS=cpu \
+            python scripts/slo_report.py --quick > /dev/null || {
+            echo "check_green: RED (slo_report --quick, run $i)" >&2
+            exit 1
+        }
     fi
     run_once "$LOG" || exit 1
 done

@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""slo_report: drive a MiniCluster workload with tracing on, assemble
+cross-daemon traces, and emit a BENCH-style SLO artifact.
+
+The cluster-SLO half of ROADMAP direction 5: op p50/p99 per op kind
+(replicated/EC write, read) measured at the client, plus a per-stage
+breakdown (objecter leg, OSD primary, replica/shard sub-ops, the
+Pallas encode/decode kernel spans) assembled from every daemon's
+`dump_traces` ring by trace_id.  The committed SLO_rNN.json is the
+regression anchor the load harness of direction 5 will compare
+against — the shape mirrors BENCH_rNN.json ("parsed" with metric /
+value / detail).
+
+    python scripts/slo_report.py              # full (SLO_rNN.json)
+    python scripts/slo_report.py --quick      # smoke: few ops, no file
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import re
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def pctl(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1,
+            max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[i]
+
+
+def stage_stats(durs: list[float]) -> dict:
+    s = sorted(durs)
+    return {"count": len(s),
+            "p50_ms": round(pctl(s, 0.50) * 1e3, 4),
+            "p99_ms": round(pctl(s, 0.99) * 1e3, 4),
+            "max_ms": round((s[-1] if s else 0.0) * 1e3, 4)}
+
+
+def run(n_ops: int, payload: int) -> dict:
+    from ceph_tpu.common.options import global_config
+    from ceph_tpu.common.tracing import span_tree
+    from ceph_tpu.testing import MiniCluster
+
+    cfg = global_config()
+    c = MiniCluster(n_osd=4, threaded=True)
+    t_wall = time.monotonic()
+    try:
+        c.wait_all_up()
+        r = c.rados()
+        r.mon_command({"prefix": "osd erasure-code-profile set",
+                       "name": "slo21",
+                       "profile": {"plugin": "tpu", "k": "2",
+                                   "m": "1",
+                                   "crush-failure-domain": "osd"}})
+        r.pool_create("slo-rep", pg_num=8)
+        r.pool_create("slo-ec", pg_num=8, pool_type="erasure",
+                      erasure_code_profile="slo21")
+        rep = r.open_ioctx("slo-rep")
+        ec = r.open_ioctx("slo-ec")
+        data = b"s" * payload
+        # warm the pools untraced so pg creation/peering cost stays
+        # out of the SLO sample
+        rep.write_full("warm", data)
+        ec.write_full("warm", data)
+
+        cfg.set("blkin_trace_all", True)
+        lat: dict[str, list[float]] = {
+            "write_replicated": [], "write_ec": [],
+            "read_replicated": [], "read_ec": []}
+        try:
+            for i in range(n_ops):
+                for kind, io in (("replicated", rep), ("ec", ec)):
+                    t0 = time.perf_counter()
+                    io.write_full(f"o{i}", data)
+                    lat[f"write_{kind}"].append(
+                        time.perf_counter() - t0)
+                for kind, io in (("replicated", rep), ("ec", ec)):
+                    t0 = time.perf_counter()
+                    io.read(f"o{i}")
+                    lat[f"read_{kind}"].append(
+                        time.perf_counter() - t0)
+        finally:
+            cfg.set("blkin_trace_all", False)
+
+        # assemble: every daemon's ring + the client's, by trace_id
+        # (the cross-daemon `dump_traces` join the CLI verb also does)
+        spans = r.objecter.dump_traces()
+        for d in c.osds.values():
+            spans += d.tracer.dump()
+        by_stage: dict[str, list[float]] = {}
+        traces: set[str] = set()
+        for s in spans:
+            traces.add(s["trace_id"])
+            stage = s["name"].split(":", 1)[0]
+            by_stage.setdefault(stage, []).append(s["duration"])
+        n_assembled = sum(1 for t in traces
+                          if len(span_tree(
+                              [s for s in spans
+                               if s["trace_id"] == t])) >= 1)
+        return {
+            "metric": "cluster_op_slo",
+            "unit": "ms",
+            "value": stage_stats(lat["write_ec"])["p99_ms"],
+            "detail": {
+                "workload": {"ops_per_kind": n_ops,
+                             "payload_bytes": payload,
+                             "osds": 4, "ec_profile": "k=2 m=1",
+                             "wall_s": round(time.monotonic()
+                                             - t_wall, 2)},
+                "op": {k: stage_stats(v) for k, v in lat.items()},
+                "stages": {k: stage_stats(v)
+                           for k, v in sorted(by_stage.items())},
+                "traces_assembled": n_assembled,
+                "spans_collected": len(spans),
+            },
+        }
+    finally:
+        c.shutdown()
+
+
+def next_round() -> int:
+    rounds = [int(m.group(1)) for p in REPO.glob("SLO_r*.json")
+              for m in [re.match(r"SLO_r(\d+)\.json", p.name)] if m]
+    return max(rounds, default=0) + 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="slo_report")
+    ap.add_argument("--quick", action="store_true",
+                    help="smoke mode: few ops, print only (the "
+                         "check_green step)")
+    ap.add_argument("--ops", type=int, default=None,
+                    help="traced ops per kind (default 40, quick 4)")
+    ap.add_argument("--payload", type=int, default=64 * 1024)
+    ap.add_argument("-o", "--out", default=None,
+                    help="output path (default SLO_r<NN>.json; "
+                         "ignored with --quick)")
+    a = ap.parse_args(argv)
+    n_ops = a.ops if a.ops is not None else (4 if a.quick else 40)
+    report = run(n_ops, a.payload)
+    det = report["detail"]
+    # sanity: the assembled stages must include the client leg, the
+    # OSD primary leg and the sub-op fan-out, or tracing regressed
+    for want in ("objecter_op", "osd_op"):
+        if want not in det["stages"] or \
+                det["stages"][want]["count"] == 0:
+            print(f"slo_report: FAIL — no '{want}' spans assembled",
+                  file=sys.stderr)
+            return 1
+    if det["stages"].get("ec_sub_write", {}).get("count", 0) == 0:
+        print("slo_report: FAIL — no EC shard spans assembled",
+              file=sys.stderr)
+        return 1
+    print(json.dumps(report, indent=1, sort_keys=True))
+    if not a.quick:
+        out = pathlib.Path(a.out) if a.out else \
+            REPO / f"SLO_r{next_round():02d}.json"
+        out.write_text(json.dumps(report, indent=1, sort_keys=True)
+                       + "\n")
+        print(f"wrote {out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

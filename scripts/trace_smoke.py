@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""trace_smoke: one traced S3 PUT + one traced EC write must assemble
+into cross-daemon trace trees with every tier present.
+
+The observability half of the ship gate (run from check_green.sh):
+
+* S3 PUT through a gateway: ONE trace tree containing the rgw
+  frontend root, the objecter legs beneath it, the OSD primary spans
+  beneath those, and the replica sub-op spans beneath those — four
+  daemon tiers stitched by trace_id.
+* EC pool write + read: the per-shard sub-op spans AND the Pallas
+  encode/decode kernel spans (the staged-decode cost) are present
+  when tracing is on.
+
+Exit 0 = every tier assembled; anything else = tracing regressed, do
+not ship.
+"""
+from __future__ import annotations
+
+import pathlib
+import sys
+import urllib.request
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    from ceph_tpu.common.options import global_config
+    from ceph_tpu.common.tracing import format_tree, span_tree
+    from ceph_tpu.rgw import RGWGateway
+    from ceph_tpu.testing import MiniCluster
+
+    cfg = global_config()
+    c = MiniCluster(n_osd=3, threaded=True)
+    gw = None
+    try:
+        c.wait_all_up()
+        r = c.rados()
+        r.mon_command({"prefix": "osd erasure-code-profile set",
+                       "name": "k2m1",
+                       "profile": {"plugin": "tpu", "k": "2",
+                                   "m": "1",
+                                   "crush-failure-domain": "osd"}})
+        r.pool_create("smoke-ec", pg_num=8, pool_type="erasure",
+                      erasure_code_profile="k2m1")
+        gw = RGWGateway(c.rados(), pool="rgw-smoke")
+        gw.start()
+        base = f"http://127.0.0.1:{gw.port}"
+        urllib.request.urlopen(urllib.request.Request(
+            f"{base}/tb", method="PUT"), timeout=30).read()
+
+        cfg.set("blkin_trace_all", True)
+        try:
+            urllib.request.urlopen(urllib.request.Request(
+                f"{base}/tb/traced-key", data=b"trace me" * 512,
+                method="PUT"), timeout=30).read()
+            ec = r.open_ioctx("smoke-ec")
+            ec.write_full("traced-ec", b"follow" * 2048)
+            ec.read("traced-ec")
+        finally:
+            cfg.set("blkin_trace_all", False)
+
+        spans = gw.tracer.dump()
+        for cl in c.clients:
+            spans += cl.objecter.dump_traces()
+        for d in c.osds.values():
+            spans += d.tracer.dump()
+
+        # --- tier check 1: the S3 PUT tree -------------------------
+        roots = [s for s in spans
+                 if s["name"].startswith("rgw_op:PUT /tb/traced-key")]
+        if len(roots) != 1:
+            print(f"FAIL: expected 1 rgw root span, got {len(roots)}",
+                  file=sys.stderr)
+            return 1
+        tid = roots[0]["trace_id"]
+        tree_spans = [s for s in spans if s["trace_id"] == tid]
+        tiers = {"rgw_op": 0, "objecter_op": 0, "osd_op": 0,
+                 "rep_write": 0}
+        for s in tree_spans:
+            stage = s["name"].split(":", 1)[0]
+            if stage in tiers:
+                tiers[stage] += 1
+        missing = [t for t, n in tiers.items() if n == 0]
+        if missing:
+            print(f"FAIL: S3 PUT trace missing tiers {missing} "
+                  f"(have {tiers})", file=sys.stderr)
+            print("\n".join(format_tree(tree_spans)), file=sys.stderr)
+            return 1
+        trees = span_tree(tree_spans)
+        if not any(t["name"].startswith("rgw_op") for t in trees):
+            print("FAIL: rgw span is not the tree root",
+                  file=sys.stderr)
+            return 1
+        print("trace_smoke: S3 PUT tree OK "
+              + " ".join(f"{k}={v}" for k, v in sorted(tiers.items())))
+        print("\n".join(format_tree(tree_spans)))
+
+        # --- tier check 2: EC shard + kernel spans -----------------
+        names = [s["name"] for s in spans]
+        for want in ("ec_sub_write", "ec_encode_kernel",
+                     "ec_decode_kernel"):
+            if not any(n == want for n in names):
+                print(f"FAIL: no {want} span from the traced EC op",
+                      file=sys.stderr)
+                return 1
+        print("trace_smoke: EC shard + kernel spans OK")
+        return 0
+    finally:
+        if gw is not None:
+            gw.shutdown()
+        c.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

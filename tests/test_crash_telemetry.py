@@ -595,3 +595,83 @@ def test_observability_cli_verbs():
         assert json.loads(out) == []
     finally:
         c.shutdown()
+
+
+def test_telemetry_upload_target(tmp_path):
+    """The mgr_telemetry_url sink (the dashboard-item's second half):
+    each observability tick posts the compiled report to a file:// or
+    http:// target, `telemetry status` carries the last-send outcome,
+    and an unreachable sink records a failure instead of killing the
+    tick."""
+    import urllib.request
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    from ceph_tpu.common.options import global_config
+
+    cfg = global_config()
+    old_url = cfg["mgr_telemetry_url"]
+    c = MiniCluster(n_osd=3, threaded=True)
+    try:
+        c.wait_all_up()
+        r = c.rados()
+        mgr = c.start_mgr()
+        tm = mgr.start_telemetry()
+        # --- file:// sink: one JSON line per send ---
+        sink = tmp_path / "telemetry.jsonl"
+        cfg.set("mgr_telemetry_url", f"file://{sink}")
+        mgr.observability_tick()
+        mgr.observability_tick()
+        lines = sink.read_text().strip().splitlines()
+        assert len(lines) == 2
+        rep = json.loads(lines[-1])
+        assert rep["cluster_id"] == tm.cluster_id()
+        rc, _, st = r.mon_command({"prefix": "telemetry status"})
+        assert rc == 0 and st["last_send"]["ok"] is True
+        assert st["last_send"]["url"].startswith("file://")
+        assert st["url"].startswith("file://")
+        # --- http:// sink: POSTed body is the report ---
+        got = []
+
+        class Sink(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                got.append(json.loads(self.rfile.read(n)))
+                self.send_response(200)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), Sink)
+        import threading
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+        try:
+            cfg.set("mgr_telemetry_url",
+                    f"http://127.0.0.1:{httpd.server_address[1]}/")
+            mgr.observability_tick()
+            assert got and got[0]["cluster_id"] == tm.cluster_id()
+            # forced resend via the CLI verb
+            rc, outs, outb = r.mon_command(
+                {"prefix": "telemetry send"})
+            assert rc == 0 and len(got) == 2
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+        # --- unreachable sink: failure recorded, tick survives ---
+        cfg.set("mgr_telemetry_url",
+                f"http://127.0.0.1:{httpd.server_address[1]}/")
+        mgr.observability_tick()
+        rc, _, st = r.mon_command({"prefix": "telemetry status"})
+        assert rc == 0 and st["last_send"]["ok"] is False
+        assert st["last_send"]["error"]
+        # --- no sink configured: nothing recorded anew ---
+        cfg.set("mgr_telemetry_url", "")
+        tm.last_send = None
+        mgr.observability_tick()
+        rc, _, st = r.mon_command({"prefix": "telemetry status"})
+        assert rc == 0 and st["last_send"] is None \
+            and st["url"] is None
+    finally:
+        cfg.set("mgr_telemetry_url", old_url)
+        c.shutdown()

@@ -46,6 +46,37 @@ def test_status_health_df(setup):
     assert st == 200
 
 
+def test_dashboard_json_and_html(setup):
+    """The read-only /dashboard status view (the dashboard-module
+    analogue over restful): one JSON document with health, usage, pg
+    states, sync lag, crashes and slow ops — and the same data as a
+    server-rendered HTML page via ?format=html."""
+    _c, _mgr, srv = setup
+    for _ in range(2):
+        _c.tick()           # land at least one pg-stat report
+    st, idx = req(srv, "GET", "/")
+    assert "/dashboard" in idx["endpoints"]
+    st, dash = req(srv, "GET", "/dashboard")
+    assert st == 200
+    for k in ("health", "osdmap", "pg_states", "usage", "sync",
+              "recent_crashes", "slow_ops"):
+        assert k in dash, k
+    assert dash["health"]["status"].startswith("HEALTH_")
+    assert dash["osdmap"]["num_up_osds"] == 4
+    assert dash["usage"]["total_kb"] > 0
+    assert isinstance(dash["sync"], list)
+    # HTML rendering serves text/html and carries the same status
+    r = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/dashboard?format=html")
+    with urllib.request.urlopen(r, timeout=30) as resp:
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/html")
+        body = resp.read().decode()
+    assert "<!DOCTYPE html>" in body
+    assert dash["health"]["status"] in body
+    assert "pg states" in body
+
+
 def test_osd_listing_and_command(setup):
     _c, _mgr, srv = setup
     st, osds = req(srv, "GET", "/osd")

@@ -54,6 +54,55 @@ def test_metrics_endpoint():
 import urllib.error  # noqa: E402  (used in the test above)
 
 
+def test_latency_histogram_families_parse():
+    """Per-op-class latency histograms export as REAL prometheus
+    histogram families: cumulative _bucket samples with le labels
+    (ending at +Inf), plus _sum and _count, and count == the +Inf
+    bucket (the exposition-format histogram contract)."""
+    c = MiniCluster(n_osd=3, threaded=True)
+    try:
+        c.wait_all_up()
+        r = c.rados()
+        r.pool_create("ph", pg_num=8)
+        io = r.open_ioctx("ph")
+        for i in range(6):
+            io.write_full(f"h{i}", b"y" * 256)
+        for _ in range(3):
+            c.tick()
+        mgr = c.start_mgr()
+        exp = mgr.start_prometheus()
+        text = _scrape(exp.port)
+        fam = "ceph_daemon_op_lat_client_seconds"
+        assert f"# TYPE {fam} histogram" in text
+        # parse one daemon's series
+        import re
+        buckets = {}
+        s = cnt = None
+        for ln in text.splitlines():
+            m = re.match(
+                rf'{fam}_bucket{{daemon="osd.0",le="([^"]+)"}} (\S+)',
+                ln)
+            if m:
+                buckets[m.group(1)] = float(m.group(2))
+            m = re.match(rf'{fam}_sum{{daemon="osd.0"}} (\S+)', ln)
+            if m:
+                s = float(m.group(1))
+            m = re.match(rf'{fam}_count{{daemon="osd.0"}} (\S+)', ln)
+            if m:
+                cnt = float(m.group(1))
+        assert buckets and s is not None and cnt is not None
+        assert "+Inf" in buckets
+        assert cnt == buckets["+Inf"] and cnt > 0
+        # buckets are cumulative and monotone in le order
+        ordered = sorted((float(k), v) for k, v in buckets.items()
+                         if k != "+Inf")
+        vals = [v for _k, v in ordered] + [buckets["+Inf"]]
+        assert vals == sorted(vals)
+        assert s > 0
+    finally:
+        c.shutdown()
+
+
 def test_rgw_sync_lag_gauges():
     """Multisite observability (ISSUE 5 satellite): the exporter
     carries per-(zone, source) sync gauges, and after convergence the

@@ -77,3 +77,44 @@ def test_pool_delete(cluster):
     rc, out = run(r, "rmpool", "doomed")
     assert rc == 0 and "successfully deleted" in out
     assert "doomed" not in run(r, "lspools")[1].split()
+
+
+def test_trace_verb_assembles_cross_daemon_tree(cluster, tmp_path):
+    """`rados trace <id> --asok-dir D` queries every daemon's
+    dump_traces ring over the admin sockets and prints ONE indented
+    span tree with per-span durations."""
+    import time
+
+    from ceph_tpu.common.options import global_config
+
+    c, r = cluster
+    cfg = global_config()
+    run(r, "mkpool", "trp", "8")
+    io = r.open_ioctx("trp")
+    asok = tmp_path / "asoks"
+    asok.mkdir()
+    for osd, d in c.osds.items():
+        d.start_admin_socket(str(asok / f"osd{osd}.asok"))
+    c.mon.start_admin_socket(str(asok / "mon0.asok"))
+    cfg.set("blkin_trace_all", True)
+    try:
+        io.write_full("traced-cli", b"cli trace" * 100)
+    finally:
+        cfg.set("blkin_trace_all", False)
+    roots = [s for s in r.objecter.dump_traces()
+             if s["name"] == "objecter_op:write_full"
+             and "traced-cli" in str(s["events"])]
+    assert roots
+    tid = roots[-1]["trace_id"]
+    rc, out = run(r, "trace", tid, "--asok-dir", str(asok))
+    assert rc == 0, out
+    # daemon-side tiers present with durations + indentation
+    assert "osd_op:write_full" in out
+    assert "rep_write" in out
+    assert "  " in out and "s" in out
+    # unknown trace: clean message, non-zero rc
+    rc, out = run(r, "trace", "deadbeef00000000",
+                  "--asok-dir", str(asok))
+    assert rc == 1 and "no spans found" in out
+    # missing --asok-dir is a usage error
+    assert run(r, "trace", tid)[0] == 1

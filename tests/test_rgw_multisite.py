@@ -457,6 +457,60 @@ def test_master_bucket_delete_propagates(ms):
                  b"second-life")
 
 
+def test_registry_tombstones_pruned_after_peers_pass(ms):
+    """Bounded tombstone growth (the PR 5 residual): a bucket-delete
+    tombstone is pruned from BOTH zones' registries once every peer's
+    sync has demonstrably passed the deletion — and never while a
+    peer still holds a live pre-deletion copy (pruning then would let
+    the next listing pull resurrect the bucket)."""
+    import time as _t
+    m1, m2 = ms
+    req(m1, "PUT", "/btomb")
+    assert _wait(lambda: "btomb" in m2._buckets())
+    # hold m2's pull so the pre-prune state is observable: while m2
+    # still lists the bucket LIVE, m1 must keep its tombstone
+    # (pruning now would let m1's next listing pull resurrect the
+    # bucket).  _sync_peer is stubbed (a backoff entry would be reset
+    # by an in-flight round's success path), and a round-length is
+    # waited out BEFORE the delete so an in-flight pull that started
+    # pre-stub cannot have seen the tombstone.
+    held = m2.sync._sync_peer
+    m2.sync._sync_peer = lambda peer, views=None: 0
+    try:
+        _t.sleep(0.3)
+        req(m1, "DELETE", "/btomb")
+        assert "btomb" in m1._buckets_raw()
+        assert "deleted" in m1._buckets_raw()["btomb"]
+        _t.sleep(0.4)           # several m1 sync rounds
+        assert "btomb" in m2._buckets(), \
+            "hold failed: peer applied it"
+        assert "btomb" in m1._buckets_raw(), \
+            "tombstone pruned while the peer still held a live copy"
+    finally:
+        m2.sync._sync_peer = held
+    # prune against a fabricated (fresh) live view is likewise a
+    # no-op, and so is one whose fetch stamp PREDATES the deletion
+    # (stale absence evidence must never prune)
+    from ceph_tpu.cls.rgw import now_str
+    live_view = {"m2": (now_str(),
+                        {"btomb": {"created": "1970-01-01T00:00:00"}})}
+    assert m1.prune_registry_tombstones(live_view) == 0
+    stale_view = {"m2": ("1970-01-01T00:00:00.000Z", {})}
+    assert m1.prune_registry_tombstones(stale_view) == 0
+    # once both agents run rounds that reach every peer, the
+    # tombstones drain from BOTH registries (count 0 = bounded)
+    assert _wait(lambda: "btomb" not in m1._buckets_raw() and
+                 "btomb" not in m2._buckets_raw())
+    # and the bucket stays deleted — pruning must not resurrect
+    time.sleep(0.3)
+    assert "btomb" not in m1._buckets()
+    assert "btomb" not in m2._buckets()
+    # a recreate after the prune behaves like any fresh bucket
+    req(m1, "PUT", "/btomb")
+    req(m1, "PUT", "/btomb/k", b"reborn")
+    assert _wait(lambda: _get_bytes(m2, "btomb", "k") == b"reborn")
+
+
 def test_reserved_object_keys_rejected(ms):
     """Client objects must not collide with the index omap's
     bookkeeping namespaces — a PUT literally named `.dlmeta` would

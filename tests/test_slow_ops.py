@@ -1,0 +1,202 @@
+"""SLOW_OPS cluster health + per-daemon op trackers (ref:
+OpTracker::check_ops_in_flight under osd_op_complaint_time; the
+health_check slice derived from per-daemon trackers; the
+dump_historic_slow_ops admin command every daemon serves)."""
+import time
+
+import pytest
+
+from ceph_tpu.common.admin_socket import admin_command
+from ceph_tpu.common.options import global_config
+from ceph_tpu.common.tracked_op import OpTracker
+from ceph_tpu.testing import MiniCluster
+
+
+@pytest.fixture()
+def fast_cfg():
+    cfg = global_config()
+    old = {k: cfg[k] for k in ("osd_op_complaint_time",
+                               "osd_mon_report_interval")}
+    cfg.set("osd_op_complaint_time", 0.05)
+    cfg.set("osd_mon_report_interval", 0.0)
+    yield cfg
+    for k, v in old.items():
+        cfg.set(k, v)
+
+
+def _health(r):
+    rc, _, h = r.mon_command({"prefix": "health"})
+    assert rc == 0
+    return h
+
+
+def _wait(pred, timeout=10.0):
+    end = time.monotonic() + timeout
+    while time.monotonic() < end:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return pred()
+
+
+def test_tracker_slow_summary_and_historic_slow():
+    t = OpTracker(history_size=4, complaint_time=0.05)
+    t.start("fast", "quick op")
+    t.finish("fast")
+    assert t.slow_summary() == {"count": 0, "oldest_age": 0.0}
+    assert t.dump_historic_slow()["num_ops"] == 0
+    t.start("stuck", "stalled op")
+    time.sleep(0.08)
+    s = t.slow_summary()
+    assert s["count"] == 1 and s["oldest_age"] >= 0.05
+    dur = t.finish("stuck")
+    assert dur is not None and dur >= 0.05
+    assert t.slow_summary()["count"] == 0
+    # the completed slow op lands in the historic-slow ring; the fast
+    # one never does
+    slow = t.dump_historic_slow()
+    assert slow["num_ops"] == 1
+    assert slow["ops"][0]["description"] == "stalled op"
+    # complaint_time=None reads the live option
+    t2 = OpTracker()
+    cfg = global_config()
+    old = cfg["osd_op_complaint_time"]
+    try:
+        cfg.set("osd_op_complaint_time", 123.0)
+        assert t2.complaint == 123.0
+    finally:
+        cfg.set("osd_op_complaint_time", old)
+
+
+def test_slow_ops_raises_and_clears_on_drain(fast_cfg):
+    """An injected stalled op on an OSD raises SLOW_OPS in `ceph
+    status` via the pg-stats path; finishing it (the drain) clears
+    the warning within one report interval."""
+    c = MiniCluster(n_osd=3, threaded=True)
+    try:
+        c.wait_all_up()
+        r = c.rados()
+        r.pool_create("slp", pg_num=8)
+        osd = c.osds[0]
+        osd.op_tracker.start(("inject", 1), "injected stalled op")
+        time.sleep(0.08)
+
+        def warned():
+            c.tick()
+            return "SLOW_OPS" in _health(r)["checks"]
+
+        assert _wait(warned), _health(r)
+        h = _health(r)
+        assert h["status"] == "HEALTH_WARN"
+        assert "osd.0" in h["checks"]["SLOW_OPS"]["summary"]
+        rc, _, s = r.mon_command({"prefix": "status"})
+        assert "SLOW_OPS" in s["health"]["checks"]
+        # health detail names the blocked daemon and count
+        rc, _, hd = r.mon_command({"prefix": "health detail"})
+        assert any("osd.0" in d
+                   for d in hd["checks"]["SLOW_OPS"]["detail"])
+        # drain: the op completes, the next stat report clears it
+        osd.op_tracker.finish(("inject", 1))
+
+        def cleared():
+            c.tick()
+            return "SLOW_OPS" not in _health(r)["checks"]
+
+        assert _wait(cleared), _health(r)
+        # the slow op is retained for post-mortem inspection
+        assert osd.op_tracker.dump_historic_slow()["num_ops"] == 1
+    finally:
+        c.shutdown()
+
+
+def test_mon_own_slow_ops_surface(fast_cfg):
+    """The mon tracks its own commands; a stuck one surfaces as
+    SLOW_OPS with the mon's entity name."""
+    c = MiniCluster(n_osd=2, threaded=True)
+    try:
+        c.wait_all_up()
+        r = c.rados()
+        c.mon.op_tracker.start(("client.x", 1),
+                               "mon_command(stuck tid=1)")
+        time.sleep(0.08)
+        h = _health(r)
+        assert "SLOW_OPS" in h["checks"]
+        assert "mon.0" in h["checks"]["SLOW_OPS"]["summary"]
+        c.mon.op_tracker.finish(("client.x", 1))
+        assert "SLOW_OPS" not in _health(r)["checks"]
+    finally:
+        c.shutdown()
+
+
+def test_mds_slow_ops_ride_beacon(fast_cfg):
+    """The MDS half of the feed: aged client requests ride the beacon
+    to the mon and clear when the tracker drains."""
+    cfg = fast_cfg
+    old_beacon = cfg["mds_beacon_interval"]
+    cfg.set("mds_beacon_interval", 0.2)
+    c = MiniCluster(n_osd=3, threaded=True)
+    try:
+        c.wait_all_up()
+        r = c.rados()
+        c.start_mds(0)
+        c.wait_mds_active(0)
+        mds = c.mdss[0]
+        mds.op_tracker.start(("client.y", 9),
+                             "client_request(stuck)")
+        time.sleep(0.08)
+
+        def warned():
+            h = _health(r)
+            return "SLOW_OPS" in h["checks"] and \
+                "mds.0" in h["checks"]["SLOW_OPS"]["summary"]
+
+        assert _wait(warned), _health(r)
+        mds.op_tracker.finish(("client.y", 9))
+        assert _wait(lambda: "SLOW_OPS" not in _health(r)["checks"]), \
+            _health(r)
+    finally:
+        cfg.set("mds_beacon_interval", old_beacon)
+        c.shutdown()
+
+
+def test_every_daemon_serves_obs_commands(tmp_path, fast_cfg):
+    """mon, mgr, mds and rgw serve the same op-tracker/trace admin
+    surface the OSD always had (dump_ops_in_flight /
+    dump_historic_ops / dump_historic_slow_ops / dump_blocked_ops /
+    dump_traces)."""
+    c = MiniCluster(n_osd=2, threaded=True)
+    try:
+        c.wait_all_up()
+        r = c.rados()
+        mgr = c.start_mgr()
+        gw_rados = c.rados()
+        from ceph_tpu.rgw import RGWGateway
+        gw = RGWGateway(gw_rados, pool="rgw-obs")
+        gw.start()
+        socks = {}
+        for name, d in (("mon", c.mon), ("mgr", mgr), ("gw", gw),
+                        ("osd", c.osds[0])):
+            p = str(tmp_path / f"{name}.asok")
+            d.start_admin_socket(p)
+            socks[name] = p
+        for name, p in socks.items():
+            for cmd in ("dump_ops_in_flight", "dump_historic_ops",
+                        "dump_historic_slow_ops"):
+                rc, out = admin_command(p, cmd)
+                assert rc == 0 and "num_ops" in out, (name, cmd)
+            rc, out = admin_command(p, "dump_blocked_ops")
+            assert rc == 0 and isinstance(out, list), name
+            rc, out = admin_command(p, "dump_traces")
+            assert rc == 0 and isinstance(out, list), name
+        # an rgw request is tracked like any daemon op
+        import urllib.request
+        urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{gw.port}/b1", method="PUT"),
+            timeout=30).read()
+        rc, hist = admin_command(socks["gw"], "dump_historic_ops")
+        assert rc == 0 and hist["num_ops"] > 0
+        assert any("PUT /b1" in op["description"]
+                   for op in hist["ops"])
+        gw.shutdown()
+    finally:
+        c.shutdown()

@@ -29,9 +29,10 @@ def test_span_primitives():
 
 @pytest.mark.parametrize("pool_kind", ["replicated", "erasure"])
 def test_cross_daemon_trace(pool_kind):
-    """One traced client write produces spans on the primary AND on
-    every replica/shard daemon, all stitched by trace_id with correct
-    parent links."""
+    """One traced client write produces spans on the CLIENT (the
+    objecter roots the trace), the primary, and every replica/shard
+    daemon — plus the encode-kernel span on an EC pool — all stitched
+    by trace_id with correct parent links."""
     c = MiniCluster(n_osd=4, threaded=True)
     cfg = global_config()
     try:
@@ -51,30 +52,69 @@ def test_cross_daemon_trace(pool_kind):
         cfg.set("blkin_trace_all", True)
         io.write_full("traced", b"follow me" * 200)
         cfg.set("blkin_trace_all", False)
-        spans = [s for d in c.osds.values() for s in d.tracer.dump()]
-        # retries (ESTALE against a not-yet-primary) add root spans to
-        # the SAME trace; the successful attempt is the one that sent
-        # a reply
-        roots = [s for s in spans if s["name"].startswith("osd_op")
-                 and s["parent"] is None
-                 and any(e["event"] == "reply_sent"
-                         for e in s["events"])]
+        client_spans = r.objecter.dump_traces()
+        spans = client_spans + \
+            [s for d in c.osds.values() for s in d.tracer.dump()]
+        # the objecter leg is the trace root
+        roots = [s for s in client_spans
+                 if s["name"].startswith("objecter_op")
+                 and s["parent"] is None]
         assert len(roots) == 1
         root = roots[0]
         tid = root["trace_id"]
-        assert all(s["trace_id"] == tid for s in spans
-                   if s["name"].startswith("osd_op"))
-        kids = [s for s in spans
-                if s["trace_id"] == tid and s["parent"] is not None]
+        spans = [s for s in spans if s["trace_id"] == tid]
+        # every send attempt lands an osd_op child under the client
+        # span; the successful one carries reply_sent
+        prim = [s for s in spans if s["name"].startswith("osd_op")
+                and any(e["event"] == "reply_sent"
+                        for e in s["events"])]
+        assert len(prim) == 1
+        assert prim[0]["parent"] == root["span_id"]
         sub = "rep_write" if pool_kind == "replicated" \
             else "ec_sub_write"
-        assert all(k["name"] == sub for k in kids)
-        assert all(k["parent"] == root["span_id"] for k in kids)
+        kids = [s for s in spans if s["name"] == sub]
         # replicated: 2 remote replicas; EC: 2 remote shards (the
         # primary's own shard applies inline, no message)
         assert len(kids) == 2
+        assert all(k["parent"] == prim[0]["span_id"] for k in kids)
         services = {k["service"] for k in kids}
-        assert root["service"] not in services
+        assert prim[0]["service"] not in services
+        if pool_kind == "erasure":
+            # the Pallas encode region gets its OWN span on the
+            # primary, so staged-encode cost is visible per stage
+            enc = [s for s in spans
+                   if s["name"] == "ec_encode_kernel"]
+            assert len(enc) == 1
+            assert enc[0]["parent"] == prim[0]["span_id"]
+            assert enc[0]["service"] == prim[0]["service"]
+        # the assembled tree renders with the client span as the root
+        from ceph_tpu.common.tracing import format_tree, span_tree
+        trees = span_tree(spans)
+        top = [t for t in trees if t["span_id"] == root["span_id"]]
+        assert len(top) == 1
+        assert any("osd_op" in ln for ln in format_tree(spans))
     finally:
         cfg.set("blkin_trace_all", False)
         c.shutdown()
+
+
+def test_trace_context_survives_tcp_wire():
+    """The Message `trace` field rides the versioned TCP frame codec
+    byte-faithfully (ref: Message.h:263 — the blkin trace is part of
+    the wire envelope, not an in-process convenience)."""
+    from ceph_tpu.msg import encoding as wire
+    from ceph_tpu.msg.messages import ECSubWrite, OSDOp
+
+    ctx = new_trace()
+    child = child_of(ctx)
+    msg = OSDOp(oid="o", op="write", tid=7, data=b"x", trace=child)
+    back = wire.decode_message(wire.encode_message(msg))
+    assert back.trace == child
+    assert back.trace["parent"] == ctx["span"]
+    sub = ECSubWrite(tid=9, shard=1, trace=child_of(child))
+    back = wire.decode_message(wire.encode_message(sub))
+    assert back.trace["trace_id"] == ctx["trace_id"]
+    assert back.trace["parent"] == child["span"]
+    # untraced messages stay untraced over the wire
+    assert wire.decode_message(
+        wire.encode_message(OSDOp(oid="o"))).trace is None

@@ -115,6 +115,22 @@ class Tracer:
         with self._lock:
             self._done.append(span)
 
+    def record_span(self, ctx: dict | None, name: str, start: float,
+                    end: float) -> Span | None:
+        """Record a span whose interval was MEASURED elsewhere (same
+        monotonic clock): sub-stage instrumentation (e.g. the EC read
+        path's survivor-stage vs kernel split) times its regions
+        inline and reports them as child spans after the fact, instead
+        of threading live Span objects through library code."""
+        if not ctx:
+            return None
+        sp = Span(ctx, name, self.service)
+        sp.start = start
+        sp.end = end
+        with self._lock:
+            self._done.append(sp)
+        return sp
+
     def dump(self, trace_id: str | None = None) -> list[dict]:
         with self._lock:
             spans = list(self._done)

@@ -30,24 +30,53 @@ MatmulFn = Callable[[np.ndarray, np.ndarray], np.ndarray]
 
 
 class DecodeTableCache:
-    """LRU of decode matrices keyed by erasure signature
-    (ref: ErasureCodeIsaTableCache.cc, decoding_tables_lru_length)."""
+    """Cost-weighted LRU of decode tables keyed by erasure signature
+    (ref: ErasureCodeIsaTableCache.cc, decoding_tables_lru_length).
+
+    `cost` weights an entry against the capacity: a full-width
+    (nerrs x n) matrix — or the HBM-resident kernel object built from
+    one — is ~(k+m)/k x the footprint of the dense (nerrs x k) table,
+    so full-matrix signatures charge more and the bound stays a real
+    memory bound, not an entry count.  Values are opaque (ndarray or
+    compiled-kernel wrappers alike)."""
 
     def __init__(self, capacity: int = 2516):
+        from ..common.lockdep import make_lock
         self.capacity = capacity
-        self._lru: OrderedDict[str, np.ndarray] = OrderedDict()
+        self._lru: OrderedDict[str, tuple[object, int]] = OrderedDict()
+        self._cost = 0
+        # the daemon shares ONE plugin instance per profile across all
+        # its PGs, so concurrent decodes hit this cache from multiple
+        # threads — and unlike the plain dict this replaced, an LRU
+        # mutates on every GET (move_to_end)
+        self._lock = make_lock("ec.decode_table_cache")
 
-    def get(self, sig: str) -> np.ndarray | None:
-        m = self._lru.get(sig)
-        if m is not None:
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._lru)
+
+    def total_cost(self) -> int:
+        with self._lock:
+            return self._cost
+
+    def get(self, sig: str):
+        with self._lock:
+            entry = self._lru.get(sig)
+            if entry is None:
+                return None
             self._lru.move_to_end(sig)
-        return m
+            return entry[0]
 
-    def put(self, sig: str, mat: np.ndarray) -> None:
-        self._lru[sig] = mat
-        self._lru.move_to_end(sig)
-        while len(self._lru) > self.capacity:
-            self._lru.popitem(last=False)
+    def put(self, sig: str, mat, cost: int = 1) -> None:
+        with self._lock:
+            old = self._lru.pop(sig, None)
+            if old is not None:
+                self._cost -= old[1]
+            self._lru[sig] = (mat, cost)
+            self._cost += cost
+            while self._cost > self.capacity and len(self._lru) > 1:
+                _, (_, c) = self._lru.popitem(last=False)
+                self._cost -= c
 
 
 def erasure_signature(decode_index: list[int], erasures: list[int]) -> str:

@@ -57,12 +57,24 @@ class ECSubWriteReply(Message):
 @dataclass
 class ECSubRead(Message):
     """Per-shard chunk read request (ref: src/messages/MOSDECSubOpRead.h,
-    ECMsgTypes.h ECSubRead: to_read offset/len lists + attrs_to_read)."""
+    ECMsgTypes.h ECSubRead: to_read offset/len lists + attrs_to_read).
+
+    v2 appends the sub-chunk repair fields: `subchunks` maps oid ->
+    [(rel_off, rel_len), ...] byte extents WITHIN each chunk_size-sized
+    chunk of the shard's stream (ref: ECMsgTypes.h ECSubRead subchunks,
+    the clay repair-plane reads of ErasureCodeClay.cc:364).  The shard
+    expands the per-chunk extents across its local stream length and
+    replies with the CONCATENATED repair planes — a single-shard
+    regenerating-code rebuild ships ~(k+m-1)/m x less data than whole
+    chunks.  Empty dict = whole-range semantics via `to_read`."""
     pgid: Any = None
     tid: int = 0
     shard: int = -1
     to_read: list = field(default_factory=list)   # [(oid, off, len)]
     attrs_to_read: list = field(default_factory=list)  # [oid]
+    # --- v2: sub-chunk (repair-plane) extents ---
+    subchunks: dict = field(default_factory=dict)  # oid -> [(off, len)]
+    chunk_size: int = 0      # chunk stride the extents repeat at
 
 
 @dataclass
@@ -740,6 +752,7 @@ class PingReply(Message):
 #: per-type (version, compat) overrides — bump when appending fields
 _VERSIONS: dict[str, tuple[int, int]] = {
     "ECSubWrite": (3, 1),       # v2: ICI-fabric; v3: push version guard
+    "ECSubRead": (2, 1),        # v2: sub-chunk repair extents
     "PGScan": (2, 1),           # v2: ranged backfill walk
     "PGScanReply": (2, 1),      # v2: ranged/begin/end echo fields
     "PGPush": (2, 1),           # v2: authoritative backfill flag

@@ -258,6 +258,7 @@ class OSDDaemon(Dispatcher, MonHunter):
         self.perf = coll.create(self.name)
         for key in ("op", "op_r", "op_w", "op_r_bytes", "op_w_bytes",
                     "subop_w", "recovery_push", "recovery_pull",
+                    "recovery_bytes_read", "recovery_bytes_rebuilt",
                     "map_epochs"):
             self.perf.add_u64_counter(key)
         # per-op-class latency histograms (ref: the l_osd_op_*_lat
@@ -441,8 +442,11 @@ class OSDDaemon(Dispatcher, MonHunter):
                 # reading primary fails fast instead of waiting
                 reply = ECSubReadReply(
                     pgid=msg.pgid, tid=msg.tid, shard=msg.shard,
-                    errors={oid: "ESTALE"
-                            for oid, _off, _len in msg.to_read})
+                    errors={**{oid: "ESTALE"
+                               for oid, _off, _len in msg.to_read},
+                            **{oid: "ESTALE"
+                               for oid in getattr(msg, "subchunks",
+                                                  {})}})
             if rsp is not None:
                 rsp.event(f"shard={msg.shard} "
                           f"errors={len(reply.errors)}")
@@ -988,6 +992,9 @@ class OSDDaemon(Dispatcher, MonHunter):
                         # kernel spans (encode/decode) land in the
                         # primary daemon's ring
                         st.backend.tracer = self.tracer
+                        # recovery-bandwidth accounting (sub-chunk
+                        # repair saving shows up here)
+                        st.backend.perf = self.perf
                 else:
                     st.shard = ReplicatedPGShard(pg, self.store)
                     if acting_p == self.whoami:

@@ -85,6 +85,25 @@ def spread_tombstones(pgid, k_plus_m: int, local_shard, whoami: int,
             send_osd(osd, msg)
 
 
+def newest_oi_attrs(per_shard: dict):
+    """Authoritative metadata selection for recovery: among the
+    gathered per-shard attr dicts, the one whose OI version is newest
+    wins (ties -> lowest shard index, so a half-applied attr update
+    racing a failure resolves deterministically).  Returns
+    (version_tuple, oi, hinfo_dict, user_xattrs) or None when no
+    shard reported attrs.  Single implementation behind the full and
+    sub-chunk recovery paths on both the backend and the peering
+    statechart."""
+    best = None
+    for s in sorted(per_shard):
+        a = per_shard[s]
+        oi = a.get(OI_ATTR) or {}
+        ver = tuple(oi.get("version", (0, 0)))
+        if best is None or ver > best[0]:
+            best = (ver, oi, a.get(HINFO_ATTR), mut.user_xattrs(a))
+    return best
+
+
 def ec_store_inventory(store, cid: str) -> dict:
     """oid -> {shard_index: ((epoch, ver), whiteout)} straight from a
     PG collection, independent of any live ECPGShard (a peer whose map
@@ -319,6 +338,30 @@ class ECPGShard:
                 reply.buffers_read[oid] = buf
             except StoreError as err:
                 reply.errors[oid] = err.errno_name
+        # v2 sub-chunk repair reads: per-chunk extents expanded over
+        # the local stream, replied as ONE concatenated repair-plane
+        # buffer per oid (the clay helper read,
+        # ref: ErasureCodeClay.cc:364 get_repair_subchunks; the crc
+        # gate does not apply — partial ranges cannot re-hash the
+        # cumulative stream, the rebuilt shard is crc-verified on its
+        # next full read instead)
+        for oid, extents in getattr(m, "subchunks", {}).items():
+            soid = ObjectId(oid, shard=self.shard)
+            try:
+                if self._is_whiteout(soid):
+                    raise StoreError("ENOENT",
+                                     f"{oid} deleted (whiteout)")
+                if m.chunk_size <= 0:
+                    raise StoreError("EINVAL", "subchunks w/o chunk_size")
+                stream_len = self.store.stat(self.cid, soid)["size"]
+                abs_extents = ecutil.expand_stream_extents(
+                    [tuple(e) for e in extents], m.chunk_size,
+                    stream_len)
+                reply.buffers_read[oid] = b"".join(
+                    self.store.read(self.cid, soid, off, length)
+                    for off, length in abs_extents)
+            except (StoreError, ValueError) as err:
+                reply.errors[oid] = getattr(err, "errno_name", "EIO")
         for oid in m.attrs_to_read:
             soid = ObjectId(oid, shard=self.shard)
             try:
@@ -585,6 +628,17 @@ class ECBackend:
         #: the owning daemon points this at its Tracer; None (library
         #: use, tracing off) costs nothing on the hot path
         self.tracer = None
+        #: PerfCounters sink (the owning daemon's) for the recovery
+        #: bandwidth pair: recovery_bytes_read (helper bytes pulled
+        #: over the wire) / recovery_bytes_rebuilt (chunk bytes pushed
+        #: to targets) — how the sub-chunk repair saving is proven
+        self.perf = None
+        #: in-flight sub-chunk repair state: tid -> dict
+        self._sub_repairs: dict[int, dict] = {}
+
+    def _perf_inc(self, key: str, n: int = 1) -> None:
+        if self.perf is not None and n:
+            self.perf.inc(key, n)
 
     # -- utilities ------------------------------------------------------
     def _next_tid(self) -> int:
@@ -601,8 +655,10 @@ class ECBackend:
         with self._lock:
             writes = list(self.tid_to_op.values())
             reads = list(self.in_flight_reads.values())
+            subs = list(self._sub_repairs.values())
             self.tid_to_op.clear()
             self.in_flight_reads.clear()
+            self._sub_repairs.clear()
             self.waiting_state.clear()
             self.waiting_reads.clear()
             self.waiting_commit.clear()
@@ -612,6 +668,11 @@ class ECBackend:
             op.on_all_commit(False)
         for rd in reads:
             rd.on_complete({}, {oid: "ESTALE" for oid in rd.reads})
+        for job in subs:
+            # sub-chunk repair jobs carry their completion separately
+            # (their _Read's on_complete is a placeholder) — fail them
+            # explicitly so recovery accounting never hangs
+            job["on_done"](False)
 
     def _next_version(self) -> EVersion:
         self.last_version = EVersion(self.epoch,
@@ -1176,6 +1237,13 @@ class ECBackend:
         # complete it a second time
         if rd.pending_shards or rd.tid not in self.in_flight_reads:
             return
+        sub_job = self._sub_repairs.pop(rd.tid, None)
+        if sub_job is not None:
+            # sub-chunk repair reads don't retry shard-by-shard: any
+            # miss falls back to the full-chunk rebuild wholesale
+            self.in_flight_reads.pop(rd.tid, None)
+            self._complete_subchunk_repair(rd, sub_job)
+            return
         # errors? try remaining shards once
         # (ref: ECBackend.cc:1628 get_remaining_shards retry)
         needs_retry = []
@@ -1208,6 +1276,13 @@ class ECBackend:
     def _complete_read(self, rd: _Read) -> None:
         results: dict[str, bytes] = {}
         errors: dict[str, str] = {}
+        if rd.for_recovery:
+            # recovery-bandwidth accounting: every helper byte this
+            # rebuild pulled over the wire (the number sub-chunk
+            # repair shrinks)
+            self._perf_inc("recovery_bytes_read", sum(
+                len(b) for per in rd.shard_bufs.values()
+                for b in per.values()))
         for oid, window in rd.reads.items():
             bufs = {s: b for s, b in rd.shard_bufs.get(oid, {}).items()}
             if len(bufs) < self.k:
@@ -1218,15 +1293,26 @@ class ECBackend:
             # output is host bytes, so survivor staging (the host-side
             # gather/stack that dominates decode_incl_stage in
             # BENCH_r05) AND the device decode are both inside the
-            # span when it closes
+            # span when it closes — and the two regions land as
+            # `stage` / `kernel` CHILD spans so the split is visible
+            # per op in SLO reports
             ksp = None if self.tracer is None or rd.trace is None \
                 else self.tracer.start_span(child_of(rd.trace),
                                             "ec_decode_kernel")
-            logical = ecutil.decode_concat(self.sinfo, self.ec, bufs)
+            timings: dict | None = {} if ksp is not None else None
+            logical = ecutil.decode_concat(self.sinfo, self.ec, bufs,
+                                           timings=timings)
             if ksp is not None:
                 ksp.event(f"shards={len(bufs)} "
                           f"bytes={len(logical)}")
                 self.tracer.finish(ksp)
+                kctx = {"trace_id": ksp.trace_id, "span": ksp.span_id,
+                        "parent": ksp.parent}
+                for stage_name in ("stage", "kernel"):
+                    iv = (timings or {}).get(stage_name)
+                    if iv is not None:
+                        self.tracer.record_span(
+                            child_of(kctx), stage_name, iv[0], iv[1])
             size = self._oi_size(rd, oid)
             # highest valid logical byte we can serve from this read
             limit = base + len(logical) if size is None \
@@ -1279,13 +1365,163 @@ class ECBackend:
         pushes outside the acting set — the EC backfill case, where a
         temp primary rebuilds chunks for the UP set's shards while
         the old acting set still serves (ref: ECBackend recovery
-        pushing to backfill targets)."""
+        pushing to backfill targets).
+
+        Single-shard loss on a regenerating code (clay,
+        sub_chunk_count > 1) takes the NETWORK-OPTIMAL path: helpers
+        serve only the plugin's repair sub-chunk extents
+        (~(k+m-1)/m x fewer bytes than k whole chunks) and the lost
+        chunk rebuilds directly, no logical decode + re-encode.
+        Non-regenerating plugins, multi-shard loss, or any repair-read
+        failure fall back to the full-chunk rebuild below."""
         targets = sorted(set(target_shards))
+        if self._try_subchunk_recover(oid, targets, on_done, version,
+                                      target_osds):
+            return
+        self._recover_object_full(oid, targets, on_done, version,
+                                  target_osds)
+
+    def _recover_object_full(self, oid: str, targets, on_done,
+                             version=None, target_osds=None) -> None:
         # read enough shards (+ attrs) to rebuild the logical object
         self.objects_read_and_reconstruct(
             {oid: None}, lambda r, e, a=None: self._recovery_reads_done(
                 oid, targets, r, e, on_done, version, a, target_osds),
             for_recovery=True, want_attrs=True)
+
+    # -- sub-chunk (repair-bandwidth-optimal) single-shard rebuild ----
+    def _try_subchunk_recover(self, oid: str, targets, on_done,
+                              version=None, target_osds=None) -> bool:
+        """Plan a repair-plane rebuild; False -> caller takes the
+        full-chunk path (non-regenerating plugin, multi-shard loss,
+        or the helper set can't cover the plugin's repair degree)."""
+        if len(targets) != 1 or not ecutil.supports_subchunk_repair(
+                self.ec):
+            return False
+        lost = targets[0]
+        avail = {s for s in self._avail_shards(oid) if s != lost}
+        if not self.ec.is_repair({lost}, avail):
+            return False
+        try:
+            minimum = self.ec.minimum_to_repair({lost}, avail)
+        except Exception:
+            return False
+        cs = self.sinfo.chunk_size
+        extents = ecutil.repair_chunk_extents(self.ec, lost, cs)
+        with self._lock:
+            tid = self._next_tid()
+            rd = _Read(tid=tid, reads={oid: None},
+                       on_complete=lambda *_: None,
+                       for_recovery=True, want_attrs=True)
+            self.in_flight_reads[tid] = rd
+            self._sub_repairs[tid] = {
+                "oid": oid, "lost": lost, "helpers": set(minimum),
+                "extents": extents, "on_done": on_done,
+                "version": version, "target_osds": target_osds,
+            }
+            rd.pending_shards = set(minimum)
+            for s in minimum:
+                msg = ECSubRead(
+                    pgid=self.pgid, tid=tid, shard=s,
+                    to_read=[], attrs_to_read=[oid],
+                    subchunks={oid: list(extents)}, chunk_size=cs,
+                    trace=child_of(rd.trace))
+                self._dispatch_read(rd, s, msg)
+            self._maybe_read_done(rd)
+        return True
+
+    def _complete_subchunk_repair(self, rd: _Read, job: dict) -> None:
+        oid, lost = job["oid"], job["lost"]
+        on_done = job["on_done"]
+        bufs = rd.shard_bufs.get(oid, {})
+        got = {s: bufs[s] for s in job["helpers"] if s in bufs}
+        if set(got) != job["helpers"] or rd.shard_errs.get(oid):
+            # any helper failure: fall back to the full-chunk rebuild
+            # (it tolerates arbitrary shard sets via minimum_to_decode)
+            self._recover_object_full(oid, [lost], on_done,
+                                      job["version"],
+                                      job["target_osds"])
+            return
+        self._perf_inc("recovery_bytes_read",
+                       sum(len(b) for b in got.values()))
+        try:
+            stream = ecutil.repair_shard_stream(
+                self.ec, self.sinfo.chunk_size, lost, got)
+        except (ValueError, KeyError, AssertionError) as ex:
+            dout("osd", 0).write("%s subchunk repair of %s failed: %r",
+                                 self.pgid, oid, ex)
+            self._recover_object_full(oid, [lost], on_done,
+                                      job["version"],
+                                      job["target_osds"])
+            return
+        # authoritative metadata from the newest-oi helper: object
+        # size/version, the shared HashInfo (it carries EVERY shard's
+        # cumulative crc — including the rebuilt one), user xattrs
+        best = newest_oi_attrs(rd.shard_attrs.get(oid, {}))
+        if best is None:
+            self._recover_object_full(oid, [lost], on_done,
+                                      job["version"],
+                                      job["target_osds"])
+            return
+        _, oi, hinfo_dict, user_attrs = best
+        version = job["version"]
+        if version is None:
+            version = EVersion(*oi.get("version", (0, 0))) \
+                if oi.get("version") else self._object_prior_version(oid)
+        self._push_repaired_shard(oid, lost, stream, oi.get("size", 0),
+                                  version, hinfo_dict, user_attrs,
+                                  on_done, job["target_osds"])
+
+    def _push_repaired_shard(self, oid: str, shard: int, stream: bytes,
+                             size: int, version, hinfo_dict,
+                             user_attrs: dict, on_done,
+                             target_osds=None) -> None:
+        """Push ONE rebuilt chunk stream (the sub-chunk repair result)
+        — the single-shard analogue of push_rebuilt, no re-encode."""
+        with self._lock:
+            cid = pg_cid(self.pgid)
+            soid = ObjectId(oid, shard=shard)
+            attrs = {OI_ATTR: {"size": size,
+                               "version": (version.epoch,
+                                           version.version)},
+                     **{mut.uxattr_key(k): v
+                        for k, v in user_attrs.items()}}
+            if hinfo_dict is not None:
+                attrs[HINFO_ATTR] = hinfo_dict
+            txn = (Transaction()
+                   .touch(cid, soid)
+                   .truncate(cid, soid, 0)
+                   .write(cid, soid, 0, stream)
+                   .setattrs(cid, soid, attrs))
+            tid = self._next_tid()
+            msg = ECSubWrite(pgid=self.pgid, tid=tid, shard=shard,
+                             txn=txn, log_entries=[], oid=oid,
+                             guard_version=(version.epoch,
+                                            version.version))
+            self._perf_inc("recovery_bytes_rebuilt", len(stream))
+
+            def reply_cb(s, committed, oid=oid):
+                if committed:
+                    pm = self.peer_missing.get(s)
+                    if pm is not None:
+                        pm.rm(oid)
+                on_done(committed)
+
+            dest = (dict(target_osds).get(shard)
+                    if target_osds else
+                    (self.acting[shard] if shard < len(self.acting)
+                     else -1))
+            if dest == self.whoami and shard == self.local_shard.shard:
+                rep = self.local_shard.handle_sub_write(msg)
+                reply_cb(shard, rep.committed)
+                return
+            self._recovery_cbs = getattr(self, "_recovery_cbs", {})
+            self._recovery_cbs[tid] = (shard, reply_cb)
+            send = (lambda m: self.send_osd(dest, m)) if target_osds \
+                else (lambda m: self.send(shard, m))
+            if dest is None or dest < 0 or not send(msg):
+                self._recovery_cbs.pop(tid, None)
+                reply_cb(shard, False)
 
     def _recovery_reads_done(self, oid: str, targets, results, errors,
                              on_done, version=None,
@@ -1294,20 +1530,11 @@ class ECBackend:
         if errors.get(oid) or oid not in results:
             on_done(False)
             return
-        # authoritative user xattrs: from the surviving shard with the
-        # newest oi version (ties -> lowest shard index) — determinism
-        # matters when a half-applied attr update races a failure
+        # authoritative user xattrs from the newest-oi surviving shard
         user_attrs: dict = {}
-        per_shard = (shard_attrs or {}).get(oid, {})
-        best = None
-        for s in sorted(per_shard):
-            a = per_shard[s]
-            oi = a.get(OI_ATTR) or {}
-            ver = tuple(oi.get("version", (0, 0)))
-            if best is None or ver > best[0]:
-                best = (ver, mut.user_xattrs(a))
+        best = newest_oi_attrs((shard_attrs or {}).get(oid, {}))
         if best is not None:
-            user_attrs = best[1]
+            user_attrs = best[3]
         self.push_rebuilt(oid, results[oid], targets, on_done,
                           version=version, user_attrs=user_attrs,
                           target_osds=target_osds)
@@ -1358,6 +1585,8 @@ class ECBackend:
             if not targets:
                 on_done(True)
                 return
+            self._perf_inc("recovery_bytes_rebuilt",
+                           sum(len(shards.get(s, b"")) for s in targets))
             for s in targets:
                 soid = ObjectId(oid, shard=s)
                 txn = (Transaction()

@@ -437,7 +437,12 @@ class ECPGPeering:
                  on_done=None) -> None:
         """Gather ≥k authoritative chunks (cross-set), decode,
         re-encode, push to `targets` ({shard: osd}) with the version
-        guard.  `on_done(ok)` defaults to the recovery countdown."""
+        guard.  `on_done(ok)` defaults to the recovery countdown.
+
+        Single-shard loss on a regenerating code takes the
+        repair-bandwidth-optimal path first: helpers serve only the
+        plugin's repair sub-chunk extents (ECSubRead v2 `subchunks`),
+        ~(k+m-1)/m x fewer bytes on the wire than k whole chunks."""
         if on_done is None:
             on_done = self._rec_job_done
         sources = self._sources_for(oid, ver)
@@ -445,6 +450,14 @@ class ECPGPeering:
         if b is None or not sources:
             on_done(False)
             return
+        if self._try_subchunk_rebuild(oid, targets, ver, sources,
+                                      on_done):
+            return
+        self._rebuild_full(oid, targets, ver, sources, on_done)
+
+    def _rebuild_full(self, oid: str, targets: dict[int, int],
+                      ver: tuple, sources: dict[int, int],
+                      on_done) -> None:
         job = {"oid": oid, "targets": targets, "ver": ver,
                "chunks": {}, "attrs": {}, "pending": set(),
                "failed": False, "on_done": on_done}
@@ -475,6 +488,111 @@ class ECPGPeering:
         if not job["pending"]:
             self._maybe_decode(job)
 
+    def _try_subchunk_rebuild(self, oid: str, targets: dict[int, int],
+                              ver: tuple, sources: dict[int, int],
+                              on_done) -> bool:
+        """Plan a repair-plane rebuild for a SINGLE lost shard on a
+        regenerating plugin; False -> caller runs the full-chunk
+        gather.  Helper reads carry per-chunk byte extents; replies
+        hold only the repair planes (ref: ErasureCodeClay.cc:400
+        repair; arxiv 1412.3022)."""
+        from . import ecutil
+        from .ec_backend import pg_cid
+        from ..store import ObjectId, StoreError
+        b = self.st.backend
+        ec = b.ec
+        if len(targets) != 1 or not ecutil.supports_subchunk_repair(ec):
+            return False
+        lost = next(iter(targets))
+        avail = {s for s in sources if s != lost}
+        if not ec.is_repair({lost}, avail):
+            return False
+        try:
+            minimum = ec.minimum_to_repair({lost}, avail)
+        except Exception:
+            return False
+        cs = b.sinfo.chunk_size
+        extents = ecutil.repair_chunk_extents(ec, lost, cs)
+        job = {"oid": oid, "targets": targets, "ver": ver,
+               "chunks": {}, "attrs": {}, "pending": set(),
+               "failed": False, "on_done": on_done, "sources": sources,
+               "repair": {"lost": lost, "helpers": set(minimum),
+                          "cs": cs}}
+        cid = pg_cid(self.pg)
+        for s in sorted(minimum):
+            if sources[s] != self.d.whoami:
+                continue
+            soid = ObjectId(oid, shard=s)
+            try:
+                stream_len = self.d.store.stat(cid, soid)["size"]
+                abs_ext = ecutil.expand_stream_extents(
+                    extents, cs, stream_len)
+                job["chunks"][s] = b"".join(
+                    self.d.store.read(cid, soid, off, length)
+                    for off, length in abs_ext)
+                job["attrs"][s] = self.d.store.getattrs(cid, soid)
+            except (StoreError, ValueError):
+                pass
+        remote = {s: sources[s] for s in minimum
+                  if sources[s] != self.d.whoami
+                  and s not in job["chunks"]}
+        for s, osd in sorted(remote.items()):
+            tid = next(self.d._tid_gen)
+            job["pending"].add(tid)
+            self._chunk_reads[tid] = (job, s)
+            if not self._send(osd, ECSubRead(
+                    pgid=self.pg, tid=tid, shard=s,
+                    to_read=[], attrs_to_read=[oid],
+                    subchunks={oid: list(extents)}, chunk_size=cs)):
+                job["pending"].discard(tid)
+                self._chunk_reads.pop(tid, None)
+        if not job["pending"]:
+            self._maybe_decode(job)
+        return True
+
+    def _repair_decode(self, job: dict) -> None:
+        """Finish a sub-chunk repair job: rebuild the lost chunk
+        stream from the helpers' repair planes and push it; any gap
+        falls back to the full-chunk gather wholesale."""
+        from . import ecutil
+        from .ec_backend import newest_oi_attrs
+        b = self.st.backend
+        rep = job["repair"]
+        oid, lost = job["oid"], rep["lost"]
+
+        def fallback():
+            self._rebuild_full(job["oid"], job["targets"], job["ver"],
+                               job["sources"], job["on_done"])
+
+        if b is None:
+            job["on_done"](False)
+            return
+        got = {s: v for s, v in job["chunks"].items()
+               if s in rep["helpers"]}
+        if set(got) != rep["helpers"]:
+            fallback()
+            return
+        self.d.perf.inc("recovery_bytes_read",
+                        sum(len(v) for v in got.values()))
+        try:
+            stream = ecutil.repair_shard_stream(b.ec, rep["cs"], lost,
+                                                got)
+        except (ValueError, KeyError, AssertionError) as ex:
+            self._log(0, "subchunk repair of %s failed: %r", oid, ex)
+            fallback()
+            return
+        # authoritative metadata from the newest-oi helper (the shared
+        # HashInfo carries the rebuilt shard's cumulative crc too)
+        best = newest_oi_attrs(job["attrs"])
+        if best is None:
+            fallback()
+            return
+        _, oi, hinfo_dict, user_attrs = best
+        b._push_repaired_shard(
+            oid, lost, stream, oi.get("size", 0),
+            EVersion(*job["ver"]), hinfo_dict, user_attrs,
+            job["on_done"], target_osds=dict(job["targets"]))
+
     def on_chunk_reply(self, msg) -> bool:
         """ECSubReadReply routing for peering-owned chunk gathers;
         returns True when consumed."""
@@ -495,9 +613,13 @@ class ECPGPeering:
 
     def _maybe_decode(self, job: dict) -> None:
         from . import ecutil
-        from . import mutations as mut
-        from .ec_backend import OI_ATTR
+        from .ec_backend import newest_oi_attrs
+        if job.get("repair"):
+            self._repair_decode(job)
+            return
         b = self.st.backend
+        self.d.perf.inc("recovery_bytes_read",
+                        sum(len(v) for v in job["chunks"].values()))
         oid, ver = job["oid"], job["ver"]
         if b is None or len(job["chunks"]) < b.k:
             job["on_done"](False)
@@ -518,17 +640,9 @@ class ECPGPeering:
             job["on_done"](False)
             return
         # logical size + user xattrs from the newest-oi source shard
-        size = None
-        best = None
-        for s in sorted(job["attrs"]):
-            a = job["attrs"][s]
-            oi = a.get(OI_ATTR) or {}
-            v = tuple(oi.get("version", (0, 0)))
-            if best is None or v > best[0]:
-                best = (v, oi.get("size"), mut.user_xattrs(a))
-        user_attrs = {}
-        if best is not None:
-            size, user_attrs = best[1], best[2]
+        best = newest_oi_attrs(job["attrs"])
+        user_attrs = {} if best is None else best[3]
+        size = None if best is None else best[1].get("size")
         if size is not None:
             logical = logical[:size]
         b.push_rebuilt(oid, logical, sorted(job["targets"]),

@@ -134,9 +134,17 @@ def encode(sinfo: StripeInfo, ec, data: bytes,
 
 
 def decode_concat(sinfo: StripeInfo, ec,
-                  to_decode: Mapping[int, bytes]) -> bytes:
+                  to_decode: Mapping[int, bytes],
+                  timings: dict | None = None) -> bytes:
     """Rebuild the logical stream from >=k shard chunk streams
-    (ref: ECUtil.cc:9 decode -> decode_concat per stripe)."""
+    (ref: ECUtil.cc:9 decode -> decode_concat per stripe).
+
+    `timings`, when passed, receives {"stage": (t0, t1),
+    "kernel": (t0, t1)} monotonic intervals separating the host-side
+    survivor staging (reply buffers -> dense array layout) from the
+    decode compute, so the read path's trace span can split into
+    stage/kernel children (the decode_incl_stage gap of BENCH_r05
+    made per-op visible)."""
     if not to_decode:
         raise ValueError("decode of no shards")
     lengths = {len(v) for v in to_decode.values()}
@@ -153,7 +161,8 @@ def decode_concat(sinfo: StripeInfo, ec,
 
     if _batchable(ec):
         # identity mapping: shards 0..k-1 ARE the data chunks
-        out = decode(sinfo, ec, to_decode, want=range(k))
+        out = decode(sinfo, ec, to_decode, want=range(k),
+                     timings=timings)
         arrs = [np.frombuffer(out[i], dtype=np.uint8).reshape(nstripes, cs)
                 for i in range(k)]
         return np.ascontiguousarray(
@@ -161,6 +170,8 @@ def decode_concat(sinfo: StripeInfo, ec,
 
     # general path: the plugin's decode_concat knows the chunk mapping
     # (ref: ECUtil.cc:31 per-stripe ec_impl->decode_concat)
+    import time as _time
+    t0 = _time.monotonic()
     views = {i: np.frombuffer(v, dtype=np.uint8)
              for i, v in to_decode.items()}
     parts = []
@@ -169,16 +180,20 @@ def decode_concat(sinfo: StripeInfo, ec,
         stripe = ec.decode_concat(chunks)
         assert len(stripe) == sinfo.stripe_width
         parts.append(stripe)
+    if timings is not None:       # per-stripe path: no separate stage
+        timings["kernel"] = (t0, _time.monotonic())
     return b"".join(parts)
 
 
 def decode(sinfo: StripeInfo, ec, to_decode: Mapping[int, bytes],
-           want: Iterable[int]) -> dict[int, bytes]:
+           want: Iterable[int],
+           timings: dict | None = None) -> dict[int, bytes]:
     """Reconstruct the `want` shards' chunk streams from available
     shard streams (ref: ECUtil.cc:47 decode(map out)).
 
     Batched: a single device dispatch reconstructs every stripe's
-    missing chunks for matrix plugins.
+    missing chunks for matrix plugins.  `timings` (optional dict)
+    receives "stage"/"kernel" monotonic intervals — see decode_concat.
     """
     want = sorted(set(want))
     avail = sorted(to_decode)
@@ -204,16 +219,28 @@ def decode(sinfo: StripeInfo, ec, to_decode: Mapping[int, bytes],
         return out
 
     if _batchable(ec) and len(avail) >= k:
+        import time as _time
         decode_index = avail[:k]
+        t0 = _time.monotonic()
         stack = np.stack(
             [np.frombuffer(to_decode[i], dtype=np.uint8)
              .reshape(nstripes, cs) for i in decode_index], axis=1)
+        t1 = _time.monotonic()
+        # np.asarray forces the device dispatch (D2H sync), so the
+        # kernel interval below is compute + readback, never
+        # dispatch-only
         rec = np.asarray(ec.decode_batch(decode_index, missing, stack))
+        t2 = _time.monotonic()
+        if timings is not None:
+            timings["stage"] = (t0, t1)
+            timings["kernel"] = (t1, t2)
         for pos, i in enumerate(missing):
             out[i] = np.ascontiguousarray(rec[:, pos, :]).tobytes()
         return out
 
     # general path: per-stripe plugin decode
+    import time as _time
+    t0 = _time.monotonic()
     parts: dict[int, list] = {i: [] for i in missing}
     for s in range(nstripes):
         chunks = {i: np.frombuffer(v, dtype=np.uint8)[s * cs:(s + 1) * cs]
@@ -223,7 +250,80 @@ def decode(sinfo: StripeInfo, ec, to_decode: Mapping[int, bytes],
             parts[i].append(np.asarray(decoded[i], dtype=np.uint8))
     for i in missing:
         out[i] = np.concatenate(parts[i]).tobytes()
+    if timings is not None:       # per-stripe path: no separate stage
+        timings["kernel"] = (t0, _time.monotonic())
     return out
+
+
+# ---------------------------------------------------------------- repair
+# Sub-chunk (network-optimal) single-shard repair: regenerating codes
+# (clay) rebuild one lost chunk from q^(t-1)-of-q^t sub-chunk ranges
+# of d helpers instead of k whole chunks (ref: ErasureCodeClay.cc:364
+# get_repair_subchunks; "Fast Product-Matrix Regenerating Codes",
+# arxiv 1412.3022).  These helpers translate the plugin's sub-chunk
+# plan into byte extents over shard chunk STREAMS (many stripes per
+# object) and drive the per-stripe repair decode.
+
+
+def supports_subchunk_repair(ec) -> bool:
+    """True when the plugin can rebuild a single shard from partial
+    (sub-chunk) helper reads.  Non-regenerating plugins and
+    sub_chunk_count == 1 codes fall back to full-chunk recovery."""
+    return (ec.get_sub_chunk_count() > 1
+            and hasattr(ec, "is_repair")
+            and hasattr(ec, "minimum_to_repair")
+            and hasattr(ec, "get_repair_subchunks"))
+
+
+def repair_chunk_extents(ec, lost_shard: int,
+                         chunk_size: int) -> list[tuple[int, int]]:
+    """Byte extents WITHIN ONE CHUNK that helpers must serve to repair
+    `lost_shard` (the plugin's sub-chunk plan scaled to bytes).  A
+    shard stream repeats these per stripe (see ECSubRead.subchunks)."""
+    sub_no = ec.get_sub_chunk_count()
+    assert chunk_size % sub_no == 0
+    ssz = chunk_size // sub_no
+    nu = getattr(ec, "nu", 0)
+    lost_node = lost_shard if lost_shard < ec.k else lost_shard + nu
+    return [(idx * ssz, cnt * ssz)
+            for idx, cnt in ec.get_repair_subchunks(lost_node)]
+
+
+def expand_stream_extents(extents: list[tuple[int, int]],
+                          chunk_size: int,
+                          stream_len: int) -> list[tuple[int, int]]:
+    """Per-chunk byte extents -> absolute extents over an
+    nstripes x chunk_size shard stream."""
+    if stream_len % chunk_size != 0:
+        raise ValueError("shard stream not chunk-aligned")
+    return [(s * chunk_size + off, length)
+            for s in range(stream_len // chunk_size)
+            for off, length in extents]
+
+
+def repair_shard_stream(ec, chunk_size: int, lost_shard: int,
+                        helper_bufs: Mapping[int, bytes]) -> bytes:
+    """Rebuild `lost_shard`'s whole chunk stream from the helpers'
+    CONCATENATED repair-plane bytes (one repair_blocksize block per
+    stripe, as handle_sub_read assembles them).  Byte-identical to the
+    chunk a full-decode + re-encode would produce."""
+    extents = repair_chunk_extents(ec, lost_shard, chunk_size)
+    rb = sum(length for _, length in extents)   # repair bytes / stripe
+    lengths = {len(v) for v in helper_bufs.values()}
+    if len(lengths) != 1:
+        raise ValueError("helper repair buffers differ in length")
+    total = lengths.pop()
+    if rb == 0 or total % rb != 0:
+        raise ValueError("helper buffer not repair-block aligned")
+    nstripes = total // rb
+    views = {s: np.frombuffer(v, dtype=np.uint8)
+             for s, v in helper_bufs.items()}
+    parts = []
+    for st in range(nstripes):
+        chunks = {s: v[st * rb:(st + 1) * rb] for s, v in views.items()}
+        rebuilt = ec.decode({lost_shard}, chunks, chunk_size)
+        parts.append(np.asarray(rebuilt[lost_shard], dtype=np.uint8))
+    return b"".join(p.tobytes() for p in parts)
 
 
 class HashInfo:

@@ -119,6 +119,23 @@ run_trace_smoke() {
     return 0
 }
 
+# Recovery-bandwidth smoke: one OSD out of a clay pool must rebuild
+# through sub-chunk (repair-plane) reads — recovery_bytes_read
+# strictly below k x rebuilt bytes (and the k x chunk x objects
+# ceiling), data byte-identical, SLOW_OPS clear.
+run_recovery_smoke() {
+    echo "=== check_green: recovery-bandwidth smoke ==="
+    timeout -k 10 180 env JAX_PLATFORMS=cpu \
+        python scripts/recovery_smoke.py
+    local rc=$?
+    if [ "$rc" -ne 0 ]; then
+        echo "check_green: RED (recovery smoke rc=$rc — sub-chunk" \
+             "repair broken) — do not ship" >&2
+        return 1
+    fi
+    return 0
+}
+
 run_static || exit 1
 if [ "$STATIC_ONLY" -eq 1 ]; then
     echo "check_green: GREEN (static only)"
@@ -127,6 +144,7 @@ fi
 run_crash_smoke || exit 1
 run_multisite_smoke || exit 1
 run_trace_smoke || exit 1
+run_recovery_smoke || exit 1
 
 if [ "$REPEAT" -gt 1 ] && [ ${#TARGETS[@]} -eq 0 ]; then
     TARGETS=(tests/test_thrasher.py tests/test_thrash_ec.py \

@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Recovery-bandwidth smoke — the network-optimal repair half of the
+ship gate (check_green.sh).
+
+Boots a MiniCluster with a clay (regenerating-code) EC pool, writes
+objects, takes one OSD out, and asserts:
+
+1. recovery completes and every object reads back byte-identical;
+2. the cluster-wide `recovery_bytes_read` counter is STRICTLY below
+   k x the rebuilt bytes — the sub-chunk repair path
+   (ECSubRead v2 `subchunks`, ref: ErasureCodeClay.cc:364
+   get_repair_subchunks; arxiv 1412.3022) shipped less than the k
+   whole chunks a full-chunk rebuild pulls, and below the
+   k x chunk_bytes x objects ceiling;
+3. SLOW_OPS stays clear — the repair reads must not wedge ops.
+
+Run from the repo root: python scripts/recovery_smoke.py
+"""
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import numpy as np                                   # noqa: E402
+
+from ceph_tpu.testing import MiniCluster             # noqa: E402
+
+K, M = 4, 2
+N_OBJ = 6
+
+
+def main() -> int:
+    c = MiniCluster(n_osd=7, threaded=False)
+    try:
+        c.pump()
+        c.wait_all_up()
+        r = c.rados()
+        r.mon_command({"prefix": "osd erasure-code-profile set",
+                       "name": "clay_smoke",
+                       "profile": {"plugin": "clay", "k": str(K),
+                                   "m": str(M),
+                                   "crush-failure-domain": "host"}})
+        r.pool_create("clay_pool", pg_num=4, pool_type="erasure",
+                      erasure_code_profile="clay_smoke")
+        c.pump()
+        io = r.open_ioctx("clay_pool")
+        rng = np.random.default_rng(23)
+        objs = {f"smoke{i}": rng.integers(0, 256, 8192 + 37 * i,
+                                          dtype=np.uint8).tobytes()
+                for i in range(N_OBJ)}
+        for oid, data in objs.items():
+            io.write_full(oid, data)
+        c.pump()
+
+        r.mon_command({"prefix": "osd out", "ids": [0]})
+        for _ in range(60):
+            c.pump()
+            if all(d.pgs_recovering() == 0 for d in c.osds.values()):
+                break
+        else:
+            print("FAIL: recovery never finished", file=sys.stderr)
+            return 1
+
+        for oid, data in objs.items():
+            got = io.read(oid)
+            if got != data:
+                print(f"FAIL: {oid} corrupted after recovery",
+                      file=sys.stderr)
+                return 1
+
+        read = sum(d.perf._c["recovery_bytes_read"].value
+                   for d in c.osds.values())
+        rebuilt = sum(d.perf._c["recovery_bytes_rebuilt"].value
+                      for d in c.osds.values())
+        if rebuilt <= 0:
+            print("FAIL: nothing was rebuilt (no recovery ran?)",
+                  file=sys.stderr)
+            return 1
+        if read >= K * rebuilt:
+            print(f"FAIL: recovery read {read} B >= k x rebuilt "
+                  f"({K} x {rebuilt} B) — sub-chunk repair did not "
+                  "engage", file=sys.stderr)
+            return 1
+        # absolute ceiling: k whole chunk streams per recovered object
+        pool_cs = next(iter(c.osds.values()))._ec_plugin(
+            "clay_smoke").get_chunk_size(K * 4096)
+        stream_bytes = sum(
+            ((len(d) + K * pool_cs - 1) // (K * pool_cs)) * pool_cs
+            for d in objs.values())
+        ceiling = K * stream_bytes
+        if read >= ceiling:
+            print(f"FAIL: recovery read {read} B >= full-chunk "
+                  f"ceiling {ceiling} B", file=sys.stderr)
+            return 1
+
+        rc, _, health = r.mon_command({"prefix": "health"})
+        if rc == 0 and "SLOW_OPS" in (health or {}).get("checks", {}):
+            print("FAIL: SLOW_OPS raised during recovery",
+                  file=sys.stderr)
+            return 1
+        print(f"recovery_smoke: OK (read {read} B vs full-chunk "
+              f">= {K * rebuilt} B for the same shards; "
+              f"saving {1 - read / (K * rebuilt):.0%})")
+        return 0
+    finally:
+        c.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

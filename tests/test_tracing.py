@@ -118,3 +118,39 @@ def test_trace_context_survives_tcp_wire():
     # untraced messages stay untraced over the wire
     assert wire.decode_message(
         wire.encode_message(OSDOp(oid="o"))).trace is None
+
+
+def test_ec_decode_span_splits_into_stage_and_kernel_children():
+    """The ec_decode_kernel span carries `stage` (host survivor
+    gather) and `kernel` (device decode) CHILD spans, so the
+    decode_incl_stage gap BENCH_r05 exposed is visible per op in
+    assembled traces."""
+    import sys
+    sys.path.insert(0, __file__.rsplit("/", 1)[0])
+    from test_ec_backend import Cluster, _payload
+    from ceph_tpu.common.tracing import span_tree
+
+    cl = Cluster()
+    tracer = Tracer("osd.0")
+    cl.backend.tracer = tracer
+    data = _payload(2 * cl.backend.sinfo.stripe_width)
+    assert cl.write("obj", 0, data)
+    cl.kill(1)          # degraded read: reconstruction must run
+    out = {}
+    cl.backend.objects_read_and_reconstruct(
+        {"obj": (0, 0)},
+        lambda r, e: out.update(results=r, errors=e),
+        trace=new_trace())
+    assert out["results"]["obj"] == data
+    spans = tracer.dump()
+    parents = [s for s in spans if s["name"] == "ec_decode_kernel"]
+    assert len(parents) == 1
+    kids = [s for s in spans if s["parent"] == parents[0]["span_id"]]
+    names = sorted(k["name"] for k in kids)
+    assert names == ["kernel", "stage"]
+    for k in kids:
+        assert 0 <= k["duration"] <= parents[0]["duration"] + 1e-6
+    # the tree renders with the children nested under the decode span
+    tree = span_tree(spans)
+    node = [n for n in tree if n["name"] == "ec_decode_kernel"]
+    assert node and len(node[0]["children"]) == 2

@@ -6,6 +6,12 @@ Findings over one parsed file.  The engine owns everything around
 them: collecting files, parsing once, matching findings against the
 suppression baseline and inline ``# cephck: ignore[rule]`` markers,
 and turning the result into an exit code the ship gate can trust.
+
+v2 runs in two phases: every file is parsed first and folded into a
+ProjectContext (symbol table + call graph, see project.py), then the
+rules run per file with ``ctx.project`` carrying the cross-module
+view — so a rule can ask "does this loop call something that host-
+syncs two modules away" instead of guessing from one AST.
 """
 from __future__ import annotations
 
@@ -16,6 +22,9 @@ import json
 import pathlib
 import sys
 from typing import Iterable, Iterator
+
+from .project import ProjectContext, dotted  # noqa: F401  (dotted is
+# re-exported: rules and external callers import it from here)
 
 #: directories never scanned: caches, VCS internals, and the fixture
 #: corpus (known-bad snippets exist to be red — scanning them would
@@ -43,14 +52,22 @@ class FileContext:
     """One parsed source file plus the cross-file engine options."""
 
     def __init__(self, path: pathlib.Path, rel: str, source: str,
-                 tree: ast.Module, options: dict):
+                 tree: ast.Module, options: dict,
+                 project: ProjectContext | None = None):
         self.path = path
         self.rel = rel
         self.source = source
         self.lines = source.splitlines()
         self.tree = tree
         self.options = options
+        #: the cross-module pass (set by the engine before rules run)
+        self.project = project
         self._parents: dict[ast.AST, ast.AST] | None = None
+
+    def module(self):
+        """This file's ModuleInfo in the project pass (import aliases,
+        jit registry) — None only if the engine skipped phase 1."""
+        return self.project.module_for(self.rel) if self.project else None
 
     # -- helpers shared by rules ---------------------------------------
 
@@ -103,19 +120,6 @@ class FileContext:
             if 0 <= ln < len(self.lines) and marker in self.lines[ln]:
                 return True
         return False
-
-
-def dotted(node: ast.AST) -> str:
-    """Best-effort dotted name of a call target: ``threading.Lock``,
-    ``time.perf_counter``, ``self._loop`` — empty for dynamic funcs."""
-    if isinstance(node, ast.Name):
-        return node.id
-    if isinstance(node, ast.Attribute):
-        base = dotted(node.value)
-        return f"{base}.{node.attr}" if base else node.attr
-    if isinstance(node, ast.Call):
-        return dotted(node.func)
-    return ""
 
 
 def repo_root(start: pathlib.Path | None = None) -> pathlib.Path:
@@ -201,6 +205,23 @@ def load_baseline(path: pathlib.Path) -> list[Suppression]:
     return out
 
 
+def prune_baseline(path: pathlib.Path,
+                   stale: list[Suppression]) -> int:
+    """Rewrite the baseline file dropping `stale` entries (matched by
+    rule/path/symbol), preserving everything else verbatim — the
+    ``--prune-baseline`` rewrite.  Returns how many entries went."""
+    data = json.loads(path.read_text())
+    gone = {(s.rule, s.path, s.symbol) for s in stale}
+    kept = [e for e in data.get("suppressions", [])
+            if (str(e.get("rule", "")).strip(),
+                str(e.get("path", "")).strip(),
+                str(e.get("symbol", "")).strip()) not in gone]
+    dropped = len(data.get("suppressions", [])) - len(kept)
+    data["suppressions"] = kept
+    path.write_text(json.dumps(data, indent=1) + "\n")
+    return dropped
+
+
 # -------------------------------------------------------------- engine
 
 class Engine:
@@ -219,19 +240,21 @@ class Engine:
         self.errors: list[str] = []
         self.scanned: list[str] = []
 
-    def check_file(self, path: pathlib.Path) -> Iterator[Finding]:
+    def _parse(self, path: pathlib.Path) -> FileContext | None:
         try:
             source = path.read_text()
             tree = ast.parse(source, filename=str(path))
         except (OSError, SyntaxError) as ex:
             self.errors.append(f"{path}: {ex}")
-            return
+            return None
         try:
             rel = path.resolve().relative_to(self.root).as_posix()
         except ValueError:
             rel = path.as_posix()
         self.scanned.append(rel)
-        ctx = FileContext(path, rel, source, tree, self.options)
+        return FileContext(path, rel, source, tree, self.options)
+
+    def _check_ctx(self, ctx: FileContext) -> Iterator[Finding]:
         for rule in self.rules:
             for f in rule.check(ctx):
                 if ctx.inline_ignored(f):
@@ -245,9 +268,32 @@ class Engine:
                     self.findings.append(f)
                     yield f
 
+    def check_file(self, path: pathlib.Path) -> Iterator[Finding]:
+        """Single-file scan (fixture tests): the project pass degrades
+        to a one-module table, so cross-module rules still run."""
+        ctx = self._parse(path)
+        if ctx is None:
+            return
+        project = ProjectContext()
+        project.add(ctx.rel, ctx.tree)
+        project.finalize()
+        ctx.project = project
+        yield from self._check_ctx(ctx)
+
     def run(self, paths: Iterable[str]) -> int:
+        # phase 1: parse everything, build the cross-module context
+        ctxs: list[FileContext] = []
+        project = ProjectContext()
         for f in collect_files(paths, self.root):
-            for _ in self.check_file(f):
+            ctx = self._parse(f)
+            if ctx is not None:
+                project.add(ctx.rel, ctx.tree)
+                ctxs.append(ctx)
+        project.finalize()
+        # phase 2: rules, per file, with the project view attached
+        for ctx in ctxs:
+            ctx.project = project
+            for _ in self._check_ctx(ctx):
                 pass
         return 1 if (self.findings or self.errors) else 0
 
@@ -274,6 +320,11 @@ def main(argv: list[str] | None = None) -> int:
                          f"<repo-root>/{BASELINE_NAME})")
     ap.add_argument("--no-baseline", action="store_true",
                     help="ignore the baseline: report everything")
+    ap.add_argument("--prune-baseline", action="store_true",
+                    help="rewrite the baseline dropping stale entries "
+                         "(file/rule pairs that no longer produce a "
+                         "finding); without this flag stale entries "
+                         "FAIL the run — the blindfold only shrinks")
     ap.add_argument("--wire-schema", default=None,
                     help="wire schema lockfile (default: "
                          "tests/fixtures/wire_schema.json)")
@@ -304,6 +355,7 @@ def main(argv: list[str] | None = None) -> int:
 
     root = repo_root()
     suppressions: list[Suppression] = []
+    bpath = None
     if not args.no_baseline:
         bpath = pathlib.Path(args.baseline) if args.baseline \
             else root / BASELINE_NAME
@@ -325,10 +377,19 @@ def main(argv: list[str] | None = None) -> int:
         print(ex, file=sys.stderr)
         return 2
 
+    stale = eng.stale_suppressions()
+    if stale and args.prune_baseline and bpath and bpath.exists():
+        prune_baseline(bpath, stale)
+        for s in stale:
+            print(f"cephck: pruned stale suppression "
+                  f"({s.rule} @ {s.path})", file=sys.stderr)
+        stale = []
+
     if args.as_json:
         print(json.dumps({
             "findings": [dataclasses.asdict(f) for f in eng.findings],
             "suppressed": len(eng.suppressed),
+            "stale": [dataclasses.asdict(s) for s in stale],
             "errors": eng.errors,
         }, indent=1))
     else:
@@ -336,13 +397,19 @@ def main(argv: list[str] | None = None) -> int:
             print(f.render())
         for e in eng.errors:
             print(f"cephck: parse error: {e}", file=sys.stderr)
-        for s in eng.stale_suppressions():
-            print(f"cephck: warning: stale suppression "
-                  f"({s.rule} @ {s.path}) — remove it from the "
-                  f"baseline", file=sys.stderr)
+        for s in stale:
+            print(f"cephck: stale suppression ({s.rule} @ {s.path}) "
+                  f"no longer matches any finding — remove it or run "
+                  f"--prune-baseline", file=sys.stderr)
         n = len(eng.findings)
         print(f"cephck: {n} finding(s), {len(eng.suppressed)} "
               f"suppressed by baseline"
               + (f", {len(eng.errors)} parse error(s)"
-                 if eng.errors else ""))
+                 if eng.errors else "")
+              + (f", {len(stale)} STALE suppression(s)"
+                 if stale else ""))
+    if stale and rc == 0:
+        # a suppression nothing matches is a blindfold over code that
+        # moved: the gate fails until the baseline shrinks to fit
+        rc = 1
     return rc

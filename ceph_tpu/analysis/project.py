@@ -1,0 +1,333 @@
+"""Project-wide context for cephck v2: symbol table + call graph.
+
+Per-file AST matching (cephck v1) cannot see that a loop in
+osd/ec_backend.py calls a helper in ec/kernels/bitmatmul.py that host-
+syncs, or that a callsite in crush/ invokes a jit wrapper declared two
+modules away.  ProjectContext is the cross-module half of the engine:
+it is built ONCE over every scanned file and handed to rules next to
+the per-file FileContext, carrying
+
+* a module table (repo-relative path -> dotted module name -> AST),
+* per-module import aliases, expanded to canonical dotted names
+  (``np.asarray`` -> ``numpy.asarray``, ``jnp.dot`` ->
+  ``jax.numpy.dot``) so rules match semantics, not spelling,
+* a symbol table of every module-level function/method,
+* the jit registry: every symbol wrapped in ``jax.jit`` (decorator,
+  ``functools.partial(jax.jit, ...)`` or ``name = jax.jit(f)``
+  assignment), with its declared static args,
+* a best-effort call graph over project-internal calls (plain names,
+  imported symbols, module attributes, ``self.method``).
+
+Resolution is deliberately conservative: a name the table cannot pin
+resolves to None and rules must stay silent about it — cross-module
+analysis buys reach, not license to guess.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+
+def dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of a call target: ``threading.Lock``,
+    ``time.perf_counter``, ``self._loop`` — empty for dynamic funcs."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return f"{base}.{node.attr}" if base else node.attr
+    if isinstance(node, ast.Call):
+        return dotted(node.func)
+    return ""
+
+
+def module_name(rel: str) -> str:
+    """Dotted module name for a repo-relative path
+    (``ceph_tpu/ec/gf.py`` -> ``ceph_tpu.ec.gf``)."""
+    parts = rel.split("/")
+    if parts[-1].endswith(".py"):
+        parts[-1] = parts[-1][:-3]
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(p for p in parts if p) or "<module>"
+
+
+def jit_statics(call: ast.Call) -> tuple[set[int], set[str]]:
+    """Declared (static positions, static names) of a jit/partial-jit
+    call — empty sets when none are declared."""
+    nums: set[int] = set()
+    names: set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            vals = kw.value.elts if isinstance(
+                kw.value, (ast.Tuple, ast.List)) else [kw.value]
+            for v in vals:
+                if isinstance(v, ast.Constant) and \
+                        isinstance(v.value, int):
+                    nums.add(v.value)
+        elif kw.arg == "static_argnames":
+            vals = kw.value.elts if isinstance(
+                kw.value, (ast.Tuple, ast.List)) else [kw.value]
+            for v in vals:
+                if isinstance(v, ast.Constant) and \
+                        isinstance(v.value, str):
+                    names.add(v.value)
+    return nums, names
+
+
+def _is_jit_name(name: str) -> bool:
+    return name.split(".")[-1] == "jit"
+
+
+def _partial_jit(call: ast.Call) -> bool:
+    """``functools.partial(jax.jit, ...)`` (decorator or assignment)."""
+    return dotted(call.func).split(".")[-1] == "partial" and any(
+        isinstance(a, (ast.Name, ast.Attribute)) and _is_jit_name(dotted(a))
+        for a in call.args)
+
+
+class ModuleInfo:
+    """One scanned module's symbols, import aliases and jit registry."""
+
+    def __init__(self, rel: str, tree: ast.Module):
+        self.rel = rel
+        self.name = module_name(rel)
+        self.tree = tree
+        #: local alias -> canonical dotted prefix ("np" -> "numpy")
+        self.imports: dict[str, str] = {}
+        #: qualname ("f", "Cls.meth") -> def node
+        self.functions: dict[str, ast.FunctionDef | ast.AsyncFunctionDef] = {}
+        #: jit-wrapped symbol -> (static positions, static names)
+        self.jitted: dict[str, tuple[set[int], set[str]]] = {}
+        #: names bound by module-level statements (containers a traced
+        #: function could leak into)
+        self.module_names: set[str] = set()
+        self._collect()
+
+    # -- alias expansion ----------------------------------------------
+
+    def expand(self, name: str) -> str:
+        """Canonical dotted name for a local spelling: resolves the
+        FIRST component through the import table (``jnp.dot`` ->
+        ``jax.numpy.dot``); unknown heads pass through unchanged."""
+        if not name:
+            return name
+        head, _, rest = name.partition(".")
+        target = self.imports.get(head)
+        if target is None:
+            return name
+        return f"{target}.{rest}" if rest else target
+
+    # -- collection ---------------------------------------------------
+
+    def _package_parts(self) -> list[str]:
+        return self.name.split(".")[:-1]
+
+    def _collect(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.imports[a.asname] = a.name
+                    else:
+                        head = a.name.split(".")[0]
+                        self.imports.setdefault(head, head)
+            elif isinstance(node, ast.ImportFrom):
+                pkg = self._package_parts()
+                if node.level:
+                    pkg = pkg[:len(pkg) - (node.level - 1)] \
+                        if node.level <= len(pkg) + 1 else []
+                    base = ".".join(pkg + ([node.module]
+                                           if node.module else []))
+                else:
+                    base = node.module or ""
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    full = f"{base}.{a.name}" if base else a.name
+                    self.imports[a.asname or a.name] = full
+        for node in self.tree.body:
+            self._collect_stmt(node, prefix="")
+            for t in getattr(node, "targets", []) or \
+                    ([node.target] if isinstance(
+                        node, (ast.AnnAssign, ast.AugAssign)) else []):
+                if isinstance(t, ast.Name):
+                    self.module_names.add(t.id)
+
+    def _collect_stmt(self, node: ast.stmt, prefix: str) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            qual = f"{prefix}{node.name}"
+            self.functions[qual] = node
+            st = self._jit_of_decorators(node)
+            if st is not None:
+                self.jitted[qual] = st
+                if prefix:          # methods also reachable by name
+                    self.jitted.setdefault(node.name, st)
+        elif isinstance(node, ast.ClassDef):
+            for item in node.body:
+                self._collect_stmt(item, prefix=f"{node.name}.")
+        elif isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call):
+            st = self._jit_of_call(node.value)
+            if st is not None:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.jitted[f"{prefix}{t.id}" if prefix
+                                    else t.id] = st
+        # jit assignments inside function bodies (``fn = jax.jit(...)``
+        # behind a cache) register under their local name too, so
+        # callsite rules recognize `fn(...)` as a jitted call
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign) and \
+                        isinstance(sub.value, ast.Call):
+                    st = self._jit_of_call(sub.value)
+                    if st is not None:
+                        for t in sub.targets:
+                            if isinstance(t, ast.Name):
+                                self.jitted.setdefault(t.id, st)
+
+    def _jit_of_decorators(self, fn) -> tuple[set[int], set[str]] | None:
+        for d in fn.decorator_list:
+            name = self.expand(dotted(d))
+            if _is_jit_name(name):
+                return (set(), set()) if not isinstance(d, ast.Call) \
+                    else jit_statics(d)
+            if isinstance(d, ast.Call):
+                if _is_jit_name(self.expand(dotted(d.func))):
+                    return jit_statics(d)
+                if _partial_jit(d):
+                    return jit_statics(d)
+        return None
+
+    def _jit_of_call(self, call: ast.Call) -> tuple[set[int],
+                                                    set[str]] | None:
+        """statics if `call` evaluates to a jit wrapper:
+        ``jax.jit(f, ...)`` or ``partial(jax.jit, ...)``.  The func
+        must be a plain name — ``jit(f)(x)``'s OUTER call (func is
+        itself a Call) invokes the wrapper, it does not build one."""
+        if isinstance(call.func, (ast.Name, ast.Attribute)) and \
+                _is_jit_name(self.expand(dotted(call.func))):
+            return jit_statics(call)
+        if _partial_jit(call):
+            return jit_statics(call)
+        return None
+
+
+class ProjectContext:
+    """The cross-module pass: module/symbol tables + call graph over
+    every file of one engine run."""
+
+    def __init__(self) -> None:
+        self.modules: dict[str, ModuleInfo] = {}     # dotted name ->
+        self.by_rel: dict[str, ModuleInfo] = {}
+        #: (modname, qualname) -> {(modname, qualname), ...}
+        self.call_graph: dict[tuple[str, str],
+                              set[tuple[str, str]]] = {}
+        self._finalized = False
+
+    def add(self, rel: str, tree: ast.Module) -> ModuleInfo:
+        mod = ModuleInfo(rel, tree)
+        self.modules[mod.name] = mod
+        self.by_rel[rel] = mod
+        self._finalized = False
+        return mod
+
+    def module_for(self, rel: str) -> ModuleInfo | None:
+        return self.by_rel.get(rel)
+
+    # -- resolution ---------------------------------------------------
+
+    def resolve(self, mod: ModuleInfo, name: str,
+                caller_qual: str = "") -> tuple[ModuleInfo, str] | None:
+        """Resolve a call-target spelling in `mod` to a (module,
+        qualname) the project owns; None for externals/dynamic."""
+        if not name:
+            return None
+        if name.startswith("self.") and "." in caller_qual:
+            cls = caller_qual.split(".")[0]
+            qual = f"{cls}.{name[5:]}"
+            if qual in mod.functions:
+                return mod, qual
+            return None
+        if name in mod.functions:
+            return mod, name
+        full = mod.expand(name)
+        # longest module prefix the project owns, remainder = qualname
+        parts = full.split(".")
+        for cut in range(len(parts) - 1, 0, -1):
+            owner = self.modules.get(".".join(parts[:cut]))
+            if owner is not None:
+                qual = ".".join(parts[cut:])
+                if qual in owner.functions:
+                    return owner, qual
+                if qual in owner.jitted:
+                    return owner, qual
+                return None
+        return None
+
+    def jit_statics_of(self, mod: ModuleInfo, name: str,
+                       caller_qual: str = "") -> tuple[set[int],
+                                                       set[str]] | None:
+        """statics when `name` at a callsite in `mod` is a jit wrapper
+        (local, method, or imported from another scanned module);
+        None when it is not known to be jitted."""
+        if name in mod.jitted:
+            return mod.jitted[name]
+        if name.startswith("self."):
+            attr = name[5:]
+            if attr in mod.jitted:
+                return mod.jitted[attr]
+            if "." in caller_qual:
+                qual = f"{caller_qual.split('.')[0]}.{attr}"
+                if qual in mod.jitted:
+                    return mod.jitted[qual]
+            return None
+        resolved = self.resolve(mod, name, caller_qual)
+        if resolved is not None:
+            owner, qual = resolved
+            return owner.jitted.get(qual)
+        return None
+
+    # -- call graph ---------------------------------------------------
+
+    def finalize(self) -> None:
+        """Build the project call graph (idempotent)."""
+        if self._finalized:
+            return
+        self.call_graph = {}
+        for mod in self.modules.values():
+            for qual, fn in mod.functions.items():
+                edges = self.call_graph.setdefault((mod.name, qual),
+                                                   set())
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    target = self.resolve(mod, dotted(node.func), qual)
+                    if target is not None:
+                        edges.add((target[0].name, target[1]))
+        self._finalized = True
+
+    def callees(self, mod: ModuleInfo, qual: str) -> set[tuple[str, str]]:
+        self.finalize()
+        return self.call_graph.get((mod.name, qual), set())
+
+    def reachable(self, mod: ModuleInfo, qual: str,
+                  max_depth: int = 3) -> Iterator[tuple[str, str]]:
+        """(modname, qualname) pairs reachable from one function,
+        breadth-first, depth-bounded — callers use it for "does this
+        loop reach device/host-sync code" questions."""
+        self.finalize()
+        seen: set[tuple[str, str]] = set()
+        frontier = {(mod.name, qual)}
+        for _ in range(max_depth):
+            nxt: set[tuple[str, str]] = set()
+            for node in frontier:
+                for tgt in self.call_graph.get(node, ()):
+                    if tgt not in seen:
+                        seen.add(tgt)
+                        nxt.add(tgt)
+                        yield tgt
+            if not nxt:
+                return
+            frontier = nxt

@@ -14,6 +14,7 @@ import re
 from typing import Iterator
 
 from .engine import FileContext, Finding, dotted
+from .project import ModuleInfo
 
 # --------------------------------------------------------------- No. 1
 
@@ -623,6 +624,501 @@ handle.
                     "minimum `except Exception`)")
 
 
+# ===================================================================
+# The device-contract family (cephck v2): cross-module rules that
+# police the host<->device boundary on the TPU hot path.  They lean on
+# ctx.project (analysis/project.py) — canonical import expansion
+# ("np.asarray" == "numpy.asarray"), the project-wide jit registry,
+# and the call graph — instead of per-file guessing.
+
+#: files on the per-stripe/per-batch hot path: everything under ec/
+#: and crush/, plus the two OSD EC files the backend dispatches from
+_HOT_BASENAMES = {"ec_backend.py", "ecutil.py"}
+
+
+def _hot_path(rel: str) -> bool:
+    parts = rel.split("/")
+    return "ec" in parts or "crush" in parts or \
+        parts[-1] in _HOT_BASENAMES
+
+
+def _loop_body_nodes(loop: ast.AST) -> Iterator[ast.AST]:
+    """Every node executed PER ITERATION of a loop: walks body/orelse
+    (plus the While test), skipping nested def/class bodies (those run
+    when called, not per iteration) — but not nested loops' bodies,
+    which do."""
+    stack: list[ast.stmt] = list(loop.body) + list(
+        getattr(loop, "orelse", []) or [])
+    if isinstance(loop, ast.While):
+        yield from ast.walk(loop.test)
+    while stack:
+        stmt = stack.pop()
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        yield stmt
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.stmt):
+                stack.append(child)
+            else:
+                yield from ast.walk(child)
+
+
+#: canonical names whose CALL forces a device->host sync (or a
+#: device round-trip) of the converted value
+_SYNC_NP = {"numpy.asarray", "numpy.array", "numpy.ascontiguousarray"}
+_SYNC_DEFINITE = {"jax.device_get"}
+
+
+def _sync_call(node: ast.Call, mod: ModuleInfo | None) -> str | None:
+    """Spelled-out sync name when `node` is a host-sync call."""
+    name = dotted(node.func)
+    if not name:
+        return None
+    canon = mod.expand(name) if mod else name
+    if canon in _SYNC_DEFINITE or canon in _SYNC_NP:
+        return canon
+    last = name.split(".")[-1]
+    if last == "item" and "." in name and not node.args:
+        return f"{name}()"
+    if last == "block_until_ready":
+        return name
+    return None
+
+
+def _definite_sync(node: ast.Call, mod: ModuleInfo | None) -> str | None:
+    """Like _sync_call but only the unambiguous device syncs — used
+    for the cross-module (callee) check, where numpy conversions are
+    too often host-native to flag at a distance."""
+    s = _sync_call(node, mod)
+    if s is None or (mod.expand(dotted(node.func)) if mod
+                     else dotted(node.func)) in _SYNC_NP:
+        return None
+    return s
+
+
+class HostSyncHotPathRule:
+    id = "host-sync-hot-path"
+    doc = """
+Host sync (.item()/float()/np.asarray()/block_until_ready/
+jax.device_get) reachable inside a per-stripe or per-batch loop on
+the EC/CRUSH hot path (ec/, crush/, osd/ec_backend.py,
+osd/ecutil.py).
+
+JAX dispatch is asynchronous; the batched EC path exists so the
+host<->device boundary is crossed ONCE per batch.  A sync inside the
+per-stripe loop turns the pipeline back into
+dispatch-wait-dispatch-wait: every iteration pays the full device
+round-trip latency, and on a multi-chip mesh every chip idles behind
+it.  This is the exact hazard class PR 9 removed from the decode path
+(staging-free decode) — the rule keeps it from growing back.  The
+check is cross-module: a loop that calls a helper (resolved through
+the project call graph) which syncs inside is flagged at the
+callsite.
+
+Fix: hoist the conversion out of the loop — batch the stripes into
+one array, dispatch once, convert once.  Where the sync is
+load-bearing (a host-native fallback path that never sees device
+arrays, a bench timer floor), waive the site inline with
+`# cephck: ignore[host-sync-hot-path]` and a reason comment, or add
+a baseline entry with the reason.
+"""
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not _hot_path(ctx.rel):
+            return
+        base = ctx.rel.split("/")[-1]
+        if base not in _HOT_BASENAMES and not ctx.imports_jax():
+            return      # host-native module (pure-numpy plugin, the
+            # scalar CRUSH oracle): nothing to sync
+        mod = ctx.module()
+        project = ctx.project
+        flagged: set[ast.AST] = set()
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            for node in _loop_body_nodes(loop):
+                if not isinstance(node, ast.Call) or node in flagged:
+                    continue
+                sync = _sync_call(node, mod)
+                if sync is not None:
+                    flagged.add(node)
+                    yield ctx.finding(
+                        self.id, node,
+                        f"{sync} inside a loop (started line "
+                        f"{loop.lineno}) — per-iteration host sync "
+                        f"serializes the device pipeline; batch the "
+                        f"loop and sync once")
+                    continue
+                if isinstance(node.func, ast.Name) and \
+                        node.func.id == "float" and len(node.args) == 1 \
+                        and not isinstance(node.args[0], ast.Constant) \
+                        and ctx.imports_jax():
+                    flagged.add(node)
+                    yield ctx.finding(
+                        self.id, node,
+                        f"float(...) inside a loop (started line "
+                        f"{loop.lineno}) — float() of a jax value "
+                        f"forces a device->host sync per iteration")
+                    continue
+                # cross-module: the loop calls a project function that
+                # definitely syncs inside (call-graph reachable)
+                if project is None or mod is None:
+                    continue
+                target = project.resolve(mod, dotted(node.func),
+                                         ctx.qualname(node))
+                if target is None:
+                    continue
+                hit = self._callee_sync(project, *target)
+                if hit is not None:
+                    flagged.add(node)
+                    tmod, tqual, sync = hit
+                    yield ctx.finding(
+                        self.id, node,
+                        f"call to {tqual}() ({tmod}) inside a loop "
+                        f"(started line {loop.lineno}) — the callee "
+                        f"host-syncs via {sync}, so every iteration "
+                        f"pays a device round-trip")
+
+    def _callee_sync(self, project, owner: ModuleInfo, qual: str,
+                     depth: int = 2):
+        """(modname, qual, syncname) when `qual` (or anything it
+        reaches within `depth` hops) contains a definite sync."""
+        targets = [(owner.name, qual)]
+        targets += list(project.reachable(owner, qual, max_depth=depth))
+        for modname, q in targets:
+            m = project.modules.get(modname)
+            fn = m.functions.get(q) if m else None
+            if fn is None:
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    s = _definite_sync(node, m)
+                    if s is not None:
+                        return modname, q, s
+        return None
+
+
+_PER_CALL_VARYING = re.compile(
+    r"(^|\.)(id|hash|perf_counter|perf_counter_ns|monotonic|time|"
+    r"time_ns|random|randint|randbytes|uuid4|tobytes|tolist)$")
+
+
+class JitRetraceChurnRule:
+    id = "jit-retrace-churn"
+    doc = """
+jax.jit callsite whose compiled-function cache cannot hit: a fresh
+jit wrapper per call, a jit wrapper built inside a loop, or a static
+argument derived from a per-call value (time, id(), random,
+.tobytes()/.tolist() of data).
+
+jit caches compiled executables PER WRAPPER OBJECT, keyed by argument
+shapes/dtypes and static values.  `jax.jit(f)(x)` inside a function
+builds a new wrapper — and a new, empty cache — on every call, so
+every call recompiles (~100ms-10s each) no matter how stable the
+shapes are.  A static arg fed from time/random/id/object-contents
+never repeats, so each call misses the cache the same way.  Either
+form silently turns the hot path into compile-per-call — the
+cache-miss churn class the Ragged-Paged-Attention literature calls
+out as the first-order TPU serving hazard.
+
+Fix: build the jit wrapper ONCE (module level, or memoized like
+crush/batch.py's _RULE_JIT keyed by static config) and call the
+cached wrapper; keep per-call values out of static args (pass them
+as traced arguments, or hoist them into the cache key on purpose).
+"""
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        mod = ctx.module()
+        if mod is None:
+            return
+        parents = ctx.parents()
+
+        def enclosing(node, kinds):
+            cur = parents.get(node)
+            while cur is not None:
+                if isinstance(cur, kinds):
+                    return cur
+                cur = parents.get(cur)
+            return None
+
+        flagged: set[ast.AST] = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            # (a)/(b): a Call that BUILDS a jit wrapper
+            if mod._jit_of_call(node) is not None:
+                loop = enclosing(node, (ast.For, ast.AsyncFor,
+                                        ast.While))
+                caller = enclosing(node, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))
+                # a decorator position is fine (wrapper built once at
+                # def time) — skip jit calls that decorate a def
+                parent = parents.get(node)
+                is_decorator = isinstance(
+                    parent, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node in parent.decorator_list
+                immediately_called = isinstance(parent, ast.Call) and \
+                    parent.func is node
+                if is_decorator:
+                    continue
+                if loop is not None and node not in flagged:
+                    flagged.add(node)
+                    yield ctx.finding(
+                        self.id, node,
+                        f"jit wrapper built inside a loop (line "
+                        f"{loop.lineno}) — each iteration gets a "
+                        f"fresh, empty compile cache; build the "
+                        f"wrapper once outside the loop")
+                    continue
+                if immediately_called and caller is not None and \
+                        node not in flagged:
+                    flagged.add(node)
+                    yield ctx.finding(
+                        self.id, node,
+                        f"jax.jit(...)(...) built and called in one "
+                        f"expression inside {caller.name}() — a new "
+                        f"wrapper (and empty cache) per call, i.e. "
+                        f"compile-per-call; hoist the jit wrapper out")
+                    continue
+            # (c): per-call-varying value in a static arg slot
+            st = None
+            if ctx.project is not None:
+                st = ctx.project.jit_statics_of(mod, dotted(node.func),
+                                                ctx.qualname(node))
+            if not st:
+                continue
+            nums, names = st
+            slots = [(f"static arg {i}", a) for i, a in
+                     enumerate(node.args) if i in nums]
+            slots += [(f"static arg {kw.arg!r}", kw.value)
+                      for kw in node.keywords if kw.arg in names]
+            for label, expr in slots:
+                bad = next(
+                    (n for n in ast.walk(expr)
+                     if isinstance(n, ast.Call) and
+                     _PER_CALL_VARYING.search(dotted(n.func) or "")),
+                    None)
+                if bad is not None:
+                    yield ctx.finding(
+                        self.id, bad,
+                        f"{label} of jitted {dotted(node.func)}() is "
+                        f"derived from {dotted(bad.func)}() — a "
+                        f"per-call value as a jit cache key misses "
+                        f"the cache (recompile) on every call")
+
+
+#: container mutators a traced function could leak a tracer through
+_LEAK_MUTATORS = {"append", "extend", "add", "insert", "update",
+                  "setdefault", "put", "put_nowait"}
+
+
+class TracerLeakRule:
+    id = "tracer-leak"
+    doc = """
+Traced (jit-wrapped) function stores a value somewhere that outlives
+the traced call: on self, on a global, or into a module-level
+container.
+
+Inside jax.jit, every intermediate is a TRACER — a symbolic stand-in
+valid only while tracing runs.  Storing one on self/globals/a shared
+container smuggles it past the trace boundary; the next use raises
+jax's "leaked tracer" UnexpectedTracerError at best, or (for cached
+shapes) silently captures a stale constant from trace time.  Either
+way the bug surfaces far from the store, usually on the second call
+with a new shape.
+
+Fix: return the value from the traced function and store it OUTSIDE
+the jit boundary; for debug taps use jax.debug.callback (or
+io_callback), which marshals concrete values out safely.
+"""
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        mod = ctx.module()
+        if mod is None:
+            return
+        traced: list = []
+        for qual, st in mod.jitted.items():
+            fn = mod.functions.get(qual)
+            if fn is not None:
+                traced.append((qual, fn))
+        # `g = jax.jit(f)` also traces module-local f
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and \
+                    mod._jit_of_call(node) is not None:
+                for a in node.args:
+                    target = dotted(a)
+                    if isinstance(a, (ast.Name, ast.Attribute)) and \
+                            target in mod.functions:
+                        traced.append((target, mod.functions[target]))
+        seen: set[ast.AST] = set()
+        for qual, fn in traced:
+            if fn in seen:
+                continue
+            seen.add(fn)
+            globals_declared: set[str] = {
+                name for node in ast.walk(fn)
+                if isinstance(node, ast.Global) for name in node.names}
+            for node in ast.walk(fn):
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [node.target]
+                for t in targets:
+                    base = t.value if isinstance(t, ast.Subscript) \
+                        else t
+                    if isinstance(base, ast.Attribute) and \
+                            isinstance(base.value, ast.Name) and \
+                            base.value.id == "self":
+                        yield ctx.finding(
+                            self.id, node,
+                            f"traced function {qual}() stores to "
+                            f"self.{base.attr} — a tracer written to "
+                            f"an attribute outlives the trace "
+                            f"(leaked-tracer class)", symbol=qual)
+                    elif isinstance(base, ast.Name) and \
+                            base.id in globals_declared:
+                        yield ctx.finding(
+                            self.id, node,
+                            f"traced function {qual}() assigns "
+                            f"global {base.id!r} — a tracer stored in "
+                            f"module state outlives the trace",
+                            symbol=qual)
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in _LEAK_MUTATORS:
+                    recv = node.func.value
+                    leaky = (isinstance(recv, ast.Attribute) and
+                             isinstance(recv.value, ast.Name) and
+                             recv.value.id == "self") or \
+                        (isinstance(recv, ast.Name) and
+                         recv.id in mod.module_names)
+                    if leaky:
+                        yield ctx.finding(
+                            self.id, node,
+                            f"traced function {qual}() calls "
+                            f".{node.func.attr}() on "
+                            f"{dotted(recv)!r} — mutating state that "
+                            f"outlives the trace leaks the tracer",
+                            symbol=qual)
+
+
+#: numpy constructors that pin a value to HOST memory
+_NP_CTORS = {
+    "numpy." + n for n in (
+        "zeros", "ones", "empty", "full", "arange", "frombuffer",
+        "array", "asarray", "ascontiguousarray", "stack",
+        "concatenate", "eye", "vstack", "hstack", "copy", "tile")}
+
+#: the EXPLICIT transfer spellings — these are the fix, never flagged
+_EXPLICIT_TRANSFER = {"jax.numpy.asarray", "jax.numpy.array",
+                      "jax.device_put"}
+
+
+def _nonassign_bindings(node: ast.AST) -> Iterator[str]:
+    """Names bound by non-Assign constructs: for/with-as targets,
+    aug/ann-assign, walrus, comprehension loop vars."""
+    targets: list[ast.AST] = []
+    if isinstance(node, (ast.For, ast.AsyncFor, ast.comprehension)):
+        targets.append(node.target)
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign,
+                           ast.NamedExpr)):
+        targets.append(node.target)
+    elif isinstance(node, (ast.With, ast.AsyncWith)):
+        targets += [i.optional_vars for i in node.items
+                    if i.optional_vars is not None]
+    for t in targets:
+        for sub in ast.walk(t):
+            if isinstance(sub, ast.Name):
+                yield sub.id
+
+
+class ImplicitTransferRule:
+    id = "implicit-transfer"
+    doc = """
+Host (numpy) array fed straight into device compute on a kernel-path
+function — an implicit host->device transfer per call.
+
+Passing a numpy array directly to a jnp op or a jit-wrapped function
+works, but XLA silently copies it host->device on EVERY call; under
+jax.transfer_guard('disallow') (armed by the jaxguard sanitizer on
+the EC/placement entry points) the same call is an error.  The rule
+uses the project call graph to recognize jit-wrapped callees defined
+in OTHER modules (e.g. a kernels/bitmatmul.py wrapper called from a
+plugin), not just local jnp spellings.
+
+Fix: stage once, explicitly — `jnp.asarray(x)` / `jax.device_put(x)`
+at the batch boundary — and keep the device array across calls; or,
+for genuinely host-side math, stay in numpy end to end.
+"""
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not _hot_path(ctx.rel) or not ctx.imports_jax():
+            return
+        mod = ctx.module()
+        if mod is None:
+            return
+        for qual, fn in mod.functions.items():
+            np_locals: dict[str, str] = {}
+            rebound: set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if not isinstance(t, ast.Name):
+                            # tuple/attr/subscript unpack: every name
+                            # inside loses its numpy provenance
+                            for sub in ast.walk(t):
+                                if isinstance(sub, ast.Name):
+                                    rebound.add(sub.id)
+                            continue
+                        if isinstance(node.value, ast.Call):
+                            canon = mod.expand(dotted(node.value.func))
+                            if canon in _NP_CTORS:
+                                np_locals[t.id] = canon
+                                continue
+                        rebound.add(t.id)
+                else:
+                    # any OTHER binding construct (for/with-as targets,
+                    # aug/ann-assign, walrus, comprehensions) rebinds
+                    # the name to an unknown value
+                    for name in _nonassign_bindings(node):
+                        rebound.add(name)
+            for name in rebound:        # conservatively drop names
+                np_locals.pop(name, None)   # ever bound to non-numpy
+            if not np_locals:
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                target = dotted(node.func)
+                canon = mod.expand(target)
+                jitted = ctx.project is not None and \
+                    ctx.project.jit_statics_of(mod, target,
+                                               qual) is not None
+                device_op = canon.startswith(("jax.numpy.",
+                                              "jax.lax.")) and \
+                    canon not in _EXPLICIT_TRANSFER
+                if not (jitted or device_op):
+                    continue
+                args = list(node.args) + [kw.value
+                                          for kw in node.keywords]
+                for a in args:
+                    if isinstance(a, ast.Name) and a.id in np_locals:
+                        kind = "jit-wrapped function" if jitted \
+                            else "device op"
+                        yield ctx.finding(
+                            self.id, node,
+                            f"host array {a.id!r} "
+                            f"({np_locals[a.id]}) passed into "
+                            f"{kind} {target}() — implicit "
+                            f"host->device transfer per call; stage "
+                            f"it once with jnp.asarray/device_put",
+                            symbol=ctx.qualname(node))
+                        break
+
+
 ALL_RULES = [RawLockRule, WireSchemaRule, UnregisteredMessageRule,
              TxnAtomicityRule, SilentThreadRule, JaxTimingRule,
-             JitStaticRule, BareExceptRule]
+             JitStaticRule, BareExceptRule, HostSyncHotPathRule,
+             JitRetraceChurnRule, TracerLeakRule, ImplicitTransferRule]

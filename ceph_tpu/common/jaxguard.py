@@ -1,0 +1,345 @@
+"""jaxguard: runtime device-contract sanitizer — lockdep for the
+host<->device boundary.
+
+Two halves, both armed by ``CEPH_TPU_JAXGUARD=1`` (the ``jaxguard``
+config option, force-set by tests/conftest.py exactly like
+``CEPH_TPU_LOCKDEP=1``):
+
+* **Recompile accounting.**  ``enable()`` wraps ``jax.jit`` so every
+  wrapper built by THIS repo's code (the caller module is checked; jax-
+  internal jit uses are left alone) counts compilations per callsite
+  and per argument signature (shapes/dtypes/weak-types/sharding of
+  array leaves, values of static leaves, the x64 flag).  A wrapper
+  that compiles AGAIN for a signature it already compiled — the cache-
+  miss-per-call churn class cephck's ``jit-retrace-churn`` rule hunts
+  statically — raises ``RecompileError`` at the offending call unless
+  the callsite declared a higher bound via ``set_recompile_bound``.
+  ``stats()`` exposes calls/compiles/signatures per callsite; the
+  jaxguard smoke (scripts/jaxguard_smoke.py) asserts exactly-once
+  compilation per signature on the EC encode/decode pair.
+
+* **Transfer guarding.**  ``guard_transfers()`` arms
+  ``jax.transfer_guard('disallow')`` around a region (the EC batched
+  encode/decode dispatch in osd/ecutil.py + the tpu plugin, and the
+  CRUSH batch placement dispatch): an IMPLICIT host<->device transfer
+  inside — a numpy array smuggled straight into a jitted call, a host
+  constant materialized per dispatch — is an error, not a silent 2x
+  slowdown.  Explicit staging (``jnp.asarray``/``jax.device_put``)
+  stays legal: the guard bans accidents, not the batch boundary.
+
+When the option is off every entry point here is a no-op: ``jax.jit``
+is never touched (zero overhead — asserted by tests/test_common.py).
+"""
+from __future__ import annotations
+
+import contextlib
+import sys
+
+from .lockdep import make_lock
+
+__all__ = ["enable", "disable", "enabled", "enable_if_configured",
+           "guard_transfers", "intended_transfers", "stats", "reset",
+           "set_recompile_bound", "JaxGuardError", "RecompileError"]
+
+
+class JaxGuardError(RuntimeError):
+    """A device-contract violation observed at runtime."""
+
+
+class RecompileError(JaxGuardError):
+    """A jit callsite recompiled for a signature it had already
+    compiled, beyond its declared bound — the compile cache is being
+    defeated (fresh wrapper per call, churning static args, ...)."""
+
+
+#: repo packages whose jax.jit calls are guarded; jax-internal (and
+#: third-party) wrappers are never touched
+_GUARDED_PREFIXES = ("ceph_tpu", "test", "scripts", "bench",
+                     "__graft_entry__", "conftest", "__main__")
+
+_enabled = False
+_orig_jit = None
+_lock = make_lock("jaxguard.sites")
+#: callsite key -> _Site
+_sites: dict[str, "_Site"] = {}
+#: substring pattern -> declared allowed recompiles per signature
+_bounds: dict[str, int] = {}
+
+
+class _Site:
+    """Compile accounting for one jax.jit callsite (file:line).
+
+    Signatures are tracked at the SITE, not the wrapper: a fresh
+    wrapper built per call (``jax.jit(f)(x)`` in a loop) re-compiles
+    the same (closure, args) signature from the same site, which is
+    exactly the churn the bound is for — while distinct wrappers with
+    DIFFERENT closures (a memoized registry like crush/batch.py's
+    _RULE_JIT, one wrapper per static config) hash to different
+    signatures and stay legal."""
+
+    __slots__ = ("key", "calls", "compiles", "wrappers", "recompiles",
+                 "sigs", "resigs")
+
+    def __init__(self, key: str):
+        self.key = key
+        self.calls = 0
+        self.compiles = 0
+        self.wrappers = 0
+        self.recompiles = 0
+        self.sigs: set[str] = set()
+        #: per-signature recompile counts — the declared bound is PER
+        #: SIGNATURE (set_recompile_bound's contract), so one churning
+        #: signature must not consume another's budget
+        self.resigs: dict[str, int] = {}
+
+
+def set_recompile_bound(pattern: str, bound: int) -> None:
+    """Declare that callsites whose key contains `pattern` may
+    recompile an already-seen signature up to `bound` times.  The
+    default bound is 0: every signature compiles exactly once."""
+    _bounds[pattern] = int(bound)
+
+
+def _bound_for(key: str) -> int:
+    best = 0
+    for pat, b in _bounds.items():
+        if pat in key:
+            best = max(best, b)
+    return best
+
+
+def _leaf_desc(v) -> str:
+    """Shape/dtype summary for array-likes (NEVER repr — repr of a
+    device array would itself force the D2H sync this module polices),
+    truncated repr for plain python values."""
+    shape = getattr(v, "shape", None)
+    dtype = getattr(v, "dtype", None)
+    if shape is not None and dtype is not None:
+        weak = getattr(getattr(v, "aval", None), "weak_type", None)
+        sharding = getattr(v, "sharding", None)
+        return f"a{tuple(shape)}:{dtype}:{weak}:{sharding}"
+    try:
+        return f"p:{type(v).__name__}:{v!r:.120}"
+    except Exception:
+        return f"p:{type(v).__name__}"
+
+
+def _closure_salt(fun) -> str:
+    """Distinguishes wrappers by what they CLOSE OVER, so one site
+    that legitimately memoizes many wrappers (one per closed-over
+    static config) is not mistaken for churn."""
+    cells = getattr(fun, "__closure__", None) or ()
+    parts = []
+    for c in cells:
+        try:
+            parts.append(_leaf_desc(c.cell_contents))
+        except ValueError:
+            # forward-referencing/self-recursive cell not yet bound
+            # when the decorator runs — the sanitizer must not change
+            # what pristine jax.jit accepts
+            parts.append("p:<unbound>")
+    return ";".join(parts)
+
+
+def _sig_of(args, kwargs) -> str:
+    """Approximation of jit's cache key: tree structure, array leaf
+    (shape, dtype, weak-type, sharding), non-array leaf repr, plus the
+    x64 flag.  Finer than jit's real key is fine (missed recompiles);
+    coarser would false-positive, so sharding/weak-type ride along."""
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    parts = [repr(treedef)]
+    for leaf in leaves:
+        parts.append(_leaf_desc(leaf))
+    parts.append(f"x64={jax.config.jax_enable_x64}")
+    return "|".join(parts)
+
+
+class _GuardedJit:
+    """Proxy over one pjit wrapper: counts compiles via the wrapper's
+    cache size, tracks signatures, raises on bound violations.
+    Everything else (lower/trace/eval_shape/...) forwards."""
+
+    def __init__(self, fn, site: _Site, salt: str):
+        self._fn = fn
+        self._site = site
+        self._salt = salt
+        #: concurrent calls in flight on THIS wrapper + a generation
+        #: counter: cache growth observed across an overlapped window
+        #: cannot be attributed to one signature (another thread's
+        #: compile lands between our before/after reads), so overlap
+        #: downgrades recompile detection to compile counting only —
+        #: the sanitizer must never raise on a pure cache hit
+        self._inflight = 0
+        self._entries = 0
+        self.__wrapped__ = fn
+
+    def _cache_size(self):
+        try:
+            return self._fn._cache_size()
+        except Exception:
+            return None
+
+    def __call__(self, *args, **kwargs):
+        site = self._site
+        sig = f"{self._salt}||{_sig_of(args, kwargs)}"
+        with _lock:
+            site.calls += 1
+            overlapped = self._inflight > 0
+            self._inflight += 1
+            self._entries += 1
+            my_entry = self._entries
+            before = self._cache_size()
+        try:
+            out = self._fn(*args, **kwargs)
+        finally:
+            with _lock:
+                self._inflight -= 1
+                if self._entries != my_entry:
+                    overlapped = True
+                after = self._cache_size()
+                grew = (before is not None and after is not None
+                        and after > before)
+                if grew:
+                    site.compiles += 1
+                    if sig not in site.sigs:
+                        site.sigs.add(sig)
+                        grew = False        # first compile: legal
+                nsig = 0
+                if grew and not overlapped:
+                    site.recompiles += 1
+                    nsig = site.resigs[sig] = \
+                        site.resigs.get(sig, 0) + 1
+                trip = nsig > _bound_for(site.key)
+        if trip:
+            raise RecompileError(
+                f"jaxguard: {site.key} recompiled an "
+                f"already-compiled signature "
+                f"(recompile #{nsig} of that signature, bound "
+                f"{_bound_for(site.key)}) — the jit cache "
+                f"is being defeated; hoist the wrapper or "
+                f"stabilize its static args "
+                f"(sig: {sig[:200]})")
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
+
+
+def _guarded_jit(fun=None, _caller=None, **kwargs):
+    """The jax.jit replacement installed by enable()."""
+    caller = _caller if _caller is not None else \
+        (sys._getframe(1).f_globals.get("__name__", "") or "")
+    if fun is None:
+        # keyword-only partial form: jax.jit(static_argnums=...)(f) —
+        # the caller was captured at the OUTER call; resolving it
+        # inside deco would see jaxguard's own frame and guard
+        # third-party wrappers
+        def deco(f):
+            return _guarded_jit(f, _caller=caller, **kwargs)
+        return deco
+    wrapped = _orig_jit(fun, **kwargs)
+    if not caller.startswith(_GUARDED_PREFIXES):
+        return wrapped
+    code = getattr(fun, "__code__", None)
+    where = f"{code.co_filename}:{code.co_firstlineno}" if code \
+        else f"{caller}:{getattr(fun, '__name__', '?')}"
+    qual = getattr(fun, "__qualname__", getattr(fun, "__name__", "?"))
+    key = f"{where} [{qual}]"
+    with _lock:
+        site = _sites.get(key)
+        if site is None:
+            site = _sites[key] = _Site(key)
+        site.wrappers += 1
+    return _GuardedJit(wrapped, site, _closure_salt(fun))
+
+
+# ----------------------------------------------------------- lifecycle
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable() -> None:
+    """Patch jax.jit for compile accounting (idempotent)."""
+    global _enabled, _orig_jit
+    if _enabled:
+        return
+    import jax
+    _orig_jit = jax.jit
+    jax.jit = _guarded_jit
+    _enabled = True
+
+
+def disable() -> None:
+    """Restore the pristine jax.jit (tests only — wrappers already
+    built stay guarded)."""
+    global _enabled
+    if not _enabled:
+        return
+    import jax
+    jax.jit = _orig_jit
+    _enabled = False
+
+
+def enable_if_configured() -> bool:
+    """Arm the sanitizer when the `jaxguard` option (env
+    ``CEPH_TPU_JAXGUARD``) is on — the conftest/smoke entry point.
+    Call it BEFORE importing modules that build jit wrappers at
+    import, for the same reason lockdep reads its option at lock
+    construction."""
+    # one parser for the option: the config env layer reads
+    # CEPH_TPU_JAXGUARD through Option.parse, so off/False/0/no all
+    # disable — a bespoke env tuple here would diverge (lockdep reads
+    # its option the same way)
+    from .options import global_config
+    if global_config()["jaxguard"]:
+        enable()
+    return _enabled
+
+
+def reset() -> None:
+    """Drop accumulated per-site counters (tests)."""
+    with _lock:
+        _sites.clear()
+
+
+def stats() -> dict[str, dict]:
+    """Per-callsite compile accounting: {key: {calls, compiles,
+    wrappers, recompiles}} — the smoke's exactly-once evidence."""
+    with _lock:
+        return {k: {"calls": s.calls, "compiles": s.compiles,
+                    "wrappers": s.wrappers,
+                    "recompiles": s.recompiles,
+                    "signatures": len(s.sigs)}
+                for k, s in _sites.items()}
+
+
+# ------------------------------------------------------ transfer guard
+
+@contextlib.contextmanager
+def guard_transfers():
+    """Arm ``jax.transfer_guard('disallow')`` for a region when
+    jaxguard is on (no-op otherwise): implicit host<->device
+    transfers inside become errors.  Explicit staging
+    (jnp.asarray / jax.device_put) remains legal — arm this around
+    the device DISPATCH, stage at the boundary."""
+    if not _enabled:
+        yield
+        return
+    import jax
+    with jax.transfer_guard("disallow"):
+        yield
+
+
+@contextlib.contextmanager
+def intended_transfers():
+    """Escape hatch inside a guarded region for a transfer that is
+    the design (e.g. a deliberate per-call host readback): documents
+    the intent in code and disarms the guard for exactly that span."""
+    if not _enabled:
+        yield
+        return
+    import jax
+    with jax.transfer_guard("allow"):
+        yield

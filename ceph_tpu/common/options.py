@@ -277,6 +277,12 @@ OPTIONS: dict[str, Option] = {opt.name: opt for opt in [
        desc="lock-order cycle detection on instrumented locks; read "
             "at lock construction, so set it before daemons start "
             "(ref: src/common/lockdep.cc)"),
+    _o("jaxguard", T.BOOL, False, L.DEV,
+       desc="device-contract sanitizer: count jit compilations per "
+            "callsite (fail on same-signature recompiles) and arm "
+            "jax.transfer_guard around the EC/placement dispatch; "
+            "read when jaxguard.enable_if_configured() runs, so set "
+            "it before jit wrappers are built (see common/jaxguard.py)"),
     _o("osd_debug_inject_dispatch_delay_probability", T.FLOAT, 0.0,
        L.DEV, min=0.0, max=1.0, runtime=True),
     _o("objectstore_debug_inject_read_err", T.BOOL, False, L.DEV,

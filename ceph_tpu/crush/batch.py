@@ -383,7 +383,12 @@ class CompiledCrushMap:
                       self.class_w)
             xs = jnp.asarray(xs, dtype=jnp.int64)
             weight = jnp.asarray(weight, dtype=jnp.int64)
-            res, cnt = fn(arrays, xs, weight)
+            # the placement tables were staged once at compile_map;
+            # under CEPH_TPU_JAXGUARD an implicit transfer inside the
+            # batched mapping dispatch is an error
+            from ..common import jaxguard
+            with jaxguard.guard_transfers():
+                res, cnt = fn(arrays, xs, weight)
         if return_counts:
             return res, cnt
         return res

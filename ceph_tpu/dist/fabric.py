@@ -52,6 +52,16 @@ class ICIFabric:
         self.n_devices = n_devices
         self.resident: set[int] = set()
         self._lock = make_lock("dist.fabric")
+        #: serializes mesh PROGRAM launches.  The fabric is driven by
+        #: many daemon threads at once (the primary staging an encode,
+        #: k+m shard OSDs each gathering their slice), and jax dispatch
+        #: is async: without this lock two in-flight XLA programs can
+        #: interleave their collective rendezvous across the shared
+        #: device set and deadlock (observed live: two psum AllReduces
+        #: stuck waiting for each other's participants).  One program
+        #: in flight at a time, completed before release — the device
+        #: contract for a process-shared mesh.
+        self._dispatch = make_lock("dist.fabric.dispatch")
         self._coders: dict = {}       # (k, m, matrix bytes) -> coder
         self._meshes: dict = {}       # shard_ways-compat k -> mesh
         self._staged: dict = {}       # fabric_key -> staging record
@@ -115,8 +125,14 @@ class ICIFabric:
         if pad:
             arr = np.concatenate(
                 [arr, np.zeros((pad, k, chunk_size), dtype=np.uint8)])
-        data_dev = coder.shard_data(arr)
-        parity_dev = coder.encode(data_dev)     # the psum fan-out step
+        import jax
+        with self._dispatch:
+            data_dev = coder.shard_data(arr)
+            parity_dev = coder.encode(data_dev)     # psum fan-out step
+            # complete before releasing the launch lock: a second
+            # program (another write's encode, a shard's fetch slice)
+            # must never rendezvous concurrently with this one
+            jax.block_until_ready(parity_dev)
         with self._lock:
             self._staged[key] = {
                 "data": data_dev, "parity": parity_dev,
@@ -134,10 +150,13 @@ class ICIFabric:
         if rec is None:
             raise KeyError(f"no staged write {key!r}")
         k = rec["k"]
-        if shard < k:
-            sl = np.asarray(rec["data"][:, shard, :])
-        else:
-            sl = np.asarray(rec["parity"][:, shard - k, :])
+        # slicing a sharded array launches a device program; serialize
+        # it with every other mesh launch (k+m shards fetch at once)
+        with self._dispatch:
+            if shard < k:
+                sl = np.asarray(rec["data"][:, shard, :])
+            else:
+                sl = np.asarray(rec["parity"][:, shard - k, :])
         return np.ascontiguousarray(sl[:rec["S"]]).tobytes()
 
     def release(self, key) -> None:

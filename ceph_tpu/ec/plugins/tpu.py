@@ -180,7 +180,11 @@ class ErasureCodeTpu(MatrixErasureCode):
                                            n, decode_index, erased)
             mm = GFDecodeFull(dmat, valid)
             self._decode_mm.put(sig, mm, cost=n)
-        return mm(data)
+        # staging-free contract (PR 9): the kernel slices survivors on
+        # device — nothing inside this dispatch may touch the host
+        from ...common import jaxguard
+        with jaxguard.guard_transfers():
+            return mm(data)
 
     def decode_batches_full(self, erasures: list[int], batches,
                             valid=None):

@@ -20,6 +20,7 @@ from typing import Iterable, Mapping
 
 import numpy as np
 
+from ..common import jaxguard
 from ..common.crc32c import crc32c
 
 
@@ -110,14 +111,20 @@ def encode(sinfo: StripeInfo, ec, data: bytes,
 
     if _batchable(ec):
         arr = np.frombuffer(data, dtype=np.uint8).reshape(nstripes, k, cs)
-        parity = np.asarray(ec.encode_batch(arr))       # (S, m, cs)
+        # the one legal host->device crossing of the encode path is
+        # the plugin's explicit staging; under CEPH_TPU_JAXGUARD any
+        # IMPLICIT transfer inside the dispatch is an error
+        with jaxguard.guard_transfers():
+            parity_dev = ec.encode_batch(arr)
+        parity = np.asarray(parity_dev)                 # (S, m, cs)
         out: dict[int, bytes] = {}
+        # tobytes() emits C-order bytes from a strided view directly —
+        # an ascontiguousarray here would copy each shard slice twice
         for shard in sorted(want):
             if shard < k:
-                out[shard] = np.ascontiguousarray(arr[:, shard, :]).tobytes()
+                out[shard] = arr[:, shard, :].tobytes()
             else:
-                out[shard] = np.ascontiguousarray(
-                    parity[:, shard - k, :]).tobytes()
+                out[shard] = parity[:, shard - k, :].tobytes()
         return out
 
     # general path: per-stripe plugin encode (handles chunk remapping
@@ -129,6 +136,10 @@ def encode(sinfo: StripeInfo, ec, data: bytes,
         for i in want:
             chunk = encoded[i]
             assert len(chunk) == cs
+            # this per-stripe fallback only serves host-native (numpy)
+            # plugins; batchable device plugins take the one-dispatch
+            # path above, so no device boundary is crossed here
+            # cephck: ignore[host-sync-hot-path] — host-native plugin path
             parts[i].append(np.asarray(chunk, dtype=np.uint8))
     return {i: np.concatenate(parts[i]).tobytes() for i in want}
 
@@ -228,14 +239,19 @@ def decode(sinfo: StripeInfo, ec, to_decode: Mapping[int, bytes],
         t1 = _time.monotonic()
         # np.asarray forces the device dispatch (D2H sync), so the
         # kernel interval below is compute + readback, never
-        # dispatch-only
-        rec = np.asarray(ec.decode_batch(decode_index, missing, stack))
+        # dispatch-only; the guard makes any implicit transfer inside
+        # the dispatch an error under CEPH_TPU_JAXGUARD
+        with jaxguard.guard_transfers():
+            rec_dev = ec.decode_batch(decode_index, missing, stack)
+        rec = np.asarray(rec_dev)
         t2 = _time.monotonic()
         if timings is not None:
             timings["stage"] = (t0, t1)
             timings["kernel"] = (t1, t2)
         for pos, i in enumerate(missing):
-            out[i] = np.ascontiguousarray(rec[:, pos, :]).tobytes()
+            # tobytes() handles the strided view; rec was synced once
+            # above, so this loop is host memcpy only
+            out[i] = rec[:, pos, :].tobytes()
         return out
 
     # general path: per-stripe plugin decode
@@ -247,6 +263,9 @@ def decode(sinfo: StripeInfo, ec, to_decode: Mapping[int, bytes],
                   for i, v in to_decode.items()}
         decoded = ec.decode(set(want), chunks, cs)
         for i in missing:
+            # only non-batchable (host-native numpy) plugins reach this
+            # per-stripe path: the asarray never crosses a device boundary
+            # cephck: ignore[host-sync-hot-path] — host-native plugin path
             parts[i].append(np.asarray(decoded[i], dtype=np.uint8))
     for i in missing:
         out[i] = np.concatenate(parts[i]).tobytes()
@@ -322,6 +341,9 @@ def repair_shard_stream(ec, chunk_size: int, lost_shard: int,
     for st in range(nstripes):
         chunks = {s: v[st * rb:(st + 1) * rb] for s, v in views.items()}
         rebuilt = ec.decode({lost_shard}, chunks, chunk_size)
+        # sub-chunk repair is the clay (host-native numpy) plugin's
+        # path; no device array ever reaches this asarray
+        # cephck: ignore[host-sync-hot-path] — host-native plugin path
         parts.append(np.asarray(rebuilt[lost_shard], dtype=np.uint8))
     return b"".join(p.tobytes() for p in parts)
 

@@ -1122,6 +1122,14 @@ class RGWGateway:
             if self.sync is None:
                 raise S3Error(404, "NoSuchKey", "not a zone member")
             return respond_json(self.sync.status())
+        if op == "sync-markers" and method == "GET":
+            # a source zone asks: how far have YOU durably applied my
+            # datalog?  Feeds the source's auto-trim (datalog records
+            # behind every registered peer's durable cursor may go)
+            if self.sync is None:
+                raise S3Error(404, "NoSuchKey", "not a zone member")
+            return respond_json(
+                self.sync.markers_for(q.get("source", "")))
         raise S3Error(404, "NoSuchKey", f"admin/{op}")
 
     def sync_ensure_bucket(self, bucket: str, meta: dict,

@@ -302,6 +302,9 @@ class SyncAgent:
     BACKOFF_CAP_S = 5.0
     #: error-list entries kept per shard (oldest dropped, logged)
     MAX_SHARD_ERRORS = 64
+    #: sync rounds between datalog auto-trim passes (the trim needs
+    #: one HTTP round-trip per peer, so it must not ride every tick)
+    TRIM_EVERY = 50
 
     def __init__(self, gw, interval: float = 0.1):
         self.gw = gw
@@ -314,6 +317,11 @@ class SyncAgent:
         self._lock = make_lock("rgw.sync")
         #: (source, bucket, shard) -> applied-up-to sequence
         self._markers: dict[tuple[str, str, int], int] = {}
+        #: (source, bucket, shard) -> marker KNOWN PERSISTED in RADOS;
+        #: the datalog auto-trim on the source must see only durable
+        #: cursors — an in-memory marker dies with a crash and the
+        #: replayed batch would read an already-trimmed log
+        self._durable: dict[tuple[str, str, int], int] = {}
         #: (source, bucket, shard) -> last observed peer head
         self._heads: dict[tuple[str, str, int], int] = {}
         #: (source, bucket, shard) -> [error records]
@@ -330,6 +338,8 @@ class SyncAgent:
         self.entries_applied = 0
         self.entries_skipped = 0
         self.full_syncs = 0
+        self.datalog_trimmed = 0
+        self._rounds = 0
         self._loaded_sources: set[str] = set()
         # sync-class apply latency (fetch + local apply per replicated
         # entry) — the fourth op-class SLO histogram next to the OSD's
@@ -404,6 +414,12 @@ class SyncAgent:
             # mid-adopt) must not approve every tombstone with zero
             # evidence
             self.gw.prune_registry_tombstones(views)
+        # periodic datalog auto-trim: drop replication records every
+        # registered peer's durable cursor has passed (bounded log
+        # growth without an operator in the loop)
+        self._rounds += 1
+        if peers and self._rounds % self.TRIM_EVERY == 0:
+            self.datalog_trim_round()
         return applied
 
     def _sync_peer(self, peer: dict,
@@ -609,6 +625,85 @@ class SyncAgent:
             self._persist(src, bucket, nshards)
         return applied
 
+    # -- datalog auto-trim ---------------------------------------------
+    def markers_for(self, source: str) -> dict[str, dict]:
+        """This zone's DURABLE cursors for entries pulled from
+        `source`: {bucket: {"gen": incarnation, "cursors": {shard:
+        marker}}} — what the source's auto-trim consumes over
+        /admin/sync-markers.  Only markers that survived a persist are
+        reported (trimming against an in-memory cursor would strand a
+        crash-replayed batch on an already-trimmed log), and each set
+        carries the bucket INCARNATION its cursors belong to: a high
+        cursor against a dead datalog must not approve trimming a
+        recreated bucket's fresh records."""
+        with self._lock:
+            out: dict[str, dict] = {}
+            for (src, bucket, shard), m in self._durable.items():
+                if src == source:
+                    rec = out.setdefault(
+                        bucket,
+                        {"gen": self._gens.get((src, bucket), ""),
+                         "cursors": {}})
+                    rec["cursors"][str(shard)] = m
+            return out
+
+    def datalog_trim_round(self) -> int:
+        """Trim every local bucket shard's datalog up to the MINIMUM
+        durable cursor across ALL registered peers (the reference's
+        datalog trim driven by peer sync markers).  A peer that is
+        lagging, unreachable, or has never synced a bucket reports a
+        lower (or no) marker and blocks the trim for exactly the
+        records it still needs — the trim can only destroy records
+        every peer has durably passed.  Returns records trimmed."""
+        self.gw.multisite.refresh()
+        peers = self.gw.multisite.peers()
+        if not peers:
+            return 0        # no peers registered: no consumers, but
+            # also no evidence — leave the log for the operator
+        views: list[dict] = []
+        for peer in peers:
+            try:
+                views.append(self._fetch_json(
+                    peer["endpoint"], "GET",
+                    f"/admin/sync-markers?source={quote(self.zone)}"))
+            except PeerError as ex:
+                dout("rgw", 4).write(
+                    "datalog trim skipped: peer %s unreachable (%s)",
+                    peer["zone"], ex)
+                return 0    # an unreachable registered peer blocks
+                # every trim: we cannot know what it still needs
+        trimmed = 0
+        local = self.gw._buckets_raw()
+        for bucket, meta in local.items():
+            if "deleted" in meta:
+                continue
+            lgen = meta.get("created", "")
+            recs = [v.get(bucket) for v in views]
+            if any(r is None or r.get("gen", "") != lgen
+                   for r in recs):
+                # a peer with no cursors for this bucket — or cursors
+                # from a DEAD incarnation (delete+recreate it hasn't
+                # resynced yet) — blocks the whole bucket: its stale
+                # high markers say nothing about the fresh datalog
+                continue
+            for s in range(self.gw._nshards(bucket)):
+                upto = min(int(r["cursors"].get(str(s), 0))
+                           for r in recs)
+                if upto <= 0:
+                    continue
+                try:
+                    n = self.datalog.trim(bucket, s, upto)
+                except RadosError:
+                    continue        # shard object gone/unreadable:
+                    # nothing to trim there this round
+                trimmed += n
+        self.datalog_trimmed += trimmed
+        if trimmed:
+            dout("rgw", 4).write(
+                "datalog auto-trim: %d record(s) behind all %d "
+                "peers' durable cursors", trimmed, len(peers))
+        return trimmed
+
     def _forget_bucket(self, src: str, bucket: str) -> None:
         """Retire a dropped bucket's cursor state, memory + durable —
         stale markers against a recreated bucket's fresh datalog
@@ -629,6 +724,9 @@ class SyncAgent:
                 del self._errors[k]
             for k in hkeys:
                 del self._heads[k]
+            for k in [k for k in self._durable
+                      if k[0] == src and k[1] == bucket]:
+                del self._durable[k]
             self._gens.pop((src, bucket), None)
         try:
             self.io.remove_omap_keys(
@@ -782,6 +880,7 @@ class SyncAgent:
         Written AFTER the applies they describe — a crash between
         apply and persist replays the batch, never skips it."""
         kv = {}
+        persisted: dict[tuple[str, str, int], int] = {}
         with self._lock:
             for s in range(nshards):
                 m = self._markers.get((src, bucket, s))
@@ -792,11 +891,15 @@ class SyncAgent:
                      "gen": self._gens.get((src, bucket), "")}).encode()
                 errs = self._errors.get((src, bucket, s), [])
                 kv[f"e.{bucket}.{s}"] = json.dumps(errs).encode()
+                persisted[(src, bucket, s)] = m
         try:
             self.io.create(sync_status_obj(src))
         except RadosError:
             pass
         self.io.set_omap(sync_status_obj(src), kv)
+        # only now (write durable) may the source's auto-trim see them
+        with self._lock:
+            self._durable.update(persisted)
 
     def _load_state(self, src: str) -> None:
         """Resume point: markers + error lists from the durable
@@ -814,6 +917,7 @@ class SyncAgent:
                     if kind == "m":
                         rec = json.loads(raw)
                         self._markers[key] = rec["marker"]
+                        self._durable[key] = rec["marker"]
                         self._gens[(src, bucket)] = rec.get("gen", "")
                     elif kind == "e":
                         self._errors[key] = json.loads(raw)

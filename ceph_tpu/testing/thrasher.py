@@ -223,7 +223,13 @@ class MDSThrasher:
                  now: float = 50_000.0):
         self.c = cluster
         self.rng = random.Random(seed)
-        self.now = now
+        # beacons sent BEFORE the first simulated tick are stamped
+        # with the mon's real clock (time.monotonic() = host uptime);
+        # a sim seed behind that runs mon time backward, so a dead
+        # gid's last stamp stays "fresh" forever and failover never
+        # fires (bit at host uptime > 50000s).  Only forward jumps
+        # are safe: seed at whichever clock is further along.
+        self.now = max(now, _time.monotonic() + 1.0)
         self.log: list[str] = []
 
     def _active_ranks(self) -> list[int]:

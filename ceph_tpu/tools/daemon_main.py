@@ -203,6 +203,11 @@ def _serve(tick, interval: float) -> None:
 
 
 def main(argv=None) -> int:
+    # the env layer propagates CEPH_TPU_JAXGUARD from the parent
+    # (tests/conftest.py) to subprocess daemons, same as lockdep —
+    # arm BEFORE daemon imports build any jit wrapper
+    from ..common import jaxguard
+    jaxguard.enable_if_configured()
     ap = argparse.ArgumentParser(prog="ceph-tpu-daemon")
     sub = ap.add_subparsers(dest="role", required=True)
     pm = sub.add_parser("mon")

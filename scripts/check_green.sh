@@ -68,6 +68,23 @@ while [ $# -gt 0 ]; do
             TARGETS+=("$1"); shift ;;
     esac
 done
+# jaxguard smoke: one EC encode/decode batch pair must compile
+# exactly once per signature (zero recompiles, round 2 pure cache
+# hits) with the transfer guard armed — the device-contract half of
+# the gate (see ceph_tpu/common/jaxguard.py).
+run_jaxguard_smoke() {
+    echo "=== check_green: jaxguard smoke ==="
+    timeout -k 10 180 env JAX_PLATFORMS=cpu \
+        python scripts/jaxguard_smoke.py
+    local rc=$?
+    if [ "$rc" -ne 0 ]; then
+        echo "check_green: RED (jaxguard smoke rc=$rc — device" \
+             "contract broken) — do not ship" >&2
+        return 1
+    fi
+    return 0
+}
+
 run_crash_smoke() {
     echo "=== check_green: crash-capture smoke ==="
     timeout -k 10 180 env JAX_PLATFORMS=cpu \
@@ -141,6 +158,7 @@ if [ "$STATIC_ONLY" -eq 1 ]; then
     echo "check_green: GREEN (static only)"
     exit 0
 fi
+run_jaxguard_smoke || exit 1
 run_crash_smoke || exit 1
 run_multisite_smoke || exit 1
 run_trace_smoke || exit 1

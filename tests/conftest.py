@@ -22,6 +22,17 @@ import os
 # sanitizer off for the whole suite.
 os.environ["CEPH_TPU_LOCKDEP"] = "1"
 
+# ... and every tier-1 run is a device-contract sanitizer run too:
+# jaxguard ON before any ceph_tpu import, because enable() wraps
+# jax.jit and module-level jit wrappers are built at import.  A jit
+# callsite that recompiles an already-compiled signature raises
+# RecompileError at the offending call, and the EC/placement entry
+# points run under jax.transfer_guard('disallow') — an unintended
+# host<->device transfer is an error, not a silent 2x slowdown
+# (see ceph_tpu/common/jaxguard.py).  Force-set for the same reason
+# as lockdep above.
+os.environ["CEPH_TPU_JAXGUARD"] = "1"
+
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
@@ -34,6 +45,12 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 assert jax.default_backend() == "cpu", jax.default_backend()
 assert len(jax.devices()) == 8, jax.devices()
+
+# arm jaxguard AFTER the backend asserts (its own jit probes must not
+# count) and BEFORE any ceph_tpu import builds a jit wrapper
+from ceph_tpu.common import jaxguard  # noqa: E402
+
+assert jaxguard.enable_if_configured(), "CEPH_TPU_JAXGUARD=1 set above"
 
 
 def _kill_stray_daemons() -> int:

@@ -1,0 +1,31 @@
+"""green: stage once, explicitly, at the batch boundary."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def gf_mul(a, b):
+    return jnp.matmul(a, b, preferred_element_type=jnp.int32)
+
+
+_TABLE = jnp.asarray(np.zeros((8, 8), dtype=np.int8))
+
+
+def encode(data):
+    table = jnp.asarray(np.zeros((8, 8), dtype=np.int8))
+    return gf_mul(table, data)
+
+
+def encode_shared(data):
+    return gf_mul(_TABLE, data)
+
+
+def encode_rebound(data, device_tables):
+    # `table` starts host-side but is REBOUND by the loop target to a
+    # device array before reaching the op — provenance must not stick
+    table = np.zeros((8, 8), dtype=np.int8)
+    out = gf_mul(jnp.asarray(table), data)
+    for table in device_tables:
+        out = gf_mul(table, data)
+    return out

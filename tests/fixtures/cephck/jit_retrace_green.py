@@ -1,0 +1,24 @@
+"""green: wrapper built once; statics are stable config."""
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnames=("group",))
+def encode(v, group):
+    return v.reshape(group, -1)
+
+
+_CACHE = {}
+
+
+def encoder(shape):
+    """Memoized: one wrapper (and one compile cache) per shape."""
+    fn = _CACHE.get(shape)
+    if fn is None:
+        fn = _CACHE[shape] = jax.jit(lambda v: v.reshape(shape))
+    return fn
+
+
+def run(v):
+    return encode(v, group=4)

@@ -1,0 +1,16 @@
+"""red: jit cache-miss churn — wrapper per call, per-call static."""
+import time
+
+import jax
+
+
+def encode(x):
+    return jax.jit(lambda v: v * 2)(x)      # fresh wrapper per call
+
+
+stamped = jax.jit(lambda v, stamp: v + stamp,
+                  static_argnames=("stamp",))
+
+
+def encode_stamped(x):
+    return stamped(x, stamp=time.time())    # never-repeating cache key

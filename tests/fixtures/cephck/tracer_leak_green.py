@@ -1,0 +1,18 @@
+"""green: traced fn returns; storage happens outside the trace."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def encode(v):
+    return jnp.matmul(v, v)
+
+
+class Coder:
+    def __init__(self):
+        self.last = None
+
+    def run(self, v):
+        out = encode(v)
+        self.last = out             # outside the jit boundary: fine
+        return out

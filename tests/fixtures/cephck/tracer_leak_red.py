@@ -1,0 +1,20 @@
+"""red: traced values stored past the trace boundary."""
+import jax
+import jax.numpy as jnp
+
+_DEBUG_TAPS = []
+
+
+class Coder:
+    @jax.jit
+    def encode(self, v):
+        out = jnp.matmul(v, v)
+        self.last = out             # leaks the tracer on self
+        return out
+
+
+@jax.jit
+def encode(v):
+    out = v * 2
+    _DEBUG_TAPS.append(out)         # leaks into module state
+    return out

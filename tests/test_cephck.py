@@ -30,6 +30,13 @@ RULE_FIXTURES = {
     "jax-timing": "jax_timing",
     "jit-static": "jit_static",
     "bare-except": "bare_except",
+    # device-contract family (cephck v2) — the host-sync and
+    # implicit-transfer rules are scoped to the EC/CRUSH hot path, so
+    # their fixtures live under ec/ (same trick as osd/txn_atomicity)
+    "host-sync-hot-path": "ec/host_sync",
+    "jit-retrace-churn": "jit_retrace",
+    "tracer-leak": "tracer_leak",
+    "implicit-transfer": "ec/implicit_transfer",
 }
 
 
@@ -115,6 +122,66 @@ def test_inline_ignore_waives_a_finding(tmp_path):
     assert not findings
 
 
+# ------------------------------------------- cross-module pass (v2)
+
+def test_host_sync_flags_callee_through_call_graph():
+    """The cross-module half: the loop itself is sync-free, but it
+    calls a helper that .item()s — flagged at the CALLSITE."""
+    findings, _ = scan(FIXTURES / "ec" / "host_sync_red.py")
+    msgs = [f.message for f in findings
+            if f.rule == "host-sync-hot-path"]
+    assert any("callee host-syncs" in m for m in msgs), msgs
+
+
+def test_host_sync_scoped_to_hot_path(tmp_path):
+    """The same source OUTSIDE ec//crush//osd-EC paths is silent —
+    the rule polices the hot path, not the whole tree."""
+    src = (FIXTURES / "ec" / "host_sync_red.py").read_text()
+    p = tmp_path / "not_hot.py"
+    p.write_text(src)
+    assert "host-sync-hot-path" not in rules_hit(p)
+
+
+def test_project_context_resolves_imported_jit(tmp_path):
+    """implicit-transfer recognizes a jit wrapper IMPORTED from
+    another scanned module — the cross-module jit registry."""
+    from ceph_tpu.analysis.engine import collect_files  # noqa: F401
+    pkg = tmp_path / "ec"
+    pkg.mkdir()
+    (pkg / "kern.py").write_text(
+        "import jax\n\n\n"
+        "@jax.jit\n"
+        "def gf_mul(a, b):\n"
+        "    return a @ b\n")
+    (pkg / "plug.py").write_text(
+        "import jax\n"
+        "import numpy as np\n\n"
+        "from ec.kern import gf_mul\n\n\n"
+        "def encode(data):\n"
+        "    table = np.zeros((8, 8), dtype=np.int8)\n"
+        "    return gf_mul(table, data)\n")
+    eng = Engine([cls() for cls in ALL_RULES], tmp_path)
+    eng.run([str(pkg)])
+    hits = [f for f in eng.findings if f.rule == "implicit-transfer"]
+    assert len(hits) == 1 and hits[0].path.endswith("plug.py"), \
+        [f.render() for f in eng.findings]
+
+
+def test_jit_retrace_flags_per_call_static():
+    findings, _ = scan(FIXTURES / "jit_retrace_red.py")
+    msgs = [f.message for f in findings
+            if f.rule == "jit-retrace-churn"]
+    assert any("per-call value" in m for m in msgs), msgs
+    assert any("compile-per-call" in m for m in msgs), msgs
+
+
+def test_tracer_leak_flags_self_and_module_state():
+    findings, _ = scan(FIXTURES / "tracer_leak_red.py")
+    msgs = [f.message for f in findings if f.rule == "tracer-leak"]
+    assert any("self.last" in m for m in msgs), msgs
+    assert any("_DEBUG_TAPS" in m for m in msgs), msgs
+
+
 # --------------------------------------------------- baseline contract
 
 def test_baseline_requires_reasons(tmp_path):
@@ -165,6 +232,36 @@ def test_tree_scans_clean():
     assert not eng.errors, eng.errors
     assert not eng.stale_suppressions(), [
         (s.rule, s.path) for s in eng.stale_suppressions()]
+
+
+def test_stale_suppression_fails_and_prune_rewrites(tmp_path):
+    """Baseline hygiene: a suppression nothing matches FAILS the run
+    (exit 1); --prune-baseline rewrites the file dropping exactly the
+    stale entries, so the blindfold can only shrink."""
+    green = FIXTURES / "bare_except_green.py"
+    b = tmp_path / "baseline.json"
+    live = {"rule": "bare-except",
+            "path": "tests/fixtures/cephck/bare_except_red.py",
+            "reason": "fixture exercise"}
+    stale = {"rule": "raw-lock",
+             "path": "tests/fixtures/cephck/bare_except_green.py",
+             "reason": "no longer true"}
+    b.write_text(json.dumps({"suppressions": [live, stale]}))
+    proc = subprocess.run(
+        [sys.executable, "-m", "ceph_tpu.analysis",
+         "--baseline", str(b), str(green)],
+        cwd=ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "stale suppression" in proc.stderr
+    proc = subprocess.run(
+        [sys.executable, "-m", "ceph_tpu.analysis",
+         "--baseline", str(b), "--prune-baseline", str(green)],
+        cwd=ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    kept = json.loads(b.read_text())["suppressions"]
+    # the stale entry went; the (unscanned, hence not-stale) live
+    # entry survives the rewrite untouched
+    assert kept == [live], kept
 
 
 def test_cli_exit_codes():

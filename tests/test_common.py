@@ -42,6 +42,7 @@ def test_config_get_set_defaults():
     # env layer: tier-1's conftest exports CEPH_TPU_LOCKDEP=1, which
     # every fresh Config legitimately reports as changed-from-default
     diff.pop("lockdep", None)
+    diff.pop("jaxguard", None)      # same env layer: CEPH_TPU_JAXGUARD=1
     assert diff == {"osd_pool_default_size": 5}
     with pytest.raises(KeyError):
         cfg.set("nonexistent_option", 1)
@@ -206,3 +207,225 @@ def test_lockdep_on_under_tier1():
     assert os.environ.get("CEPH_TPU_LOCKDEP") == "1"
     assert global_config()["lockdep"] is True
     assert isinstance(make_lock("tier1.probe"), DebugLock)
+
+
+# ----------------------------------------------------------- jaxguard
+
+def test_jaxguard_on_under_tier1():
+    """tests/conftest.py exports CEPH_TPU_JAXGUARD=1 and arms the
+    sanitizer before any ceph_tpu import, so every module-level jit
+    wrapper in the tree is compile-accounted."""
+    import os
+
+    import jax
+
+    from ceph_tpu.common import jaxguard
+    import ceph_tpu.ec.kernels.bitmatmul as bm
+
+    assert os.environ.get("CEPH_TPU_JAXGUARD") == "1"
+    assert jaxguard.enabled()
+    assert jax.jit is jaxguard._guarded_jit
+    assert type(bm.gf_matmul_xla).__name__ == "_GuardedJit"
+    assert any("bitmatmul" in k for k in jaxguard.stats())
+
+
+def test_jaxguard_recompile_trips_on_wrapper_churn():
+    """jax.jit(f)(x) per call = a fresh wrapper (empty cache) per
+    call: the second identical call recompiles an already-compiled
+    site signature and trips the default bound of 0."""
+    import jax
+    import jax.numpy as jnp
+
+    from ceph_tpu.common import jaxguard
+
+    def churn(x):
+        # deliberate churn: this test exercises the runtime
+        # sanitizer's recompile detector
+        # cephck: ignore[jit-retrace-churn] — intentional churn under test
+        return jax.jit(lambda v: v * 3)(x)
+
+    x = jnp.ones(4)
+    churn(x)                               # first compile: legal
+    with pytest.raises(jaxguard.RecompileError):
+        churn(x)                           # same sig, fresh wrapper
+
+
+def test_jaxguard_declared_bound_allows_n_recompiles():
+    import jax
+    import jax.numpy as jnp
+
+    from ceph_tpu.common import jaxguard
+
+    jaxguard.set_recompile_bound("_bounded_kernel", 2)
+    try:
+        def churn(x):
+            def _bounded_kernel(v):
+                return v * 5
+            # deliberate churn: this test exercises the runtime
+            # sanitizer's recompile detector
+            # cephck: ignore[jit-retrace-churn] — intentional churn under test
+            return jax.jit(_bounded_kernel)(x)
+
+        x = jnp.ones(3)
+        churn(x)
+        churn(x)                           # recompile 1 (<= 2)
+        churn(x)                           # recompile 2 (<= 2)
+        with pytest.raises(jaxguard.RecompileError):
+            churn(x)                       # recompile 3 (> 2)
+    finally:
+        jaxguard._bounds.pop("_bounded_kernel", None)
+
+
+def test_jaxguard_recompile_bound_is_per_signature():
+    """The declared bound meters EACH signature separately: one
+    signature's legal recompiles must not consume another's budget."""
+    import jax
+    import jax.numpy as jnp
+
+    from ceph_tpu.common import jaxguard
+
+    jaxguard.set_recompile_bound("_persig_kernel", 1)
+    try:
+        def churn(x):
+            def _persig_kernel(v):
+                return v * 7
+            # deliberate churn: this test exercises the runtime
+            # sanitizer's recompile detector
+            # cephck: ignore[jit-retrace-churn] — intentional churn under test
+            return jax.jit(_persig_kernel)(x)
+
+        a, b = jnp.ones(3), jnp.ones(5)
+        churn(a)                           # sig A: compile
+        churn(a)                           # sig A: recompile 1 (<= 1)
+        churn(b)                           # sig B: compile
+        churn(b)                           # sig B: recompile 1 (<= 1)
+        with pytest.raises(jaxguard.RecompileError):
+            churn(a)                       # sig A: recompile 2 (> 1)
+    finally:
+        jaxguard._bounds.pop("_persig_kernel", None)
+
+
+def test_jaxguard_wraps_forward_referencing_closures():
+    """A decorated function whose closure cell is not yet bound when
+    jax.jit runs (forward ref/self-recursion) must wrap cleanly — the
+    sanitizer cannot reject code pristine jax.jit accepts."""
+    import jax
+    import jax.numpy as jnp
+
+    def make():
+        @jax.jit
+        def step(x):
+            return helper(x)
+
+        def helper(x):
+            return x + 2
+
+        return step
+
+    assert make()(jnp.ones(2))[0] == 3.0
+
+
+def test_jaxguard_memoized_wrappers_with_distinct_closures_are_legal():
+    """One site building MANY wrappers is not churn when each closes
+    over a different static config (crush/batch.py's _RULE_JIT
+    pattern) — the closure salt keeps their signatures apart."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones(4)
+    for shape in [(2, 2), (4, 1), (1, 4)]:
+        def outer(v, s=shape):
+            # deliberate churn: this test exercises the runtime
+            # sanitizer's recompile detector
+            # cephck: ignore[jit-retrace-churn] — intentional churn under test
+            return jax.jit(lambda u: u.reshape(s))(v)
+        outer(x)                           # distinct closure: legal
+
+
+def test_jaxguard_keyword_form_keeps_caller_scoping():
+    """jax.jit(static_argnums=...)(f) resolves the GUARDED/foreign
+    decision at the outer call, not inside jaxguard's own deco frame:
+    repo callers get a guarded wrapper, foreign modules never do."""
+    import types
+
+    import jax
+
+    from ceph_tpu.common import jaxguard
+
+    # one-shot wrapper: this test inspects the wrapper TYPE, not churn
+    # cephck: ignore[jit-retrace-churn] — built once, never re-built
+    wrapped = jax.jit(static_argnums=(1,))(lambda v, n: v * n)
+    assert type(wrapped).__name__ == "_GuardedJit"
+
+    foreign = types.ModuleType("thirdparty_lib")
+    exec("import jax\n"
+         "def build():\n"
+         "    return jax.jit(static_argnums=(1,))(lambda v, n: v * n)\n",
+         foreign.__dict__)
+    assert jaxguard.enabled()
+    assert type(foreign.build()).__name__ != "_GuardedJit"
+
+
+def test_jaxguard_transfer_guard_arms_and_disarms():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ceph_tpu.common import jaxguard
+
+    f = jax.jit(lambda v: v + 1)
+    host = np.ones(4, np.float32)
+    with jaxguard.guard_transfers():
+        with pytest.raises(Exception):
+            f(host)                        # implicit H2D: blocked
+        dev = jnp.asarray(host)            # explicit staging: legal
+        f(dev)
+        with jaxguard.intended_transfers():
+            f(host)                        # declared intent: legal
+    f(host)                                # outside the guard: legal
+
+
+def test_jaxguard_guarded_ec_decode_dispatch_is_transfer_clean():
+    """The armed entry point end to end: a batched encode/decode pair
+    through osd/ecutil runs under the transfer guard without
+    tripping — the staging is all explicit."""
+    import numpy as np
+
+    from ceph_tpu.ec.registry import ErasureCodePluginRegistry
+    from ceph_tpu.osd import ecutil
+
+    ec = ErasureCodePluginRegistry.instance().factory(
+        "tpu", {"k": "2", "m": "1"})
+    cs = ec.get_chunk_size(2 * 64)
+    sinfo = ecutil.StripeInfo(2, 2 * cs)
+    data = bytes(range(256)) * (2 * cs * 4 // 256)
+    shards = ecutil.encode(sinfo, ec, data)
+    got = ecutil.decode(sinfo, ec, {0: shards[0], 2: shards[2]},
+                        want=[0, 1])
+    assert got[1] == shards[1]
+
+
+def test_jaxguard_zero_overhead_when_env_unset():
+    """With CEPH_TPU_JAXGUARD unset, enable_if_configured() is a
+    no-op: jax.jit is the pristine function and module-level wrappers
+    are plain pjit objects."""
+    import subprocess
+    import sys
+
+    code = (
+        "import os\n"
+        "os.environ.pop('CEPH_TPU_JAXGUARD', None)\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "import jax\n"
+        "orig = jax.jit\n"
+        "from ceph_tpu.common import jaxguard\n"
+        "assert not jaxguard.enable_if_configured()\n"
+        "assert not jaxguard.enabled()\n"
+        "assert jax.jit is orig\n"
+        "import ceph_tpu.ec.kernels.bitmatmul as bm\n"
+        "assert type(bm.gf_matmul_xla).__name__ != '_GuardedJit'\n"
+        "assert not jaxguard.stats()\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stderr

@@ -68,6 +68,68 @@ def test_mesh_validation(devices):
         MeshECCoder(5, 2, mesh)           # k=5 not divisible by 2
 
 
+def test_fabric_concurrent_stage_and_fetch(devices):
+    """Device-contract regression: the fabric serializes mesh program
+    launches.  k+m shard OSDs fetch their slices CONCURRENTLY while
+    more writes stage — without the fabric's dispatch lock, two
+    in-flight XLA programs could interleave their psum rendezvous
+    across the shared devices and deadlock (observed live as the
+    graft-entry dryrun's write op timing out)."""
+    import threading
+
+    from ceph_tpu.dist.fabric import ICIFabric
+    from ceph_tpu.ec.registry import ErasureCodePluginRegistry
+
+    k, m, cs = 8, 4, 256
+    ec = ErasureCodePluginRegistry.instance().factory(
+        "tpu", {"k": str(k), "m": str(m)})
+    fab = ICIFabric(8)
+    assert fab.supports(ec)
+    rng = np.random.default_rng(13)
+    segs = {w: rng.integers(0, 256, 2 * k * cs, dtype=np.uint8)
+            .tobytes() for w in range(3)}
+    fab.stage_encode(("w", 0), ec, segs[0], cs)
+
+    results: dict[tuple[int, int], bytes] = {}
+    errors: list[BaseException] = []
+
+    def fetch(write, shard):
+        try:
+            results[(write, shard)] = fab.fetch_chunk(("w", write),
+                                                      shard)
+        except BaseException as ex:   # noqa: BLE001 — surfaced below
+            errors.append(ex)
+
+    def stage(write):
+        try:
+            fab.stage_encode(("w", write), ec, segs[write], cs)
+            for s in range(k + m):
+                fetch(write, s)
+        except BaseException as ex:   # noqa: BLE001
+            errors.append(ex)
+
+    threads = [threading.Thread(target=fetch, args=(0, s), daemon=True)
+               for s in range(k + m)]
+    threads += [threading.Thread(target=stage, args=(w,), daemon=True)
+                for w in (1, 2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not any(t.is_alive() for t in threads), \
+        "fabric mesh dispatch deadlocked"
+    assert not errors, errors
+
+    # every fetched slice byte-identical to the host oracle
+    for w, seg in segs.items():
+        arr = np.frombuffer(seg, dtype=np.uint8).reshape(2, k, cs)
+        parity = np.asarray(ec.encode_batch(arr))
+        for s in range(k + m):
+            want = (arr[:, s, :] if s < k
+                    else parity[:, s - k, :]).tobytes()
+            assert results[(w, s)] == want, (w, s)
+
+
 def test_graft_entry_dryrun_inproc(devices):
     """The driver gate, run in-process on the virtual mesh."""
     import __graft_entry__ as g

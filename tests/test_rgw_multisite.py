@@ -457,6 +457,65 @@ def test_master_bucket_delete_propagates(ms):
                  b"second-life")
 
 
+def test_datalog_auto_trim_and_lagging_peer_blocks(cluster):
+    """Datalog auto-trim (ROADMAP multisite residual): a shard's .dl.
+    records go once EVERY registered peer's durable cursor has passed
+    them; a registered-but-lagging peer blocks the trim for exactly
+    the records it still needs."""
+    t1, t2 = cluster.rgw_multisite(zones=("t1", "t2"),
+                                   sync_interval=0.02)
+    req(t1, "PUT", "/tb")
+    for i in range(6):
+        req(t1, "PUT", f"/tb/k{i}", b"v%d" % i)
+    assert _wait(lambda: t2.sync.caught_up())
+    assert len(_dl_entries(t1, "tb")) == 6
+    # the peer answers /admin/sync-markers with its DURABLE cursors;
+    # durability trails the in-memory apply by up to one sync round
+    # (caught_up flips before that round's _persist lands), so wait
+    assert _wait(lambda: sum(
+        int(m) for m in t2.sync.markers_for("t1")
+        .get("tb", {"cursors": {}})["cursors"].values()) >= 6)
+
+    # every record is behind t2's durable cursor: the trim takes all
+    def _trim_converged():
+        t1.sync.datalog_trim_round()
+        return _dl_entries(t1, "tb") == []
+    assert _wait(_trim_converged)
+    assert t1.sync.datalog_trimmed >= 6
+
+    # make t2 lag (agent stopped, zone still registered) and write on
+    t2.sync.stop()
+    for i in range(4):
+        req(t1, "PUT", f"/tb/l{i}", b"w%d" % i)
+    assert len(_dl_entries(t1, "tb")) == 4
+    # the lagging peer's cursors sit below the new records: no trim
+    assert t1.sync.datalog_trim_round() == 0
+    assert len(_dl_entries(t1, "tb")) == 4
+    # sequences never regress across a trim: the new records continue
+    # past the trimmed range, so a resumed peer cannot re-read gaps
+    assert min(e["seq"] for e in _dl_entries(t1, "tb")) > 0
+
+    # incarnation guard: recreate the bucket while the peer (still
+    # stopped) holds the OLD incarnation's cursors — its stale high
+    # markers say nothing about the fresh datalog, so no trim
+    for key in [f"k{i}" for i in range(6)] + [f"l{i}" for i in range(4)]:
+        req(t1, "DELETE", f"/tb/{key}")
+    req(t1, "DELETE", "/tb")
+    req(t1, "PUT", "/tb")
+    req(t1, "PUT", "/tb/fresh", b"new-life")
+    entries = _dl_entries(t1, "tb")
+    fresh = len(entries)
+    assert fresh >= 1
+    stale = t2.sync.markers_for("t1")["tb"]
+    assert sum(int(m) for m in stale["cursors"].values()) >= 6
+    # the fresh datalog restarted below the stale cursors: without
+    # the incarnation check these records WOULD be trimmed
+    assert min(e["seq"] for e in entries) <= max(
+        int(m) for m in stale["cursors"].values())
+    assert t1.sync.datalog_trim_round() == 0
+    assert len(_dl_entries(t1, "tb")) == fresh
+
+
 def test_registry_tombstones_pruned_after_peers_pass(ms):
     """Bounded tombstone growth (the PR 5 residual): a bucket-delete
     tombstone is pruned from BOTH zones' registries once every peer's

@@ -193,8 +193,17 @@ def test_every_daemon_serves_obs_commands(tmp_path, fast_cfg):
         urllib.request.urlopen(urllib.request.Request(
             f"http://127.0.0.1:{gw.port}/b1", method="PUT"),
             timeout=30).read()
-        rc, hist = admin_command(socks["gw"], "dump_historic_ops")
-        assert rc == 0 and hist["num_ops"] > 0
+        # the tracker's finish() runs in the handler's `finally` AFTER
+        # the response went out, so the dump can race it — wait
+        hist = None
+        end = time.monotonic() + 10.0
+        while time.monotonic() < end:
+            rc, hist = admin_command(socks["gw"], "dump_historic_ops")
+            assert rc == 0
+            if hist["num_ops"] > 0:
+                break
+            time.sleep(0.05)
+        assert hist and hist["num_ops"] > 0
         assert any("PUT /b1" in op["description"]
                    for op in hist["ops"])
         gw.shutdown()

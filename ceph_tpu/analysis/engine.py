@@ -329,7 +329,13 @@ def main(argv: list[str] | None = None) -> int:
                     help="wire schema lockfile (default: "
                          "tests/fixtures/wire_schema.json)")
     ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="machine-readable findings on stdout")
+                    help="machine-readable findings on stdout "
+                         "(alias for --format json)")
+    ap.add_argument("--format", default=None, dest="fmt",
+                    choices=("text", "json", "github"),
+                    help="findings output: text (default), json "
+                         "(one machine-readable document), or github "
+                         "(::error workflow annotations for CI)")
     ap.add_argument("--list-rules", action="store_true",
                     help="print every rule id + one-line summary")
     ap.add_argument("--explain", metavar="RULE",
@@ -385,13 +391,33 @@ def main(argv: list[str] | None = None) -> int:
                   f"({s.rule} @ {s.path})", file=sys.stderr)
         stale = []
 
-    if args.as_json:
+    fmt = args.fmt or ("json" if args.as_json else "text")
+    if fmt == "json":
         print(json.dumps({
             "findings": [dataclasses.asdict(f) for f in eng.findings],
             "suppressed": len(eng.suppressed),
             "stale": [dataclasses.asdict(s) for s in stale],
             "errors": eng.errors,
         }, indent=1))
+    elif fmt == "github":
+        # GitHub Actions workflow commands: each finding becomes an
+        # inline annotation on the PR diff.  Newlines/percent must be
+        # URL-style escaped per the workflow-command grammar.
+        def esc(s: str) -> str:
+            return s.replace("%", "%25").replace("\r", "%0D") \
+                    .replace("\n", "%0A")
+        for f in eng.findings:
+            print(f"::error file={f.path},line={f.line},"
+                  f"title=cephck {f.rule}::{esc(f.message)}")
+        for e in eng.errors:
+            print(f"::error title=cephck parse error::{esc(e)}")
+        for s in stale:
+            print(f"::error file={s.path},title=cephck stale "
+                  f"suppression::{esc(s.rule)} no longer matches any "
+                  f"finding — remove it or run --prune-baseline")
+        print(f"cephck: {len(eng.findings)} finding(s), "
+              f"{len(eng.suppressed)} suppressed by baseline",
+              file=sys.stderr)
     else:
         for f in eng.findings:
             print(f.render())

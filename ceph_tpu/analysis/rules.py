@@ -1118,7 +1118,327 @@ for genuinely host-side math, stay in numpy end to end.
                         break
 
 
+# ===================================================================
+# The concurrency family (racecheck's static half): guarded-by
+# inference and blocking-in-dispatch, both leaning on ctx.project.
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """'x' when `node` is ``self.x`` (one level only)."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+_LOCK_FACTORIES = {"make_lock", "Lock", "RLock", "Condition"}
+
+
+def _lock_fields(cls: ast.ClassDef) -> set[str]:
+    """Attribute names assigned from make_lock()/threading locks
+    anywhere in the class body: the candidate guards."""
+    out: set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call) and \
+                dotted(node.value.func).split(".")[-1] in _LOCK_FACTORIES:
+            for t in node.targets:
+                attr = _self_attr(t)
+                if attr is not None:
+                    out.add(attr)
+    return out
+
+
+def _acquires(fn: ast.AST, lock: str) -> bool:
+    """Does `fn` take ``self.<lock>`` anywhere — ``with self.L:`` or
+    an explicit ``self.L.acquire()``?  Method-level granularity on
+    purpose: cephck flags the METHOD that touches guarded state
+    without ever taking the guard (the persist_log shape), not
+    statement-level windows."""
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                expr = item.context_expr
+                if _self_attr(expr) == lock:
+                    return True
+                if isinstance(expr, ast.Call) and \
+                        _self_attr(expr.func) == lock:
+                    return True
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ("acquire", "acquire_lock"):
+            if _self_attr(node.func.value) == lock:
+                return True
+    return False
+
+
+#: methods whose accesses never count: constructors and teardown run
+#: before publish / after quiesce (the init-before-publish phase the
+#: runtime sanitizer's EXCLUSIVE state models)
+_GB_EXEMPT_METHODS = {"__init__", "__post_init__", "__del__", "init",
+                      "start", "shutdown", "close", "stop", "__exit__"}
+
+#: minimum accessing methods / guarded fraction before the inference
+#: trusts itself: below this the "majority" is noise, not a contract
+_GB_MIN_GUARDED_METHODS = 2
+_GB_MIN_ACCESSES = 5
+_GB_MIN_FRACTION = 0.75
+
+
+class GuardedByRule:
+    id = "guarded-by"
+    doc = """
+Attribute access outside the lock that guards it everywhere else in
+the class.
+
+For each class owning a make_lock() field, the rule infers which lock
+guards each ``self._x``: if >= 75% of the accesses (outside
+__init__/shutdown) happen in methods that take ``self._lock``, that
+lock IS the attribute's guard — and the minority accesses in methods
+that never take it are exactly the persist_log bug shape (PR 2: one
+unlocked writer clobbering pgmeta under a peering merge), caught at
+parse time instead of by the unlucky interleaving.  A method reached
+ONLY from acquiring methods (a private helper called under the lock)
+counts as guarded through the project call graph.
+
+Fix: take the inferred lock around the flagged access (or hoist the
+access into a locked caller).  If the access is genuinely safe — an
+init-phase path, a hand-off the runtime sanitizer documents with
+transfer_ownership(), a read of a monotonic flag — waive it inline
+with `# cephck: ignore[guarded-by]` and a reason comment, or add a
+baseline entry with the reason.  The runtime twin of this rule is
+common/racecheck.py: annotate both the same way.
+"""
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        mod = ctx.module()
+        project = ctx.project
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            locks = _lock_fields(cls)
+            if not locks:
+                continue
+            methods = [n for n in cls.body if isinstance(
+                n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+            if not methods:
+                continue
+            # attr -> [(method, access node, is_store)]
+            accesses: dict[str, list] = {}
+            acquired: dict[str, set[str]] = {
+                L: {m.name for m in methods if _acquires(m, L)}
+                for L in locks}
+            for m in methods:
+                if m.name in _GB_EXEMPT_METHODS:
+                    continue
+                for node in ast.walk(m):
+                    attr = _self_attr(node)
+                    if attr is None or attr in locks or \
+                            not attr.startswith("_") or \
+                            attr.startswith("__"):
+                        continue
+                    accesses.setdefault(attr, []).append(
+                        (m, node, isinstance(node.ctx, ast.Store)))
+            for attr, accs in accesses.items():
+                yield from self._check_attr(ctx, mod, project, cls,
+                                            attr, accs, acquired)
+
+    def _covered(self, project, mod, cls: ast.ClassDef,
+                 guarded: set[str], method: str) -> bool:
+        """True when `method` is reached ONLY from guarded methods of
+        the same class (a locked caller's private helper).  A public
+        or caller-less method is its own entry point: not covered."""
+        if project is None or mod is None or \
+                not method.startswith("_"):
+            return False
+        project.finalize()
+        me = (mod.name, f"{cls.name}.{method}")
+        callers = project.callers.get(me)
+        if not callers:
+            return False
+        seen = {method}
+        work = list(callers)
+        while work:
+            src_mod, src_qual = work.pop()
+            if src_mod != mod.name or \
+                    not src_qual.startswith(f"{cls.name}."):
+                return False            # reached from outside the class
+            name = src_qual.split(".", 1)[1]
+            if name in guarded or name in seen:
+                continue
+            if not name.startswith("_"):
+                return False
+            seen.add(name)
+            nxt = project.callers.get((src_mod, src_qual))
+            if not nxt:
+                return False
+            work.extend(nxt)
+        return True
+
+    def _check_attr(self, ctx, mod, project, cls, attr, accs,
+                    acquired) -> Iterator[Finding]:
+        if len(accs) < _GB_MIN_ACCESSES:
+            return
+        best = None
+        for lock, fns in acquired.items():
+            under = sum(1 for m, _n, _w in accs if m.name in fns)
+            if best is None or under > best[1]:
+                best = (lock, under, fns)
+        lock, under, fns = best
+        if under < len(accs) * _GB_MIN_FRACTION or under == len(accs):
+            return
+        if len({m.name for m, _n, _w in accs
+                if m.name in fns}) < _GB_MIN_GUARDED_METHODS:
+            return
+        flagged: set[int] = set()
+        for m, node, is_store in accs:
+            if m.name in fns or node.lineno in flagged:
+                continue
+            if self._covered(project, mod, cls, fns, m.name):
+                continue
+            flagged.add(node.lineno)
+            kind = "write to" if is_store else "read of"
+            yield ctx.finding(
+                self.id, node,
+                f"{kind} self.{attr} in {cls.name}.{m.name}() without "
+                f"self.{lock} — {under}/{len(accs)} accesses take "
+                f"that lock, so it is the inferred guard "
+                f"(persist_log bug class: one unlocked accessor "
+                f"corrupts state every locked site protects)",
+                symbol=f"{cls.name}.{m.name}")
+
+
+# -------------------------------------------------- blocking-in-dispatch
+
+#: function names that ARE a message-dispatch context: the messenger
+#: dispatch/reader threads call these per message, so anything that
+#: blocks inside stalls every peer behind the queue.  The top-of-loop
+#: waits (_dispatch_loop's queue.get, _read_loop's recv) are the
+#: wait-for-work by design and are NOT entries.
+_DISPATCH_ENTRIES = {"ms_dispatch", "_deliver", "_deliver_verified"}
+
+#: canonical call names that block the calling thread outright
+_BLOCKING_CANON = {"time.sleep", "socket.create_connection",
+                   "select.select"}
+
+#: attribute-call patterns that block: last segment -> receiver test
+_THREADISH = re.compile(r"(thread|worker|proc)", re.I)
+_QUEUEISH = re.compile(r"(queue|_q)$|^q$", re.I)
+_SOCKISH = re.compile(r"(sock|conn|listener)$|^s$", re.I)
+
+
+def _blocking_call(node: ast.Call, mod: ModuleInfo | None) -> str | None:
+    """Human-readable description when `node` blocks its thread."""
+    name = dotted(node.func)
+    if not name:
+        return None
+    canon = mod.expand(name) if mod else name
+    if canon in _BLOCKING_CANON:
+        return canon
+    last = name.split(".")[-1]
+    recv = name.rsplit(".", 2)[-2] if "." in name else ""
+    if last == "sleep" and (canon.startswith("time.") or recv == "time"):
+        return f"{name}()"
+    if last == "join" and _THREADISH.search(recv):
+        return f"{name}() (thread join)"
+    if last in ("wait", "wait_for"):
+        # Event/Condition wait — any receiver: there is no non-blocking
+        # spelling of .wait()
+        return f"{name}() (condition/event wait)"
+    if last == "get" and _QUEUEISH.search(recv) and not node.args \
+            and not any(kw.arg == "block" for kw in node.keywords):
+        # a positional arg IS `block` (q.get(False)), and an explicit
+        # block= keyword means the caller chose — only the bare
+        # blocking default is flagged
+        return f"{name}() (blocking queue get)"
+    if last in ("recv", "recv_into", "accept") and \
+            _SOCKISH.search(recv or "x"):
+        return f"{name}() (socket wait)"
+    if last == "block_until_ready":
+        return f"{name}() (device sync)"
+    if last in ("recv_frame", "_recv_exact"):
+        return f"{name}() (socket wait)"
+    return None
+
+
+class BlockingInDispatchRule:
+    id = "blocking-in-dispatch"
+    doc = """
+Blocking call reachable from a messenger dispatch entry point
+(ms_dispatch / the deliver path in ceph_tpu/msg/).
+
+The dispatch thread is shared: every message from every peer funnels
+through it.  A handler that sleeps, joins a thread, waits on a
+condition, or blocks in a socket/queue/device wait stalls the WHOLE
+daemon's inbound traffic for the duration — and when the thing it
+waits for needs another message on the same thread to make progress,
+it deadlocks outright (the ICIFabric concurrent mesh-launch hang:
+dispatch blocked in block_until_ready while the reply it needed sat
+behind it in the queue).  The check is cross-module: the project call
+graph is walked from each dispatch entry (depth-bounded), so a
+handler that calls a helper that sleeps two modules away is flagged
+at the handler.
+
+Fix: move the blocking work off the dispatch thread (queue it to a
+worker, complete it from the tick), or make the wait event-driven.
+For a BOUNDED wait that is the design (e.g. a capped handshake wait
+with a timeout argument), waive the site inline with
+`# cephck: ignore[blocking-in-dispatch]` and a reason comment, or
+add a baseline entry with the reason.
+"""
+    MAX_DEPTH = 4
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        mod = ctx.module()
+        if mod is None:
+            return
+        project = ctx.project
+        for qual, fn in mod.functions.items():
+            short = qual.split(".")[-1]
+            if short not in _DISPATCH_ENTRIES:
+                continue
+            # local blocking calls: flagged at the call itself
+            reported: set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    b = _blocking_call(node, mod)
+                    if b is not None and b not in reported:
+                        reported.add(b)
+                        yield ctx.finding(
+                            self.id, node,
+                            f"{b} inside dispatch entry {qual}() — "
+                            f"the dispatch thread serves every peer; "
+                            f"a blocked handler stalls the daemon's "
+                            f"whole inbound queue", symbol=qual)
+            if project is None:
+                continue
+            # cross-module: anything reachable from the entry that
+            # contains a blocking call, flagged at the entry
+            for tmod_name, tqual in project.reachable(
+                    mod, qual, max_depth=self.MAX_DEPTH):
+                tmod = project.modules.get(tmod_name)
+                tfn = tmod.functions.get(tqual) if tmod else None
+                if tfn is None:
+                    continue
+                for node in ast.walk(tfn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    b = _blocking_call(node, tmod)
+                    key = f"{tmod_name}.{tqual}:{b}"
+                    if b is not None and key not in reported:
+                        reported.add(key)
+                        yield ctx.finding(
+                            self.id, fn,
+                            f"dispatch entry {qual}() reaches "
+                            f"{tqual}() ({tmod_name}) which blocks "
+                            f"in {b} — the dispatch thread serves "
+                            f"every peer; a blocked handler stalls "
+                            f"the daemon's whole inbound queue",
+                            symbol=qual)
+
+
 ALL_RULES = [RawLockRule, WireSchemaRule, UnregisteredMessageRule,
              TxnAtomicityRule, SilentThreadRule, JaxTimingRule,
              JitStaticRule, BareExceptRule, HostSyncHotPathRule,
-             JitRetraceChurnRule, TracerLeakRule, ImplicitTransferRule]
+             JitRetraceChurnRule, TracerLeakRule, ImplicitTransferRule,
+             GuardedByRule, BlockingInDispatchRule]

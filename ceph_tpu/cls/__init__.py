@@ -15,7 +15,7 @@ reference folds cls writes into the op's ObjectStore transaction.
 
 Built-in classes mirror the reference's most-used plugins:
 `lock` (src/cls/lock), `refcount` (src/cls/refcount),
-`version` (src/cls/version).
+`version` (src/cls/version), `log` (src/cls/log).
 
 Exec is limited to replicated pools (the data reads a method may issue
 are synchronous primary-local reads; EC pools would need a
@@ -137,7 +137,7 @@ class ClassHandler:
     """Singleton method registry (ref: src/osd/ClassHandler.cc —
     open_class/dlopen replaced by lazy import of built-in modules)."""
 
-    _BUILTIN = ("lock", "refcount", "version", "rgw", "queue")
+    _BUILTIN = ("lock", "refcount", "version", "rgw", "queue", "log")
 
     def __init__(self):
         self._methods: dict[tuple[str, str], tuple[int, Callable]] = {}

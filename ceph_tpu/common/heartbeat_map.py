@@ -40,10 +40,17 @@ class HeartbeatMap:
         self._workers: list[HeartbeatHandle] = []
 
     def add_worker(self, name: str, grace: float,
-                   suicide_grace: float = 0.0) -> HeartbeatHandle:
+                   suicide_grace: float = 0.0,
+                   arm: bool = True) -> HeartbeatHandle:
+        """``arm=False`` registers the worker UNARMED: the deadline
+        only starts at its first reset_timeout, so a daemon
+        constructed but never driven (a harness-built mon that never
+        ticks) is not unhealthy — only a loop that beat once and then
+        stopped is."""
         h = HeartbeatHandle(name=name, grace=grace,
                             suicide_grace=suicide_grace)
-        self.reset_timeout(h)
+        if arm:
+            self.reset_timeout(h)
         with self._lock:
             self._workers.append(h)
         return h
@@ -70,6 +77,19 @@ class HeartbeatMap:
 
     def is_healthy(self) -> bool:
         return not self.get_unhealthy_workers()
+
+    def health_check(self) -> dict:
+        """HEARTBEAT_STALE health-check slice ({} when healthy) —
+        ONE rendering shared by every daemon that surfaces its hbmap
+        through the health path (mon checks, mgr module report)."""
+        stale = self.get_unhealthy_workers()
+        if not stale:
+            return {}
+        return {"HEARTBEAT_STALE": {
+            "severity": "HEALTH_WARN",
+            "summary": f"{len(stale)} worker thread(s) missed their "
+                       f"heartbeat grace",
+            "detail": [f"{w} had timed out" for w in stale]}}
 
     def get_unhealthy_workers(self) -> list[str]:
         """(ref: HeartbeatMap.cc check / is_healthy)."""

@@ -39,6 +39,17 @@ def _held() -> list:
     return stack
 
 
+def held_lock_names() -> tuple[str, ...]:
+    """Names of the DebugLocks the CURRENT thread holds, innermost
+    last.  This is the lockset feed for the racecheck sanitizer
+    (common/racecheck.py): lockdep already tracks every instrumented
+    acquisition per thread, so the Eraser-style candidate-lockset
+    intersection reuses that bookkeeping instead of double-counting.
+    Plain-RLock locks (lockdep off) are invisible — racecheck
+    therefore requires the `lockdep` option to be armed too."""
+    return tuple(n for n, _c in _held())
+
+
 def _reaches(src: str, dst: str) -> bool:
     """DFS over the follows-graph (callers hold _graph_lock)."""
     seen = set()

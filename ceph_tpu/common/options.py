@@ -277,6 +277,16 @@ OPTIONS: dict[str, Option] = {opt.name: opt for opt in [
        desc="lock-order cycle detection on instrumented locks; read "
             "at lock construction, so set it before daemons start "
             "(ref: src/common/lockdep.cc)"),
+    _o("racecheck", T.BOOL, False, L.DEV,
+       desc="Eraser-style lockset data-race sanitizer on classes "
+            "marked shared_state()/RaceTracked: attribute accesses "
+            "intersect per-(object, attr) candidate locksets against "
+            "the thread's held DebugLocks and raise RaceError when "
+            "the intersection empties; requires `lockdep` (the held "
+            "set comes from it) and is read when "
+            "racecheck.enable_if_configured() runs "
+            "(see common/racecheck.py)",
+       see_also=("lockdep",)),
     _o("jaxguard", T.BOOL, False, L.DEV,
        desc="device-contract sanitizer: count jit compilations per "
             "callsite (fail on same-signature recompiles) and arm "

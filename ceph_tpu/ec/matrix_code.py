@@ -24,11 +24,17 @@ from typing import Callable, Iterable, Mapping
 import numpy as np
 
 from . import gf
+from ..common.racecheck import shared_state
 from .interface import ErasureCode, ErasureCodeError
 
 MatmulFn = Callable[[np.ndarray, np.ndarray], np.ndarray]
 
 
+# one plugin instance serves every PG of a profile, so concurrent
+# decodes hit the LRU from many threads: the racecheck sanitizer
+# checks that every access really goes through self._lock (`_lru` is
+# mutating — an LRU get() reorders the dict, so reads count as writes)
+@shared_state(only=("_lru", "_cost"), mutating=("_lru", "_cost"))
 class DecodeTableCache:
     """Cost-weighted LRU of decode tables keyed by erasure signature
     (ref: ErasureCodeIsaTableCache.cc, decoding_tables_lru_length).

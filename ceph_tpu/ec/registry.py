@@ -61,7 +61,10 @@ class ErasureCodePluginRegistry:
             self._plugins[name] = plugin
 
     def get(self, name: str) -> ErasureCodePlugin | None:
-        return self._plugins.get(name)
+        # under the lock like add/load: a bare dict read racing load's
+        # insert is exactly the guarded-by/racecheck bug class
+        with self._lock:
+            return self._plugins.get(name)
 
     def load(self, name: str) -> ErasureCodePlugin:
         """Analogue of dlopen + __erasure_code_init

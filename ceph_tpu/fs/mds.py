@@ -268,6 +268,14 @@ class MDSDaemon(Dispatcher):
         self._subtree_cache_at = 0.0
         self._pending_revokes: list[tuple[str, MClientCaps]] = []
         self._revoking: dict[tuple[int, str], float] = {}
+        # internal thread-liveness watchdog (ref: MDSRank's hbmap
+        # reset in dispatch): the dispatch worker arms on the first
+        # client request and a wedged dispatch surfaces via asok
+        # status instead of silent beacon loss
+        from ..common.heartbeat_map import HeartbeatMap
+        self.hbmap = HeartbeatMap()
+        self._hb_handle = self.hbmap.add_worker(
+            f"mds.{rank}.dispatch", grace=60.0, arm=False)
         # MDS-to-MDS slave calls (cross-rank rename): tid -> (event,
         # reply slot); replies ride MClientReply like client traffic
         self._peer_tids = itertools.count(1)
@@ -335,7 +343,9 @@ class MDSDaemon(Dispatcher):
         a.register("status", "daemon status",
                    lambda c: (0, {"whoami": self.rank,
                                   "state": self._mds_state,
-                                  "gid": self.gid}))
+                                  "gid": self.gid,
+                                  "hbmap_unhealthy":
+                                      self.hbmap.get_unhealthy_workers()}))
         a.start()
         self.asok = a
 
@@ -919,6 +929,9 @@ class MDSDaemon(Dispatcher):
                 if snapc is None:
                     snapc = self._snapc_for_chain(prefix + chain)
                 for client in self._opens[d["ino"]]:
+                    # every _op_* runs under handle_op's self._lock;
+                    # the getattr dispatch in _route hides that from
+                    # the call graph: cephck: ignore[guarded-by]
                     self._pending_revokes.append((client, MClientCaps(
                         op="snapc", ino=d["ino"], snapc=snapc)))
         return {"id": snapid, "name": name}
@@ -1010,6 +1023,9 @@ class MDSDaemon(Dispatcher):
                 self._opens.get(ino, {}).pop(c, None)
                 self._revoking.pop(key, None)
                 continue
+            # callers (handle_op's _op_* dispatch, the tick's session
+            # reaper) all hold self._lock; the getattr dispatch hides
+            # that from the call graph: cephck: ignore[guarded-by]
             self._pending_revokes.append((c, MClientCaps(
                 op="revoke", ino=ino,
                 caps=self._caps.get(ino, {}).get(c, 0))))
@@ -1228,6 +1244,7 @@ class MDSDaemon(Dispatcher):
         subtree table, so explicit pins stay the operator's override
         and are never auto-migrated."""
         from ..common.options import global_config
+        self.hbmap.reset_timeout(self._hb_handle)
         now = time.monotonic() if now is None else now
         cfg = global_config()
         interval = cfg["mds_bal_interval"]
@@ -1854,6 +1871,10 @@ class MDSDaemon(Dispatcher):
 
     # --------------------------------------------------------- dispatch
     def ms_dispatch(self, msg: Message) -> bool:
+        # the liveness worker beats on every message AND every tick
+        # (ref: MDSRank heartbeat_reset in _dispatch): a daemon is
+        # unhealthy only when both loops stopped past the grace
+        self.hbmap.reset_timeout(self._hb_handle)
         if isinstance(msg, MFSMap):
             self._handle_fsmap(msg)
             return True

@@ -18,6 +18,7 @@ import threading
 from ..common.lockdep import make_lock
 
 from ..common.log import dout
+from ..common.racecheck import shared_state
 from ..common.options import global_config
 from ..msg.messages import (MMap, MMgrCommand, MMgrCommandReply,
                             MMonCommand, MMonCommandAck,
@@ -28,6 +29,11 @@ from ..osd.balancer import Balancer
 from ..osd.osdmap import OSDMap
 
 
+# module state shared between the dispatch thread (command replies,
+# map ingest) and the observability/balancer tick — racecheck asserts
+# every access holds self._lock
+@shared_state(only=("_health_reports", "_pending", "_sync_cmds"),
+              mutating=("_health_reports", "_pending", "_sync_cmds"))
 class MgrDaemon(Dispatcher, MonHunter):
     def __init__(self, network: LocalNetwork, rank: int = 0,
                  mon="mon.0", threaded: bool = False,
@@ -75,6 +81,14 @@ class MgrDaemon(Dispatcher, MonHunter):
         self.op_tracker = OpTracker(
             history_size=global_config()["osd_op_history_size"])
         self.tracer = Tracer(self.name)
+        # internal thread-liveness watchdog (the OSD's hbmap, here for
+        # the mgr's observability loop): arms on the first
+        # observability_tick; a stalled loop surfaces through the
+        # module-health path as HEARTBEAT_STALE and in `status`
+        from ..common.heartbeat_map import HeartbeatMap
+        self.hbmap = HeartbeatMap()
+        self._hb_handle = self.hbmap.add_worker(
+            f"{self.name}.observability", grace=60.0, arm=False)
         self.asok = None
         self.ms = Messenger.create(network, self.name, threaded=threaded)
         self.ms.add_dispatcher(self)
@@ -289,6 +303,7 @@ class MgrDaemon(Dispatcher, MonHunter):
         health), insights (history rings), and telemetry (report
         compile) — the serve-loop slice the reference modules run in
         their own threads."""
+        self.hbmap.reset_timeout(self._hb_handle)
         self._register_mgr()
         if self.crash is not None:
             self.crash.tick(now)
@@ -296,6 +311,10 @@ class MgrDaemon(Dispatcher, MonHunter):
             self.insights.tick(now)
         if self.telemetry is not None:
             self.telemetry.tick(now)
+        # liveness slice: unhealthy workers ride the same volatile
+        # module-health report every other mgr module uses (cleared
+        # the moment the worker beats again)
+        self.set_health_checks("hbmap", self.hbmap.health_check())
 
     def start_prometheus(self, port: int = 0):
         """Serve /metrics (ref: pybind/mgr/prometheus).  Exports
@@ -365,5 +384,7 @@ class MgrDaemon(Dispatcher, MonHunter):
                     "mode": "upmap",
                     "epoch": self.osdmap.epoch,
                     "last_optimize": dict(self.last_optimize),
+                    "hbmap_unhealthy":
+                        self.hbmap.get_unhealthy_workers(),
                     "score": {k: score.get(k)
                               for k in ("stddev", "max_deviation")}}

@@ -26,6 +26,7 @@ import itertools
 import threading
 
 from ..common.lockdep import make_lock
+from ..common.racecheck import shared_state
 import time
 from collections import deque
 
@@ -78,6 +79,11 @@ def build_initial(n_osd: int, osds_per_host: int = 1
     return m, w
 
 
+# health tables shared between the dispatch thread (beacons, mgr
+# health reports, failure reports) and the tick thread (auto-out,
+# lease churn) — racecheck asserts both sides hold self._lock
+@shared_state(only=("_down_stamp", "_module_health", "_mds_slow"),
+              mutating=("_down_stamp", "_module_health", "_mds_slow"))
 class Monitor(Dispatcher):
     """mon.<rank> (ref: src/mon/Monitor.h:201)."""
 
@@ -149,6 +155,15 @@ class Monitor(Dispatcher):
         #: count, oldest_age} (volatile like _beacon; cleared when a
         #: beacon reports count 0)
         self._mds_slow: dict[str, dict] = {}
+        # internal thread-liveness watchdog (ref: the ceph-mon's
+        # HeartbeatMap wired through Monitor::tick): the tick worker
+        # arms on its FIRST tick (a constructed-but-never-ticked mon
+        # in a harness is not unhealthy) and a stalled tick loop
+        # surfaces as the HEARTBEAT_STALE health check + in `status`
+        from ..common.heartbeat_map import HeartbeatMap
+        self.hbmap = HeartbeatMap()
+        self._hb_handle = self.hbmap.add_worker(
+            f"{self.name}.tick", grace=60.0, arm=False)
         self._lock = make_lock(f"mon.{rank}")
         # ---- quorum state ------------------------------------------
         self.mon_ranks = sorted(mon_ranks) if mon_ranks else [rank]
@@ -683,6 +698,10 @@ class Monitor(Dispatcher):
                 (grace <= 0 or
                  now - self._module_health_stamp <= grace):
             checks.update(self._module_health)
+        # own thread-liveness watchdog (ref: "heartbeat_map is_healthy
+        # ... had timed out"): a mon tick loop that stopped beating
+        # past its grace is a health warning, not a silent wedge
+        checks.update(self.hbmap.health_check())
         if prefix in ("health", "health detail"):
             out = {"status": health_status(checks),
                    "checks": {k: {"severity": v["severity"],
@@ -1098,6 +1117,7 @@ class Monitor(Dispatcher):
             raise
 
     def _tick(self, now: float | None = None) -> None:
+        self.hbmap.reset_timeout(self._hb_handle)
         with self._lock:
             now = self.clock() if now is None else now
             if not self.standalone:

@@ -16,11 +16,19 @@ between begin and commit without touching the services.
 from __future__ import annotations
 
 from ..common.log import dout
+from ..common.racecheck import shared_state
 from .store import MonitorStore, StoreTransaction
 
 PAXOS_PREFIX = "paxos"
 
 
+# Paxos has no lock of its own: every entry runs under the owning
+# Monitor's lock (dispatch, tick, asok all take it).  The sanitizer
+# checks that contract — a bare-threaded caller mutating the commit
+# pipeline is exactly the fork bug class PR 1 shipped.
+@shared_state(only=("first_committed", "last_committed",
+                    "_inflight", "_pending"),
+              mutating=("_pending",))
 class Paxos:
     """Commit log with optional quorum replication
     (ref: src/mon/Paxos.h:174).

@@ -22,6 +22,7 @@ from typing import Callable, Optional
 
 from ..common.log import dout
 from ..common.options import global_config
+from ..common.racecheck import shared_state
 
 EntityName = str      # "osd.3", "mon.0", "client.4121"
 
@@ -211,6 +212,7 @@ class Messenger:
             d.ms_handle_reset(peer)
 
 
+@shared_state(only=("_endpoints",), mutating=("_endpoints",))
 class LocalNetwork:
     """In-process "wire": entity registry + routing + fault injection.
 

@@ -26,6 +26,7 @@ import threading
 from ..common.lockdep import make_lock
 
 from ..common.log import dout
+from ..common.racecheck import shared_state
 from .encoding import WireError, decode_message, encode_message
 from .messenger import Connection, Dispatcher, Message
 
@@ -80,6 +81,11 @@ class TcpNet:
         self.compress_min = compress_min
 
 
+# the connection maps are shared between the send path (any caller
+# thread), the accept loop, and every per-socket reader thread —
+# racecheck asserts each access holds self._lock
+@shared_state(only=("_out", "_learned", "_accepted", "_sessions"),
+              mutating=("_out", "_learned", "_accepted", "_sessions"))
 class TcpMessenger:
     """One endpoint bound to addr_map[name]
     (ref: Messenger::bind + AsyncMessenger accept loop)."""
@@ -349,10 +355,12 @@ class TcpMessenger:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             with self._lock:
                 self._accepted.append(conn)
-            if self._secure_secret is not None:
-                from .secure import SecureConn
-                self._sessions[conn] = SecureConn(self._secure_secret,
-                                                  initiator=False)
+                if self._secure_secret is not None:
+                    # inside the lock: the send path reads _sessions
+                    # under it concurrently (racecheck-audited)
+                    from .secure import SecureConn
+                    self._sessions[conn] = SecureConn(
+                        self._secure_secret, initiator=False)
             self._spawn_reader(conn, learn=True)
 
     def _spawn_reader(self, conn: socket.socket,
@@ -367,7 +375,8 @@ class TcpMessenger:
 
     def _read_loop(self, conn: socket.socket, learn: bool) -> None:
         peer = None
-        sc = self._sessions.get(conn)
+        with self._lock:
+            sc = self._sessions.get(conn)
         try:
             while self._running:
                 frame = recv_frame(conn)
@@ -436,8 +445,8 @@ class TcpMessenger:
                 conn.close()
             except OSError:
                 pass
-            self._sessions.pop(conn, None)
             with self._lock:
+                self._sessions.pop(conn, None)
                 # prune dead accepted sockets: a long-lived endpoint
                 # (a mon taking beacons across thrash rounds) must
                 # not accumulate one entry per past connection

@@ -20,6 +20,7 @@ from typing import Optional
 from ..common.heartbeat_map import HeartbeatMap
 from ..common.log import dout
 from ..common.options import global_config
+from ..common.racecheck import shared_state
 from ..ec import registry as ec_registry
 from ..msg.messages import (BackfillReserve, ECSubRead, ECSubReadReply,
                             ECSubWrite, ECSubWriteReply, MConfig, MMap,
@@ -129,6 +130,12 @@ class _ScrubState:
         self.unrepairable: list[str] = []
 
 
+# the PG table and in-flight notify map are shared between the
+# dispatch thread, the tick thread, watch-notify timers, and asok
+# readers — racecheck asserts every post-publish access holds
+# self._lock (both maps mutate through reads, so reads count)
+@shared_state(only=("pgs", "_notifies"),
+              mutating=("pgs", "_notifies"))
 class OSDDaemon(Dispatcher, MonHunter):
     """osd.<id> (ref: src/osd/OSD.h:1036)."""
 
@@ -336,7 +343,9 @@ class OSDDaemon(Dispatcher, MonHunter):
                 return 0, {"whoami": self.whoami,
                            "osdmap_epoch": self.osdmap.epoch,
                            "num_pgs": len(self.pgs),
-                           "pgs_recovering": self.pgs_recovering()}
+                           "pgs_recovering": self.pgs_recovering(),
+                           "hbmap_unhealthy":
+                               self.hbmap.get_unhealthy_workers()}
         a.register("status", "daemon status", _status)
         a.start()
         self.asok = a
@@ -357,6 +366,17 @@ class OSDDaemon(Dispatcher, MonHunter):
 
     # ------------------------------------------------------- dispatch
     def ms_dispatch(self, msg: Message) -> bool:
+        # the whole dispatch runs under the daemon lock (the Monitor
+        # does the same): the TCP backend delivers each connection on
+        # its own reader thread, and the tick/timer/asok threads walk
+        # self.pgs and self._notifies under this lock — racecheck
+        # caught the unlocked handler paths mutating both (the
+        # map-ingest rebuild racing a tick iteration).  The lock is
+        # reentrant, so handlers that take it internally are fine.
+        with self._lock:
+            return self._dispatch(msg)
+
+    def _dispatch(self, msg: Message) -> bool:
         if isinstance(msg, MMap):
             self._handle_map(msg)
             return True
@@ -1067,7 +1087,13 @@ class OSDDaemon(Dispatcher, MonHunter):
             if item is None:
                 break
             try:
-                item()
+                # queued recovery/scrub work touches PG state like a
+                # dispatch handler does — and runs on the tick thread
+                # or a pacing Timer thread, so it takes the same
+                # daemon lock (racecheck caught a Timer-thread push
+                # racing the dispatch thread's PG rebuild)
+                with self._lock:
+                    item()
             except Exception:
                 import traceback
                 dout("osd", 0).write("%s: queued op failed: %s",
@@ -1208,8 +1234,11 @@ class OSDDaemon(Dispatcher, MonHunter):
                           {s: st.acting[s] for s in targets})
 
     def pgs_recovering(self) -> int:
-        return sum(1 for st in self.pgs.values()
-                   if st.recovering or st.backfilling)
+        # self-locking: called bare by harnesses/tests while the
+        # dispatch thread rebuilds self.pgs (racecheck-audited)
+        with self._lock:
+            return sum(1 for st in self.pgs.values()
+                       if st.recovering or st.backfilling)
 
     # ------------------------------------------- peering statechart glue
     def _replica_merge_log(self, msg: PGLogPush) -> None:

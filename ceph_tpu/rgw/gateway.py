@@ -411,7 +411,12 @@ class RGWGateway:
         register_obs_commands(a, self.op_tracker, self.tracer)
         a.register("status", "gateway status",
                    lambda c: (0, {"zone": self.zone, "pool": self.pool,
-                                  "port": self.port}))
+                                  "port": self.port,
+                                  "hbmap_unhealthy":
+                                      (self.sync.hbmap
+                                       .get_unhealthy_workers()
+                                       if getattr(self, "sync", None)
+                                       is not None else [])}))
         a.start()
         self.asok = a
 

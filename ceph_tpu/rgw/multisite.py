@@ -50,6 +50,7 @@ from urllib.parse import quote
 from ..client import RadosError
 from ..common.lockdep import make_lock
 from ..common.log import dout
+from ..common.racecheck import shared_state
 from .datalog import DataLog, shard_of_key
 
 #: omap object holding the period (current + staging) in the rgw pool
@@ -289,9 +290,18 @@ def sync_apply_hists() -> dict[str, dict]:
     return out
 
 
+@shared_state(only=("_markers", "_durable", "_heads", "_errors",
+                    "_gens"),
+              mutating=("_markers", "_durable", "_heads", "_errors",
+                        "_gens"))
 class SyncAgent:
     """Per-zone replication worker: one thread, pull-based, durable
-    cursors (ref: RGWDataSyncProcessorThread + RGWRemoteDataLog)."""
+    cursors (ref: RGWDataSyncProcessorThread + RGWRemoteDataLog).
+
+    The cursor/quarantine maps are shared between the agent thread
+    and status/trim readers (sync_status, the gateway's asok scrape),
+    so they are racecheck-instrumented: every access must hold
+    self._lock."""
 
     #: datalog entries pulled per shard per round — small on purpose:
     #: the cursor persists per batch, so batch size bounds the replay
@@ -314,7 +324,10 @@ class SyncAgent:
         self.datalog = DataLog(self.io)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
-        self._lock = make_lock("rgw.sync")
+        # per-instance name: racecheck locksets (and lockdep edges)
+        # key by lock NAME, so two zones' agents sharing "rgw.sync"
+        # would alias each other's guard
+        self._lock = make_lock(f"rgw.sync.{gw.zone}")
         #: (source, bucket, shard) -> applied-up-to sequence
         self._markers: dict[tuple[str, str, int], int] = {}
         #: (source, bucket, shard) -> marker KNOWN PERSISTED in RADOS;
@@ -347,6 +360,15 @@ class SyncAgent:
         from ..common.perf_counters import PerfCounters
         self.perf = PerfCounters(f"rgw.sync.{self.zone}")
         self.perf.add_latency_histogram("op_lat_sync")
+        # internal thread-liveness watchdog: the sync round registers
+        # as a worker (arms on the first round) so a wedged agent —
+        # stuck HTTP pull, a quarantine loop gone hot — surfaces
+        # through sync status / the gateway asok instead of silently
+        # stalling replication
+        from ..common.heartbeat_map import HeartbeatMap
+        self.hbmap = HeartbeatMap()
+        self._hb_handle = self.hbmap.add_worker(
+            f"rgw.sync.{self.zone}.round", grace=60.0, arm=False)
         _AGENTS.add(self)
 
     # -- lifecycle ----------------------------------------------------
@@ -376,6 +398,7 @@ class SyncAgent:
     # -- the round ----------------------------------------------------
     def tick(self) -> int:
         """One pass over every peer; returns entries applied."""
+        self.hbmap.reset_timeout(self._hb_handle)
         self.gw.multisite.refresh()
         applied = 0
         now = time.monotonic()
@@ -455,7 +478,10 @@ class SyncAgent:
                     self._forget_bucket(src, bucket)
                 continue
             gen = meta.get("created", "")
-            known = self._gens.get((src, bucket))
+            # under the lock: _load_state/markers_for touch _gens
+            # from the gateway's admin threads (racecheck-audited)
+            with self._lock:
+                known = self._gens.get((src, bucket))
             if known is not None and known != gen:
                 # recreated under the same name while we held cursors
                 # for the old incarnation: the fresh datalog restarts
@@ -465,13 +491,17 @@ class SyncAgent:
                 # bucket), so it is discarded before the full sync
                 self._forget_bucket(src, bucket)
                 self.gw.sync_reset_bucket(bucket, meta, registry=local)
-            self._gens[(src, bucket)] = gen
+            with self._lock:
+                self._gens[(src, bucket)] = gen
             self.gw.sync_ensure_bucket(
                 bucket, meta, from_master=peer.get("master", False),
                 registry=local)
             nshards = int(meta.get("shards", 1))
-            have = [s for s in range(nshards)
-                    if (src, bucket, s) in self._markers]
+            # under the lock: sync_status() reads _markers from other
+            # threads while this round mutates it
+            with self._lock:
+                have = [s for s in range(nshards)
+                        if (src, bucket, s) in self._markers]
             try:
                 if len(have) < nshards:
                     pending_full += 1
@@ -571,8 +601,11 @@ class SyncAgent:
     # -- incremental sync (datalog cursors) ---------------------------
     def _incremental(self, src: str, endpoint: str, bucket: str,
                      nshards: int) -> int:
-        markers = {s: self._markers.get((src, bucket, s), 0)
-                   for s in range(nshards)}
+        # under the lock: status/persist readers walk _markers from
+        # the gateway's threads concurrently (racecheck-audited)
+        with self._lock:
+            markers = {s: self._markers.get((src, bucket, s), 0)
+                       for s in range(nshards)}
         out = self._log_list(endpoint, bucket, markers, self.BATCH)
         ln = self.gw._nshards(bucket)
         applied = 0
@@ -583,7 +616,8 @@ class SyncAgent:
                 self._heads[(src, bucket, s)] = shard.get("head", 0)
             # retry the shard's error list first: a poisoned entry
             # gets another chance every round, never thread death
-            errs = self._errors.get((src, bucket, s), [])
+            with self._lock:
+                errs = self._errors.get((src, bucket, s), [])
             still = []
             for rec in errs:
                 if self._stop.is_set():
@@ -966,6 +1000,7 @@ class SyncAgent:
                 "caught_up": (state == "incremental" and behind == 0
                               and nerr == 0)})
         return {"zone": self.zone, "period_epoch": self.gw.multisite.epoch,
+                "hbmap_unhealthy": self.hbmap.get_unhealthy_workers(),
                 "entries_applied": self.entries_applied,
                 "entries_skipped": self.entries_skipped,
                 "full_syncs": self.full_syncs,

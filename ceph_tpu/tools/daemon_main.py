@@ -208,6 +208,10 @@ def main(argv=None) -> int:
     # arm BEFORE daemon imports build any jit wrapper
     from ..common import jaxguard
     jaxguard.enable_if_configured()
+    # ... and CEPH_TPU_RACECHECK the same way, so TCP multi-process
+    # daemons run the lockset sanitizer their parent suite runs
+    from ..common import racecheck
+    racecheck.enable_if_configured()
     ap = argparse.ArgumentParser(prog="ceph-tpu-daemon")
     sub = ap.add_subparsers(dest="role", required=True)
     pm = sub.add_parser("mon")

@@ -85,6 +85,23 @@ run_jaxguard_smoke() {
     return 0
 }
 
+# racecheck smoke: the lockset data-race sanitizer must trip on an
+# unguarded two-thread write (with both access stacks) and stay
+# silent on locked/hand-off traffic — the concurrency-contract half
+# of the gate (see ceph_tpu/common/racecheck.py).
+run_racecheck_smoke() {
+    echo "=== check_green: racecheck smoke ==="
+    timeout -k 10 180 env JAX_PLATFORMS=cpu \
+        python scripts/racecheck_smoke.py
+    local rc=$?
+    if [ "$rc" -ne 0 ]; then
+        echo "check_green: RED (racecheck smoke rc=$rc — race" \
+             "sanitizer broken) — do not ship" >&2
+        return 1
+    fi
+    return 0
+}
+
 run_crash_smoke() {
     echo "=== check_green: crash-capture smoke ==="
     timeout -k 10 180 env JAX_PLATFORMS=cpu \
@@ -159,6 +176,7 @@ if [ "$STATIC_ONLY" -eq 1 ]; then
     exit 0
 fi
 run_jaxguard_smoke || exit 1
+run_racecheck_smoke || exit 1
 run_crash_smoke || exit 1
 run_multisite_smoke || exit 1
 run_trace_smoke || exit 1

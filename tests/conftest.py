@@ -22,6 +22,18 @@ import os
 # sanitizer off for the whole suite.
 os.environ["CEPH_TPU_LOCKDEP"] = "1"
 
+# ... and every tier-1 run is a data-race sanitizer run: racecheck ON
+# before any ceph_tpu import (shared_state()/RaceTracked classes
+# register at class creation; enable() retro-instruments, but the env
+# must be set before global_config() first resolves).  Attribute
+# accesses on instrumented daemon structures intersect Eraser-style
+# candidate locksets against lockdep's per-thread held set and raise
+# RaceError when no common lock protects a write-shared attribute
+# (see ceph_tpu/common/racecheck.py).  Propagates to subprocess
+# daemons through the env layer like lockdep.  Force-set for the
+# same reason as lockdep above.
+os.environ["CEPH_TPU_RACECHECK"] = "1"
+
 # ... and every tier-1 run is a device-contract sanitizer run too:
 # jaxguard ON before any ceph_tpu import, because enable() wraps
 # jax.jit and module-level jit wrappers are built at import.  A jit
@@ -51,6 +63,13 @@ assert len(jax.devices()) == 8, jax.devices()
 from ceph_tpu.common import jaxguard  # noqa: E402
 
 assert jaxguard.enable_if_configured(), "CEPH_TPU_JAXGUARD=1 set above"
+
+# arm racecheck before any ceph_tpu daemon module is imported: classes
+# already registered instrument now, later registrations instrument at
+# class creation
+from ceph_tpu.common import racecheck  # noqa: E402
+
+assert racecheck.enable_if_configured(), "CEPH_TPU_RACECHECK=1 set above"
 
 
 def _kill_stray_daemons() -> int:

@@ -1,0 +1,20 @@
+"""GREEN: the handler stays non-blocking — work is queued for the
+tick thread, replies go out without waiting, and the one queue read
+is the non-blocking spelling."""
+import queue
+
+
+class OSDStub:
+    def ms_dispatch(self, msg):
+        if msg == "flush":
+            self._work.put_nowait(msg)
+            return True
+        self._apply(msg)
+        return True
+
+    def _apply(self, msg):
+        self._log.append(msg)
+        try:
+            self._work.get_nowait()
+        except queue.Empty:
+            pass

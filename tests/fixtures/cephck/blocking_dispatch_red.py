@@ -1,0 +1,21 @@
+"""RED: a dispatch handler blocks — directly and through a helper
+the project call graph resolves (the graft-entry dryrun deadlock
+shape: dispatch waiting on something that needs dispatch to make
+progress)."""
+import time
+
+
+class OSDStub:
+    def ms_dispatch(self, msg):
+        if msg == "flush":
+            # BUG: sleeping ON the dispatch thread stalls every peer
+            time.sleep(0.2)
+            return True
+        self._apply(msg)
+        return True
+
+    def _apply(self, msg):
+        # BUG: cross-function — reachable from ms_dispatch, blocks in
+        # a condition wait
+        self._flushed.wait(5.0)
+        self._log.append(msg)

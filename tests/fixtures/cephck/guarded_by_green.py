@@ -1,0 +1,35 @@
+"""GREEN: every access takes the inferred guard — including a
+private helper that touches the table bare but is ONLY reached from
+locked callers (the call-graph coverage path)."""
+from ceph_tpu.common.lockdep import make_lock
+
+
+class PGMetaTable:
+    def __init__(self):
+        self._lock = make_lock("fixture.pgmeta")
+        self._table = {}
+
+    def put(self, k, v):
+        with self._lock:
+            self._table[k] = v
+            self._compact()
+
+    def get(self, k):
+        with self._lock:
+            return self._table.get(k)
+
+    def merge(self, other):
+        with self._lock:
+            self._table.update(other)
+            self._compact()
+            return len(self._table)
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self._table)
+
+    def _compact(self):
+        # bare access, but every caller holds self._lock: covered
+        # through the project call graph, not flagged
+        if len(self._table) > 64:
+            self._table.pop(next(iter(self._table)))

@@ -1,0 +1,40 @@
+"""RED: one accessor skips the lock every other access site takes.
+
+The persist_log shape: _table is mutated under self._lock in every
+writer and reader EXCEPT drain(), which clobbers it bare — the
+guarded-by inference must flag exactly that minority access.
+"""
+from ceph_tpu.common.lockdep import make_lock
+
+
+class PGMetaTable:
+    def __init__(self):
+        self._lock = make_lock("fixture.pgmeta")
+        self._table = {}
+
+    def put(self, k, v):
+        with self._lock:
+            self._table[k] = v
+
+    def get(self, k):
+        with self._lock:
+            return self._table.get(k)
+
+    def merge(self, other):
+        with self._lock:
+            self._table.update(other)
+            return len(self._table)
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self._table)
+
+    def size(self):
+        with self._lock:
+            return len(self._table)
+
+    def drain(self):
+        # BUG: no lock — races every locked accessor above
+        out = dict(self._table)
+        self._table = {}
+        return out

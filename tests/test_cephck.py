@@ -37,6 +37,9 @@ RULE_FIXTURES = {
     "jit-retrace-churn": "jit_retrace",
     "tracer-leak": "tracer_leak",
     "implicit-transfer": "ec/implicit_transfer",
+    # concurrency family (racecheck's static half)
+    "guarded-by": "guarded_by",
+    "blocking-in-dispatch": "blocking_dispatch",
 }
 
 
@@ -165,6 +168,51 @@ def test_project_context_resolves_imported_jit(tmp_path):
     hits = [f for f in eng.findings if f.rule == "implicit-transfer"]
     assert len(hits) == 1 and hits[0].path.endswith("plug.py"), \
         [f.render() for f in eng.findings]
+
+
+def test_guarded_by_flags_minority_access_and_covers_helpers():
+    findings, _ = scan(FIXTURES / "guarded_by_red.py")
+    hits = [f for f in findings if f.rule == "guarded-by"]
+    # exactly the drain() accesses — the locked majority and the
+    # covered-helper pattern stay silent (green fixture proves the
+    # latter end to end)
+    assert hits and all(f.symbol == "PGMetaTable.drain" for f in hits)
+    assert all("self._lock" in f.message for f in hits)
+
+
+def test_blocking_in_dispatch_local_and_cross_function():
+    findings, _ = scan(FIXTURES / "blocking_dispatch_red.py")
+    msgs = [f.message for f in findings
+            if f.rule == "blocking-in-dispatch"]
+    assert any("time.sleep" in m for m in msgs), msgs
+    assert any("reaches" in m and "wait" in m for m in msgs), msgs
+
+
+def test_format_github_emits_workflow_annotations():
+    red = FIXTURES / "bare_except_red.py"
+    proc = subprocess.run(
+        [sys.executable, "-m", "ceph_tpu.analysis",
+         "--format", "github", str(red)],
+        cwd=ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    line = next(ln for ln in proc.stdout.splitlines()
+                if ln.startswith("::error "))
+    assert "file=tests/fixtures/cephck/bare_except_red.py" in line
+    assert "title=cephck bare-except" in line
+
+
+def test_format_json_matches_legacy_json_flag():
+    red = FIXTURES / "bare_except_red.py"
+    out = {}
+    for flag in (["--json"], ["--format", "json"]):
+        proc = subprocess.run(
+            [sys.executable, "-m", "ceph_tpu.analysis",
+             *flag, str(red)],
+            cwd=ROOT, capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 1
+        out[tuple(flag)] = json.loads(proc.stdout)
+    assert out[("--json",)] == out[("--format", "json")]
+    assert out[("--json",)]["findings"][0]["rule"] == "bare-except"
 
 
 def test_jit_retrace_flags_per_call_static():

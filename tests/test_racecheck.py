@@ -1,0 +1,321 @@
+"""racecheck: the Eraser-style lockset sanitizer
+(ceph_tpu/common/racecheck.py).
+
+Covers the state machine's red path (intersected lockset trips with
+both access stacks), the green paths that keep real code quiet
+(init-before-publish, common-lock discipline, ownership hand-off,
+stale-tolerant external reads), the mixin form, and the
+zero-overhead-when-unset contract the tier-1 gate relies on.
+"""
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from ceph_tpu.common import racecheck
+from ceph_tpu.common.lockdep import make_lock
+from ceph_tpu.common.racecheck import (RaceError, RaceTracked,
+                                       shared_state,
+                                       transfer_ownership)
+
+
+@pytest.fixture(autouse=True)
+def _clean_reports():
+    racecheck.reset()
+    yield
+    racecheck.reset()
+
+
+def _in_thread(fn):
+    """Run fn on a fresh thread, returning what it raised (if)."""
+    box = []
+
+    def run():
+        try:
+            fn()
+        except BaseException as e:          # noqa: BLE001 — relayed
+            box.append(e)
+    t = threading.Thread(target=run, name="racer")
+    t.start()
+    t.join()
+    return box[0] if box else None
+
+
+def test_racecheck_on_under_tier1():
+    """conftest force-sets CEPH_TPU_RACECHECK=1: every tier-1 run is
+    a lockset-sanitizer run (like lockdep/jaxguard)."""
+    from ceph_tpu.common.options import global_config
+    assert global_config()["racecheck"] is True
+    assert racecheck.enabled()
+
+
+def test_unlocked_cross_thread_write_trips_with_both_stacks():
+    @shared_state(only=("val",))
+    class S:
+        def __init__(self):
+            self.val = 0
+
+    s = S()
+    s.val = 1                      # exclusive phase: silent
+
+    def racer():
+        s.val = 2
+    err = _in_thread(racer)
+    assert isinstance(err, RaceError)
+    assert "S.val" in str(err)
+    # both access stacks ride the error (the racing pair)
+    assert err.cur[0] == "racer"
+    assert any(__file__ in fn for fn, _l, _n in err.cur[2])
+    assert racecheck.races(), "evidence survives the raise"
+
+
+def test_common_lock_discipline_stays_green():
+    @shared_state(only=("n",))
+    class G:
+        def __init__(self):
+            self.lock = make_lock("racecheck-test.g")
+            self.n = 0
+
+        def bump(self):
+            with self.lock:
+                self.n += 1
+
+    g = G()
+    g.bump()
+    assert _in_thread(g.bump) is None
+    g.bump()
+    assert not racecheck.races()
+
+
+def test_lockset_intersection_trips_on_disjoint_locks():
+    """Two threads each hold A lock — just never the same one: the
+    candidate set empties and the write trips (the Eraser point: a
+    lock is not THE lock)."""
+    @shared_state(only=("n",))
+    class S:
+        def __init__(self):
+            self.a = make_lock("racecheck-test.a")
+            self.b = make_lock("racecheck-test.b")
+            self.n = 0
+
+    s = S()
+    with s.a:
+        s.n = 1
+
+    def racer():
+        with s.b:
+            s.n = 2
+    # the second thread's first access SEEDS the candidate set {b} —
+    # the trip comes when the next access proves no common lock
+    assert _in_thread(racer) is None
+    with pytest.raises(RaceError):
+        with s.a:
+            s.n = 3
+
+
+def test_init_before_publish_is_exclusive_and_silent():
+    @shared_state(only=("table",), mutating=("table",))
+    class S:
+        def __init__(self):
+            self.table = {}
+            for i in range(32):        # single-threaded init churn
+                self.table[i] = i
+
+        def reader(self):
+            return len(self.table)
+
+    s = S()
+    assert s.reader() == 32
+    assert not racecheck.races()
+
+
+def test_transfer_ownership_documents_handoff():
+    @shared_state(only=("payload",))
+    class Op:
+        def __init__(self):
+            self.payload = "built"
+
+    op = Op()
+    transfer_ownership(op)
+
+    def consumer():
+        op.payload = "consumed"     # new exclusive owner
+    assert _in_thread(consumer) is None
+    assert not racecheck.races()
+
+
+def test_mutating_reads_count_as_writes_from_own_methods():
+    @shared_state(only=("m",), mutating=("m",))
+    class S:
+        def __init__(self):
+            self.lock = make_lock("racecheck-test.m")
+            self.m = {}
+
+        def put(self, k, v):
+            with self.lock:
+                self.m[k] = v
+
+        def put_unlocked(self, k, v):
+            self.m[k] = v
+
+    s = S()
+    s.put("a", 1)
+    assert _in_thread(lambda: s.put("b", 2)) is None
+    err = _in_thread(lambda: s.put_unlocked("c", 3))
+    assert isinstance(err, RaceError), \
+        "container mutation without the guard must trip"
+
+
+def test_external_reads_are_stale_tolerant():
+    """A harness/test peeking a mutating container from outside the
+    object neither trips nor poisons the lockset."""
+    @shared_state(only=("m",), mutating=("m",))
+    class S:
+        def __init__(self):
+            self.lock = make_lock("racecheck-test.ext")
+            self.m = {"a": 1}
+
+        def put(self, k, v):
+            with self.lock:
+                self.m[k] = v
+
+    s = S()
+    s.put("b", 2)
+    assert _in_thread(lambda: s.put("c", 3)) is None
+    # external unlocked peek (what every MiniCluster test does)
+    assert _in_thread(lambda: s.m.get("a")) is None
+    assert _in_thread(lambda: s.put("d", 4)) is None
+    assert not racecheck.races()
+
+
+def test_race_tracked_mixin_registers():
+    class H(RaceTracked):
+        RACE_TRACK = ("state",)
+
+        def __init__(self):
+            self.state = "boot"
+
+    h = H()
+    h.state = "up"
+
+    def racer():
+        h.state = "down"
+    err = _in_thread(racer)
+    assert isinstance(err, RaceError)
+    assert "H.state" in str(err)
+
+
+def test_enable_requires_lockdep():
+    """Arming without lockdep would make every guarded access look
+    unguarded (make_lock hands out invisible RLocks): refused."""
+    code = (
+        "import os\n"
+        "os.environ.pop('CEPH_TPU_LOCKDEP', None)\n"
+        "os.environ['CEPH_TPU_RACECHECK'] = '1'\n"
+        "from ceph_tpu.common import racecheck\n"
+        "try:\n"
+        "    racecheck.enable()\n"
+        "except RuntimeError as e:\n"
+        "    assert 'lockdep' in str(e)\n"
+        "else:\n"
+        "    raise SystemExit('enable() without lockdep must refuse')\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_retro_enable_adopts_pre_arming_instances():
+    """enable() after an instance was built must not orphan its
+    attribute values (review-found: the descriptor shadowed the
+    plain-name dict entry and every read raised AttributeError)."""
+    code = (
+        "import os\n"
+        "os.environ['CEPH_TPU_LOCKDEP'] = '1'\n"
+        "os.environ.pop('CEPH_TPU_RACECHECK', None)\n"
+        "from ceph_tpu.common import racecheck\n"
+        "@racecheck.shared_state(only=('t',), mutating=('t',))\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self.t = {'a': 1}\n"
+        "s = S()\n"
+        "racecheck.enable()\n"
+        "assert s.t == {'a': 1}\n"       # adopted, not orphaned
+        "s.t = {'b': 2}\n"
+        "assert s.t == {'b': 2}\n"
+        "del s.t\n"
+        "try:\n"
+        "    s.t\n"
+        "except AttributeError:\n"
+        "    pass\n"
+        "else:\n"
+        "    raise SystemExit('del did not remove the value')\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_zero_overhead_when_env_unset():
+    """With CEPH_TPU_RACECHECK unset, shared_state()/RaceTracked only
+    register: the class keeps object.__setattr__/__getattribute__,
+    no record store appears, and instrumented production classes
+    (TcpMessenger, SyncAgent, DecodeTableCache) stay pristine."""
+    code = (
+        "import os\n"
+        "os.environ.pop('CEPH_TPU_RACECHECK', None)\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "from ceph_tpu.common import racecheck\n"
+        "assert not racecheck.enable_if_configured()\n"
+        "assert not racecheck.enabled()\n"
+        "@racecheck.shared_state(only=('x',))\n"
+        "class S:\n"
+        "    pass\n"
+        "assert S.__setattr__ is object.__setattr__\n"
+        "assert S.__getattribute__ is object.__getattribute__\n"
+        "assert 'x' not in vars(S)\n"
+        "s = S(); s.x = 1\n"
+        "assert s.__dict__ == {'x': 1}\n"
+        "from ceph_tpu.msg.tcp import TcpMessenger\n"
+        "from ceph_tpu.ec.matrix_code import DecodeTableCache\n"
+        "assert '_out' not in vars(TcpMessenger)\n"
+        "assert '_lru' not in vars(DecodeTableCache)\n"
+        "assert racecheck.stats()['instrumented'] == 0\n"
+        "assert racecheck.stats()['registered'] > 0\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_production_classes_are_instrumented_under_tier1():
+    """The tier-1 arming reaches the daemon structures the issue
+    names: connection maps, sync cursors, the decode-matrix LRU."""
+    from ceph_tpu.ec.matrix_code import DecodeTableCache
+    from ceph_tpu.msg.tcp import TcpMessenger
+    from ceph_tpu.rgw.multisite import SyncAgent
+    for cls, attr in ((DecodeTableCache, "_lru"),
+                      (TcpMessenger, "_out"),
+                      (SyncAgent, "_markers")):
+        assert isinstance(vars(cls).get(attr), property), (cls, attr)
+
+
+def test_decode_table_cache_locked_end_to_end():
+    """The EC decode-matrix LRU under concurrent get/put: every
+    access goes through its lock, so the sanitizer stays quiet."""
+    from ceph_tpu.ec.matrix_code import DecodeTableCache
+    c = DecodeTableCache(capacity=8)
+    c.put("+0+1-2", object(), cost=2)
+
+    def churn():
+        for i in range(50):
+            c.put(f"+0-{i % 4}", object(), cost=1)
+            c.get("+0+1-2")
+    t = [threading.Thread(target=churn) for _ in range(3)]
+    for x in t:
+        x.start()
+    churn()
+    for x in t:
+        x.join()
+    assert not racecheck.races()

@@ -512,7 +512,11 @@ class Objecter(Dispatcher, MonHunter):
             self._complete_op(op, msg)
 
     def _schedule_rescan(self, delay: float = 0.05) -> None:
-        """Periodic retry for parked ops (the reference's tick_event)."""
+        """Periodic retry for parked ops (the reference's tick_event).
+        The interval doubles up to a cap and is jittered: many clients
+        parked by the same outage must not re-probe the recovering
+        primary in lockstep at fixed phases (the chaos harness's
+        heal-at-the-wrong-phase schedules livelock exactly that)."""
         if getattr(self, "_rescan_timer", None) is not None:
             return
 
@@ -526,7 +530,8 @@ class Objecter(Dispatcher, MonHunter):
                 if self.homeless:
                     self._schedule_rescan(min(delay * 2, 1.0))
 
-        self._rescan_timer = threading.Timer(delay, fire)
+        from ..common.backoff import full_jitter
+        self._rescan_timer = threading.Timer(full_jitter(delay), fire)
         self._rescan_timer.daemon = True
         self._rescan_timer.start()
 
@@ -543,9 +548,14 @@ class Objecter(Dispatcher, MonHunter):
         issued right after mgr start, but a cluster with no mgr at
         all must answer fast, not spin out the whole deadline."""
         import time
+        from ..common.backoff import Backoff
         now = time.monotonic()
         deadline = now + timeout
         mgr_deadline = now + min(timeout, 1.0)
+        # EAGAIN pacing: an election storm answers every resend with
+        # -11; a fixed 0.1s retry re-probed in lockstep with the
+        # churn (shared capped-exponential helper instead)
+        backoff = Backoff(base_s=0.05, cap_s=1.0)
         while True:
             tid = next(self._tid)
             ev = threading.Event()
@@ -565,7 +575,12 @@ class Objecter(Dispatcher, MonHunter):
                         MGR_UNAVAILABLE_EAGAIN):
                     retry_until = mgr_deadline
                 if time.monotonic() < retry_until:
-                    time.sleep(0.1)
+                    if self.pump_hook is not None:
+                        self.pump_hook()   # pump-mode: drive the
+                        # election forward instead of sleeping blind
+                        time.sleep(min(0.01, backoff.next_delay()))
+                    else:
+                        backoff.sleep()
                     continue
             return slot["r"], slot["outs"], slot["outb"]
 

@@ -212,11 +212,16 @@ class _MDSSession(Dispatcher):
         # non-idempotent op (ref: Client request resend is gated on
         # session state the same way)
         msg = MClientRequest(tid=tid, op=op, args=args)
+        # send-retry pacing: shared capped-exponential with jitter —
+        # a fixed interval can phase-lock against a failover that
+        # heals right after every probe (chaos-exposed livelock shape)
+        from ..common.backoff import Backoff
+        backoff = Backoff(base_s=0.05, cap_s=1.0)
         while not self.ms.connect(target).send_message(msg):
             if time.monotonic() >= deadline:
                 self._pending.pop(tid, None)
                 raise _SendTimeout(f"mds {target} unreachable")
-            time.sleep(0.25)
+            backoff.sleep()
         if not self._rados.objecter.wait_sync(
                 ev.is_set, max(0.1, deadline - time.monotonic()),
                 ev=ev):
@@ -733,7 +738,9 @@ class CephFS:
         """EAGAIN retry loop: the MDS answers EAGAIN while revoking
         caps out from under the op; the client waits it out (ref:
         Client's cap-wait)."""
+        from ..common.backoff import Backoff
         deadline = _time.monotonic() + timeout
+        backoff = Backoff(base_s=0.01, cap_s=0.25)
         while True:
             try:
                 return fn()
@@ -741,7 +748,7 @@ class CephFS:
                 if e.errno_name != "EAGAIN" or \
                         _time.monotonic() >= deadline:
                     raise
-                _time.sleep(0.02)
+                backoff.sleep()
 
     # -- multi-MDS subtree pinning (ref: setfattr ceph.dir.pin) ---------
     def set_pin(self, path: str, rank: int) -> None:

@@ -98,10 +98,20 @@ class Elector:
             self.send(msg.rank, MMonElection(op="ack", epoch=self.epoch,
                                              rank=self.rank))
         else:
-            # we outrank the proposer: push our own candidacy
-            self.send(msg.rank, MMonElection(op="propose",
-                                             epoch=self.epoch,
-                                             rank=self.rank))
+            # we outrank the proposer: push our own candidacy — to
+            # EVERY rank, not just the proposer.  Under an asymmetric
+            # partition the proposer may be unreachable from us; if
+            # our counter-candidacy went only to it, every reachable
+            # voter would sit in the bumped epoch never hearing a
+            # proposal, and the quorum would stall until the lease
+            # timeout restarted the whole election (found by the
+            # chaos harness's asymmetric mon-partition schedule).
+            for r in self.ranks:
+                if r != self.rank:
+                    self.send(r, MMonElection(op="propose",
+                                              epoch=self.epoch,
+                                              rank=self.rank))
+            self._check_win()
 
     def _handle_ack(self, msg: MMonElection) -> None:
         if msg.epoch > self.epoch:
@@ -111,7 +121,30 @@ class Elector:
             self.electing = True
             self.leader = None
             self.acked_me = {self.rank}
-        elif msg.epoch < self.epoch or not self.electing:
+        elif msg.epoch < self.epoch:
+            return
+        elif not self.electing:
+            # late ack for an epoch we already won: the voter was one
+            # delivery behind the majority when victory fired, and
+            # dropping its ack would leave it a lease-fed peon OUTSIDE
+            # the quorum forever (MON_DOWN that never clears — found
+            # by the chaos harness's mon-partition heal).  Expand the
+            # quorum and re-announce (ref: real Ceph avoids the race
+            # by waiting out the full election timeout).
+            if self.leader == self.rank and msg.rank in self.ranks \
+                    and msg.rank not in self.quorum:
+                self.acked_me.add(msg.rank)
+                self.quorum = sorted(set(self.quorum) | {msg.rank})
+                dout("mon", 1).write(
+                    "elector %d: late ack from %d, quorum now %s",
+                    self.rank, msg.rank, self.quorum)
+                for r in self.ranks:
+                    if r != self.rank:
+                        self.send(r, MMonElection(op="victory",
+                                                  epoch=self.epoch,
+                                                  rank=self.rank,
+                                                  quorum=self.quorum))
+                self.on_win(self.epoch, self.quorum)
             return
         self.acked_me.add(msg.rank)
         self._check_win()

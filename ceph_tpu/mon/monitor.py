@@ -58,6 +58,12 @@ from .store import MonitorStore
 
 LEASE_INTERVAL = 5.0          # leader lease period (mon_lease)
 LEASE_TIMEOUT = 15.0          # peon re-elects after silence (mon_lease_ack)
+# stale-lease re-election pacing: a mon that keeps losing its lease
+# (partitioned away, or its victories never arrive back) must not
+# force a quorum-wide election every tick — capped exponential,
+# reset the moment it rejoins a reign (win, lose, or a fresh lease)
+ELECTION_BACKOFF_BASE_S = 1.0
+ELECTION_BACKOFF_CAP_S = 60.0
 
 
 def build_initial(n_osd: int, osds_per_host: int = 1
@@ -179,6 +185,14 @@ class Monitor(Dispatcher):
         self.paxos.on_peon_commit = self._on_peon_commit
         self._lease_stamp = self.clock()
         self._last_lease_sent = 0.0
+        # stale-lease re-election pacing (shared helper; chaos found
+        # the unpaced loop: a partitioned mon re-proposing every tick
+        # drags the surviving quorum through an election each time)
+        from ..common.backoff import Backoff
+        self._elect_backoff = Backoff(
+            base_s=ELECTION_BACKOFF_BASE_S,
+            cap_s=ELECTION_BACKOFF_CAP_S, jitter=False,
+            clock=self.clock)
         # serialized map mutations: (stage_fn, reply_cb)
         self._chg_queue: deque = deque()
         self._chg_busy = False
@@ -283,6 +297,7 @@ class Monitor(Dispatcher):
     def _on_win(self, epoch: int, quorum: list[int]) -> None:
         self.is_leader = True
         self.leader_rank = self.rank
+        self._elect_backoff.reset()
         self.paxos.quorum = quorum
         self.paxos.all_ranks = list(self.mon_ranks)
         self.paxos.epoch = epoch
@@ -321,6 +336,7 @@ class Monitor(Dispatcher):
         self.paxos.abort_inflight()
         self._fail_queued("EAGAIN")
         self._lease_stamp = self.clock()
+        self._elect_backoff.reset()
         self._persist_elector()
         # catch up on anything we missed while electing
         self._send_rank(leader, MPaxosSyncReq(
@@ -347,7 +363,8 @@ class Monitor(Dispatcher):
                 self._send_rank(r, MMonLease(
                     epoch=self.elector.epoch,
                     stamp=self._last_lease_sent,
-                    last_committed=self.paxos.last_committed))
+                    last_committed=self.paxos.last_committed,
+                    quorum=tuple(self.elector.quorum)))
 
     def _on_peon_commit(self) -> None:
         """A replicated value landed on this peon: refresh the services
@@ -451,6 +468,16 @@ class Monitor(Dispatcher):
                     self.paxos.all_ranks = list(self.mon_ranks)
                     self._persist_elector()
                 self._lease_stamp = self.clock()
+                if msg.quorum:
+                    # adopt the reigning quorum: ours may be a stale
+                    # pre-partition view that still lists us, masking
+                    # that the leader's election left us out
+                    self.elector.quorum = list(msg.quorum)
+                if self.rank in self.elector.quorum:
+                    # an out-of-quorum peon keeps its backoff armed:
+                    # leases alone must not pace-reset the re-propose
+                    # loop that gets it readmitted
+                    self._elect_backoff.reset()
                 if msg.last_committed > self.paxos.last_committed:
                     self._send_rank(sender, MPaxosSyncReq(
                         version=self.paxos.last_committed,
@@ -1127,10 +1154,17 @@ class Monitor(Dispatcher):
                         self._broadcast_lease()   # re-ask for acks
                     elif now - self._last_lease_sent >= LEASE_INTERVAL:
                         self._broadcast_lease()
-                elif self.leader_rank is None or \
-                        now - self._lease_stamp > LEASE_TIMEOUT:
+                elif (self.leader_rank is None or
+                        now - self._lease_stamp > LEASE_TIMEOUT or
+                        self.rank not in self.elector.quorum) and \
+                        self._elect_backoff.ready(now):
+                    # third clause: a lease-fed peon OUTSIDE the
+                    # quorum (its election ack got lost) must keep
+                    # proposing — paced — until the quorum admits it
                     dout("mon", 1).write(
-                        "%s: lease stale, re-electing", self.name)
+                        "%s: lease stale, re-electing (attempt %d)",
+                        self.name, self._elect_backoff.failures + 1)
+                    self._elect_backoff.fail(now)
                     self.elector.start()
                     self._persist_elector()
             if not self.is_leader:

@@ -652,6 +652,10 @@ class MMonLease(Message):
     epoch: int = 0
     stamp: float = 0.0
     last_committed: int = 0    # peons behind this request a sync
+    #: the reigning quorum: a recipient NOT listed learns it was left
+    #: out (its election ack never landed) and must re-propose — a
+    #: lease alone is not membership
+    quorum: tuple = ()
 
 
 @dataclass
@@ -763,6 +767,7 @@ _VERSIONS: dict[str, tuple[int, int]] = {
     "PGLogReq": (2, 1),         # v2: EC shard-log view flag
     "MPGStats": (2, 1),         # v2: slow-op summary (SLOW_OPS feed)
     "MMDSBeacon": (2, 1),       # v2: slow-op summary (SLOW_OPS feed)
+    "MMonLease": (2, 1),        # v2: reigning quorum rides the lease
 }
 
 

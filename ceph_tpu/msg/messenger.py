@@ -15,6 +15,7 @@ from __future__ import annotations
 import itertools
 import queue
 import threading
+from collections import deque
 
 from ..common.lockdep import make_lock
 from dataclasses import dataclass, field
@@ -108,7 +109,8 @@ class Messenger:
             return TcpMessenger(network.addr_map, name,
                                 secure_secret=network.secure_secret,
                                 compress=network.compress,
-                                compress_min=network.compress_min)
+                                compress_min=network.compress_min,
+                                faults=network.faults)
         if ms_type is None:
             ms_type = global_config()["ms_type"]
         if ms_type in ("local", "ici"):
@@ -212,24 +214,47 @@ class Messenger:
             d.ms_handle_reset(peer)
 
 
+#: drop-ring depth: enough context to debug a fault burst without an
+#: unbounded list outliving a long chaos run (drops_total keeps the
+#: exact count)
+DROP_RING = 512
+
+
 @shared_state(only=("_endpoints",), mutating=("_endpoints",))
 class LocalNetwork:
     """In-process "wire": entity registry + routing + fault injection.
 
-    One instance per simulated cluster.  Message drop emulation uses
-    `ms_inject_socket_failures` = drop 1 of every N routed messages
-    (ref: src/common/options.cc:987; the reference resets the socket,
-    losing in-flight messages — here the message itself is dropped and
-    both sides get ms_handle_reset)."""
+    One instance per simulated cluster.  Fault injection is delegated
+    to the attached FaultPlane (ceph_tpu.msg.faults): per-link drop
+    probability, partitions, delay, reorder, duplication — all from
+    one seeded RNG.  `ms_inject_socket_failures` survives as a
+    compatibility shim that installs an equivalent all-links drop rule
+    with probability 1/N (ref: src/common/options.cc:987; the
+    reference resets the socket, losing in-flight messages — shim
+    drops likewise give both sides ms_handle_reset, while partition
+    drops stay silent so detection is timeout-driven like a real
+    netsplit)."""
 
-    def __init__(self):
+    def __init__(self, fault_seed: int = 0):
+        from .faults import FaultPlane
         self._endpoints: dict[EntityName, Messenger] = {}
         self._lock = make_lock("msgr.local_network")
         self._routed = 0
-        self.dropped: list[tuple[EntityName, EntityName, Message]] = []
+        #: last DROP_RING dropped messages (debugging ring; the full
+        #: count lives in drops_total)
+        self.dropped: "deque[tuple[EntityName, EntityName, Message]]" \
+            = deque(maxlen=DROP_RING)
+        #: monotonically-increasing drop counter, exported through the
+        #: daemon perf-dump path (osd msgr_drops_total)
+        self.drops_total = 0
         #: optional test hook: (src, dst, msg) -> False to drop
         self.filter: Callable[[EntityName, EntityName, Message], bool] \
             | None = None
+        self.faults = FaultPlane(seed=fault_seed)
+        self.faults.deliver_cb = self._fault_deliver
+        #: ms_inject_socket_failures value the shim rule reflects
+        self._shim_inject = 0
+        self._shim_rule: int | None = None
 
     def register(self, ms: Messenger) -> Messenger:
         with self._lock:
@@ -246,26 +271,68 @@ class LocalNetwork:
         with self._lock:
             return self._endpoints.get(name)
 
-    def route(self, src: EntityName, dst: EntityName,
-              msg: Message) -> bool:
+    def _sync_inject_shim(self) -> None:
+        """Mirror ms_inject_socket_failures into an equivalent
+        FaultPlane rule: drop 1-in-N becomes probability 1/N on every
+        link (seeded, so bursts are now possible — the modulus never
+        dropped two consecutive messages)."""
         inject = global_config()["ms_inject_socket_failures"]
+        if inject == self._shim_inject:
+            return
+        self._shim_inject = inject
+        if self._shim_rule is not None:
+            self.faults.remove_rule(self._shim_rule)
+            self._shim_rule = None
+        if inject:
+            self._shim_rule = self.faults.add_rule(
+                "*", "*", drop=1.0 / inject, reset=True)
+
+    def _fault_deliver(self, src: EntityName, dst: EntityName,
+                       msg: Message) -> None:
+        """Terminal delivery for the fault plane (also used for held
+        messages released later by flush)."""
         with self._lock:
-            self._routed += 1
-            drop = bool(inject and self._routed % inject == 0)
-            if not drop and self.filter is not None:
-                drop = not self.filter(src, dst, msg)
-            src_ms = self._endpoints.get(src)
             dst_ms = self._endpoints.get(dst)
-        if drop:
-            self.dropped.append((src, dst, msg))
-            if src_ms:
-                src_ms.handle_reset(dst)
-            if dst_ms:
-                dst_ms.handle_reset(src)
-            return False
+            src_ms = self._endpoints.get(src)
         if dst_ms is None:
             if src_ms:
                 src_ms.handle_reset(dst)
-            return False
+            return
         dst_ms.enqueue(msg)
+
+    def _drop(self, src: EntityName, dst: EntityName, msg: Message,
+              reset: bool) -> None:
+        self.dropped.append((src, dst, msg))
+        self.drops_total += 1
+        if not reset:
+            return
+        with self._lock:
+            src_ms = self._endpoints.get(src)
+            dst_ms = self._endpoints.get(dst)
+        if src_ms:
+            src_ms.handle_reset(dst)
+        if dst_ms:
+            dst_ms.handle_reset(src)
+
+    def route(self, src: EntityName, dst: EntityName,
+              msg: Message) -> bool:
+        self._sync_inject_shim()
+        if self.filter is not None and \
+                not self.filter(src, dst, msg):
+            self._drop(src, dst, msg, reset=True)
+            return False
+        with self._lock:
+            self._routed += 1
+            dst_ms = self._endpoints.get(dst)
+            src_ms = self._endpoints.get(src)
+        if dst_ms is None:
+            self.faults.flush(self._fault_deliver)
+            if src_ms:
+                src_ms.handle_reset(dst)
+            return False
+        eff = self.faults.intercept(src, dst, msg,
+                                    self._fault_deliver)
+        if eff.dropped:
+            self._drop(src, dst, msg, reset=eff.reset)
+            return False
         return True

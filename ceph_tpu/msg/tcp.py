@@ -22,6 +22,7 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time
 
 from ..common.lockdep import make_lock
 
@@ -71,7 +72,8 @@ class TcpNet:
     def __init__(self, addr_map: dict[str, tuple[str, int]],
                  secure_secret: str | bytes | None = None,
                  compress: str | None = None,
-                 compress_min: int = 4096):
+                 compress_min: int = 4096,
+                 faults=None):
         self.addr_map = dict(addr_map)
         self.secure_secret = secure_secret
         #: on-wire compression (ref: msgr v2 compression negotiation,
@@ -79,6 +81,10 @@ class TcpNet:
         #: compressed with the named registry algorithm
         self.compress = compress
         self.compress_min = compress_min
+        #: optional shared FaultPlane — every endpoint created on this
+        #: net intercepts its sends through it (drop/partition/delay/
+        #: dup; reorder needs a queue transport and is a no-op here)
+        self.faults = faults
 
 
 # the connection maps are shared between the send path (any caller
@@ -93,9 +99,14 @@ class TcpMessenger:
     def __init__(self, addr_map: dict[str, tuple[str, int]], name: str,
                  secure_secret: str | bytes | None = None,
                  compress: str | None = None,
-                 compress_min: int = 4096):
+                 compress_min: int = 4096,
+                 faults=None):
         self.name = name
         self.addr_map = dict(addr_map)
+        #: send-side fault intercept (ceph_tpu.msg.faults.FaultPlane):
+        #: consulted before every socket write, so a partitioned or
+        #: lossy link fails here exactly like the in-process backend
+        self.faults = faults
         # secure wire mode (ref: frames_v2 SECURE): every CONNECTION
         # runs its own KEX and seals under per-session, per-direction
         # keys (msg/secure.py SecureConn; VERDICT r3 #4 — one captured
@@ -261,6 +272,17 @@ class TcpMessenger:
 
     def _send(self, peer: str, msg: Message) -> bool:
         import dataclasses
+        eff = None
+        if self.faults is not None:
+            # decide (and sleep out an injected delay) BEFORE taking
+            # the lock: a delayed link must not stall unrelated peers
+            eff = self.faults.decide(self.name, peer, msg.type_name)
+            if eff.dropped:
+                if eff.reset:
+                    self.handle_reset(peer)
+                return False
+            if eff.delay > 0.0:
+                time.sleep(min(eff.delay, 1.0))
         with self._lock:
             self._seq += 1
             msg = dataclasses.replace(msg, src=self.name, seq=self._seq)
@@ -299,6 +321,10 @@ class TcpMessenger:
                 self._spawn_reader(sock)
             try:
                 self._send_sealed(sock, payload)
+                if eff is not None and eff.dup:
+                    # injected duplication: same frame, same seq — the
+                    # receiver sees a TCP-retransmit-style replay
+                    self._send_sealed(sock, payload)
                 return True
             except OSError:
                 (self._learned if learned else self._out).pop(peer, None)

@@ -274,6 +274,10 @@ class OSDDaemon(Dispatcher, MonHunter):
         for key in ("op_lat_client", "op_lat_recovery",
                     "op_lat_snaptrim"):
             self.perf.add_latency_histogram(key)
+        # messenger drops seen by the shared network fabric
+        # (FaultPlane/filter/shim): a monotonic total so chaos runs
+        # can audit injected loss through the normal perf-dump path
+        self.perf.add_u64_counter("msgr_drops_total")
         self.ms = Messenger.create(network, self.name, threaded=threaded)
         if keyring is not None:
             from ..auth import attach_cephx
@@ -322,8 +326,11 @@ class OSDDaemon(Dispatcher, MonHunter):
         (ref: OSD::asok_command src/osd/OSD.cc:2712)."""
         from ..common.admin_socket import AdminSocket
         a = AdminSocket(path)
-        a.register("perf dump", "dump perf counters",
-                   lambda c: (0, self.perf.dump()))
+
+        def _perf_dump(c):
+            self._refresh_msgr_perf()
+            return 0, self.perf.dump()
+        a.register("perf dump", "dump perf counters", _perf_dump)
         a.register("config show", "dump live config values",
                    lambda c: (0, global_config().dump()))
         a.register("config diff", "values changed from defaults",
@@ -2331,6 +2338,14 @@ class OSDDaemon(Dispatcher, MonHunter):
                 self._hb_reported.discard(p)
 
     # ------------------------------------------------------- pg stats
+    def _refresh_msgr_perf(self) -> None:
+        """Pull the network fabric's drop total into our counter set
+        (LocalNetwork only; TcpNet has no shared drop ledger)."""
+        net = getattr(self.ms, "network", None)
+        total = getattr(net, "drops_total", None)
+        if total is not None:
+            self.perf.set("msgr_drops_total", total)
+
     def _send_pg_stats(self, now: float) -> None:
         """Primary-reported per-PG stats + store usage
         (ref: src/osd/OSD.cc collect_pg_stats / pg_stat_t states
@@ -2380,6 +2395,7 @@ class OSDDaemon(Dispatcher, MonHunter):
                 "store_bytes": store_b,
                 "acting": list(st.acting), "primary": True}
         fs = self.store.statfs()
+        self._refresh_msgr_perf()
         perf = self.perf.dump()
         # device-health feed: BlueStore media error counters ride the
         # perf report (ref: the SMART scrape mgr/devicehealth pulls)

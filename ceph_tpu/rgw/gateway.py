@@ -131,6 +131,9 @@ class RGWGateway:
         #: multisite identity: the zone this gateway serves (None =
         #: standalone gateway, no datalog, no sync agent)
         self.zone = zone
+        #: optional FaultPlane; peer_request consults it so partition
+        #: rules cover the HTTP sync path as well as the messenger
+        self.faults = None
         #: (access_key, secret) this gateway signs sync/forwarded
         #: requests to peers with (ref: the multisite system user)
         self.system_key = system_key
@@ -1006,6 +1009,10 @@ class RGWGateway:
         configured (ref: the system user's SigV4 on every sync/forward
         request) so secured peers accept it through the normal auth
         gate."""
+        if self.faults is not None:
+            # raises ConnectionError (an OSError — callers already
+            # translate that into PeerError) when a rule severs us
+            self.faults.check_http(f"rgw.{self.zone}", endpoint)
         url = endpoint.rstrip("/") + path
         hdrs = dict(headers or {})
         if self.system_key is not None:

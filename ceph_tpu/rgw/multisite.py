@@ -39,7 +39,6 @@ the mgr prometheus gauges (`ceph_rgw_sync_lag_entries`,
 from __future__ import annotations
 
 import json
-import random
 import threading
 import time
 import urllib.error
@@ -48,6 +47,7 @@ import weakref
 from urllib.parse import quote
 
 from ..client import RadosError
+from ..common.backoff import Backoff
 from ..common.lockdep import make_lock
 from ..common.log import dout
 from ..common.racecheck import shared_state
@@ -339,8 +339,10 @@ class SyncAgent:
         self._heads: dict[tuple[str, str, int], int] = {}
         #: (source, bucket, shard) -> [error records]
         self._errors: dict[tuple[str, str, int], list[dict]] = {}
-        #: source -> (consecutive failures, monotonic next-try time)
-        self._backoff: dict[str, tuple[int, float]] = {}
+        #: source -> shared capped-exponential backoff (the canonical
+        #: policy now lives in common/backoff.py; this agent is where
+        #: the shape was extracted from)
+        self._backoff: dict[str, "Backoff"] = {}
         #: (source, bucket) -> the bucket's "created" stamp the
         #: cursors belong to — a recreate under the same name restarts
         #: the datalog sequences, so stale cursors must be retired
@@ -408,25 +410,26 @@ class SyncAgent:
         views: dict[str, dict] = {}
         for peer in peers:
             src = peer["zone"]
-            fails, next_ok = self._backoff.get(src, (0, 0.0))
-            if now < next_ok:
+            bo = self._backoff.get(src)
+            if bo is None:
+                bo = self._backoff[src] = Backoff(
+                    base_s=self.BACKOFF_BASE_S,
+                    cap_s=self.BACKOFF_CAP_S)
+            if not bo.ready(now):
                 continue
             try:
                 applied += self._sync_peer(peer, views)
-                self._backoff[src] = (0, 0.0)
+                bo.reset()
                 self._peer_ok[src] = True
             except PeerError as ex:
-                fails += 1
-                delay = min(self.BACKOFF_CAP_S,
-                            self.BACKOFF_BASE_S * 2 ** (fails - 1))
-                delay *= 0.5 + random.random()      # jitter: peers
-                # recovering together must not re-stampede in lockstep
-                self._backoff[src] = (fails,
-                                      time.monotonic() + delay)
+                # jitter rides the shared helper: peers recovering
+                # together must not re-stampede in lockstep
+                delay = bo.fail(time.monotonic())
                 self._peer_ok[src] = False
                 dout("rgw", 4).write(
-                    "sync %s<-%s unreachable (%s), backoff %.2fs",
-                    self.zone, src, ex, delay)
+                    "sync %s<-%s unreachable (%s), backoff %.2fs "
+                    "(%d consecutive)",
+                    self.zone, src, ex, delay, bo.failures)
         if peers and len(views) == len(peers) and \
                 not self._stop.is_set():
             # every peer answered this round: registry delete-

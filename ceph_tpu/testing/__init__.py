@@ -1,5 +1,8 @@
 """Test/QA harnesses (the qa/ tier analogues)."""
+from ..msg.faults import FaultPlane
+from .chaos import ChaosRunner, InvariantViolation
 from .cluster import MiniCluster
 from .thrasher import OSDThrasher
 
-__all__ = ["MiniCluster", "OSDThrasher"]
+__all__ = ["MiniCluster", "OSDThrasher", "ChaosRunner",
+           "InvariantViolation", "FaultPlane"]

@@ -20,9 +20,13 @@ class MiniCluster:
     def __init__(self, n_osd: int = 6, osds_per_host: int = 1,
                  threaded: bool = True, n_mon: int = 1,
                  auth: str = "none", fabric=None,
-                 mon_crash_dirs: dict[int, str] | None = None):
+                 mon_crash_dirs: dict[int, str] | None = None,
+                 fault_seed: int = 0):
         import copy
-        self.network = LocalNetwork()
+        self.network = LocalNetwork(fault_seed=fault_seed)
+        # fault-plane delays release against the same clock the mons
+        # tick on, so a sim-time schedule holds messages sim-time long
+        self.network.faults.clock = self._clock
         self.threaded = threaded
         #: shared ICIFabric — OSDs become device-mesh co-resident and
         #: EC writes ride the psum fan-out (ceph_tpu.dist.fabric)
@@ -254,7 +258,10 @@ class MiniCluster:
     def pump(self, rounds: int = 30) -> None:
         """Non-threaded mode: pump every endpoint until quiescent."""
         for _ in range(rounds):
-            moved = sum(mn.ms.poll() for mn in self.mons.values())
+            # release fault-held (delayed/reordered) messages whose
+            # deadline passed; counts as movement so we keep pumping
+            moved = self.network.faults.flush()
+            moved += sum(mn.ms.poll() for mn in self.mons.values())
             for d in self.osds.values():
                 moved += d.ms.poll()
             for c in self.clients:
@@ -327,6 +334,12 @@ class MiniCluster:
             gw.multisite.refresh(force=True)
         self.rgws = getattr(self, "rgws", [])
         self.rgws.extend(gws)
+        for i, z in enumerate(zones):
+            # HTTP fault coverage: peer pulls to this zone's endpoint
+            # resolve to the entity "rgw.<zone>" in partition rules
+            self.network.faults.bind_alias(
+                f"http://127.0.0.1:{gws[i].port}", f"rgw.{z}")
+            gws[i].faults = self.network.faults
         for gw in gws:
             gw.start()
         return gws
@@ -365,6 +378,7 @@ class MiniCluster:
             sync_interval=gw.sync.interval, **kw)
         self.rgws = getattr(self, "rgws", [])
         self.rgws.append(g2)
+        g2.faults = gw.faults
         g2.start()
         return g2
 

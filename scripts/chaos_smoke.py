@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Chaos smoke — the fault-injection half of the ship gate
+(check_green.sh).
+
+Boots a fixed-seed MiniCluster and drives the regression schedule for
+the chaos-surfaced elector bugs (ISSUE 17) through ChaosRunner:
+
+  t=20   partition the mon minority (mon.2) from the majority
+  t=60   heal — mon.2 must be readmitted to the quorum
+  t=80   kill osd.3 (flap down)
+  t=120  revive osd.3
+  t=140  2% seeded Ping loss on every osd<->osd heartbeat link
+  t=200  heal
+
+all under live client IO.  run() raises InvariantViolation unless,
+at the end: quorum re-forms with a leader, every PG returns to
+active+clean, every acked write reads back byte-identical, SLOW_OPS
+and health warnings clear, and the crash table is empty.
+
+Determinism gate: the schedule runs TWICE against fresh clusters and
+the per-link fault-log digest (sha256 over every decided fault) plus
+the per-kind fault counts must match byte-for-byte — a failing chaos
+run must replay exactly from its seed or it cannot be debugged.
+
+Writes CHAOS_r01.json with per-phase client-IO p50/p99 latencies,
+fault counts, and the replay digest.
+
+Run from the repo root: python scripts/chaos_smoke.py
+"""
+import json
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from ceph_tpu.testing import ChaosRunner, MiniCluster   # noqa: E402
+
+FAULT_SEED = 7          # FaultPlane per-link RNG seed
+RUNNER_SEED = 1         # ChaosRunner IO/placement seed
+N_OSD = 5
+N_MON = 3
+
+SCHEDULE = [
+    {"at": 20.0, "action": "partition", "a": ["mon.2"],
+     "b": ["mon.0", "mon.1"], "label": "mon-minority"},
+    {"at": 60.0, "action": "heal", "target": "mon-minority"},
+    {"at": 80.0, "action": "kill_osd", "osd": 3},
+    {"at": 120.0, "action": "revive_osd", "osd": 3},
+    {"at": 140.0, "action": "drop", "src": "osd.*", "dst": "osd.*",
+     "p": 0.02, "types": ["Ping"], "label": "ping-loss"},
+    {"at": 200.0, "action": "heal", "target": "ping-loss"},
+]
+
+
+def run_once() -> dict:
+    c = MiniCluster(n_osd=N_OSD, threaded=False, n_mon=N_MON,
+                    fault_seed=FAULT_SEED)
+    try:
+        c.pump()
+        c.wait_all_up()
+        return ChaosRunner(c, SCHEDULE, rados=c.rados(),
+                           seed=RUNNER_SEED).run()
+    finally:
+        c.shutdown()
+
+
+def main() -> int:
+    rep1 = run_once()
+    if not (rep1["acked"] == rep1["ops_total"] > 0):
+        print(f"chaos smoke: FAIL — {rep1['acked']}/{rep1['ops_total']}"
+              " writes acked", file=sys.stderr)
+        return 1
+    if rep1["fault_counts"].get("partition", 0) <= 0:
+        print("chaos smoke: FAIL — the partition never bit",
+              file=sys.stderr)
+        return 1
+
+    rep2 = run_once()
+    if rep2["fault_digest"] != rep1["fault_digest"] or \
+            rep2["fault_counts"] != rep1["fault_counts"]:
+        print("chaos smoke: FAIL — replay diverged from seed "
+              f"{FAULT_SEED}:\n  run1 {rep1['fault_digest']} "
+              f"{rep1['fault_counts']}\n  run2 {rep2['fault_digest']} "
+              f"{rep2['fault_counts']}", file=sys.stderr)
+        return 1
+
+    out = {
+        "smoke": "chaos",
+        "fault_seed": FAULT_SEED,
+        "runner_seed": RUNNER_SEED,
+        "n_osd": N_OSD,
+        "n_mon": N_MON,
+        "schedule": SCHEDULE,
+        "fault_digest": rep1["fault_digest"],
+        "fault_counts": rep1["fault_counts"],
+        "ops_total": rep1["ops_total"],
+        "acked": rep1["acked"],
+        "phases": rep1["phases"],
+    }
+    path = pathlib.Path(__file__).resolve().parent.parent / \
+        "CHAOS_r01.json"
+    path.write_text(json.dumps(out, indent=1, sort_keys=True) + "\n")
+    worst = max((p["p99_ms"] for p in rep1["phases"]), default=0.0)
+    print(f"chaos smoke: OK — {rep1['acked']}/{rep1['ops_total']} "
+          f"writes acked+verified, faults {rep1['fault_counts']}, "
+          f"digest replayed, worst phase p99 {worst:.1f} ms "
+          f"-> {path.name}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

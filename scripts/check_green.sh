@@ -170,6 +170,24 @@ run_recovery_smoke() {
     return 0
 }
 
+# Chaos smoke: scripts/chaos_smoke.py drives the elector-regression
+# schedule (mon-minority partition + OSD flap + seeded Ping loss)
+# under live IO through ChaosRunner, twice, and asserts the cluster
+# invariants hold AND the fault-log digest replays byte-identically
+# from the seed — the fault-injection half of the gate.
+run_chaos_smoke() {
+    echo "=== check_green: chaos smoke ==="
+    timeout -k 10 180 env JAX_PLATFORMS=cpu \
+        python scripts/chaos_smoke.py
+    local rc=$?
+    if [ "$rc" -ne 0 ]; then
+        echo "check_green: RED (chaos smoke rc=$rc — invariants or" \
+             "fault replay broken) — do not ship" >&2
+        return 1
+    fi
+    return 0
+}
+
 run_static || exit 1
 if [ "$STATIC_ONLY" -eq 1 ]; then
     echo "check_green: GREEN (static only)"
@@ -181,10 +199,12 @@ run_crash_smoke || exit 1
 run_multisite_smoke || exit 1
 run_trace_smoke || exit 1
 run_recovery_smoke || exit 1
+run_chaos_smoke || exit 1
 
 if [ "$REPEAT" -gt 1 ] && [ ${#TARGETS[@]} -eq 0 ]; then
     TARGETS=(tests/test_thrasher.py tests/test_thrash_ec.py \
-             tests/test_snaptrim.py tests/test_rgw_multisite.py)
+             tests/test_snaptrim.py tests/test_rgw_multisite.py \
+             tests/test_chaos.py)
 fi
 if [ ${#TARGETS[@]} -eq 0 ]; then
     TARGETS=(tests/)

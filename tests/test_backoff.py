@@ -1,0 +1,77 @@
+"""common/backoff: the shared capped-exponential retry policy
+(extracted from the RGW SyncAgent; now also paces MonClient hunting,
+mon elections, objecter/MDS-client retries)."""
+import random
+
+import pytest
+
+from ceph_tpu.common.backoff import Backoff, full_jitter
+
+
+def test_delay_doubles_to_cap():
+    b = Backoff(base_s=0.1, cap_s=1.0, jitter=False)
+    assert [round(b.next_delay(), 3) for _ in range(6)] == \
+        [0.1, 0.2, 0.4, 0.8, 1.0, 1.0]
+    assert b.failures == 6
+
+
+def test_reset_restarts_at_base():
+    b = Backoff(base_s=0.1, cap_s=5.0, jitter=False)
+    for _ in range(4):
+        b.next_delay()
+    b.reset()
+    assert b.failures == 0
+    assert b.next_delay() == pytest.approx(0.1)
+
+
+def test_jitter_spreads_over_half_to_threehalves():
+    rng = random.Random(7)
+    b = Backoff(base_s=1.0, cap_s=1.0, jitter=True, rng=rng)
+    draws = [b.next_delay() for _ in range(200)]
+    assert all(0.5 <= d < 1.5 for d in draws)
+    assert max(draws) - min(draws) > 0.5      # actually spread out
+
+
+def test_full_jitter_seeded_stream_is_deterministic():
+    a = [full_jitter(2.0, random.Random(3)) for _ in range(3)]
+    b = [full_jitter(2.0, random.Random(3)) for _ in range(3)]
+    assert a == b
+    assert all(1.0 <= x < 3.0 for x in a)
+
+
+def test_deadline_form_on_a_fake_clock():
+    t = [100.0]
+    b = Backoff(base_s=1.0, cap_s=8.0, jitter=False,
+                clock=lambda: t[0])
+    assert b.ready()                 # never failed: go
+    assert b.fail() == 1.0
+    assert not b.ready()
+    t[0] += 0.5
+    assert not b.ready()
+    t[0] += 0.6
+    assert b.ready()
+    # explicit-now form (simulated-time mon ticks)
+    assert b.fail(now=200.0) == 2.0
+    assert not b.ready(now=201.0)
+    assert b.ready(now=202.0)
+    b.reset()
+    assert b.ready(now=0.0)          # reset rearms immediately
+
+
+def test_bad_bounds_rejected():
+    with pytest.raises(ValueError):
+        Backoff(base_s=0.0, cap_s=1.0)
+    with pytest.raises(ValueError):
+        Backoff(base_s=2.0, cap_s=1.0)
+
+
+def test_sync_agent_uses_shared_backoff():
+    """The policy's birthplace now consumes the shared class (the
+    extraction satellite): per-source Backoff instances, cap/base from
+    the agent's own knobs."""
+    from ceph_tpu.rgw.multisite import SyncAgent
+    assert SyncAgent.BACKOFF_BASE_S == pytest.approx(0.1)
+    assert SyncAgent.BACKOFF_CAP_S == pytest.approx(5.0)
+    import inspect
+    src = inspect.getsource(SyncAgent.tick)
+    assert "Backoff(" in src and "bo.fail(" in src

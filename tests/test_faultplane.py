@@ -1,0 +1,242 @@
+"""FaultPlane: deterministic link-level fault injection (drop /
+partition / delay / reorder / dup), its LocalNetwork wiring, and the
+drops ledger + perf export (ref: ms_inject_socket_failures and the qa
+netem partition helpers, unified; ISSUE 17)."""
+import time
+
+import pytest
+
+from ceph_tpu.common.options import global_config
+from ceph_tpu.msg import LocalNetwork, Messenger
+from ceph_tpu.msg.messages import Ping
+
+
+class Msg:
+    """Minimal message for raw-plane tests."""
+    def __init__(self, n=0, type_name="X"):
+        self.n = n
+        self.type_name = type_name
+
+    def __repr__(self):
+        return f"Msg({self.n})"
+
+
+def plane(seed=0, clock=None):
+    from ceph_tpu.msg.faults import FaultPlane
+    return FaultPlane(seed=seed) if clock is None \
+        else FaultPlane(seed=seed, clock=clock)
+
+
+def drive(p, n=30, src="a", dst="b", type_name="X"):
+    got = []
+    for i in range(n):
+        p.intercept(src, dst, Msg(i, type_name),
+                    lambda s, d, m: got.append(m.n))
+    return got
+
+
+# ---------------------------------------------------------- determinism
+def test_same_seed_same_fault_sequence_and_digest():
+    runs = []
+    for _ in range(2):
+        p = plane(seed=42)
+        p.add_rule("a", "b", drop=0.4)
+        runs.append((drive(p), p.digest()))
+    assert runs[0] == runs[1]
+    # a different seed draws a different stream
+    p = plane(seed=43)
+    p.add_rule("a", "b", drop=0.4)
+    assert (drive(p), p.digest()) != runs[0]
+
+
+def test_digest_insensitive_to_cross_link_interleaving():
+    """Traffic order ACROSS links must not perturb the digest — only
+    each link's own sequence matters (real-time timers elsewhere in
+    the cluster cannot break replay)."""
+    pa = plane(seed=1)
+    pa.add_rule("*", "*", drop=0.3)
+    pb = plane(seed=1)
+    pb.add_rule("*", "*", drop=0.3)
+    # run A: all of link1 then all of link2; run B: interleaved
+    for i in range(10):
+        pa.intercept("x", "y", Msg(i), lambda *a: None)
+    for i in range(10):
+        pa.intercept("y", "x", Msg(i), lambda *a: None)
+    for i in range(10):
+        pb.intercept("x", "y", Msg(i), lambda *a: None)
+        pb.intercept("y", "x", Msg(i), lambda *a: None)
+    assert pa.digest() == pb.digest()
+
+
+def test_probabilistic_drop_produces_bursts():
+    """The old 1-in-N modulus could never drop two consecutive
+    messages; the seeded probability draw can."""
+    p = plane(seed=0)
+    p.add_rule("a", "b", drop=0.5)
+    delivered = set(drive(p, 100))
+    gaps = [i for i in range(99)
+            if i not in delivered and i + 1 not in delivered]
+    assert gaps                      # at least one 2-message burst
+
+
+# ------------------------------------------------------------ partition
+def test_asymmetric_partition_is_one_directional():
+    p = plane()
+    p.partition(["a"], ["b"], symmetric=False)
+    assert drive(p, 5, "a", "b") == []           # a -> b black-holed
+    assert drive(p, 5, "b", "a") == [0, 1, 2, 3, 4]  # reverse flows
+    assert p.counts["partition"] == 5
+
+
+def test_heal_restores_and_releases_held():
+    t = [100.0]
+    p = plane(clock=lambda: t[0])
+    ids = p.partition(["a"], ["b"])
+    rid = p.add_rule("c", "d", delay=5.0)
+    held = []
+    p.intercept("c", "d", Msg(7), lambda s, d, m: held.append(m.n))
+    assert held == [] and p.pending() == 1
+    p.deliver_cb = lambda s, d, m: held.append(m.n)
+    p.heal(ids + [rid])              # targeted heal flushes the hold
+    assert held == [7] and p.pending() == 0
+    assert drive(p, 2, "a", "b") == [0, 1]
+    assert not p.rules()
+
+
+def test_isolate_cuts_both_directions():
+    p = plane()
+    p.isolate("osd.3")
+    assert drive(p, 3, "osd.3", "mon.0") == []
+    assert drive(p, 3, "mon.0", "osd.3") == []
+    assert drive(p, 3, "osd.1", "mon.0") == [0, 1, 2]
+
+
+# --------------------------------------------------------- delay/reorder
+def test_delay_holds_until_clock_passes():
+    t = [50.0]
+    p = plane(clock=lambda: t[0])
+    p.add_rule("a", "b", delay=2.0)
+    got = []
+    deliver = lambda s, d, m: got.append(m.n)   # noqa: E731
+    p.intercept("a", "b", Msg(1), deliver)
+    assert got == [] and p.pending() == 1
+    assert p.flush(deliver) == 0                # too early
+    t[0] = 52.5
+    assert p.flush(deliver) == 1
+    assert got == [1]
+
+
+def test_jittered_delay_is_seeded():
+    for _ in range(2):
+        t = [0.0]
+        p = plane(seed=9, clock=lambda: t[0])
+        p.add_rule("a", "b", delay=1.0, jitter=1.0)
+        p.intercept("a", "b", Msg(0), lambda *a: None)
+    # the drawn delay rides the digest (recorded to 6dp)
+    d1 = p.digest()
+    t = [0.0]
+    p2 = plane(seed=9, clock=lambda: t[0])
+    p2.add_rule("a", "b", delay=1.0, jitter=1.0)
+    p2.intercept("a", "b", Msg(0), lambda *a: None)
+    assert p2.digest() == d1
+
+
+def test_reorder_window_releases_shuffled_deterministically():
+    def run():
+        p = plane(seed=5)
+        p.add_rule("a", "b", reorder=4)
+        return drive(p, 8), p.digest()
+    (order1, d1), (order2, d2) = run(), run()
+    assert order1 == order2 and d1 == d2
+    assert sorted(order1) == list(range(8))     # nothing lost
+    assert order1 != list(range(8))             # actually shuffled
+
+
+def test_partial_reorder_window_latches_out():
+    t = [10.0]
+    p = plane(seed=5, clock=lambda: t[0])
+    p.add_rule("a", "b", reorder=10)
+    got = drive(p, 3)
+    assert got == [] and p.pending() == 3
+    t[0] += 1.0                                 # past REORDER_LATCH_S
+    released = []
+    p.flush(lambda s, d, m: released.append(m.n))
+    assert sorted(released) == [0, 1, 2]
+
+
+def test_dup_delivers_twice():
+    p = plane(seed=0)
+    p.add_rule("a", "b", dup=1.0)
+    assert drive(p, 3) == [0, 0, 1, 1, 2, 2]
+    assert p.counts["dup"] == 3
+
+
+def test_type_filter_scopes_the_rule():
+    p = plane()
+    p.partition(["a"], ["b"], symmetric=False, types=("Ping",))
+    assert drive(p, 2, type_name="Ping") == []
+    assert drive(p, 2, type_name="MOSDOp") == [0, 1]
+
+
+# ----------------------------------------------------- network wiring
+def test_localnetwork_drop_ring_bounded_total_monotonic():
+    from ceph_tpu.msg.messenger import DROP_RING
+    cfg = global_config()
+    net = LocalNetwork()
+    a = Messenger.create(net, "a", "local", threaded=False)
+    Messenger.create(net, "b", "local", threaded=False)
+    try:
+        cfg.set("ms_inject_socket_failures", 1)   # p=1: drop all
+        n = DROP_RING + 50
+        for i in range(n):
+            a.connect("b").send_message(Ping(epoch=i))
+        assert net.drops_total == n               # exact, monotonic
+        assert len(net.dropped) == DROP_RING      # ring bounded
+    finally:
+        cfg.set("ms_inject_socket_failures", 0)
+
+
+def test_partition_is_silent_no_resets():
+    """Partitions black-hole without handle_reset — detection must be
+    timeout-driven, like a real netsplit (shim drops DO reset)."""
+    net = LocalNetwork()
+    a = Messenger.create(net, "a", "local", threaded=False)
+    b = Messenger.create(net, "b", "local", threaded=False)
+    resets = []
+    class D:
+        def ms_dispatch(self, m): return True
+        def ms_handle_reset(self, peer): resets.append(peer)
+    a.add_dispatcher(D())
+    b.add_dispatcher(D())
+    net.faults.partition(["a"], ["b"])
+    assert a.connect("b").send_message(Ping()) is False
+    assert resets == []
+    assert net.drops_total == 1
+
+
+def test_drops_total_exported_through_perf_dump():
+    """satellite (a): the fabric's drop ledger rides the OSD perf
+    counters up to the mon's `osd perf dump`."""
+    from ceph_tpu.testing import MiniCluster
+    c = MiniCluster(n_osd=3, threaded=False)
+    c.pump()
+    c.wait_all_up()
+    try:
+        # heartbeat peers come from PG membership: need a pool
+        r = c.rados()
+        r.pool_create("p", pg_num=8)
+        c.pump()
+        rid = c.network.faults.add_rule(
+            "osd.*", "osd.*", drop=1.0, types=("Ping",))
+        now = 50_000.0
+        c.tick(now)                     # heartbeats -> dropped pings
+        c.network.faults.heal([rid])
+        assert c.network.drops_total > 0
+        now += 11.0
+        c.tick(now)                     # pg-stats report carries perf
+        rc, _, out = c.mon.handle_command({"prefix": "osd perf dump"})
+        assert rc == 0
+        vals = [r.get("msgr_drops_total") for r in out.values()]
+        assert any(v == c.network.drops_total for v in vals), out
+    finally:
+        c.shutdown()

@@ -91,20 +91,36 @@ def test_duplicate_bind_rejected():
 
 
 def test_inject_socket_failures_drops():
+    """ms_inject_socket_failures=N is a compat shim over the
+    FaultPlane: a seeded 1/N drop probability per message, not the
+    old every-Nth modulus — assert consistency + determinism rather
+    than an exact count."""
     cfg = global_config()
-    net = LocalNetwork()
-    a = Messenger.create(net, "a", "local", threaded=False)
-    b = Messenger.create(net, "b", "local", threaded=False)
-    cb = Collector()
-    b.add_dispatcher(cb)
-    try:
-        cfg.set("ms_inject_socket_failures", 3)   # drop every 3rd
+
+    def run():
+        net = LocalNetwork()
+        a = Messenger.create(net, "a", "local", threaded=False)
+        b = Messenger.create(net, "b", "local", threaded=False)
+        cb = Collector()
+        b.add_dispatcher(cb)
         sent = [a.connect("b").send_message(Ping(epoch=i))
-                for i in range(9)]
-        assert sent.count(False) == 3
-        assert len(net.dropped) == 3
+                for i in range(60)]
         b.poll()
-        assert len(cb.msgs) == 6
+        return sent, net, cb
+
+    try:
+        cfg.set("ms_inject_socket_failures", 3)   # p = 1/3 per message
+        sent, net, cb = run()
+        dropped = sent.count(False)
+        assert 0 < dropped < 60            # some but not all
+        assert dropped == len(net.dropped) == net.drops_total
+        assert dropped + len(cb.msgs) == 60
+        # drops signal resets both ways (legacy shim semantics)
+        assert len(net.dropped) == len(
+            [p for p in cb.resets if p == "a"]) > 0
+        # same seed -> byte-identical drop pattern on a fresh network
+        sent2, _, _ = run()
+        assert sent2 == sent
     finally:
         cfg.set("ms_inject_socket_failures", 0)
 

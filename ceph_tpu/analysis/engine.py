@@ -307,6 +307,59 @@ class Engine:
 
 # ----------------------------------------------------------------- CLI
 
+def sarif_report(rules, findings, errors=(), stale=()) -> dict:
+    """SARIF 2.1.0 log for code-scanning uploads (the github format
+    annotates the diff; SARIF populates the Security/Code-scanning
+    tab and survives as an artifact).  String escaping is json.dumps's
+    job — messages with quotes, newlines or %-sequences must round-
+    trip verbatim (asserted by tests/test_cephck.py)."""
+    fired = {f.rule for f in findings}
+    driver_rules = [{
+        "id": r.id,
+        "shortDescription": {
+            "text": (r.doc or r.id).strip().splitlines()[0]},
+        "fullDescription": {"text": (r.doc or r.id).strip()},
+    } for r in rules if r.id in fired]
+    index = {dr["id"]: i for i, dr in enumerate(driver_rules)}
+    results = [{
+        "ruleId": f.rule,
+        "ruleIndex": index[f.rule],
+        "level": "error",
+        "message": {"text": f.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": f.path,
+                                     "uriBaseId": "SRCROOT"},
+                "region": {"startLine": f.line},
+            },
+        }],
+    } for f in findings]
+    notifications = [
+        {"level": "error", "message": {"text": e}} for e in errors
+    ] + [
+        {"level": "error",
+         "message": {"text": f"stale suppression ({s.rule} @ {s.path})"
+                             f" no longer matches any finding"}}
+        for s in stale
+    ]
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "cephck",
+                "rules": driver_rules,
+            }},
+            "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+            "invocations": [{
+                "executionSuccessful": not (errors or stale),
+                "toolExecutionNotifications": notifications,
+            }],
+            "results": results,
+        }],
+    }
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m ceph_tpu.analysis",
@@ -332,10 +385,11 @@ def main(argv: list[str] | None = None) -> int:
                     help="machine-readable findings on stdout "
                          "(alias for --format json)")
     ap.add_argument("--format", default=None, dest="fmt",
-                    choices=("text", "json", "github"),
+                    choices=("text", "json", "github", "sarif"),
                     help="findings output: text (default), json "
-                         "(one machine-readable document), or github "
-                         "(::error workflow annotations for CI)")
+                         "(one machine-readable document), github "
+                         "(::error workflow annotations for CI), or "
+                         "sarif (2.1.0 log for code-scanning uploads)")
     ap.add_argument("--list-rules", action="store_true",
                     help="print every rule id + one-line summary")
     ap.add_argument("--explain", metavar="RULE",
@@ -399,6 +453,9 @@ def main(argv: list[str] | None = None) -> int:
             "stale": [dataclasses.asdict(s) for s in stale],
             "errors": eng.errors,
         }, indent=1))
+    elif fmt == "sarif":
+        print(json.dumps(sarif_report(rules, eng.findings,
+                                      eng.errors, stale), indent=1))
     elif fmt == "github":
         # GitHub Actions workflow commands: each finding becomes an
         # inline annotation on the PR diff.  Newlines/percent must be

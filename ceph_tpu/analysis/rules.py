@@ -1437,8 +1437,508 @@ add a baseline entry with the reason.
                             symbol=qual)
 
 
+# ===================================================================
+# The error-contract family (errcheck's static half): how failures
+# propagate — or vanish — between `except`, the return value, and the
+# reply a client is waiting on.  Runtime twin: common/errcheck.py
+# (the fired-handler coverage sanitizer; ERRCOV_rNN.json says which of
+# these handlers fault injection has actually reached).
+
+#: the broad spellings; bare `except:` is bare-except's, not ours
+_BROAD_EXC = {"Exception", "BaseException"}
+
+
+def _error_scope(rel: str) -> bool:
+    """Daemon/library code only: tests and scripts sleep-poll and
+    clean up best-effort BY DESIGN, so the error-contract rules skip
+    them.  The fixture corpus stays in scope so the rules can
+    demonstrate themselves."""
+    parts = rel.split("/")
+    if "fixtures" in parts:
+        return True
+    return parts[0] not in ("tests", "scripts", "bench.py")
+
+
+def _broad_handler(node: ast.AST) -> str | None:
+    """'Exception'/'BaseException' when `node` is a handler catching
+    (at least) everything an op can raise."""
+    if isinstance(node, ast.ExceptHandler) and node.type is not None:
+        t = dotted(node.type).split(".")[-1]
+        if t in _BROAD_EXC:
+            return t
+    return None
+
+
+class SwallowedErrorRule:
+    id = "swallowed-error"
+    doc = """
+Broad except handler whose body is only pass/continue/break — the
+failure vanishes without a trace.
+
+`except Exception: pass` is how DataLog.list turned an injected EIO
+into "caught up" and how an undecodable sync marker wedged a sync
+tick forever: the caller branches on a result that no longer says
+anything, and the first observable symptom is minutes away from the
+fault.  This tree has crash capture (common/crash.py), a structured
+logger (common/log.py dout/derr), and a quarantine pattern for
+poison input — a handler that uses NONE of them is hiding a failure,
+not handling it.
+
+Fix: narrow the except to the exceptions this call genuinely expects,
+or keep the broad catch but leave a trace (dout/derr), record the
+error somewhere a caller/supervisor checks, or re-raise what you
+can't own.  For a true don't-care (best-effort cleanup on teardown),
+waive inline with `# cephck: ignore[swallowed-error]` and a reason
+comment, or add a baseline entry with the reason.
+"""
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not _error_scope(ctx.rel):
+            return
+        for node in ast.walk(ctx.tree):
+            t = _broad_handler(node)
+            if t is None:
+                continue
+            if all(isinstance(s, (ast.Pass, ast.Continue, ast.Break))
+                   for s in node.body):
+                yield ctx.finding(
+                    self.id, node,
+                    f"except {t} swallows every failure without "
+                    f"logging, recording, or re-raising — narrow the "
+                    f"except or leave a trace (DataLog "
+                    f"EIO-became-'caught up' class)")
+
+
+def _success_shaped(expr: ast.expr | None) -> str | None:
+    """Spelled-out value when `expr` is a success-shaped constant —
+    the shapes a healthy read path also returns, so the caller cannot
+    tell failure from empty.  Booleans are excluded: False IS an
+    error encoding for predicate paths."""
+    if expr is None:
+        return "None"
+    if isinstance(expr, ast.Constant):
+        v = expr.value
+        if v is None:
+            return "None"
+        if isinstance(v, bool):
+            return None
+        if v == 0 or v == "" or v == b"":
+            return repr(v)
+        return None
+    if isinstance(expr, ast.List) and not expr.elts:
+        return "[]"
+    if isinstance(expr, ast.Tuple) and not expr.elts:
+        return "()"
+    if isinstance(expr, ast.Dict) and not expr.keys:
+        return "{}"
+    if isinstance(expr, ast.Call) and not expr.args and \
+            not expr.keywords and \
+            dotted(expr.func) in ("list", "dict", "set", "tuple"):
+        return f"{dotted(expr.func)}()"
+    return None
+
+
+def _enoent_raise(handler: ast.ExceptHandler) -> ast.Raise | None:
+    """A raise inside `handler` that maps the caught exception to an
+    ENOENT-shaped error (errno 2 / "ENOENT" literal)."""
+    for node in ast.walk(handler):
+        if not (isinstance(node, ast.Raise) and
+                isinstance(node.exc, ast.Call)):
+            continue
+        args = node.exc.args
+        if not args:
+            continue
+        first = args[0]
+        enoentish = (isinstance(first, ast.Constant) and
+                     first.value == 2 and
+                     not isinstance(first.value, bool)) or any(
+            isinstance(a, ast.Constant) and a.value == "ENOENT"
+            for a in args)
+        if enoentish:
+            return node
+    return None
+
+
+class ErrnoConflationRule:
+    id = "errno-conflation"
+    doc = """
+Broad except handler that maps EVERY failure of a read/apply path to
+one success-shaped or ENOENT-shaped result.
+
+Three shapes of the same bug: (a) `except Exception: return []` — an
+injected EIO now reads as "no data" (the DataLog.list class, fixed in
+PR 5 by re-raising non-ENOENT); (b) `except Exception: x = 0` — a
+transient stat failure silently resets a cursor/size to its initial
+value; (c) `except Exception: raise XError(2, ...)` — decode errors,
+EIO, and genuine not-found all become "does not exist", so the caller
+deletes/recreates state that still exists.  In every shape the errno
+dataflow from the fault to the caller is severed at the handler.
+
+Fix: catch the one exception that legitimately means empty/not-found
+(KeyError, the ENOENT RadosError) and let everything else propagate —
+or map exceptions to DISTINCT errnos so the caller can branch.  A
+handler that LOGS before collapsing (dout/derr) is observable and is
+exempt from shapes (a)/(b) — the bug class is silence.  Where the
+collapse is the documented contract, waive inline with
+`# cephck: ignore[errno-conflation]` and a reason comment, or add a
+baseline entry with the reason.
+"""
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not _error_scope(ctx.rel):
+            return
+        parents = ctx.parents()
+
+        def enclosing_fn(node: ast.AST):
+            cur = parents.get(node)
+            while cur is not None and not isinstance(
+                    cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                cur = parents.get(cur)
+            return cur
+
+        for node in ast.walk(ctx.tree):
+            t = _broad_handler(node)
+            if t is None:
+                continue
+            # (c) everything -> ENOENT
+            rz = _enoent_raise(node)
+            if rz is not None:
+                yield ctx.finding(
+                    self.id, rz,
+                    f"except {t} re-raised as an ENOENT-shaped error — "
+                    f"EIO/decode failures become 'does not exist'; "
+                    f"narrow the except or map distinct errnos")
+                continue
+            # (a)/(b) fire only on SILENT collapse — a handler that
+            # logs first is observable
+            if len(node.body) != 1:
+                continue
+            only = node.body[0]
+            # (a) everything -> success-shaped return
+            if isinstance(only, ast.Return):
+                shape = _success_shaped(only.value)
+                fn = enclosing_fn(node)
+                if shape is None or fn is None:
+                    continue
+                real_return = any(
+                    isinstance(r, ast.Return) and r is not only and
+                    r.value is not None and
+                    _success_shaped(r.value) is None
+                    for r in ast.walk(fn))
+                if real_return:
+                    yield ctx.finding(
+                        self.id, only,
+                        f"except {t}: return {shape} — every failure "
+                        f"of {fn.name}() now reads as a successful "
+                        f"empty result (DataLog EIO class); re-raise "
+                        f"what isn't the expected miss")
+            # (b) everything -> success-shaped assignment
+            elif isinstance(only, ast.Assign) and \
+                    len(only.targets) == 1 and \
+                    isinstance(only.targets[0], ast.Name):
+                shape = _success_shaped(only.value)
+                if shape is not None:
+                    yield ctx.finding(
+                        self.id, only,
+                        f"except {t}: {only.targets[0].id} = {shape} — "
+                        f"any failure (including EIO) silently resets "
+                        f"the value to its success-shaped default; "
+                        f"narrow the except or propagate")
+
+
+# ------------------------------------------------- reply-on-all-paths
+
+#: command handlers that must RETURN a (r, outs, outb) result (or
+#: raise) on every path — the caller unpacks the tuple
+_RETURN_CONV = {"handle_command", "_handle_module_command"}
+
+#: HTTP-op methods (RGW/Swift `_*_op` convention): every path must
+#: send a reply, delegate, or raise
+_OP_METHOD = re.compile(r"^_[a-z0-9_]+_op$")
+
+#: call names that ARE the reply
+_REPLYISH = {"_respond", "respond", "send_reply", "send_error",
+             "reply_cb"}
+
+_RESOLVED, _OPEN = "resolved", "open"
+
+
+def _reply_call(node: ast.Call) -> bool:
+    last = (dotted(node.func) or "").split(".")[-1]
+    return last in _REPLYISH or bool(_OP_METHOD.match(last))
+
+
+class _PathScan:
+    """Conservative all-paths walk over a handler body.  Tracks, per
+    path, whether a reply has been sent; collects findings at returns
+    that end a path unanswered.  `block` returns (_RESOLVED if no
+    path can fall out the bottom, else _OPEN, replied-after)."""
+
+    def __init__(self, conv: str):
+        self.conv = conv                    # "return" | "respond"
+        self.findings: list[tuple[ast.AST, str]] = []
+
+    def block(self, stmts, replied: bool):
+        for st in stmts:
+            status, replied = self.stmt(st, replied)
+            if status is _RESOLVED:
+                return _RESOLVED, replied
+        return _OPEN, replied
+
+    def _branches(self, replied, *blocks, fallthrough: bool):
+        """If/Match combinator: every branch resolved (and no silent
+        fallthrough) resolves the statement; else the open paths'
+        replied states AND together."""
+        outs = []
+        for b in blocks:
+            s, r = self.block(b, replied)
+            if s is _OPEN:
+                outs.append(r)
+        if fallthrough:
+            outs.append(replied)
+        if not outs:
+            return _RESOLVED, replied
+        return _OPEN, all(outs)
+
+    def stmt(self, st: ast.stmt, replied: bool):
+        if isinstance(st, ast.Return):
+            if self.conv == "return":
+                if st.value is None:
+                    self.findings.append((
+                        st, "bare `return` — the caller unpacks a "
+                            "(r, outs, outb) result and gets None "
+                            "(30s-client-hang class)"))
+            else:
+                ok = replied or isinstance(st.value, ast.Call)
+                if not ok:
+                    self.findings.append((
+                        st, "returns without sending a reply on this "
+                            "path — the client waits out its full "
+                            "timeout"))
+            return _RESOLVED, replied
+        if isinstance(st, ast.Raise):
+            return _RESOLVED, replied
+        if isinstance(st, ast.If):
+            return self._branches(
+                replied, st.body, *((st.orelse,) if st.orelse else ()),
+                fallthrough=not st.orelse)
+        if isinstance(st, ast.Match):
+            wild = any(isinstance(c.pattern, ast.MatchAs) and
+                       c.pattern.pattern is None for c in st.cases)
+            return self._branches(
+                replied, *(c.body for c in st.cases),
+                fallthrough=not wild)
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            return self.block(st.body, replied)
+        if isinstance(st, ast.Try):
+            sb, rb = self.block(list(st.body) + list(st.orelse),
+                                replied)
+            outs = [] if sb is _RESOLVED else [rb]
+            for h in st.handlers:
+                sh, rh = self.block(h.body, replied)
+                if sh is _OPEN:
+                    outs.append(rh)
+            entry = all(outs) if outs else True
+            sf, rf = self.block(st.finalbody, entry)
+            if sf is _RESOLVED or not outs:
+                return _RESOLVED, rf
+            return _OPEN, rf
+        if isinstance(st, (ast.For, ast.AsyncFor, ast.While)):
+            # findings inside still count; the loop itself only
+            # guarantees resolution when it can never exit
+            self.block(st.body, replied)
+            self.block(st.orelse, replied)
+            infinite = isinstance(st, ast.While) and \
+                isinstance(st.test, ast.Constant) and \
+                bool(st.test.value) and not any(
+                    isinstance(n, ast.Break)
+                    for n in _loop_body_nodes(st))
+            return (_RESOLVED if infinite else _OPEN), replied
+        # simple statement: a reply call anywhere in it answers the
+        # client for the rest of this path
+        if any(isinstance(n, ast.Call) and _reply_call(n)
+               for n in ast.walk(st)):
+            replied = True
+        return _OPEN, replied
+
+
+def _class_has_respond(cls: ast.ClassDef) -> bool:
+    return any(isinstance(n, ast.Call) and
+               _self_attr(n.func) == "_respond"
+               for n in ast.walk(cls))
+
+
+class ReplyOnAllPathsRule:
+    id = "reply-on-all-paths"
+    doc = """
+Dispatch/command handler with an execution path that never answers.
+
+The PR 4 bug class: a mgr module command path that neither returned a
+result nor raised left the client waiting out its FULL 30s timeout —
+the failure mode is silence, which no log line ever explains.  Two
+conventions are checked: (1) command handlers (handle_command /
+_handle_module_command) must `return` a (r, outs, outb) result or
+raise on every CFG path — a bare `return` or falling off the end
+hands the caller None; (2) RGW/Swift HTTP op methods (`_*_op` in a
+class that replies via self._respond) must send a reply
+(_respond/send_error/...), delegate (`return self._other_op(...)`),
+or raise on every path — an early `return` before any reply leaves
+the HTTP client hanging.
+
+Fix: make the missing branch answer — return an explicit
+(-errno, explanation, None), call self._respond with the right
+status, or raise the typed error the wrapper maps to a reply.  For a
+path that genuinely must not reply (a reply already owned by a
+callee the rule can't see), waive inline with
+`# cephck: ignore[reply-on-all-paths]` and a reason comment, or add
+a baseline entry with the reason.
+"""
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not _error_scope(ctx.rel):
+            return
+        parents = ctx.parents()
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            if fn.name in _RETURN_CONV:
+                conv = "return"
+            elif _OP_METHOD.match(fn.name) and isinstance(
+                    parents.get(fn), ast.ClassDef) and \
+                    _class_has_respond(parents[fn]):
+                conv = "respond"
+            else:
+                continue
+            scan = _PathScan(conv)
+            status, replied = scan.block(fn.body, False)
+            for node, msg in scan.findings:
+                yield ctx.finding(self.id, node,
+                                  f"{fn.name}(): {msg}",
+                                  symbol=ctx.qualname(fn))
+            if status is _OPEN:
+                if conv == "return":
+                    yield ctx.finding(
+                        self.id, fn,
+                        f"{fn.name}() can fall off the end without "
+                        f"returning a (r, outs, outb) result — the "
+                        f"caller unpacks None (30s-client-hang "
+                        f"class)", symbol=ctx.qualname(fn))
+                elif not replied:
+                    yield ctx.finding(
+                        self.id, fn,
+                        f"{fn.name}() has a path that falls off the "
+                        f"end without sending a reply — the HTTP "
+                        f"client waits out its full timeout",
+                        symbol=ctx.qualname(fn))
+
+
+class BareRetryRule:
+    id = "bare-retry"
+    doc = """
+Retry loop pacing itself with raw time.sleep / hand-rolled delay
+math instead of common/backoff.Backoff.
+
+PR 17 unified retry pacing for a reason: fixed-delay retries
+synchronize (every client re-hits the dead mon on the same beat),
+hand-rolled `delay *= 2` forgets the cap or the jitter, and none of
+it is clock-injectable for tests.  Backoff(base_s, cap_s) gives
+capped exponential full-jitter pacing (AWS-architecture shape), a
+fail()/ready() non-blocking form for tick loops, and deterministic
+tests via rng/clock injection.
+
+The rule fires on (a) a time.sleep inside an except handler inside a
+loop — the classic catch-sleep-retry shape — and (b) a loop that
+sleeps on a delay variable it multiplies/exponentiates itself.
+Fixed-interval tick/poll pacing (sleep in the loop body, no handler
+involvement) is not a retry and is not flagged; sleeps driven by a
+Backoff (.next_delay()/.sleep()) are the fix, never flagged.
+
+Fix: hoist a Backoff(base_s=..., cap_s=...) out of the loop, call
+.sleep() where the raw sleep was, and .reset() on success.
+"""
+
+    def _is_time_sleep(self, node: ast.Call, mod) -> bool:
+        name = dotted(node.func)
+        if not name:
+            return False
+        canon = mod.expand(name) if mod else name
+        if canon != "time.sleep" and name != "time.sleep" and \
+                not (mod is None and name == "sleep"):
+            return False
+        # a Backoff-derived delay is the sanctioned spelling
+        return not any(
+            isinstance(n, ast.Call) and
+            dotted(n.func).split(".")[-1] == "next_delay"
+            for a in node.args for n in ast.walk(a))
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not _error_scope(ctx.rel) or \
+                ctx.rel.endswith("common/backoff.py"):
+            return
+        mod = ctx.module()
+        parents = ctx.parents()
+
+        def inside(node: ast.AST, kinds) -> ast.AST | None:
+            cur = parents.get(node)
+            while cur is not None and not isinstance(
+                    cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Module)):
+                if isinstance(cur, kinds):
+                    return cur
+                cur = parents.get(cur)
+            return None
+
+        flagged: set[ast.AST] = set()
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and
+                    self._is_time_sleep(node, mod)):
+                continue
+            loop = inside(node, (ast.For, ast.AsyncFor, ast.While))
+            if loop is None:
+                continue
+            # (a) sleep inside an except handler inside the loop
+            handler = inside(node, ast.ExceptHandler)
+            if handler is not None and node not in flagged:
+                flagged.add(node)
+                yield ctx.finding(
+                    self.id, node,
+                    f"catch-sleep-retry loop paced by raw "
+                    f"time.sleep — use common.backoff.Backoff "
+                    f"(capped exponential, jittered, "
+                    f"clock-injectable) and .reset() on success")
+                continue
+            # (b) sleep(delay) where the loop multiplies delay itself
+            arg = node.args[0] if node.args else None
+            if not isinstance(arg, ast.Name):
+                continue
+            grows = any(
+                (isinstance(n, ast.AugAssign) and
+                 isinstance(n.target, ast.Name) and
+                 n.target.id == arg.id and
+                 isinstance(n.op, (ast.Mult, ast.Pow))) or
+                (isinstance(n, ast.Assign) and
+                 any(isinstance(t, ast.Name) and t.id == arg.id
+                     for t in n.targets) and
+                 any(isinstance(b, ast.BinOp) and
+                     isinstance(b.op, (ast.Mult, ast.Pow))
+                     for b in ast.walk(n.value)))
+                for n in _loop_body_nodes(loop)
+                if isinstance(n, (ast.AugAssign, ast.Assign)))
+            if grows and node not in flagged:
+                flagged.add(node)
+                yield ctx.finding(
+                    self.id, node,
+                    f"hand-rolled exponential delay ({arg.id!r} "
+                    f"multiplied in-loop) — common.backoff.Backoff "
+                    f"already does capped full-jitter pacing; "
+                    f"hand-rolled math forgets the cap or the jitter")
+
+
 ALL_RULES = [RawLockRule, WireSchemaRule, UnregisteredMessageRule,
              TxnAtomicityRule, SilentThreadRule, JaxTimingRule,
              JitStaticRule, BareExceptRule, HostSyncHotPathRule,
              JitRetraceChurnRule, TracerLeakRule, ImplicitTransferRule,
-             GuardedByRule, BlockingInDispatchRule]
+             GuardedByRule, BlockingInDispatchRule,
+             SwallowedErrorRule, ErrnoConflationRule,
+             ReplyOnAllPathsRule, BareRetryRule]

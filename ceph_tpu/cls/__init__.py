@@ -15,7 +15,8 @@ reference folds cls writes into the op's ObjectStore transaction.
 
 Built-in classes mirror the reference's most-used plugins:
 `lock` (src/cls/lock), `refcount` (src/cls/refcount),
-`version` (src/cls/version), `log` (src/cls/log).
+`version` (src/cls/version), `log` (src/cls/log),
+`numops` (src/cls/numops — atomic omap counter arithmetic).
 
 Exec is limited to replicated pools (the data reads a method may issue
 are synchronous primary-local reads; EC pools would need a
@@ -137,7 +138,8 @@ class ClassHandler:
     """Singleton method registry (ref: src/osd/ClassHandler.cc —
     open_class/dlopen replaced by lazy import of built-in modules)."""
 
-    _BUILTIN = ("lock", "refcount", "version", "rgw", "queue", "log")
+    _BUILTIN = ("lock", "refcount", "version", "rgw", "queue", "log",
+                "numops")
 
     def __init__(self):
         self._methods: dict[tuple[str, str], tuple[int, Callable]] = {}

@@ -177,8 +177,8 @@ class _GuardedJit:
     def _cache_size(self):
         try:
             return self._fn._cache_size()
-        except Exception:
-            return None
+        except (AttributeError, TypeError):
+            return None     # jax build without the cache-size probe
 
     def __call__(self, *args, **kwargs):
         site = self._site

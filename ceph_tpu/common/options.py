@@ -293,6 +293,14 @@ OPTIONS: dict[str, Option] = {opt.name: opt for opt in [
             "jax.transfer_guard around the EC/placement dispatch; "
             "read when jaxguard.enable_if_configured() runs, so set "
             "it before jit wrappers are built (see common/jaxguard.py)"),
+    _o("errcheck", T.BOOL, False, L.DEV,
+       desc="error-path coverage sanitizer: an import hook recompiles "
+            "instrumented packages with a counter bump at the top of "
+            "every except handler, so coverage_report() can list the "
+            "handlers no test or chaos run has ever entered; read "
+            "when errcheck.enable_if_configured() runs — arm it "
+            "before the modules you want counted import (see "
+            "common/errcheck.py)"),
     _o("osd_debug_inject_dispatch_delay_probability", T.FLOAT, 0.0,
        L.DEV, min=0.0, max=1.0, runtime=True),
     _o("objectstore_debug_inject_read_err", T.BOOL, False, L.DEV,

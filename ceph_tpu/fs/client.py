@@ -77,8 +77,9 @@ class _MDSSession(Dispatcher):
         try:
             self.ms.connect(rados.objecter.mon).send_message(
                 MMonSubscribe(what="fsmap"))
-        except Exception:      # noqa: BLE001 — monless harness
-            pass
+        except Exception as ex:    # noqa: BLE001 — monless harness
+            dout("client", 10).write("fsmap subscribe skipped "
+                                     "(monless harness?): %s", ex)
         # cap messages (revoke/snapc) run sync RADOS IO whose replies
         # ride the dispatch thread, so they must be offloaded — but
         # ordered PER INO, not a thread per message: two snapc

@@ -170,8 +170,9 @@ class SnapMapper:
                 continue
             try:
                 covers = wire.decode(v)
-            except Exception:
-                covers = None
+            except (wire.WireError, IndexError):
+                covers = None   # undecodable entry shows as unknown;
+                # anything else (a programming error) propagates
             out.append({"snap": p[0], "clone": p[1], "oid": p[2],
                         "covers": covers})
         return out

@@ -980,7 +980,7 @@ class Image:
             try:
                 self.ioctx.unwatch(header_name(self.name),
                                    self._watch_cookie)
-            except Exception:      # best-effort: peer may be gone
+            except RadosError:     # best-effort: peer may be gone
                 pass
             self._watch_cookie = None
         if self._parent_image is not None:

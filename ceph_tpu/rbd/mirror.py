@@ -27,6 +27,7 @@ import json
 import uuid
 
 from ..journal import Journaler, data_obj
+from ..client.rados import RadosError
 from .image import RBD, Image, RBDError, data_name, header_name
 
 
@@ -40,8 +41,10 @@ def _head_pos(j: Journaler) -> tuple[int, int]:
     _first, active = j._range()
     try:
         size = j.io.stat(data_obj(j.jid, active))["size"]
-    except Exception:
-        size = 0
+    except RadosError as ex:
+        if ex.errno_name != "ENOENT":
+            raise       # an EIO here must NOT read as "caught up"
+        size = 0        # head object not written yet: genuinely empty
     return (active, size)
 
 
@@ -54,9 +57,18 @@ class SplitBrainError(RBDError):
 
 def _load_meta(ioctx, name: str) -> dict:
     try:
-        return json.loads(ioctx.read(header_name(name)).decode())
-    except Exception as ex:
+        raw = ioctx.read(header_name(name))
+    except RadosError as ex:
+        if ex.errno_name != "ENOENT":
+            raise       # EIO keeps its errno — only a true miss maps
         raise RBDError(2, f"image {name!r} does not exist") from ex
+    try:
+        return json.loads(raw.decode())
+    except ValueError as ex:
+        # a corrupt header is NOT "does not exist": callers that
+        # recreate on ENOENT would overwrite a live (damaged) image
+        raise RBDError(5, f"image {name!r}: undecodable metadata "
+                          f"header") from ex
 
 
 def _store_meta(ioctx, name: str, meta: dict) -> None:
@@ -291,8 +303,8 @@ class ImageMirror:
                 for objno in range(span):
                     try:
                         self.dst.remove(data_name(self.name, objno))
-                    except Exception:
-                        pass
+                    except RadosError:
+                        pass    # best-effort: object may not exist
                 j = Journaler(self.dst, journal_id(self.name), "rs")
                 if j.exists():
                     j.remove()
@@ -304,12 +316,12 @@ class ImageMirror:
                             for s in snap_ids]):
                     try:
                         self.dst.remove(om)
-                    except Exception:
-                        pass
+                    except RadosError:
+                        pass    # best-effort: map may not exist
                 try:
                     self.dst.remove(header_name(self.name))
-                except Exception:
-                    pass
+                except RadosError:
+                    pass        # best-effort: header may not exist
             RBD().create(self.dst, self.name, size=src_img.size,
                          order=src_img.order)
             dst = Image(self.dst, self.name)

@@ -203,8 +203,14 @@ def _serve(tick, interval: float) -> None:
 
 
 def main(argv=None) -> int:
-    # the env layer propagates CEPH_TPU_JAXGUARD from the parent
-    # (tests/conftest.py) to subprocess daemons, same as lockdep —
+    # the env layer propagates CEPH_TPU_ERRCHECK from the parent
+    # (tests/conftest.py or the errcov smoke) — arm the error-path
+    # coverage hook FIRST so run_mon/run_osd's daemon imports are
+    # instrumented; with CEPH_TPU_ERRCHECK_DIR set this process dumps
+    # its handler counters there at exit for the parent to merge
+    from ..common import errcheck
+    errcheck.enable_if_configured()
+    # ... CEPH_TPU_JAXGUARD the same way, same as lockdep —
     # arm BEFORE daemon imports build any jit wrapper
     from ..common import jaxguard
     jaxguard.enable_if_configured()

@@ -381,11 +381,12 @@ def _bench(r, a, out):
     print(f"Max latency(s):         {max(lat):.6f}", file=out)
     print(f"Min latency(s):         {min(lat):.6f}", file=out)
     if a.mode == "write" and not a.no_cleanup:
+        from ..client import RadosError
         for j in range(i):
             try:
                 io.remove(prefix + str(j))
-            except Exception:
-                pass
+            except RadosError:
+                pass            # best-effort cleanup of bench objects
     return 0
 
 

@@ -102,6 +102,25 @@ run_racecheck_smoke() {
     return 0
 }
 
+# errcov smoke: errcheck (the error-path coverage sanitizer) drives a
+# faulted mini workload — injected EC shard EIO, cls EINVALs, a
+# FaultPlane drop window, an OSD flap — asserts the known error
+# handlers actually fire, regenerates ERRCOV_r01.json, and ratchets
+# the never-fired handler count against the committed artifact:
+# error paths may only GAIN coverage (see ceph_tpu/common/errcheck.py).
+run_errcov_smoke() {
+    echo "=== check_green: errcov smoke ==="
+    timeout -k 10 180 env JAX_PLATFORMS=cpu \
+        python scripts/errcov_smoke.py
+    local rc=$?
+    if [ "$rc" -ne 0 ]; then
+        echo "check_green: RED (errcov smoke rc=$rc — error-path" \
+             "coverage regressed or sanitizer broken) — do not ship" >&2
+        return 1
+    fi
+    return 0
+}
+
 run_crash_smoke() {
     echo "=== check_green: crash-capture smoke ==="
     timeout -k 10 180 env JAX_PLATFORMS=cpu \
@@ -195,6 +214,7 @@ if [ "$STATIC_ONLY" -eq 1 ]; then
 fi
 run_jaxguard_smoke || exit 1
 run_racecheck_smoke || exit 1
+run_errcov_smoke || exit 1
 run_crash_smoke || exit 1
 run_multisite_smoke || exit 1
 run_trace_smoke || exit 1

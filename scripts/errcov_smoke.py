@@ -1,0 +1,219 @@
+#!/usr/bin/env python
+"""errcov smoke — the error-path coverage half of the ship gate
+(check_green.sh).
+
+Boots a MiniCluster with errcheck armed and drives a deliberately
+faulted mini workload — missing-object reads, cls EINVAL/EOPNOTSUPP
+calls, EC shard reads failing with injected EIO
+(objectstore_debug_inject_read_err), and a FaultPlane message-drop
+window — so real error handlers FIRE, then:
+
+1. asserts the known handlers did fire (an EC-read error path in
+   osd/ec_backend and a cls-call error path — if those stay cold the
+   sanitizer is a no-op and the gate is blind);
+2. writes ERRCOV_r01.json: per-module fired/total handler ratios and
+   the never-fired list from errcheck.coverage_report();
+3. ratchets: the never-fired count must not grow past the committed
+   ERRCOV_r01.json (+ a small jitter allowance for timing-dependent
+   handlers) — error paths may only GAIN coverage.
+
+Run from the repo root: python scripts/errcov_smoke.py
+"""
+import json
+import os
+import pathlib
+import sys
+import tempfile
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+# arm BEFORE any other ceph_tpu import: the hook only instruments
+# modules imported after it installs
+os.environ["CEPH_TPU_ERRCHECK"] = "1"
+_DUMPDIR = tempfile.mkdtemp(prefix="errcov-")
+os.environ["CEPH_TPU_ERRCHECK_DIR"] = _DUMPDIR
+
+from ceph_tpu.common import errcheck            # noqa: E402
+
+if not errcheck.enable_if_configured():
+    print("errcov smoke: sanitizer did not arm", file=sys.stderr)
+    sys.exit(1)
+
+from ceph_tpu.client import RadosError          # noqa: E402
+from ceph_tpu.common.options import global_config  # noqa: E402
+from ceph_tpu.testing import MiniCluster        # noqa: E402
+
+ARTIFACT = ROOT / "ERRCOV_r01.json"
+#: run-to-run jitter allowance on the ratchet: a handful of handlers
+#: are timing-dependent (heartbeat grace, backoff windows) and may or
+#: may not fire within one short smoke — the ratchet tolerates that
+#: noise while still failing a real coverage regression
+RATCHET_SLACK = 5
+K, M = 2, 1
+
+
+def expect(exc_match, fn, *args, **kw):
+    """Run fn expecting a RadosError containing exc_match."""
+    try:
+        fn(*args, **kw)
+    except RadosError as ex:
+        assert exc_match in str(ex), (exc_match, ex)
+        return
+    raise AssertionError(f"{fn} did not raise {exc_match}")
+
+
+def drive_workload() -> None:
+    # fast heartbeats so the FaultPlane drop window below sees real
+    # traffic within the smoke's budget (daemons read this at init)
+    global_config().set("osd_heartbeat_interval", 0.25)
+    c = MiniCluster(n_osd=4, threaded=True, fault_seed=7)
+    try:
+        c.wait_all_up()
+        r = c.rados()
+        r.pool_create("meta", pg_num=8)
+        r.mon_command({"prefix": "osd erasure-code-profile set",
+                       "name": "k2m1",
+                       "profile": {"plugin": "tpu", "k": str(K),
+                                   "m": str(M),
+                                   "crush-failure-domain": "osd"}})
+        r.pool_create("ecp", pg_num=8, pool_type="erasure",
+                      erasure_code_profile="k2m1")
+        io = r.open_ioctx("meta")
+
+        # -- client/cls error paths ---------------------------------
+        expect("ENOENT", io.read, "never-written")
+        expect("ENOENT", io.stat, "never-written")
+        expect("EOPNOTSUPP", io.exec, "o", "no-such-class", "x")
+        io.exec("ctr", "numops", "add", {"key": "n", "value": 2})
+        expect("EINVAL", io.exec, "ctr", "numops", "add",
+               {"key": "n", "value": "three"})
+        expect("EINVAL", io.exec, "ctr", "numops", "div",
+               {"key": "n", "value": 0})
+
+        # -- EC shard EIO: reconstructing-read error path -----------
+        cfg = global_config()
+        cfg.set("objectstore_debug_inject_read_err", True)
+        try:
+            ec = r.open_ioctx("ecp")
+            payload = bytes((i * 37) % 256 for i in range(1 << 14))
+            ec.write_full("eobj", payload)
+            pid = r.pool_lookup("ecp")
+            m = c.mon.osdmap
+            raw = m.object_locator_to_pg("eobj", pid)
+            pg = m.pools[pid].raw_pg_to_pg(raw)
+            _, _, acting, primary = m.pg_to_up_acting_osds(raw)
+            victim_shard = next(s for s in range(K)
+                                if acting[s] != primary
+                                and acting[s] >= 0)
+            st = c.osds[acting[victim_shard]].pgs[pg]
+            st.shard.inject_read_err("eobj")
+            assert ec.read("eobj") == payload   # reconstructs anyway
+            st.shard.clear_read_err("eobj")
+        finally:
+            cfg.set("objectstore_debug_inject_read_err", False)
+
+        # -- rbd/journal error paths --------------------------------
+        from ceph_tpu.journal import Journaler, data_obj
+        from ceph_tpu.rbd import RBD
+        from ceph_tpu.rbd.image import RBDError, header_name
+        from ceph_tpu.rbd.mirror import _load_meta
+        RBD().create(io, "vm", size=1 << 18, order=16, journaling=True)
+        io.write_full(header_name("vm"), b"\xffnot json")
+        try:
+            _load_meta(io, "vm")        # corrupt header -> EIO
+        except RBDError as ex:
+            assert ex.errno == 5
+        try:
+            _load_meta(io, "gone")      # missing image -> ENOENT
+        except RBDError as ex:
+            assert ex.errno == 2
+        j = Journaler(io, "torn", "master")
+        j.create()
+        j.register_client()
+        j.append("ok", {"v": 1})
+        io.append(data_obj("torn", 0), b"\x00\x01\x02torn!")
+        got = []
+        j.replay(lambda t, d: got.append(d["v"]))   # torn-tail handler
+        assert got == [1]
+
+        # -- mon command error paths --------------------------------
+        try:
+            r.mon_command({"prefix": "no such command"})
+        except RadosError:
+            pass
+        try:
+            r.pool_create("meta", pg_num=8)     # EEXIST
+        except RadosError:
+            pass
+
+        # -- FaultPlane: a lossy heartbeat window (heartbeats fire on
+        # harness ticks, so drive them explicitly under the rule) ----
+        plane = c.network.faults
+        rid = plane.add_rule("osd.*", "osd.*", drop=0.3,
+                             types=["Ping"])
+        for _ in range(12):
+            c.tick()
+        plane.remove_rule(rid)
+        plane.flush()
+        for _ in range(4):
+            c.tick()            # heal: peers re-ping cleanly
+        assert plane.counts.get("drop", 0) > 0, \
+            "fault plane never bit"
+
+        # -- OSD flap: down/up peering churn under live data --------
+        c.kill_osd(3)
+        for _ in range(6):
+            c.tick()
+        c.revive_osd(3)
+        for _ in range(6):
+            c.tick()
+        # data written before the flap still reads back
+        assert io.exec("ctr", "numops", "add",
+                       {"key": "n", "value": 1})["value"] == 3
+    finally:
+        c.shutdown()
+
+
+def main() -> int:
+    drive_workload()
+
+    fired = errcheck.merge_dir(_DUMPDIR)
+    fired_modules = {m for (m, _ln, _exc) in fired}
+
+    # the sanitizer must have seen the error paths the workload forced
+    for want in ("ceph_tpu.osd.ec_backend", "ceph_tpu.cls"):
+        if not any(m == want or m.startswith(want + ".")
+                   for m in fired_modules):
+            print(f"errcov smoke: FAIL — no handler fired under "
+                  f"{want}; the coverage hook is blind", file=sys.stderr)
+            return 1
+
+    rep = errcheck.coverage_report(str(ROOT / "ceph_tpu"),
+                                   package="ceph_tpu", fired=fired)
+    new_never = rep["never_fired_count"]
+
+    if ARTIFACT.exists():
+        old = json.loads(ARTIFACT.read_text())
+        old_never = old.get("never_fired_count")
+        if old_never is not None and \
+                new_never > old_never + RATCHET_SLACK:
+            print(f"errcov smoke: FAIL — never-fired handlers grew "
+                  f"{old_never} -> {new_never} (slack "
+                  f"{RATCHET_SLACK}); error paths lost coverage.\n"
+                  f"If handlers were legitimately added, exercise "
+                  f"them here or in tier-1, then regenerate "
+                  f"ERRCOV_r01.json with this script.",
+                  file=sys.stderr)
+            return 1
+
+    ARTIFACT.write_text(json.dumps(rep, indent=1) + "\n")
+    print(f"errcov smoke: OK — {rep['handlers_fired']}/"
+          f"{rep['handlers_total']} handlers fired "
+          f"(ratio {rep['ratio']}), {new_never} never fired "
+          f"({ARTIFACT.name} updated)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

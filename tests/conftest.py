@@ -34,6 +34,15 @@ os.environ["CEPH_TPU_LOCKDEP"] = "1"
 # same reason as lockdep above.
 os.environ["CEPH_TPU_RACECHECK"] = "1"
 
+# ... and every tier-1 run is an error-path coverage run: errcheck ON
+# so the import hook can instrument ceph_tpu modules as tests pull
+# them in — every except handler entered anywhere in the suite bumps
+# a (module, line, exception-type) counter, and scripts/errcov_smoke.py
+# turns the same machinery into the published ERRCOV artifact.  The
+# env layer propagates to subprocess daemons (tools/daemon_main) like
+# the other sanitizers.  Force-set for the same reason as lockdep.
+os.environ["CEPH_TPU_ERRCHECK"] = "1"
+
 # ... and every tier-1 run is a device-contract sanitizer run too:
 # jaxguard ON before any ceph_tpu import, because enable() wraps
 # jax.jit and module-level jit wrappers are built at import.  A jit
@@ -57,6 +66,13 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 assert jax.default_backend() == "cpu", jax.default_backend()
 assert len(jax.devices()) == 8, jax.devices()
+
+# arm errcheck FIRST among the ceph_tpu imports: the import hook
+# only instruments modules imported AFTER it installs, so it must be
+# live before jaxguard/racecheck (and everything they pull) load
+from ceph_tpu.common import errcheck  # noqa: E402
+
+assert errcheck.enable_if_configured(), "CEPH_TPU_ERRCHECK=1 set above"
 
 # arm jaxguard AFTER the backend asserts (its own jit probes must not
 # count) and BEFORE any ceph_tpu import builds a jit wrapper

@@ -1,0 +1,14 @@
+"""green: pacing through the shared capped-exponential Backoff
+(common/backoff.py) — jittered, capped, clock-injectable."""
+from ceph_tpu.common.backoff import Backoff
+
+
+def mount(rados, pool):
+    b = Backoff(base_s=0.05, cap_s=1.0)
+    while True:
+        try:
+            out = rados.pool_lookup(pool)
+            b.reset()
+            return out
+        except LookupError:
+            b.sleep()

@@ -1,0 +1,23 @@
+"""RED: hand-rolled retry pacing — catch-sleep-retry with raw
+time.sleep, and a loop growing its own exponential delay."""
+import time
+
+
+def mount(rados, pool):
+    while True:
+        try:
+            return rados.pool_lookup(pool)
+        except LookupError:
+            time.sleep(0.2)       # fixed beat: every client retries
+            # on the same schedule and re-hits the dead mon together
+
+
+def connect(sock, addr):
+    delay = 0.05
+    while True:
+        try:
+            return sock.connect(addr)
+        except OSError:
+            pass                  # narrow: the retry IS the handling
+        time.sleep(delay)
+        delay = min(delay * 2, 1.0)   # forgot the jitter

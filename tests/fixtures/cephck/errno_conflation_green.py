@@ -1,0 +1,27 @@
+"""green: only the one expected miss maps to empty/not-found;
+everything else propagates with its own errno."""
+
+
+class ShardError(Exception):
+    pass
+
+
+class Shard:
+    def list_entries(self, marker):
+        try:
+            return self._read(marker)
+        except KeyError:          # narrow: the one expected miss
+            return []
+
+    def stat_size(self):
+        try:
+            size = self._io.stat()["size"]
+        except Exception as ex:
+            raise ShardError("EIO", f"stat failed: {ex}") from ex
+        return self._active, size
+
+    def read_header(self):
+        try:
+            return self._decode(self._io.read("header"))
+        except KeyError:
+            raise ShardError("ENOENT", "no header") from None

@@ -1,0 +1,28 @@
+"""RED: every failure of a read path becomes one success-shaped (or
+ENOENT-shaped) result — the errno dataflow is severed at the
+handler, so the caller cannot tell EIO from empty."""
+
+
+class ShardError(Exception):
+    pass
+
+
+class Shard:
+    def list_entries(self, marker):
+        try:
+            return self._read(marker)
+        except Exception:
+            return []             # EIO now reads as "caught up"
+
+    def stat_size(self):
+        try:
+            size = self._io.stat()["size"]
+        except Exception:
+            size = 0              # replay cursor resets on ANY error
+        return self._active, size
+
+    def read_header(self):
+        try:
+            return self._decode(self._io.read("header"))
+        except Exception:
+            raise ShardError("ENOENT", "no header")

@@ -1,0 +1,34 @@
+"""green: every path answers — a reply call, a delegation, an
+explicit errno result, or a raise the wrapper maps to a reply."""
+
+
+class HandlerError(Exception):
+    pass
+
+
+class Handler:
+    def _respond(self, h, status, body=b""):
+        h.send(status, body)
+
+    def _bucket_op(self, h, method, bucket, q):
+        if method == "PUT":
+            self._respond(h, 200)
+            return
+        if method == "DELETE":
+            self._delete(bucket)
+            self._respond(h, 204)
+            return
+        if method == "HEAD":
+            return self._object_op(h, method, bucket, q)
+        raise HandlerError(405, "method not allowed")
+
+    def _object_op(self, h, method, bucket, q):
+        self._respond(h, 200)
+
+    def handle_command(self, cmdmap):
+        if cmdmap.get("prefix") == "status":
+            return 0, "", self._status()
+        if cmdmap.get("prefix") == "flush":
+            self._flush()
+            return 0, "flushed", None
+        return -22, f"unknown command {cmdmap.get('prefix')!r}", None

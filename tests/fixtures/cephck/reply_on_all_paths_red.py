@@ -1,0 +1,24 @@
+"""RED: handlers with a path that never answers (the PR 4 mgr
+EIO-hang class: the failure mode is silence and the client waits out
+its full timeout)."""
+
+
+class Handler:
+    def _respond(self, h, status, body=b""):
+        h.send(status, body)
+
+    def _bucket_op(self, h, method, bucket, q):
+        if method == "PUT":
+            self._respond(h, 200)
+            return
+        if method == "DELETE":
+            self._delete(bucket)
+            return                # BUG: no reply on the DELETE path
+        self._respond(h, 405)
+
+    def handle_command(self, cmdmap):
+        if cmdmap.get("prefix") == "status":
+            return 0, "", self._status()
+        if cmdmap.get("prefix") == "flush":
+            self._flush()
+            return                # BUG: caller unpacks (r, outs, outb)

@@ -3,11 +3,13 @@ import threading
 
 
 def _loop():
+    failures = 0
     while True:
         try:
             work()
         except Exception:
-            pass          # the thread dies silently
+            failures += 1     # counted but never surfaced anywhere a
+            # supervisor looks: the thread still dies silently
 
 
 def work():

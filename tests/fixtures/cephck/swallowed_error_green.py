@@ -1,0 +1,21 @@
+"""green: every handler leaves a trace, records the failure, or
+re-raises what it can't own."""
+from ceph_tpu.common.log import dout
+
+
+def apply_entry(store, entry):
+    try:
+        store.apply(entry)
+    except Exception as ex:
+        dout("osd", 1).write("apply failed: %s", ex)
+        raise
+
+
+def drain(store, entries):
+    bad = []
+    for e in entries:
+        try:
+            store.apply(e)
+        except KeyError:
+            bad.append(e)         # recorded: the supervisor checks
+    return bad

@@ -1,0 +1,18 @@
+"""RED: a broad handler that makes the failure vanish outright —
+the caller's next branch reads state that no longer means anything
+(the DataLog EIO-became-"caught up" shape)."""
+
+
+def apply_entry(store, entry):
+    try:
+        store.apply(entry)
+    except Exception:
+        pass          # EIO, decode error, poison input: all gone
+
+
+def drain(store, entries):
+    for e in entries:
+        try:
+            store.apply(e)
+        except Exception:
+            continue  # the wedged entry is retried forever

@@ -15,7 +15,8 @@ import pytest
 
 from ceph_tpu.analysis import ALL_RULES
 from ceph_tpu.analysis.engine import (BaselineError, Engine,
-                                      load_baseline, repo_root)
+                                      load_baseline, repo_root,
+                                      sarif_report)
 
 ROOT = repo_root(pathlib.Path(__file__).resolve())
 FIXTURES = ROOT / "tests" / "fixtures" / "cephck"
@@ -40,6 +41,11 @@ RULE_FIXTURES = {
     # concurrency family (racecheck's static half)
     "guarded-by": "guarded_by",
     "blocking-in-dispatch": "blocking_dispatch",
+    # error-contract family (errcheck's static half)
+    "swallowed-error": "swallowed_error",
+    "errno-conflation": "errno_conflation",
+    "reply-on-all-paths": "reply_on_all_paths",
+    "bare-retry": "bare_retry",
 }
 
 
@@ -230,6 +236,54 @@ def test_tracer_leak_flags_self_and_module_state():
     assert any("_DEBUG_TAPS" in m for m in msgs), msgs
 
 
+# --------------------------------------- error-contract family details
+
+def test_swallowed_error_flags_pass_and_continue():
+    findings, _ = scan(FIXTURES / "swallowed_error_red.py")
+    hits = [f for f in findings if f.rule == "swallowed-error"]
+    assert len(hits) == 2, [f.render() for f in hits]
+
+
+def test_errno_conflation_flags_all_three_shapes():
+    findings, _ = scan(FIXTURES / "errno_conflation_red.py")
+    msgs = [f.message for f in findings if f.rule == "errno-conflation"]
+    assert any("return []" in m for m in msgs), msgs
+    assert any("size = 0" in m for m in msgs), msgs
+    assert any("ENOENT-shaped" in m for m in msgs), msgs
+
+
+def test_errno_conflation_scoped_out_of_tests(tmp_path):
+    """The same source under tests/ (outside the fixture corpus) is
+    silent — the error-contract rules police daemon code."""
+    src = (FIXTURES / "errno_conflation_red.py").read_text()
+    # scoping is by repo-relative path: simulate a tests/ location
+    sub = tmp_path / "tests"
+    sub.mkdir()
+    q = sub / "x.py"
+    q.write_text(src)
+    eng = Engine([cls() for cls in ALL_RULES], tmp_path)
+    hits = [f for f in eng.check_file(q)
+            if f.rule in ("errno-conflation", "swallowed-error",
+                          "bare-retry", "reply-on-all-paths")]
+    assert not hits, [f.render() for f in hits]
+
+
+def test_reply_on_all_paths_flags_missing_branch_and_bare_return():
+    findings, _ = scan(FIXTURES / "reply_on_all_paths_red.py")
+    msgs = [f.message for f in findings
+            if f.rule == "reply-on-all-paths"]
+    assert any("without sending a reply" in m for m in msgs), msgs
+    assert any("bare `return`" in m for m in msgs), msgs
+    assert any("fall off the end" in m for m in msgs), msgs
+
+
+def test_bare_retry_points_at_backoff():
+    findings, _ = scan(FIXTURES / "bare_retry_red.py")
+    msgs = [f.message for f in findings if f.rule == "bare-retry"]
+    assert len(msgs) == 2, msgs
+    assert all("Backoff" in m for m in msgs), msgs
+
+
 # --------------------------------------------------- baseline contract
 
 def test_baseline_requires_reasons(tmp_path):
@@ -325,6 +379,65 @@ def test_cli_exit_codes():
         [sys.executable, "-m", "ceph_tpu.analysis", str(green)],
         cwd=ROOT, capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_sarif_output_schema_and_escaping():
+    """--format sarif: a valid SARIF 2.1.0 log whose results point at
+    the right file/line, with rule metadata for every fired rule and
+    json-level escaping of hostile message content."""
+    red = FIXTURES / "bare_except_red.py"
+    proc = subprocess.run(
+        [sys.executable, "-m", "ceph_tpu.analysis", "--format", "sarif",
+         str(red)],
+        cwd=ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    log = json.loads(proc.stdout)          # must parse as-is
+    assert log["version"] == "2.1.0"
+    assert log["$schema"].endswith("sarif-2.1.0.json")
+    run = log["runs"][0]
+    assert run["tool"]["driver"]["name"] == "cephck"
+    results = run["results"]
+    assert results, "red fixture must produce results"
+    rule_ids = [r["id"] for r in run["tool"]["driver"]["rules"]]
+    for res in results:
+        assert res["level"] == "error"
+        # ruleIndex must agree with the driver rules table
+        assert rule_ids[res["ruleIndex"]] == res["ruleId"]
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith(
+            "bare_except_red.py")
+        assert loc["region"]["startLine"] >= 1
+    assert any(r["ruleId"] == "bare-except" for r in results)
+    assert run["invocations"][0]["executionSuccessful"] is True
+
+
+def test_sarif_report_escapes_hostile_messages():
+    """Messages carrying quotes, newlines, %-sequences and non-ascii
+    must survive the emit -> parse round trip byte-exact (json.dumps
+    owns the escaping; this pins that no manual mangling creeps in)."""
+    import dataclasses as _dc
+    from ceph_tpu.analysis.engine import Finding
+    nasty = 'quote " backslash \\ newline \n percent %0A tab \t \u00e9'
+    f = Finding(rule="bare-except", path='a "b"/c.py', line=3,
+                symbol="f", message=nasty)
+    rules = [cls() for cls in ALL_RULES]
+    log = sarif_report(rules, [f], errors=["boom \n %25"],
+                       stale=[])
+    text = json.dumps(log)
+    back = json.loads(text)
+    res = back["runs"][0]["results"][0]
+    assert res["message"]["text"] == nasty
+    assert res["locations"][0]["physicalLocation"][
+        "artifactLocation"]["uri"] == 'a "b"/c.py'
+    notes = back["runs"][0]["invocations"][0][
+        "toolExecutionNotifications"]
+    assert notes[0]["message"]["text"] == "boom \n %25"
+    assert back["runs"][0]["invocations"][0][
+        "executionSuccessful"] is False
+    # only fired rules appear in the driver table, with descriptions
+    table = back["runs"][0]["tool"]["driver"]["rules"]
+    assert [r["id"] for r in table] == ["bare-except"]
+    assert table[0]["shortDescription"]["text"]
 
 
 def test_no_raw_locks_outside_lockdep():

@@ -1,0 +1,114 @@
+"""cls numops: atomic omap counter arithmetic
+(ref: src/cls/numops/cls_numops.cc; see ceph_tpu/cls/numops.py)."""
+import pytest
+
+from ceph_tpu.client import RadosError
+from ceph_tpu.testing import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(n_osd=3, threaded=True)
+    c.wait_all_up()
+    r = c.rados()
+    r.pool_create("meta", pg_num=8)
+    yield c, r
+    c.shutdown()
+
+
+@pytest.fixture()
+def io(cluster):
+    _, r = cluster
+    return r.open_ioctx("meta")
+
+
+def test_add_creates_counter_and_accumulates(io):
+    oid = "n-acc"
+    out = io.exec(oid, "numops", "add", {"key": "hits", "value": 3})
+    assert out == {"key": "hits", "value": 3}
+    out = io.exec(oid, "numops", "add", {"key": "hits", "value": 4})
+    assert out["value"] == 7
+    # stored representation is a clean decimal string other omap
+    # readers can parse
+    assert dict(io.get_omap_vals(oid)[0])["hits"] == b"7"
+
+
+def test_sub_mul_div_roundtrip(io):
+    oid = "n-ops"
+    io.exec(oid, "numops", "add", {"key": "k", "value": 10})
+    assert io.exec(oid, "numops", "sub",
+                   {"key": "k", "value": 4})["value"] == 6
+    assert io.exec(oid, "numops", "mul",
+                   {"key": "k", "value": 3})["value"] == 18
+    assert io.exec(oid, "numops", "div",
+                   {"key": "k", "value": 4})["value"] == 4.5
+    assert dict(io.get_omap_vals(oid)[0])["k"] == b"4.5"
+    # back to integral: the trailing .0 is dropped in storage
+    assert io.exec(oid, "numops", "mul",
+                   {"key": "k", "value": 2})["value"] == 9
+    assert dict(io.get_omap_vals(oid)[0])["k"] == b"9"
+
+
+def test_keys_are_independent(io):
+    oid = "n-multi"
+    io.exec(oid, "numops", "add", {"key": "a", "value": 1})
+    io.exec(oid, "numops", "add", {"key": "b", "value": 2})
+    io.exec(oid, "numops", "add", {"key": "a", "value": 1})
+    omap = dict(io.get_omap_vals(oid)[0])
+    assert omap["a"] == b"2" and omap["b"] == b"2"
+
+
+def test_missing_key_counts_as_zero(io):
+    oid = "n-zero"
+    assert io.exec(oid, "numops", "sub",
+                   {"key": "fresh", "value": 5})["value"] == -5
+    assert io.exec(oid, "numops", "mul",
+                   {"key": "fresh2", "value": 5})["value"] == 0
+
+
+def test_non_numeric_input_is_einval(io):
+    oid = "n-badin"
+    for bad in ("three", None, [1], True):
+        with pytest.raises(RadosError, match="EINVAL"):
+            io.exec(oid, "numops", "add", {"key": "k", "value": bad})
+    with pytest.raises(RadosError, match="EINVAL"):
+        io.exec(oid, "numops", "add", {"value": 1})     # no key
+    with pytest.raises(RadosError, match="EINVAL"):
+        io.exec(oid, "numops", "add", {"key": "k"})     # no value
+    # failed calls must not have created the object
+    with pytest.raises(RadosError, match="ENOENT"):
+        io.stat(oid)
+
+
+def test_non_numeric_stored_value_is_einval_not_clobbered(io):
+    """A key someone else uses for non-counter data must not be
+    silently overwritten — the reference rejects unparseable stored
+    values instead of treating them as zero."""
+    oid = "n-badstore"
+    io.set_omap(oid, {"blob": b"not a number"})
+    with pytest.raises(RadosError, match="EINVAL"):
+        io.exec(oid, "numops", "add", {"key": "blob", "value": 1})
+    assert dict(io.get_omap_vals(oid)[0])["blob"] == b"not a number"
+
+
+def test_div_by_zero_is_einval_and_atomic(io):
+    oid = "n-div0"
+    io.exec(oid, "numops", "add", {"key": "k", "value": 9})
+    with pytest.raises(RadosError, match="EINVAL"):
+        io.exec(oid, "numops", "div", {"key": "k", "value": 0})
+    # the failed method's queued mutations never commit
+    assert dict(io.get_omap_vals(oid)[0])["k"] == b"9"
+
+
+def test_concurrent_adds_all_land(io):
+    """The point of the class: racing increments are serialized
+    inside the OSD, so none is lost to read-modify-write races."""
+    import concurrent.futures
+    oid = "n-race"
+
+    def bump(_):
+        return io.exec(oid, "numops", "add", {"key": "c", "value": 1})
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=8) as ex:
+        list(ex.map(bump, range(32)))
+    assert dict(io.get_omap_vals(oid)[0])["c"] == b"32"

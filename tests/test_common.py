@@ -44,6 +44,7 @@ def test_config_get_set_defaults():
     diff.pop("lockdep", None)
     diff.pop("jaxguard", None)      # same env layer: CEPH_TPU_JAXGUARD=1
     diff.pop("racecheck", None)     # ... and CEPH_TPU_RACECHECK=1
+    diff.pop("errcheck", None)      # ... and CEPH_TPU_ERRCHECK=1
     assert diff == {"osd_pool_default_size": 5}
     with pytest.raises(KeyError):
         cfg.set("nonexistent_option", 1)

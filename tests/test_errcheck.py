@@ -1,0 +1,203 @@
+"""errcheck: the runtime error-path coverage sanitizer
+(ceph_tpu/common/errcheck.py — the dynamic twin of cephck's
+error-contract rule family).
+
+Covers the ISSUE-18 contract: fired-handler counting keyed by concrete
+exception type, the never-fired report shape, instrumented modules
+behaving EXACTLY like pristine ones, and zero footprint when the
+option is off (subprocess probe)."""
+import json
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from ceph_tpu.common import errcheck
+
+PROBE_SRC = textwrap.dedent("""\
+    def lookup(d, k):
+        try:
+            return d[k]
+        except KeyError:
+            return None
+
+    def reraise(x):
+        try:
+            return 10 // x
+        except ZeroDivisionError as ex:
+            raise ValueError("zero divisor") from ex
+
+    def cold(x):
+        try:
+            return x + 1
+        except TypeError:
+            return -1
+
+    try:
+        import _ec_no_such_module_
+    except ImportError:
+        HAVE_OPT = False
+""")
+
+
+def _mk_pkg(tmp_path, pkgname, src=PROBE_SRC):
+    """A throwaway importable package holding the probe module."""
+    d = tmp_path / pkgname
+    d.mkdir()
+    (d / "__init__.py").write_text("")
+    (d / "mod.py").write_text(src)
+    return d
+
+
+@pytest.fixture
+def probe(tmp_path, monkeypatch, request):
+    """Import <unique pkg>.mod under the (already conftest-armed)
+    hook, widened to the temp package; cleaned out of sys.modules."""
+    pkg = f"ec_probe_{request.function.__name__}"
+    _mk_pkg(tmp_path, pkg)
+    monkeypatch.syspath_prepend(str(tmp_path))
+    assert errcheck.enabled(), "conftest arms CEPH_TPU_ERRCHECK=1"
+    errcheck.enable(prefixes=(pkg,))    # idempotent widen, not reinstall
+    mod = __import__(f"{pkg}.mod", fromlist=["mod"])
+    yield pkg, mod
+    for name in [m for m in sys.modules if m.split(".")[0] == pkg]:
+        del sys.modules[name]
+
+
+def _probe_counts(pkg):
+    return {(m, ln, exc): n for (m, ln, exc), n in
+            errcheck.counters().items() if m.startswith(pkg)}
+
+
+# ---------------------------------------------------- fired counting
+
+def test_fired_handlers_counted_by_exception_type(probe):
+    pkg, mod = probe
+    assert mod.lookup({"a": 1}, "a") == 1       # no exception: no bump
+    assert _probe_counts(pkg) == {
+        (f"{pkg}.mod", 21, "ModuleNotFoundError"): 1}
+        # ^ the CONCRETE type from exc_info, not the declared ImportError
+    assert mod.lookup({}, "x") is None
+    assert mod.lookup({}, "y") is None
+    with pytest.raises(ValueError):
+        mod.reraise(0)
+    c = _probe_counts(pkg)
+    assert c[(f"{pkg}.mod", 4, "KeyError")] == 2
+    assert c[(f"{pkg}.mod", 10, "ZeroDivisionError")] == 1
+    # the cold handler exists but never fired — no key at its line
+    assert not any(ln == 16 for (_m, ln, _e) in c)
+
+
+def test_module_level_handler_counts_during_import(probe):
+    """Import-fallback handlers run while exec_module is still on the
+    stack — the hook global must be seeded BEFORE the body runs."""
+    pkg, mod = probe
+    assert mod.HAVE_OPT is False
+    assert _probe_counts(pkg)[
+        (f"{pkg}.mod", 21, "ModuleNotFoundError")] == 1
+
+
+# ------------------------------------------------- never-fired report
+
+def test_coverage_report_shape_and_never_fired(probe, tmp_path):
+    pkg, mod = probe
+    mod.lookup({}, "x")
+    rep = errcheck.coverage_report(str(tmp_path / pkg), package=pkg)
+    assert rep["package"] == pkg
+    assert rep["handlers_total"] == 4
+    # KeyError handler + the import-time ImportError fallback fired
+    assert rep["handlers_fired"] == 2
+    assert rep["never_fired_count"] == 2
+    assert rep["handlers_fired"] + rep["never_fired_count"] == \
+        rep["handlers_total"]
+    st = rep["modules"][f"{pkg}.mod"]
+    assert st == {"handlers": 4, "fired": 2, "ratio": 0.5}
+    cold = {(d["module"], d["line"], d["catches"])
+            for d in rep["never_fired"]}
+    assert cold == {(f"{pkg}.mod", 10, "ZeroDivisionError"),
+                    (f"{pkg}.mod", 16, "TypeError")}
+
+
+def test_census_counts_unimported_modules(tmp_path):
+    """The denominator is static: a module nothing imported still
+    contributes its handlers (that is the whole point — dead error
+    paths hide in exactly the code no test pulls in)."""
+    d = _mk_pkg(tmp_path, "ec_cold_pkg")
+    (d / "never_imported.py").write_text(PROBE_SRC)
+    census = errcheck.handler_census(str(d), package="ec_cold_pkg")
+    mods = {m for m, _ln, _c in census}
+    assert "ec_cold_pkg.never_imported" in mods
+    assert len([1 for m, *_ in census
+                if m == "ec_cold_pkg.never_imported"]) == 4
+
+
+# -------------------------------------------- semantics are unchanged
+
+def test_instrumentation_preserves_semantics(probe):
+    pkg, mod = probe
+    # values, exception chaining and tracebacks all pristine
+    with pytest.raises(ValueError) as ei:
+        mod.reraise(0)
+    assert isinstance(ei.value.__cause__, ZeroDivisionError)
+    assert ei.traceback[-1].lineno + 1 == 11   # the raise, untouched
+    assert mod.cold(5) == 6
+    # uncaught exceptions still propagate untouched
+    with pytest.raises(TypeError):
+        mod.lookup(None, "k")
+
+
+def test_syntax_error_modules_fail_like_pristine(probe, tmp_path):
+    pkg, _mod = probe
+    (tmp_path / pkg / "broken.py").write_text("def f(:\n")
+    with pytest.raises(SyntaxError):
+        __import__(f"{pkg}.broken")
+
+
+# ------------------------------------------- subprocess counter dumps
+
+def test_dump_and_merge_dir_roundtrip(probe, tmp_path):
+    pkg, mod = probe
+    mod.lookup({}, "x")
+    path = tmp_path / "dumps" / "errcheck-12345.json"
+    errcheck.dump(str(path))
+    raw = json.loads(path.read_text())
+    assert raw[f"{pkg}.mod\x004\x00KeyError"] == 1
+    merged = errcheck.merge_dir(str(tmp_path / "dumps"))
+    # live counters + the dump of the same counters = doubled
+    assert merged[(f"{pkg}.mod", 4, "KeyError")] == 2
+    # junk files are skipped, not fatal
+    (tmp_path / "dumps" / "errcheck-junk.json").write_text("{nope")
+    merged2 = errcheck.merge_dir(str(tmp_path / "dumps"))
+    assert merged2[(f"{pkg}.mod", 4, "KeyError")] == 2
+
+
+# --------------------------------------------- zero-overhead when off
+
+def test_off_means_no_hook_no_globals_no_counters(tmp_path):
+    """With CEPH_TPU_ERRCHECK unset, importing errcheck and a real
+    ceph_tpu module must leave the import machinery pristine: no
+    finder on sys.meta_path, no __errcheck_hit__ in module dicts, no
+    counters.  Run in a subprocess — this suite's own interpreter is
+    deliberately armed by conftest."""
+    code = textwrap.dedent("""\
+        import sys
+        from ceph_tpu.common import errcheck
+        assert not errcheck.enable_if_configured()
+        assert not errcheck.enabled()
+        assert not any(type(f).__module__ == "ceph_tpu.common.errcheck"
+                       for f in sys.meta_path), sys.meta_path
+        from ceph_tpu.common import backoff
+        assert errcheck.HIT_NAME not in vars(backoff)
+        assert errcheck.counters() == {}
+        print("PRISTINE")
+    """)
+    import os
+    env = dict(os.environ)
+    env.pop("CEPH_TPU_ERRCHECK", None)
+    env.pop("CEPH_TPU_ERRCHECK_DIR", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "PRISTINE" in out.stdout

@@ -255,3 +255,53 @@ def test_failover_abort_repromotes_drained_old_primary(cluster):
         promote(ioa, name, force=False)
     m.sync()
     promote(ioa, name, force=False)
+
+
+# -- error-contract regressions (errcheck audit fixes) ------------------
+
+def test_head_pos_propagates_non_enoent(cluster, monkeypatch):
+    """A non-ENOENT stat failure on the journal head must propagate:
+    reading EIO as 'caught up, size 0' would let a replayer commit a
+    position it never reached (the errno-conflation class)."""
+    from ceph_tpu.client.rados import RadosError
+    from ceph_tpu.rbd.mirror import _head_pos
+    io = cluster.rados().open_ioctx("primary")
+    j = Journaler(io, "headpos", "master")
+    j.create()
+    j.register_client()
+    j.append("ev", {"v": 1})
+    active, size = _head_pos(j)
+    assert size > 0
+
+    def eio_stat(oid):
+        raise RadosError("EIO", f"injected for {oid}")
+    monkeypatch.setattr(j.io, "stat", eio_stat)
+    with pytest.raises(RadosError, match="EIO"):
+        _head_pos(j)
+
+    def enoent_stat(oid):
+        raise RadosError("ENOENT", oid)
+    monkeypatch.setattr(j.io, "stat", enoent_stat)
+    # a true miss IS "empty head": size 0, no raise
+    assert _head_pos(j) == (active, 0)
+
+
+def test_load_meta_corrupt_header_is_eio_not_enoent(cluster):
+    """A corrupt image header must surface as EIO, not ENOENT: callers
+    that recreate on 'does not exist' would overwrite a live (damaged)
+    image.  A genuinely missing image still maps to ENOENT."""
+    from ceph_tpu.rbd.image import RBDError, header_name
+    from ceph_tpu.rbd.mirror import _load_meta
+    io = cluster.rados().open_ioctx("primary")
+    RBD().create(io, "hdr-vm", size=1 << 18, order=16, journaling=True)
+    assert _load_meta(io, "hdr-vm")["size"] == 1 << 18
+    # scribble over the header: undecodable, but the image EXISTS
+    io.write_full(header_name("hdr-vm"), b"\x00not json\xff")
+    with pytest.raises(RBDError) as ei:
+        _load_meta(io, "hdr-vm")
+    assert ei.value.errno == 5
+    assert "undecodable" in str(ei.value)
+    # missing image keeps its distinct errno
+    with pytest.raises(RBDError) as ei:
+        _load_meta(io, "no-such-vm")
+    assert ei.value.errno == 2

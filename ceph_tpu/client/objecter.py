@@ -60,7 +60,8 @@ class _Op:
     def __init__(self, tid: int, pool: int, oid: str, op: str,
                  offset: int, length: int, data: bytes,
                  future: OpFuture, pg_ps: Optional[int] = None,
-                 args: Optional[dict] = None):
+                 args: Optional[dict] = None,
+                 unordered: bool = False):
         self.tid = tid
         self.pool = pool
         self.oid = oid
@@ -70,6 +71,7 @@ class _Op:
         self.data = data
         self.args = args or {}
         self.future = future
+        self.unordered = unordered
         self.pg_ps = pg_ps        # PG-addressed op (pgls)
         self.pg: Optional[PG] = None
         self.target_osd = -1
@@ -314,11 +316,20 @@ class Objecter(Dispatcher, MonHunter):
     def submit(self, pool: int, oid: str, op: str, offset: int = 0,
                length: int = 0, data: bytes = b"",
                pg_ps: Optional[int] = None,
-               args: Optional[dict] = None) -> OpFuture:
-        """(ref: Objecter.cc:2378 _op_submit)."""
+               args: Optional[dict] = None,
+               unordered: bool = False) -> OpFuture:
+        """(ref: Objecter.cc:2378 _op_submit).
+
+        `unordered=True` opts the op out of per-object ordering (the
+        librados semantics preserved by _obj_key): N such ops on one
+        object all go to the wire at once instead of serializing
+        behind each other.  Only safe for reads of objects the caller
+        knows are immutable while the ops are in flight — the serve
+        page-fetch wave (epoch-versioned artifact objects) is the
+        intended user."""
         fut = OpFuture()
         o = _Op(next(self._tid), pool, oid, op, offset, length, data,
-                fut, pg_ps=pg_ps, args=args)
+                fut, pg_ps=pg_ps, args=args, unordered=unordered)
         # capture the frontend's ambient trace NOW: a queued op may
         # launch later from the dispatch thread, where the submitting
         # handler's scope is gone
@@ -351,7 +362,7 @@ class Objecter(Dispatcher, MonHunter):
 
     @classmethod
     def _obj_key(cls, op: _Op):
-        if op.op in cls._UNORDERED_OPS:
+        if op.op in cls._UNORDERED_OPS or op.unordered:
             return None
         return (op.pool, op.oid) if op.oid else None
 

@@ -146,11 +146,16 @@ class IoCtx:
                                           args=self._margs())
 
     def aio_read(self, oid: str, length: int = 0, offset: int = 0,
-                 snapid: int | None = None) -> OpFuture:
+                 snapid: int | None = None,
+                 unordered: bool = False) -> OpFuture:
+        """`unordered=True` skips per-object op ordering so N reads of
+        one object parallelize — only for objects immutable while the
+        reads are in flight (serve artifact pages)."""
         args = {"snapid": snapid} if snapid is not None else None
         return self.rados.objecter.submit(self.pool_id, oid, "read",
                                           offset=offset, length=length,
-                                          args=args)
+                                          args=args,
+                                          unordered=unordered)
 
     def aio_remove(self, oid: str) -> OpFuture:
         return self.rados.objecter.submit(self.pool_id, oid, "delete",

@@ -30,6 +30,15 @@ and cephfs (striped file objects):
 
     read_fn(oid, off, length) -> bytes   # short/empty = sparse zeros
     write_fn(oid, off, data)  -> None
+
+An optional third callable batches cold fills for `read_many`:
+
+    read_many_fn([(oid, off, length), ...]) -> [bytes, ...]
+
+Readahead is a pluggable **policy** per cacher (selectable per serve
+handle): `checkpoint` is the historical sequential-doubling window,
+`kvcache` is the random-page policy — no readahead, pages pinned /
+refcounted by the caller, LRU eviction only among unpinned pages.
 """
 from __future__ import annotations
 
@@ -42,7 +51,7 @@ from typing import Callable
 
 class _CachedObject:
     __slots__ = ("pages", "valid", "dirty", "vlen", "seq_end",
-                 "ra_window")
+                 "ra_window", "pins")
 
     def __init__(self):
         self.pages: dict[int, bytearray] = {}
@@ -59,20 +68,84 @@ class _CachedObject:
         #: where the last read ended, and the current readahead window
         self.seq_end: int = -1
         self.ra_window: int = 0
+        #: page -> pin refcount; pinned pages never evict (kvcache
+        #: policy: a page handed to attention kernels must stay
+        #: resident until the caller unpins it)
+        self.pins: dict[int, int] = {}
+
+
+class ReadaheadPolicy:
+    """Per-read fill-overshoot decision.  `on_read` sees the request
+    and the object's detector state and returns how many bytes PAST
+    the request the fill may fetch (0 = exactly the request)."""
+    name = "none"
+
+    def on_read(self, o: _CachedObject, off: int, length: int,
+                page: int, max_readahead: int) -> int:
+        o.seq_end = off + length
+        return 0
+
+
+class CheckpointReadahead(ReadaheadPolicy):
+    """Sequential-resume streaming (checkpoint shards read front to
+    back): a read starting where the last one ended doubles the
+    window up to max_readahead; any random jump resets it — so
+    amplification only ever follows a proven sequential pattern
+    (ref: src/common/Readahead.cc update)."""
+    name = "checkpoint"
+
+    def on_read(self, o, off, length, page, max_readahead):
+        if max_readahead and off == o.seq_end:
+            o.ra_window = min(max(o.ra_window * 2, page),
+                              max_readahead)
+        else:
+            o.ra_window = 0
+        o.seq_end = off + length
+        return o.ra_window
+
+
+class KVCacheReadahead(ReadaheadPolicy):
+    """Random-page KV-cache gets: page ids arrive in attention order,
+    not address order, so readahead is pure waste — never overshoot.
+    Residency is the caller's business via pin()/unpin(); eviction
+    runs LRU among the unpinned only."""
+    name = "kvcache"
+
+    def on_read(self, o, off, length, page, max_readahead):
+        o.seq_end = off + length
+        o.ra_window = 0
+        return 0
+
+
+READAHEAD_POLICIES: dict[str, type[ReadaheadPolicy]] = {
+    "none": ReadaheadPolicy,
+    "checkpoint": CheckpointReadahead,
+    "kvcache": KVCacheReadahead,
+}
 
 
 class ObjectCacher:
     def __init__(self, read_fn: Callable, write_fn: Callable,
                  max_dirty: int = 8 << 20, max_size: int = 32 << 20,
-                 page: int = 1 << 16, max_readahead: int = 512 << 10):
+                 page: int = 1 << 16, max_readahead: int = 512 << 10,
+                 policy: "ReadaheadPolicy | str" = "checkpoint",
+                 read_many_fn: Callable | None = None):
         self._read = read_fn
         self._write = write_fn
+        #: batched cold-fill: read_many() hands ALL missing runs of a
+        #: wave to this in one call (the serve store wires the
+        #: objecter's parallel aio fan-out here); absent, runs fill
+        #: one read_fn call each
+        self._read_many = read_many_fn
         self.max_dirty = max_dirty
         self.max_size = max_size
         self.page = page
         #: sequential readahead cap (ref: rbd_readahead_max_bytes /
         #: ObjectCacher's max_readahead); 0 disables
         self.max_readahead = max_readahead
+        if isinstance(policy, str):
+            policy = READAHEAD_POLICIES[policy]()
+        self.policy = policy
         self._objs: "OrderedDict[str, _CachedObject]" = OrderedDict()
         self._lock = make_lock("osdc.object_cacher")
         # O(1) accounting: page counts maintained at every transition
@@ -149,23 +222,15 @@ class ObjectCacher:
         with self._lock:
             o = self._obj(oid)
             pages = list(self._page_range(off, length))
-            # sequential detection: a read starting where the last one
-            # ended doubles the readahead window (up to max_readahead)
-            # and extends the FILL — not the returned bytes — past the
-            # request (ref: src/common/Readahead.cc update; the
-            # reference's ObjectCacher issues the same overshoot via
-            # max_readahead).  Random reads reset the window, so
-            # amplification only ever follows a proven pattern.
-            if self.max_readahead and off == o.seq_end:
-                o.ra_window = min(max(o.ra_window * 2, self.page),
-                                  self.max_readahead)
-            else:
-                o.ra_window = 0
-            o.seq_end = off + length
+            # the policy decides the fill overshoot — not the returned
+            # bytes — past the request (checkpoint: sequential-doubling
+            # window per src/common/Readahead.cc; kvcache/none: 0)
+            overshoot = self.policy.on_read(o, off, length, self.page,
+                                            self.max_readahead)
             fill_pages = pages
-            if o.ra_window:
+            if overshoot:
                 fill_pages = list(self._page_range(
-                    off, length + o.ra_window))
+                    off, length + overshoot))
             if all(p in o.valid for p in pages):
                 self.stats["hit"] += 1
             else:
@@ -183,6 +248,140 @@ class ObjectCacher:
             base = off - pages[0] * self.page
             self._maybe_evict()
             return bytes(out[base:base + length])
+
+    def read_many(self, reqs: list[tuple[str, int, int]]
+                  ) -> list[bytes]:
+        """Batched multi-range read: the whole page-fetch wave hits
+        the cache under ONE lock acquisition.  Missing pages across
+        all requests are unioned per object, grouped into contiguous
+        runs, and fetched in a single read_many_fn wave (per-run
+        read_fn calls when no batcher is wired).  Results come back
+        in request order.
+
+        Accounting: one hit/miss per request (a request whose pages
+        arrive via ANOTHER request's fill in the same batch is still
+        a miss — it needed backing bytes); `readahead_pages` counts
+        only policy-overshoot pages no request in the batch asked
+        for, so a page "prefetched" for a sibling request is demand,
+        not readahead."""
+        if not reqs:
+            return []
+        with self._lock:
+            plans = []          # (oid, o, pages, off, length)
+            need: dict[str, set[int]] = {}     # demand pages per oid
+            fill: dict[str, set[int]] = {}     # demand + overshoot
+            for oid, off, length in reqs:
+                if length <= 0:
+                    plans.append((oid, None, [], off, length))
+                    continue
+                o = self._obj(oid)
+                pages = list(self._page_range(off, length))
+                overshoot = self.policy.on_read(
+                    o, off, length, self.page, self.max_readahead)
+                plans.append((oid, o, pages, off, length))
+                need.setdefault(oid, set()).update(pages)
+                fill.setdefault(oid, set()).update(pages)
+                if overshoot:
+                    fill[oid].update(self._page_range(
+                        off, length + overshoot))
+            # hit/miss judged against pre-fill validity
+            for oid, o, pages, _, length in plans:
+                if length <= 0:
+                    continue
+                key = "hit" if all(p in o.valid for p in pages) \
+                    else "miss"
+                self.stats[key] += 1
+            # readahead = overshoot pages nobody demanded, not yet
+            # cached, that the fill will actually fetch
+            for oid, want in fill.items():
+                o = self._objs[oid]
+                self.stats["readahead_pages"] += sum(
+                    1 for p in want - need.get(oid, set())
+                    if p not in o.valid)
+            # contiguous missing runs per object -> one backing wave
+            fetches: list[tuple[str, int, int]] = []
+            runs: list[tuple[str, int, int]] = []   # (oid, lo, n)
+            for oid, want in fill.items():
+                o = self._objs[oid]
+                missing = sorted(p for p in want if p not in o.valid)
+                lo = prev = None
+                for p in missing + [None]:
+                    if lo is not None and (p is None or p != prev + 1):
+                        runs.append((oid, lo, prev - lo + 1))
+                        fetches.append((oid, lo * self.page,
+                                        (prev - lo + 1) * self.page))
+                        lo = None
+                    if p is not None:
+                        if lo is None:
+                            lo = p
+                        prev = p
+            if fetches:
+                if self._read_many is not None:
+                    datas = self._read_many(fetches)
+                else:
+                    datas = [self._read(oid, off, ln) or b""
+                             for oid, off, ln in fetches]
+                for (oid, lo, n), data in zip(runs, datas):
+                    data = data or b""
+                    o = self._objs[oid]
+                    for p in range(lo, lo + n):
+                        if p in o.valid:
+                            continue
+                        base = (p - lo) * self.page
+                        buf = bytearray(self.page)
+                        chunk = data[base:base + self.page]
+                        buf[:len(chunk)] = chunk
+                        self._install(o, p, buf, vlen=len(chunk))
+            out: list[bytes] = []
+            for oid, o, pages, off, length in plans:
+                if length <= 0:
+                    out.append(b"")
+                    continue
+                blob = bytearray()
+                for p in pages:
+                    blob += o.pages[p]
+                base = off - pages[0] * self.page
+                out.append(bytes(blob[base:base + length]))
+            self._maybe_evict()
+            return out
+
+    # -- pinning (kvcache policy) ---------------------------------------
+    def pin(self, oid: str, off: int, length: int) -> None:
+        """Make [off, off+length) resident and bump each page's pin
+        refcount; pinned pages are exempt from LRU eviction until the
+        matching unpin()."""
+        if length <= 0:
+            return
+        with self._lock:
+            o = self._obj(oid)
+            pages = list(self._page_range(off, length))
+            self._fill_span(oid, o, pages)
+            for p in pages:
+                o.pins[p] = o.pins.get(p, 0) + 1
+
+    def unpin(self, oid: str, off: int, length: int) -> None:
+        """Drop one pin ref per page; at zero the page rejoins the
+        LRU.  Unbalanced unpins are a caller bug -> ValueError."""
+        if length <= 0:
+            return
+        with self._lock:
+            o = self._objs.get(oid)
+            if o is None:
+                raise ValueError(f"unpin of uncached object {oid!r}")
+            for p in self._page_range(off, length):
+                n = o.pins.get(p, 0)
+                if n <= 0:
+                    raise ValueError(
+                        f"unpin without pin: {oid!r} page {p}")
+                if n == 1:
+                    del o.pins[p]
+                else:
+                    o.pins[p] = n - 1
+
+    def pinned_bytes(self) -> int:
+        with self._lock:
+            return sum(len(o.pins) for o in self._objs.values()) \
+                * self.page
 
     def write(self, oid: str, off: int, data: bytes) -> None:
         if not data:
@@ -229,6 +428,7 @@ class ObjectCacher:
                     o.valid.discard(p)
                     o.dirty.discard(p)
                     o.vlen.pop(p, None)
+                    o.pins.pop(p, None)   # discard outranks pins
                 elif p in o.valid:
                     o.pages[p][lo:hi] = b"\0" * (hi - lo)
 
@@ -288,10 +488,12 @@ class ObjectCacher:
                 del self._objs[k]
 
     def _maybe_evict(self) -> None:
-        """LRU eviction of clean pages once past max_size."""
+        """LRU eviction of clean UNPINNED pages once past max_size
+        (pinned pages are promised-resident until unpin)."""
         while self.cached_bytes() > self.max_size:
             for oid, o in self._objs.items():
-                clean = [p for p in o.valid if p not in o.dirty]
+                clean = [p for p in o.valid
+                         if p not in o.dirty and not o.pins.get(p)]
                 if clean:
                     for p in clean:
                         o.pages.pop(p, None)

@@ -337,9 +337,42 @@ class RGWGateway:
         #: prune's read-then-remove racing a handler thread's bucket
         #: recreate must not remove the fresh live entry
         self._registry_lock = make_lock("rgw.registry")
+        #: bucket -> (monotonic stamp, {shard: datalog head}) at the
+        #: most recent index dump served to a peer: an in-flight
+        #: full sync's incremental cursor starts AT that head, so
+        #: age-based trim (zero-peer zones) must not cross it while
+        #: the grace window is open
+        self._fullsync_floors: dict[str, tuple[float, dict]] = {}
+        self._fullsync_lock = make_lock("rgw.fullsync_floors")
 
     #: seconds an orphaned object outlives its index unlink
     GC_GRACE_S = 2.0
+    #: how long a served index dump pins the datalog against
+    #: age-based trim (a full sync slower than this restarts from a
+    #: fresh dump anyway)
+    FULLSYNC_GRACE_S = 600.0
+
+    def note_fullsync_dump(self, bucket: str) -> None:
+        """Record the per-shard datalog heads at the moment a bucket
+        index dump leaves for a peer (the full-sync entry point)."""
+        heads = self.datalog.heads(bucket, self._nshards(bucket))
+        import time as _time
+        with self._fullsync_lock:
+            self._fullsync_floors[bucket] = (_time.monotonic(), heads)
+
+    def fullsync_floor(self, bucket: str) -> dict | None:
+        """{shard: head-at-dump} for an in-flight (non-expired) full
+        sync of `bucket`, else None."""
+        import time as _time
+        with self._fullsync_lock:
+            rec = self._fullsync_floors.get(bucket)
+            if rec is None:
+                return None
+            stamp, heads = rec
+            if _time.monotonic() - stamp > self.FULLSYNC_GRACE_S:
+                del self._fullsync_floors[bucket]
+                return None
+            return dict(heads)
 
     def _gc_loop(self) -> None:
         while not self._gc_stop.is_set():
@@ -1109,7 +1142,11 @@ class RGWGateway:
                 raise S3Error(404, "NoSuchBucket", name)
             # in-flight multipart bookkeeping (.upload.*) shares the
             # index omap but is not object state — a peer's full sync
-            # must see objects only
+            # must see objects only.  The dump marks a full-sync
+            # floor: the puller's incremental cursors start at the
+            # CURRENT datalog heads, so age-trim must spare newer
+            # records until the grace passes.
+            self.note_fullsync_dump(name)
             return respond_json(
                 {k: v for k, v in self._index(name).items()
                  if not k.startswith(".upload.")})

@@ -315,6 +315,11 @@ class SyncAgent:
     #: sync rounds between datalog auto-trim passes (the trim needs
     #: one HTTP round-trip per peer, so it must not ride every tick)
     TRIM_EVERY = 50
+    #: zero-peer zones have no cursors to trim behind; entries older
+    #: than this are trimmed by AGE instead (ref: the reference's
+    #: rgw_data_log_window expiry) — bounded per shard per round
+    NOPEER_MAX_AGE_S = 3600.0
+    NOPEER_TRIM_MAX = 256
 
     def __init__(self, gw, interval: float = 0.1):
         self.gw = gw
@@ -444,7 +449,9 @@ class SyncAgent:
         # registered peer's durable cursor has passed (bounded log
         # growth without an operator in the loop)
         self._rounds += 1
-        if peers and self._rounds % self.TRIM_EVERY == 0:
+        if self._rounds % self.TRIM_EVERY == 0:
+            # zero-peer zones trim by age inside the round (the
+            # peer-cursor path needs peers; the age path needs none)
             self.datalog_trim_round()
         return applied
 
@@ -695,8 +702,11 @@ class SyncAgent:
         self.gw.multisite.refresh()
         peers = self.gw.multisite.peers()
         if not peers:
-            return 0        # no peers registered: no consumers, but
-            # also no evidence — leave the log for the operator
+            # no peers registered: no cursors, so no cursor-driven
+            # trim — but an unconsumed log must not grow forever
+            # either.  Age out old records (bounded), sparing
+            # anything past an in-flight full-sync floor.
+            return self._trim_by_age()
         views: list[dict] = []
         for peer in peers:
             try:
@@ -739,6 +749,54 @@ class SyncAgent:
             dout("rgw", 4).write(
                 "datalog auto-trim: %d record(s) behind all %d "
                 "peers' durable cursors", trimmed, len(peers))
+        return trimmed
+
+    def _trim_by_age(self) -> int:
+        """Datalog trim for a zone with ZERO registered peers: every
+        record older than NOPEER_MAX_AGE_S goes, at most
+        NOPEER_TRIM_MAX inspected per shard per round (the trim must
+        not turn into an unbounded scan on a hot shard).  One guard:
+        a peer mid-full-sync (it just pulled the bucket index dump —
+        e.g. a zone about to register) starts its incremental cursor
+        at the dump-time head, so records PAST the recorded floor
+        survive until the gateway's grace window expires."""
+        from ..cls.rgw import parse_mtime
+        now = time.time()
+        trimmed = 0
+        local = self.gw._buckets_raw()
+        for bucket, meta in local.items():
+            if "deleted" in meta:
+                continue
+            floors = self.gw.fullsync_floor(bucket)
+            for s in range(self.gw._nshards(bucket)):
+                try:
+                    entries, _head = self.datalog.list(
+                        bucket, s, 0, self.NOPEER_TRIM_MAX)
+                except RadosError:
+                    continue    # shard object gone/unreadable
+                upto = 0
+                for ent in entries:
+                    stamp = parse_mtime(ent.get("mtime", ""))
+                    if stamp <= 0 or now - stamp < \
+                            self.NOPEER_MAX_AGE_S:
+                        break   # entries list in seq order: the
+                        # first young (or unstamped) record ends the
+                        # trimmable prefix
+                    upto = ent["seq"]
+                if floors is not None:
+                    upto = min(upto, floors.get(s, 0))
+                if upto <= 0:
+                    continue
+                try:
+                    n = self.datalog.trim(bucket, s, upto)
+                except RadosError:
+                    continue
+                trimmed += n
+        self.datalog_trimmed += trimmed
+        if trimmed:
+            dout("rgw", 4).write(
+                "datalog age-trim (no peers): %d record(s) older "
+                "than %.0fs", trimmed, self.NOPEER_MAX_AGE_S)
         return trimmed
 
     def _forget_bucket(self, src: str, bucket: str) -> None:

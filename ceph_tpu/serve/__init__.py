@@ -1,0 +1,18 @@
+"""ceph_tpu.serve — paged artifact store for LLM serving.
+
+Model checkpoints and KV-cache page pools as first-class RADOS
+citizens: a fixed page grid striped over epoch-versioned objects
+(manifest.py), a batched parallel page-fetch wave, and per-handle
+readahead policies — `checkpoint` streaming vs `kvcache` random
+page gets with pin/refcount residency (store.py).
+"""
+from .manifest import ArtifactManifest, ShardInfo, data_oid, \
+    manifest_oid
+from .store import ArtifactHandle, ArtifactStore, DEFAULT_PAGE, \
+    default_layout
+
+__all__ = [
+    "ArtifactHandle", "ArtifactManifest", "ArtifactStore",
+    "DEFAULT_PAGE", "ShardInfo", "data_oid", "default_layout",
+    "manifest_oid",
+]

@@ -325,6 +325,67 @@ def cmd_rgw(r, a, out):
     return 0
 
 
+def cmd_serve(r, a, out):
+    """Paged artifact store verbs (ceph_tpu.serve): put a checkpoint
+    shard, stream it back through a readahead policy, stat the
+    manifest, or inspect individual pages."""
+    import hashlib
+    import json
+    from ..serve import ArtifactStore
+
+    def usage(msg):
+        print(f"error: {msg}", file=sys.stderr)
+        return 1
+
+    io = r.open_ioctx(a.pool)
+    st = ArtifactStore(io, page_size=a.page_size)
+    if a.verb == "put":
+        if len(a.args) != 1:
+            return usage("serve put <pool> <name> <infile> "
+                         "[--shard s] [--page-size n]")
+        data = sys.stdin.buffer.read() if a.args[0] == "-" else \
+            open(a.args[0], "rb").read()
+        m = st.put(a.name, shards={a.shard: data})
+        si = m.shards[a.shard]
+        print(f"published {a.name} epoch {m.epoch}: shard "
+              f"{a.shard} {si.size} B in {si.n_pages} pages "
+              f"({len(si.vlens)} ragged)", file=out)
+    elif a.verb == "get":
+        if len(a.args) > 1:
+            return usage("serve get <pool> <name> [outfile] "
+                         "[--shard s] [--policy p]")
+        h = st.open(a.name, policy=a.policy)
+        data = h.read_shard(a.shard)
+        h.close()
+        outfile = a.args[0] if a.args else "-"
+        if outfile == "-":
+            out.write(data.decode(errors="replace"))
+        else:
+            with open(outfile, "wb") as f:
+                f.write(data)
+    elif a.verb == "stat":
+        print(json.dumps(st.stat(a.name), indent=1, sort_keys=True),
+              file=out)
+    elif a.verb == "pages":
+        if len(a.args) != 2:
+            return usage("serve pages <pool> <name> <shard> "
+                         "<id,id,...>")
+        shard = a.args[0]
+        try:
+            ids = [int(x) for x in a.args[1].split(",") if x]
+        except ValueError:
+            return usage(f"bad page-id list {a.args[1]!r}")
+        m = st.manifest(a.name)
+        if shard not in m.shards:
+            return usage(f"no shard {shard!r} in {a.name}")
+        blobs = st.fetch_pages(a.name, shard, ids, manifest=m)
+        for pid, blob in zip(ids, blobs):
+            digest = hashlib.sha256(blob).hexdigest()[:16]
+            print(f"page {pid}: {len(blob)} B sha256 {digest}",
+                  file=out)
+    return 0
+
+
 # ---------------------------------------------------------------- bench
 # (ref: src/common/obj_bencher.cc ObjBencher::write_bench /
 #  seq_read_bench: fixed-depth aio pipeline, per-op latency tracking,
@@ -470,6 +531,19 @@ def main(argv=None, rados=None, out=None) -> int:
                         "(sync-status)")
     p.add_argument("--secret", default="",
                    help="system-user secret key (sync-status)")
+    p = sub.add_parser("serve")
+    p.add_argument("verb", choices=["put", "get", "stat", "pages"])
+    p.add_argument("pool")
+    p.add_argument("name", help="artifact name")
+    p.add_argument("args", nargs="*")
+    p.add_argument("--shard", default="shard0",
+                   help="shard name (put/get)")
+    p.add_argument("--page-size", type=int, default=1 << 16,
+                   help="page size for put (readers take it from "
+                        "the manifest)")
+    p.add_argument("--policy", default="checkpoint",
+                   choices=["checkpoint", "kvcache"],
+                   help="readahead policy for get")
     p = sub.add_parser("bench")
     p.add_argument("pool")
     p.add_argument("seconds", type=float)
@@ -501,7 +575,8 @@ def main(argv=None, rados=None, out=None) -> int:
                   "listomapvals": cmd_listomapvals,
                   "crash": cmd_crash, "telemetry": cmd_telemetry,
                   "insights": cmd_insights,
-                  "rgw": cmd_rgw}[a.cmd](rados, a, out)
+                  "rgw": cmd_rgw,
+                  "serve": cmd_serve}[a.cmd](rados, a, out)
             return rc or 0
         except RadosError as e:
             print(f"error: {e}", file=sys.stderr)

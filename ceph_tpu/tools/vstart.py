@@ -19,6 +19,8 @@ command language:
     crash [ls|ls-new|stat|info <id>|archive <id>|archive-all|prune <d>]
     telemetry [show|status|on|off] | insights
     trace on|off | trace ls | trace <trace_id>
+    serve put <pool> <name> <file> | serve get <pool> <name> [file]
+    serve stat <pool> <name> | serve pages <pool> <name> <shard> <ids>
     perf dump | status | quit
 
 Example:
@@ -245,6 +247,8 @@ class VstartShell:
             return True
         if cmd == "rgw":
             return self._rgw(toks[1:])
+        if cmd == "serve":
+            return self._serve(toks[1:])
         if cmd == "trace":
             return self._trace(toks[1:])
         if cmd == "perf" and toks[1:] == ["dump"]:
@@ -350,6 +354,63 @@ class VstartShell:
                     self._print(r.read().decode(errors="replace"))
             return True
         self._print(f"Error: unknown rgw verb {sub}")
+        return True
+
+    def _serve(self, toks: list[str]) -> bool:
+        """Paged artifact store verbs (ceph_tpu.serve):
+          serve put <pool> <name> <file>    — publish as one shard
+          serve get <pool> <name> [file]    — stream a shard back
+          serve stat <pool> <name>          — manifest summary
+          serve pages <pool> <name> <shard> <id,id,...>
+        """
+        import hashlib
+        from ..serve import ArtifactStore
+        if not toks:
+            self._print("serve put|get|stat|pages ...")
+            return True
+        sub, rest = toks[0], toks[1:]
+        if sub not in ("put", "get", "stat", "pages") or \
+                len(rest) < 2:
+            self._print(f"Error: serve {sub} wants "
+                        "<pool> <name> ... (see docstring)")
+            return True
+        st = ArtifactStore(self.rados.open_ioctx(rest[0]))
+        name = rest[1]
+        if sub == "put":
+            if len(rest) != 3:
+                self._print("Error: serve put <pool> <name> <file>")
+                return True
+            data = open(rest[2], "rb").read()
+            m = st.put(name, shards={"shard0": data})
+            si = m.shards["shard0"]
+            self._print(f"published {name} epoch {m.epoch}: "
+                        f"{si.size} B in {si.n_pages} pages")
+            return True
+        if sub == "get":
+            h = st.open(name)
+            data = h.read_shard("shard0")
+            h.close()
+            dst = rest[2] if len(rest) > 2 else "-"
+            if dst == "-":
+                self.out.write(data.decode(errors="replace"))
+                self.out.flush()
+            else:
+                open(dst, "wb").write(data)
+                self._print(f"read {len(data)} bytes to {dst}")
+            return True
+        if sub == "stat":
+            self._print(json.dumps(st.stat(name), indent=1,
+                                   sort_keys=True))
+            return True
+        # pages
+        if len(rest) != 4:
+            self._print("Error: serve pages <pool> <name> <shard> "
+                        "<id,id,...>")
+            return True
+        ids = [int(x) for x in rest[3].split(",") if x]
+        for pid, blob in zip(ids, st.fetch_pages(name, rest[2], ids)):
+            digest = hashlib.sha256(blob).hexdigest()[:16]
+            self._print(f"page {pid}: {len(blob)} B sha256 {digest}")
         return True
 
     def _trace(self, toks: list[str]) -> bool:

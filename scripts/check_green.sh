@@ -207,6 +207,23 @@ run_chaos_smoke() {
     return 0
 }
 
+# Serve smoke: the LLM artifact store must stream a sharded
+# checkpoint byte-identical through both readahead policies and
+# fetch random KV pages batched == per-page loop, healthy AND with
+# one EC shard's OSD killed (degraded reconstruction).
+run_serve_smoke() {
+    echo "=== check_green: serve (artifact store) smoke ==="
+    timeout -k 10 180 env JAX_PLATFORMS=cpu \
+        python scripts/serve_smoke.py
+    local rc=$?
+    if [ "$rc" -ne 0 ]; then
+        echo "check_green: RED (serve smoke rc=$rc — artifact" \
+             "store broken) — do not ship" >&2
+        return 1
+    fi
+    return 0
+}
+
 run_static || exit 1
 if [ "$STATIC_ONLY" -eq 1 ]; then
     echo "check_green: GREEN (static only)"
@@ -220,11 +237,12 @@ run_multisite_smoke || exit 1
 run_trace_smoke || exit 1
 run_recovery_smoke || exit 1
 run_chaos_smoke || exit 1
+run_serve_smoke || exit 1
 
 if [ "$REPEAT" -gt 1 ] && [ ${#TARGETS[@]} -eq 0 ]; then
     TARGETS=(tests/test_thrasher.py tests/test_thrash_ec.py \
              tests/test_snaptrim.py tests/test_rgw_multisite.py \
-             tests/test_chaos.py)
+             tests/test_chaos.py tests/test_serve.py)
 fi
 if [ ${#TARGETS[@]} -eq 0 ]; then
     TARGETS=(tests/)

@@ -137,6 +137,31 @@ def drive_workload() -> None:
         j.replay(lambda t, d: got.append(d["v"]))   # torn-tail handler
         assert got == [1]
 
+        # -- serve: lost-object sparse reads + CLI error paths ------
+        from io import StringIO
+        from ceph_tpu.serve import ArtifactStore
+        from ceph_tpu.tools import rados_cli
+        st = ArtifactStore(io, page_size=4096)
+        # first put probes for a prior manifest: ENOENT -> epoch 1
+        m1 = st.put("smoke-art", shards={"s": b"\x5a" * (3 * 4096 + 7)})
+        assert m1.epoch == 1
+        io.remove(sorted(m1.data_oids())[-1])   # lose a data object
+        # sparse semantics: BOTH fetch paths read the hole as zeros
+        # (the batched wave and the per-page loop hit distinct
+        # ENOENT-tolerant handlers)
+        wave = st.fetch_pages("smoke-art", "s", [0, 1, 2, 3])
+        loop = st.fetch_pages("smoke-art", "s", [0, 1, 2, 3],
+                              batched=False)
+        assert wave == loop
+        # epoch flip over the half-removed epoch: cleanup tolerates
+        # already-gone objects
+        assert st.put("smoke-art", shards={"s": b"\xa5" * 4096}
+                      ).epoch == 2
+        # CLI: malformed page-id list reports usage, not a traceback
+        assert rados_cli.main(["serve", "pages", "meta", "smoke-art",
+                               "s", "0,zap"], rados=r,
+                              out=StringIO()) == 1
+
         # -- mon command error paths --------------------------------
         try:
             r.mon_command({"prefix": "no such command"})

@@ -28,6 +28,68 @@ def test_compressor_stored_raw_fallback():
     assert len(blob) < len(rnd) + 32
 
 
+def test_striper_extent_to_file_roundtrip_property():
+    """Random layouts x random file ranges: file_to_extents followed
+    by extent_to_file must reproduce exactly the logical range, with
+    no overlap, no gap, and no spill outside it."""
+    import random
+
+    from ceph_tpu.osdc.striper import Striper
+
+    rng = random.Random(1234)
+    for _ in range(200):
+        su = rng.choice([1, 7, 512, 4096, 65536])
+        spo = rng.randrange(1, 9)
+        sc = rng.randrange(1, 7)
+        layout = StripeLayout(stripe_unit=su, stripe_count=sc,
+                              object_size=su * spo)
+        span = su * spo * sc * 3
+        off = rng.randrange(0, span)
+        length = rng.randrange(0, span)
+        exts = Striper.file_to_extents(layout, off, length)
+        # forward map covers [off, off+length) exactly, in order
+        assert sum(e.length for e in exts) == length
+        pos = off
+        covered = []
+        for e in exts:
+            assert e.logical_offset == pos
+            assert 0 <= e.offset and \
+                e.offset + e.length <= layout.object_size
+            covered += Striper.extent_to_file(
+                layout, e.objectno, e.offset, e.length)
+            pos += e.length
+        # inverse map lands back on the same logical bytes
+        covered.sort()
+        assert sum(n for _, n in covered) == length
+        if covered:
+            assert covered[0][0] == off
+            at = off
+            for lo, n in covered:
+                assert lo == at, (layout, off, length)
+                at += n
+            assert at == off + length
+
+
+def test_striper_ragged_tail_extents():
+    """A length that is aligned to neither page, stripe unit, nor
+    object boundary still round-trips byte-exact through the striper
+    (the serve layout's ragged-tail case)."""
+    from ceph_tpu.osdc.striper import Striper
+
+    layout = StripeLayout(stripe_unit=4096, stripe_count=3,
+                          object_size=16384)
+    length = 2 * 16384 * 3 + 5 * 4096 + 123     # mid-block tail
+    exts = Striper.file_to_extents(layout, 0, length)
+    assert sum(e.length for e in exts) == length
+    tail = exts[-1]
+    assert tail.length == 123                    # ragged final extent
+    back = Striper.extent_to_file(layout, tail.objectno, tail.offset,
+                                  tail.length)
+    assert back == [(length - 123, 123)]
+    # zero-length range maps to no extents at all
+    assert Striper.file_to_extents(layout, 500, 0) == []
+
+
 def test_rados_striper(request):
     c = MiniCluster(n_osd=4, threaded=True)
     try:

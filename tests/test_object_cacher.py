@@ -272,6 +272,116 @@ def test_readahead_disabled_with_zero_max():
     assert b.reads == 16
 
 
+# -- readahead policies / pins / read_many (serve: PR 19) --------------
+
+def test_policy_selection_by_name_and_instance():
+    from ceph_tpu.osdc.object_cacher import (CheckpointReadahead,
+                                             KVCacheReadahead)
+    _, oc = mk(policy="kvcache")
+    assert isinstance(oc.policy, KVCacheReadahead)
+    _, oc = mk(policy=CheckpointReadahead())
+    assert oc.policy.name == "checkpoint"
+    with pytest.raises(KeyError):
+        mk(policy="not-a-policy")
+
+
+def test_kvcache_policy_never_reads_ahead():
+    """Sequential reads through the kvcache policy must NOT open a
+    readahead window — random page ids make overshoot pure waste."""
+    b, oc = mk(page=4096, max_readahead=64 << 10, policy="kvcache")
+    b.objs["o"] = bytearray(b"k" * (256 << 10))
+    for off in range(0, 256 << 10, 4096):       # perfectly sequential
+        oc.read("o", off, 4096)
+    assert oc.stats["readahead_pages"] == 0
+    assert b.reads == 64                        # one per page miss
+
+
+def test_pin_exempts_pages_from_eviction_until_unpin():
+    b, oc = mk(page=4096, max_size=8 * 4096, policy="kvcache")
+    b.objs["hot"] = bytearray(b"h" * (4 * 4096))
+    b.objs["cold"] = bytearray(b"c" * (64 * 4096))
+    oc.pin("hot", 0, 4 * 4096)
+    assert oc.pinned_bytes() == 4 * 4096
+    reads_after_pin = b.reads
+    oc.read("cold", 0, 64 * 4096)               # blows the LRU budget
+    assert oc.cached_bytes() <= 8 * 4096
+    # pinned pages survived the eviction storm: re-read hits cache
+    assert oc.read("hot", 0, 4 * 4096) == b"h" * (4 * 4096)
+    assert b.reads == reads_after_pin + 1       # only the cold read
+    oc.unpin("hot", 0, 4 * 4096)
+    assert oc.pinned_bytes() == 0
+    oc.read("cold", 0, 64 * 4096)               # now hot may evict
+    assert oc.cached_bytes() <= 8 * 4096
+    with pytest.raises(ValueError):
+        oc.unpin("hot", 0, 4096)                # unbalanced unpin
+    with pytest.raises(ValueError):
+        oc.unpin("never-cached", 0, 4096)
+
+
+def test_read_many_batches_backing_reads():
+    """A ragged multi-range wave goes to the backing store as
+    coalesced contiguous runs through read_many_fn — not one read per
+    page — and returns bytes identical to per-range read()s."""
+    batches = []
+
+    def read_many_fn(fetches):
+        batches.append(list(fetches))
+        return [b.read(oid, off, ln) for oid, off, ln in fetches]
+
+    b = Backing()
+    oc = ObjectCacher(b.read, b.write, page=4096, policy="kvcache",
+                      read_many_fn=read_many_fn)
+    b.objs["o1"] = bytearray(bytes(range(256)) * 256)   # 64 KiB
+    b.objs["o2"] = bytearray(b"Z" * (64 << 10))
+    reqs = [("o1", 0, 4096), ("o1", 4096, 4096),        # contiguous
+            ("o1", 3 * 4096, 100), ("o2", 8 * 4096, 8192),
+            ("o2", 0, 1)]
+    got = oc.read_many(reqs)
+    assert got == [bytes(b.objs[oid][off:off + ln])
+                   for oid, off, ln in reqs]
+    # one wave; pages 0-1 coalesced into a single run
+    assert len(batches) == 1
+    assert ("o1", 0, 8192) in batches[0]
+    assert len(batches[0]) == 4                  # 2 runs/oid, not 5
+    assert oc.stats["miss"] == len(reqs)
+    # the whole wave again: pure hits, no second wave
+    assert oc.read_many(reqs) == got
+    assert len(batches) == 1
+    assert oc.stats["hit"] == len(reqs)
+
+
+def test_read_many_shared_page_counts_demand_not_readahead():
+    """Two requests overlapping the same missing page are two misses
+    served by one backing run, and a page prefetched for a SIBLING
+    request is demand — readahead_pages counts only policy overshoot
+    nobody asked for."""
+    b, oc = mk(page=4096, policy="kvcache")
+    b.objs["o"] = bytearray(b"s" * (32 << 10))
+    got = oc.read_many([("o", 0, 100), ("o", 200, 100)])
+    assert got == [b"s" * 100, b"s" * 100]
+    assert oc.stats["miss"] == 2                 # both needed bytes
+    assert b.reads == 1                          # one shared fill
+    assert oc.stats["readahead_pages"] == 0
+
+    # checkpoint policy overshoot IS counted when it fetches pages
+    # beyond every request in the batch
+    b2, oc2 = mk(page=4096, max_readahead=32 << 10)
+    b2.objs["o"] = bytearray(b"t" * (256 << 10))
+    oc2.read("o", 0, 4096)                       # prime the detector
+    oc2.read_many([("o", 4096, 4096)])           # sequential resume
+    assert oc2.stats["readahead_pages"] > 0
+
+
+def test_read_many_falls_back_to_read_fn_and_handles_empty():
+    b, oc = mk(page=4096, policy="kvcache")
+    b.objs["o"] = bytearray(b"f" * 8192)
+    assert oc.read_many([]) == []
+    got = oc.read_many([("o", 0, 8192), ("o", 100, 0),
+                        ("missing", 0, 4096)])
+    assert got == [b"f" * 8192, b"", b"\0" * 4096]   # sparse zeros
+    assert b.reads == 2                          # one run per object
+
+
 def test_readahead_pages_counted_only_when_fetched():
     """ADVICE r5 low: `readahead_pages` must count pages the miss
     path actually fetched — full hits (and overshoot into

@@ -1093,3 +1093,46 @@ def test_datalog_head_probe_returns_no_entries(ms):
         assert ents == []
         heads += head
     assert heads >= 1           # the put IS in some shard's log
+
+
+def test_zero_peer_datalog_trims_by_age_respecting_fullsync(cluster):
+    """Zero-peer residual (ROADMAP): a zone with NO registered peers
+    has no cursors to trim behind, so its datalog ages out instead —
+    bounded per round, and never past an in-flight full-sync floor
+    (a peer that just pulled the bucket index dump starts its
+    incremental cursors at the dump-time heads)."""
+    solo, = cluster.rgw_multisite(zones=("solo",), zonegroup="zgsolo",
+                                  realm="lone", sync_interval=0.5)
+    assert solo.multisite.peers() == []
+    req(solo, "PUT", "/ab")
+    for i in range(6):
+        req(solo, "PUT", f"/ab/k{i}", b"v%d" % i)
+    first = _dl_entries(solo, "ab")
+    assert len(first) == 6
+    # entries are younger than the age bar: nothing trims
+    assert solo.sync.datalog_trim_round() == 0
+    assert len(_dl_entries(solo, "ab")) == 6
+
+    # an in-flight full sync (the bucket index dump) floors the trim:
+    # records past the dump-time heads must survive any aging
+    assert json.loads(req(solo, "GET", "/admin/bucket?name=ab")[2])
+    floors = solo.fullsync_floor("ab")
+    assert floors and sum(floors.values()) >= 6
+    for i in range(3):
+        req(solo, "PUT", f"/ab/post{i}", b"p%d" % i)
+    time.sleep(0.15)
+    solo.sync.NOPEER_MAX_AGE_S = 0.1        # everything now "old"
+    assert _wait(lambda: (solo.sync.datalog_trim_round() or True) and
+                 len(_dl_entries(solo, "ab")) == 3)
+    # exactly the pre-dump records went; the post-dump ones survived
+    left = {e["key"] for e in _dl_entries(solo, "ab")}
+    assert left == {f"post{i}" for i in range(3)}
+
+    # grace expiry releases the floor: the rest ages out too, still
+    # bounded per shard per round
+    solo.FULLSYNC_GRACE_S = 0.0
+    assert solo.fullsync_floor("ab") is None
+    solo.sync.NOPEER_TRIM_MAX = 1
+    assert _wait(lambda: (solo.sync.datalog_trim_round() or True) and
+                 _dl_entries(solo, "ab") == [])
+    assert solo.sync.datalog_trimmed >= 9

@@ -1,0 +1,269 @@
+"""ceph_tpu.serve: paged artifact store — manifest math, put/get
+byte-identity through both readahead policies, batched page-fetch
+waves, pin residency, epoch flips, CLI verbs, and EC-degraded reads
+(PR 19)."""
+import io as _io
+import json
+import random
+
+import pytest
+
+from ceph_tpu.serve import (ArtifactManifest, ArtifactStore,
+                            ShardInfo, data_oid, manifest_oid)
+from ceph_tpu.serve.manifest import paginate, shard_from_pages
+from ceph_tpu.osdc.striper import StripeLayout
+from ceph_tpu.testing import MiniCluster
+from ceph_tpu.tools import rados_cli
+
+PAGE = 4096
+LAYOUT = StripeLayout(stripe_unit=4 * PAGE, stripe_count=2,
+                      object_size=16 * PAGE)
+
+
+# ------------------------------------------------- manifest (pure)
+
+def test_paginate_and_shard_from_pages():
+    assert paginate(b"", PAGE) == (1, 0, {0: 0})
+    assert paginate(b"x" * PAGE, PAGE) == (1, PAGE, {})
+    assert paginate(b"x" * (PAGE + 7), PAGE) == (2, PAGE + 7, {1: 7})
+    si = shard_from_pages([b"a" * PAGE, b"b" * 9, b""], PAGE)
+    assert (si.n_pages, si.size) == (3, PAGE + 9)
+    assert si.vlens == {1: 9, 2: 0}
+    assert si.vlen(0, PAGE) == PAGE and si.vlen(2, PAGE) == 0
+    with pytest.raises(ValueError):
+        shard_from_pages([b"x" * (PAGE + 1)], PAGE)
+
+
+def test_manifest_json_roundtrip_and_versioning():
+    m = ArtifactManifest(
+        name="ck", epoch=3, page_size=PAGE, layout=LAYOUT,
+        shards={"s0": ShardInfo(n_pages=5, size=4 * PAGE + 11,
+                                vlens={4: 11}),
+                "kv": ShardInfo(n_pages=2, size=PAGE, vlens={1: 0})})
+    m2 = ArtifactManifest.from_json(m.to_json())
+    assert m2 == m
+    # a manifest from the future must refuse to parse, not misread
+    d = json.loads(m.to_json())
+    d["version"] = 99
+    with pytest.raises(ValueError):
+        ArtifactManifest.from_json(json.dumps(d).encode())
+
+
+def test_manifest_page_extents_ragged_and_bounds():
+    m = ArtifactManifest(
+        name="ck", epoch=1, page_size=PAGE, layout=LAYOUT,
+        shards={"s": ShardInfo(n_pages=3, size=2 * PAGE + 5,
+                               vlens={1: 0, 2: 5})})
+    full = m.page_extents("s", 0)
+    assert sum(e.length for e in full) == PAGE
+    assert m.page_extents("s", 1) == []          # zero page: no bytes
+    tail = m.page_extents("s", 2)
+    assert sum(e.length for e in tail) == 5      # ragged: vlen only
+    assert tail[0].logical_offset == 2 * PAGE
+    with pytest.raises(IndexError):
+        m.page_extents("s", 3)
+    with pytest.raises(IndexError):
+        m.page_extents("s", -1)
+
+
+# -------------------------------------------------- cluster-backed
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(n_osd=5, threaded=False)
+    try:
+        c.pump()
+        c.wait_all_up()
+        r = c.rados()
+        r.mon_command({"prefix": "osd erasure-code-profile set",
+                       "name": "serve_t",
+                       "profile": {"plugin": "tpu", "k": "2", "m": "1",
+                                   "crush-failure-domain": "host"}})
+        r.pool_create("sv", pg_num=8, pool_type="erasure",
+                      erasure_code_profile="serve_t")
+        c.pump()
+        yield c
+    finally:
+        c.shutdown()
+
+
+@pytest.fixture()
+def store(cluster):
+    return ArtifactStore(cluster.rados().open_ioctx("sv"),
+                         page_size=PAGE, layout=LAYOUT)
+
+
+def test_put_get_byte_identical_both_policies(store):
+    rng = random.Random(7)
+    s0 = rng.randbytes(9 * PAGE + 321)           # ragged tail
+    s1 = rng.randbytes(PAGE)                     # exactly one page
+    s2 = b""                                     # empty shard
+    m = store.put("ckpt", shards={"s0": s0, "s1": s1, "s2": s2})
+    assert m.epoch == 1
+    assert m.shards["s0"].vlens == {9: 321}
+    for policy in ("checkpoint", "kvcache"):
+        h = store.open("ckpt", policy=policy)
+        assert h.read_shard("s0", chunk=3 * PAGE) == s0
+        assert h.read_shard("s1") == s1
+        assert h.read_shard("s2") == b""
+        # range reads across object/stripe boundaries
+        assert h.read("s0", 3 * PAGE - 5, 4 * PAGE) == \
+            s0[3 * PAGE - 5:7 * PAGE - 5]
+        h.close()
+    # the checkpoint policy actually opened a readahead window; the
+    # kvcache policy must not have (fresh handles, same stream)
+    h_ck = store.open("ckpt", policy="checkpoint")
+    h_kv = store.open("ckpt", policy="kvcache")
+    for h in (h_ck, h_kv):
+        h.read_shard("s0", chunk=PAGE)
+    assert h_ck.stats["readahead_pages"] > 0
+    assert h_kv.stats["readahead_pages"] == 0
+    h_ck.close()
+    h_kv.close()
+
+
+def test_put_validates_inputs(store):
+    with pytest.raises(ValueError):
+        store.put("nothing")
+    with pytest.raises(ValueError):
+        store.put("dup", shards={"a": b"x"}, pages={"a": [b"y"]})
+
+
+def test_fetch_pages_batched_equals_loop(store):
+    rng = random.Random(23)
+    kv = [rng.randbytes(rng.choice([PAGE, PAGE, 500, 0]))
+          for _ in range(40)]
+    m = store.put("kvpool", pages={"kv": kv})
+    # ragged id list with duplicates, covering ragged + empty pages
+    ids = [rng.randrange(len(kv)) for _ in range(25)] + [0, 0]
+    want = [kv[i] for i in ids]
+    assert store.fetch_pages("kvpool", "kv", ids) == want
+    assert store.fetch_pages("kvpool", "kv", ids,
+                             batched=False) == want
+    assert store.fetch_pages("kvpool", "kv", [], manifest=m) == []
+    with pytest.raises(KeyError):
+        store.fetch_pages("kvpool", "nope", [0])
+
+
+def test_interior_ragged_shard_refuses_streaming(store):
+    store.put("ragged", pages={"kv": [b"a" * PAGE, b"b" * 5,
+                                      b"c" * PAGE]})
+    h = store.open("ragged", policy="kvcache")
+    with pytest.raises(ValueError):
+        h.read_shard("kv")
+    # but page access works and is byte-exact
+    assert h.get_pages("kv", [1, 0, 2]) == \
+        [b"b" * 5, b"a" * PAGE, b"c" * PAGE]
+    h.close()
+
+
+def test_get_pages_pin_unpin_residency(store):
+    rng = random.Random(31)
+    kv = [rng.randbytes(PAGE) for _ in range(16)]
+    store.put("pins", pages={"kv": kv})
+    h = store.open("pins", policy="kvcache")
+    ids = [3, 7, 3, 11]
+    assert h.get_pages("kv", ids, pin=True) == [kv[i] for i in ids]
+    assert h.cacher.pinned_bytes() > 0
+    # pinned pages re-serve from cache: no new miss
+    misses = h.stats["miss"]
+    assert h.get_pages("kv", ids) == [kv[i] for i in ids]
+    assert h.stats["miss"] == misses
+    h.unpin_pages("kv", ids)
+    assert h.cacher.pinned_bytes() == 0
+    with pytest.raises(ValueError):
+        h.unpin_pages("kv", ids)                 # unbalanced
+    h.close()
+
+
+def test_epoch_flip_replaces_objects_atomically(store, cluster):
+    io = cluster.rados().open_ioctx("sv")
+    m1 = store.put("flip", shards={"w": b"v1" * PAGE})
+    old_oids = set(m1.data_oids())
+    assert old_oids and all(o.startswith("flip.e1.") for o in old_oids)
+    m2 = store.put("flip", shards={"w": b"v2" * (2 * PAGE)})
+    assert m2.epoch == 2
+    h = store.open("flip")
+    assert h.read_shard("w") == b"v2" * (2 * PAGE)
+    h.close()
+    # the old epoch's data objects were reaped after the flip
+    live = set(io.list_objects())
+    assert not (old_oids & live)
+    assert manifest_oid("flip") in live
+    assert store.stat("flip")["epoch"] == 2
+    # delete removes data + manifest
+    store.delete("flip")
+    live = set(io.list_objects())
+    assert manifest_oid("flip") not in live
+    assert not any(o.startswith("flip.e") for o in live)
+
+
+def test_stat_reports_shards_and_raggedness(store):
+    store.put("st", shards={"a": b"z" * (2 * PAGE + 9)},
+              pages={"kv": [b"q" * 100]})
+    st = store.stat("st")
+    assert st["epoch"] == 1 and st["page_size"] == PAGE
+    assert st["shards"]["a"] == {"size": 2 * PAGE + 9, "n_pages": 3,
+                                 "ragged_pages": 1}
+    assert st["shards"]["kv"]["ragged_pages"] == 1
+    assert st["bytes"] == 2 * PAGE + 9 + 100
+
+
+def test_cli_serve_verbs(store, cluster, tmp_path):
+    rng = random.Random(41)
+    payload = rng.randbytes(3 * PAGE + 17)
+    src = tmp_path / "ckpt.bin"
+    src.write_bytes(payload)
+    r = cluster.rados()
+    out = _io.StringIO()
+    rc = rados_cli.main(
+        ["serve", "put", "sv", "cli-art", str(src),
+         "--page-size", str(PAGE)], rados=r, out=out)
+    assert rc == 0
+    assert "epoch 1" in out.getvalue()
+    dst = tmp_path / "back.bin"
+    rc = rados_cli.main(
+        ["serve", "get", "sv", "cli-art", str(dst),
+         "--page-size", str(PAGE), "--policy", "kvcache"],
+        rados=r, out=_io.StringIO())
+    assert rc == 0
+    assert dst.read_bytes() == payload
+    out = _io.StringIO()
+    rc = rados_cli.main(
+        ["serve", "stat", "sv", "cli-art",
+         "--page-size", str(PAGE)], rados=r, out=out)
+    assert rc == 0
+    st = json.loads(out.getvalue())
+    assert st["shards"]["shard0"]["size"] == len(payload)
+    out = _io.StringIO()
+    rc = rados_cli.main(
+        ["serve", "pages", "sv", "cli-art", "shard0", "0,3",
+         "--page-size", str(PAGE)], rados=r, out=out)
+    assert rc == 0
+    lines = out.getvalue().splitlines()
+    assert lines[0].startswith(f"page 0: {PAGE} B sha256 ")
+    assert lines[1].startswith("page 3: 17 B sha256 ")
+    # malformed verbs fail with usage, not a traceback
+    assert rados_cli.main(["serve", "put", "sv", "x"],
+                          rados=r, out=_io.StringIO()) == 1
+    assert rados_cli.main(["serve", "pages", "sv", "cli-art",
+                           "shard0", "1,zap"],
+                          rados=r, out=_io.StringIO()) == 1
+
+
+# keep LAST in the module: kills an OSD of the module-scoped cluster
+def test_degraded_ec_reads_byte_identical(store, cluster):
+    rng = random.Random(53)
+    ckpt = rng.randbytes(7 * PAGE + 99)
+    kv = [rng.randbytes(rng.choice([PAGE, 640])) for _ in range(12)]
+    store.put("deg", shards={"s0": ckpt}, pages={"kv": kv})
+    victim = 0
+    cluster.kill_osd(victim)
+    cluster.rados().mon_command({"prefix": "osd down",
+                                 "ids": [victim]})
+    cluster.pump()
+    h = store.open("deg", policy="checkpoint")
+    assert h.read_shard("s0") == ckpt            # reconstructed
+    h.close()
+    ids = [rng.randrange(len(kv)) for _ in range(8)]
+    assert store.fetch_pages("deg", "kv", ids) == [kv[i] for i in ids]

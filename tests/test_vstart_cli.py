@@ -21,6 +21,10 @@ def test_vstart_shell_tour(tmp_path):
             "pg map 0.1",
             "pg scrub 0.1",
             "balance",
+            f"serve put p art {src}",
+            f"serve get p art {tmp_path / 'art_back'}",
+            "serve stat p art",
+            "serve pages p art shard0 0",
             "osd down 1",
             "osd in 1",
             "status",
@@ -36,6 +40,11 @@ def test_vstart_shell_tour(tmp_path):
         assert '"inconsistent": []' in text
         assert "marked down osd.1" in text
         assert '"op"' in text            # perf dump
+        assert "published art epoch 1" in text
+        assert (tmp_path / "art_back").read_bytes() == \
+            src.read_bytes()
+        assert '"ragged_pages": 1' in text      # serve stat
+        assert "page 0: 120 B sha256 " in text  # serve pages
         # errors report, not raise, and the shell keeps running
         assert sh.run_line("bogus command here")
         assert "Error:" in out.getvalue()

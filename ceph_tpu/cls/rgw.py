@@ -14,6 +14,12 @@ Entry format (JSON, one omap value per key; shared with
 rgw/gateway.py):
   plain:     {"size", "etag", "mtime"}
   versioned: {"versions": [head..tail], "size", "etag", "mtime", "dm"}
+  tombstone: {"tomb": true, "mtime"} — a plain delete leaves this in
+             place of the entry (invisible to reads/listings) so a
+             peer zone's put record that raced the delete compares
+             against the delete's mtime instead of landing on an
+             absent key and resurrecting the object.  A newer put
+             (local or replicated) overwrites it.
 Each version: {"vid", "size", "etag", "mtime", "dm", "obj"} where
 "obj" names the RADOS data object backing that version (None for
 delete markers).
@@ -113,11 +119,23 @@ def _load(ctx, key: str, raw: dict | None = None) -> dict | None:
     return json.loads(v) if v else None
 
 
+def is_tomb(ent: dict | None) -> bool:
+    """Per-key delete tombstone (see module docstring).  Shared with
+    the gateway so its reads/listings drop tombstones the same way
+    they drop datalog keys."""
+    return bool(ent) and bool(ent.get("tomb"))
+
+
+def _set_tomb(ctx, key: str, mtime: str) -> None:
+    ctx.omap_set({key: json.dumps(
+        {"tomb": True, "mtime": mtime}).encode()})
+
+
 def _fold(ent: dict | None, plain_obj: str | None) -> list:
     """Existing version stack; a pre-versioning plain entry becomes
     the S3 'null' version backed by the plain data object
     (ref: rgw null-version semantics)."""
-    if ent is None:
+    if ent is None or is_tomb(ent):
         return []
     if ent.get("versions") is not None:
         return ent["versions"]
@@ -164,8 +182,10 @@ def obj_store(ctx, d):
         d = dict(d, mtime=_bump_mtime(
             ent["mtime"] if ent is not None else None, d["mtime"]))
         removed = []
+        # a tombstone backs no data object (its delete already gc'd
+        # it) — only a live entry orphans anything
         old = (ent.get("obj") or d.get("plain_obj")) \
-            if ent is not None else None
+            if ent is not None and not is_tomb(ent) else None
         if old and old != d["obj"]:
             removed.append(old)
         ctx.omap_set({key: json.dumps(
@@ -249,7 +269,7 @@ def obj_delete_version(ctx, d):
     key = d["key"]
     raw = ctx.omap_get()
     ent = _load(ctx, key, raw)
-    if ent is None:
+    if ent is None or is_tomb(ent):
         raise ClsError("ENOENT", key)
     versions = _fold(ent, d.get("plain_obj"))
     keep = [v for v in versions if v["vid"] != d["vid"]]
@@ -272,21 +292,24 @@ def obj_delete_plain(ctx, d):
     key = d["key"]
     raw = ctx.omap_get()
     ent = _load(ctx, key, raw)
-    if ent is None:
-        return {"removed": []}
+    if ent is None or is_tomb(ent):
+        return {"removed": []}   # nothing live to delete; an existing
+        # tombstone keeps its (newer-or-equal) delete stamp
     if ent.get("versions") is not None:
         raise ClsError("ECANCELED", key)
     if "if_mtime" in d and ent.get("mtime") != d["if_mtime"]:
         raise ClsError("ECANCELED", key)
-    ctx.omap_rmkeys([key])
     dead = ent.get("obj") or d.get("plain_obj")
     # bump past the entry's (possibly future-bumped) mtime like the
     # write paths: a wall-clock stamp could be OLDER than the head a
     # same-millisecond put left behind, and the replica's newer-wins
     # rule would then keep an object the origin dropped
-    _dl_append(ctx, d, "del", key, raw=raw,
-               mtime=_bump_mtime(ent.get("mtime"),
-                                 d.get("mtime") or now_str()))
+    mtime = _bump_mtime(ent.get("mtime"), d.get("mtime") or now_str())
+    # leave a tombstone, not an absent key: a peer's put record that
+    # raced this delete must compare against the delete's mtime when
+    # it arrives, or the sync apply resurrects the object
+    _set_tomb(ctx, key, mtime)
+    _dl_append(ctx, d, "del", key, raw=raw, mtime=mtime)
     return {"removed": [dead] if dead else []}
 
 
@@ -369,8 +392,15 @@ def obj_sync_apply(ctx, d):
     if op == "put" and d.get("mode", "plain") == "plain":
         if ent is not None and ent.get("versions") is not None:
             return skip()       # local entry grew a version stack
-        if ent is not None and not _newer(d["mtime"], d["etag"],
-                                          ent["mtime"], ent["etag"]):
+        if is_tomb(ent):
+            # the key was deleted here; only a put STRICTLY newer than
+            # the delete may land (ties go to the delete, same rule as
+            # the 'del' branch below) — this is the put-racing-
+            # cross-zone-delete window the tombstone exists to close
+            if not d["mtime"] > ent["mtime"]:
+                return skip()
+        elif ent is not None and not _newer(d["mtime"], d["etag"],
+                                            ent["mtime"], ent["etag"]):
             return skip()       # local state is newer (or identical)
         if ent is not None and ent.get("obj"):
             removed.append(ent["obj"])
@@ -383,16 +413,24 @@ def obj_sync_apply(ctx, d):
         return {"applied": True, "vid": None, "removed": removed}
 
     if op == "del":
-        if ent is None or ent.get("versions") is not None:
+        if ent is not None and ent.get("versions") is not None:
             return skip()
-        if ent["mtime"] > d["mtime"]:
+        if is_tomb(ent) and not d["mtime"] > ent["mtime"]:
+            return skip()       # replay, or an older delete
+        if ent is not None and not is_tomb(ent) \
+                and ent["mtime"] > d["mtime"]:
             return skip()       # a local write outran the delete.
             # Ties go to the delete: a same-second put-then-delete on
             # the origin replays in datalog order, and the delete must
             # win or the replica keeps an object the origin dropped.
-        ctx.omap_rmkeys([key])
-        if ent.get("obj"):
+        if ent is not None and ent.get("obj"):
             removed.append(ent["obj"])
+        # write the tombstone even when the key is absent here: the
+        # put this delete removed may still be in flight from a third
+        # zone (or this one), and must find the delete's stamp waiting.
+        # A replayed delete hit the equal-mtime tombstone skip above,
+        # so every path reaching here changed state — re-log it.
+        _set_tomb(ctx, key, d["mtime"])
         _dl_append(ctx, d, "del", key, raw=raw, mtime=d["mtime"])
         return {"applied": True, "vid": None, "removed": removed}
 

@@ -93,6 +93,15 @@ class ErasureCodeInterface(abc.ABC):
     def minimum_to_decode_with_cost(self, want_to_read: set,
                                     available: Mapping[int, int]) -> set: ...
 
+    def repair_schedule(self, erasures: set, available: set):
+        """RepairPlan (ceph_tpu.ec.repairc) for rebuilding `erasures`
+        whole from partial helper reads, or None when this code has no
+        better schedule than wholesale full-chunk recovery for the
+        signature.  Plans feed the repair-schedule compiler: the OSD
+        recovery paths lower a returned plan to one fused
+        gather/matmul/scatter program, cached per signature."""
+        return None
+
     @abc.abstractmethod
     def encode(self, want_to_encode: Iterable[int], data: bytes
                ) -> dict[int, np.ndarray]: ...

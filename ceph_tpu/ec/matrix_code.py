@@ -186,6 +186,24 @@ class MatrixErasureCode(ErasureCode):
     def get_data_chunk_count(self) -> int:
         return self.k
 
+    def repair_schedule(self, erasures: set, available: set):
+        """MDS fallback plan: k full survivor chunks (the same
+        first-k-in-index-order selection as decode_chunks, so the
+        compiled matrix IS the cached decode matrix) rebuilding every
+        lost shard directly — no decode-to-logical + re-encode round
+        trip.  Wide-word fields (gfw w=16/32) are not byte-linear, so
+        they stay on the interpreted path."""
+        if self.field is not None:
+            return None
+        erasures = set(erasures)
+        avail = sorted(set(available) - erasures)
+        if not erasures or len(erasures) > self.m or len(avail) < self.k:
+            return None
+        from .repairc import RepairPlan
+        return RepairPlan.make(
+            erasures, {h: [(0, 1)] for h in avail[:self.k]},
+            sub_chunk_no=1)
+
     # -- math --------------------------------------------------------------
     def encode_chunks(self, want_to_encode: Iterable[int],
                       encoded: dict[int, np.ndarray]) -> None:

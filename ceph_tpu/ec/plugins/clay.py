@@ -208,12 +208,35 @@ class ErasureCodeClay(ErasureCode):
     # -- minimum_to_decode ---------------------------------------------------
     def minimum_to_decode(self, want_to_read: set, available: set
                           ) -> dict[int, list[tuple[int, int]]]:
-        """ref: ErasureCodeClay.cc:98-106."""
+        """ref: ErasureCodeClay.cc:98-106.  Extended past the
+        reference: when `want_to_read` spans multiple shards but only
+        ONE of them is erased, the lost shard still repairs from
+        sub-chunk planes — the wanted survivors are whole-chunk reads
+        and the erased one keeps the d-helper repair plan, instead of
+        silently falling through to a k-full-chunk decode."""
         want_to_read = set(want_to_read)
         available = set(available)
         if self.is_repair(want_to_read, available):
             return self.minimum_to_repair(want_to_read, available)
+        erased = want_to_read - available
+        if len(erased) == 1 and self.is_repair(erased, available):
+            minimum = self.minimum_to_repair(erased, available)
+            for c in want_to_read & available:
+                minimum[c] = [(0, self.sub_chunk_no)]
+            return minimum
         return super().minimum_to_decode(want_to_read, available)
+
+    def repair_schedule(self, erasures: set, available: set):
+        """Single-erasure regenerating plan: d helpers each shipping
+        the q^(t-1)-of-q^t repair planes of minimum_to_repair."""
+        erasures = set(erasures)
+        available = set(available) - erasures
+        if not self.is_repair(erasures, available):
+            return None
+        from ...ec.repairc import RepairPlan
+        minimum = self.minimum_to_repair(erasures, available)
+        return RepairPlan.make(erasures, minimum,
+                               sub_chunk_no=self.sub_chunk_no)
 
     def minimum_to_repair(self, want_to_read: set, available_chunks: set
                           ) -> dict[int, list[tuple[int, int]]]:
@@ -269,7 +292,42 @@ class ErasureCodeClay(ErasureCode):
         first_len = len(next(iter(chunks.values()))) if chunks else 0
         if self.is_repair(want, avail) and chunk_size > first_len:
             return self.repair(want, chunks, chunk_size)
+        erased = want - avail
+        if (chunk_size and len(erased) == 1 and len(want) > 1
+                and self.is_repair(erased, avail)
+                and all(len(chunks[i]) == chunk_size
+                        for i in want & avail)):
+            out = self._decode_one_erased(erased, chunks, chunk_size)
+            if out is not None:
+                out.update({i: chunks[i] for i in want & avail})
+                return {i: out[i] for i in want}
         return self._decode(want, chunks)
+
+    def _decode_one_erased(self, erased: set,
+                           chunks: Mapping[int, np.ndarray],
+                           chunk_size: int):
+        """Companion to the extended minimum_to_decode: rebuild the one
+        erased chunk from its d helpers' repair planes.  Helpers read
+        whole (because they were also wanted) are sliced down to their
+        repair planes; helpers that shipped only planes pass through.
+        None when buffers fit neither shape (caller falls back)."""
+        lost = next(iter(erased))
+        lost_node = lost if lost < self.k else lost + self.nu
+        ssz = chunk_size // self.sub_chunk_no
+        ext = [(o * ssz, c * ssz)
+               for o, c in self.get_repair_subchunks(lost_node)]
+        rb = sum(length for _, length in ext)
+        helpers = {}
+        for h in self.minimum_to_repair(erased, set(chunks)):
+            buf = chunks[h]
+            if len(buf) == chunk_size:
+                helpers[h] = np.concatenate(
+                    [buf[o:o + length] for o, length in ext])
+            elif len(buf) == rb:
+                helpers[h] = buf
+            else:
+                return None
+        return self.repair(erased, helpers, chunk_size)
 
     def decode_chunks(self, want_to_read, chunks, decoded) -> None:
         """ref: ErasureCodeClay.cc:160-188."""

@@ -316,6 +316,111 @@ class ErasureCodeLrc(ErasureCode):
             f"EIO: not enough chunks in {sorted(available_chunks)} to "
             f"read {sorted(want_to_read)}")
 
+    # -- local-group repair -------------------------------------------------
+    #: weight multiplier for reads outside the wanted chunk's local
+    #: parity group in minimum_to_decode_with_cost — a cross-group read
+    #: crosses a CRUSH fault domain when crush-locality maps groups to
+    #: domains (see parse_kml/create_rule), so it is charged like the
+    #: slower, blast-radius-expanding read it is
+    CROSS_GROUP_COST = 4
+
+    def local_layer(self, chunk: int):
+        """The smallest layer containing `chunk` — for kml profiles,
+        its local parity group; the global layer only when no local
+        layer covers the chunk."""
+        best = None
+        for layer in self.layers:
+            if chunk in layer.chunks_as_set and (
+                    best is None
+                    or len(layer.chunks_as_set) < len(best.chunks_as_set)):
+                best = layer
+        return best
+
+    def _repair_layer(self, chunk: int, available: set):
+        """Smallest layer that can rebuild `chunk` from available
+        survivors, or None."""
+        best = None
+        for layer in self.layers:
+            if chunk not in layer.chunks_as_set:
+                continue
+            erased = layer.chunks_as_set - set(available) \
+                - {chunk} | {chunk}
+            if len(erased) > layer.erasure_code.get_coding_chunk_count():
+                continue
+            if best is None or \
+                    len(layer.chunks_as_set) < len(best.chunks_as_set):
+                best = layer
+        return best
+
+    def is_repair(self, want_to_read: set, available_chunks: set) -> bool:
+        """True when the single wanted erasure rebuilds from a local
+        parity group smaller than a k-survivor decode (l << k reads)."""
+        want = set(want_to_read)
+        if len(want) != 1 or want <= set(available_chunks):
+            return False
+        layer = self._repair_layer(next(iter(want)),
+                                   set(available_chunks))
+        return layer is not None and \
+            len(layer.chunks_as_set & set(available_chunks)) < \
+            self.get_data_chunk_count()
+
+    def minimum_to_repair(self, want_to_read: set, available_chunks: set
+                          ) -> dict[int, list[tuple[int, int]]]:
+        """The lost chunk's local-group survivors, whole chunks each
+        (lrc has no sub-chunk granularity — the saving is reading
+        l << k chunks, not partial chunks)."""
+        want = set(want_to_read)
+        avail = set(available_chunks)
+        lost = next(iter(want))
+        layer = self._repair_layer(lost, avail)
+        if layer is None:
+            raise ErasureCodeError(
+                f"minimum_to_repair: no layer can rebuild {lost} from "
+                f"{sorted(avail)}")
+        return {c: [(0, 1)] for c in layer.chunks_as_set & avail}
+
+    def repair_schedule(self, erasures: set, available: set):
+        """Single-erasure LRC plan: the local group's l survivors,
+        full chunks."""
+        erasures = set(erasures)
+        available = set(available) - erasures
+        if not self.is_repair(erasures, available):
+            return None
+        from ..repairc import RepairPlan
+        return RepairPlan.make(
+            erasures, self.minimum_to_repair(erasures, available),
+            sub_chunk_no=1)
+
+    def minimum_to_decode_with_cost(self, want_to_read: set,
+                                    available: Mapping[int, int]) -> set:
+        """Cost-weighted survivor choice: reads outside the wanted
+        chunks' local parity groups are charged CROSS_GROUP_COST x
+        their supplied cost, so degraded reads prefer in-group
+        survivors (the base class charges every read the same)."""
+        want = set(want_to_read)
+        avail = set(available)
+        costs = dict(available) if isinstance(available, Mapping) else {}
+        home: set = set()
+        for c in want:
+            layer = self.local_layer(c)
+            if layer is not None:
+                home |= layer.chunks_as_set
+        candidates = [self._minimum_to_decode(want, avail)]
+        lost = want - avail
+        if len(lost) == 1:
+            layer = self._repair_layer(next(iter(lost)), avail)
+            if layer is not None:
+                candidates.append(
+                    (layer.chunks_as_set & avail) | (want & avail))
+
+        def total(chunks: set) -> int:
+            return sum(
+                costs.get(c, 1) * (1 if c in home
+                                   else self.CROSS_GROUP_COST)
+                for c in chunks)
+
+        return min(candidates, key=total)
+
     # -- encode / decode ----------------------------------------------------
     def encode_chunks(self, want_to_encode, encoded) -> None:
         """ref: ErasureCodeLrc.cc:737-775."""

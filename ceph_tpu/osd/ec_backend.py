@@ -31,6 +31,7 @@ from typing import Callable, Iterable, Optional
 
 from ..common.log import dout
 from ..common.tracing import child_of
+from ..ec.interface import ErasureCodeError
 from ..msg.messages import (ECSubRead, ECSubReadReply, ECSubWrite,
                             ECSubWriteReply)
 from ..store import ObjectId, StoreError, Transaction
@@ -1367,13 +1368,16 @@ class ECBackend:
         the old acting set still serves (ref: ECBackend recovery
         pushing to backfill targets).
 
-        Single-shard loss on a regenerating code (clay,
-        sub_chunk_count > 1) takes the NETWORK-OPTIMAL path: helpers
-        serve only the plugin's repair sub-chunk extents
-        (~(k+m-1)/m x fewer bytes than k whole chunks) and the lost
-        chunk rebuilds directly, no logical decode + re-encode.
-        Non-regenerating plugins, multi-shard loss, or any repair-read
-        failure fall back to the full-chunk rebuild below."""
+        Plan-driven recovery: when the plugin publishes a repair
+        schedule for the erasure signature (ec.repair_schedule —
+        clay's d-helper sub-chunk planes, lrc's l-survivor local
+        parity group, matrix codes' k-survivor direct decode), the
+        helpers serve only the plan's extents and the lost chunks
+        rebuild through the signature's COMPILED repair program
+        (ceph_tpu.ec.repairc: one gather/GF-matmul/scatter dispatch,
+        cached per signature) — no logical decode + re-encode.  Codes
+        without a plan, or any repair-read failure, fall back to the
+        wholesale full-chunk rebuild below."""
         targets = sorted(set(target_shards))
         if self._try_subchunk_recover(oid, targets, on_done, version,
                                       target_osds):
@@ -1389,25 +1393,22 @@ class ECBackend:
                 oid, targets, r, e, on_done, version, a, target_osds),
             for_recovery=True, want_attrs=True)
 
-    # -- sub-chunk (repair-bandwidth-optimal) single-shard rebuild ----
+    # -- plan-driven (repair-bandwidth-optimal) rebuild ---------------
     def _try_subchunk_recover(self, oid: str, targets, on_done,
                               version=None, target_osds=None) -> bool:
-        """Plan a repair-plane rebuild; False -> caller takes the
-        full-chunk path (non-regenerating plugin, multi-shard loss,
-        or the helper set can't cover the plugin's repair degree)."""
-        if len(targets) != 1 or not ecutil.supports_subchunk_repair(
-                self.ec):
-            return False
-        lost = targets[0]
-        avail = {s for s in self._avail_shards(oid) if s != lost}
-        if not self.ec.is_repair({lost}, avail):
-            return False
-        try:
-            minimum = self.ec.minimum_to_repair({lost}, avail)
-        except Exception:
+        """Plan a compiled-program rebuild; False -> caller takes the
+        full-chunk path (no plan for this erasure signature, or the
+        helper set can't cover the plan's repair degree)."""
+        avail = {s for s in self._avail_shards(oid)
+                 if s not in set(targets)}
+        plan = ecutil.repair_plan(self.ec, targets, avail)
+        if plan is None or set(plan.lost) != set(targets):
             return False
         cs = self.sinfo.chunk_size
-        extents = ecutil.repair_chunk_extents(self.ec, lost, cs)
+        try:
+            byte_extents = plan.byte_extents(cs)
+        except ValueError:
+            return False
         with self._lock:
             tid = self._next_tid()
             rd = _Read(tid=tid, reads={oid: None},
@@ -1415,12 +1416,13 @@ class ECBackend:
                        for_recovery=True, want_attrs=True)
             self.in_flight_reads[tid] = rd
             self._sub_repairs[tid] = {
-                "oid": oid, "lost": lost, "helpers": set(minimum),
-                "extents": extents, "on_done": on_done,
+                "oid": oid, "plan": plan,
+                "helpers": set(plan.helper_ids()),
+                "on_done": on_done,
                 "version": version, "target_osds": target_osds,
             }
-            rd.pending_shards = set(minimum)
-            for s in minimum:
+            rd.pending_shards = set(plan.helper_ids())
+            for s, extents in byte_extents.items():
                 msg = ECSubRead(
                     pgid=self.pgid, tid=tid, shard=s,
                     to_read=[], attrs_to_read=[oid],
@@ -1431,35 +1433,37 @@ class ECBackend:
         return True
 
     def _complete_subchunk_repair(self, rd: _Read, job: dict) -> None:
-        oid, lost = job["oid"], job["lost"]
+        oid, plan = job["oid"], job["plan"]
         on_done = job["on_done"]
+        targets = list(plan.lost)
         bufs = rd.shard_bufs.get(oid, {})
         got = {s: bufs[s] for s in job["helpers"] if s in bufs}
         if set(got) != job["helpers"] or rd.shard_errs.get(oid):
             # any helper failure: fall back to the full-chunk rebuild
             # (it tolerates arbitrary shard sets via minimum_to_decode)
-            self._recover_object_full(oid, [lost], on_done,
+            self._recover_object_full(oid, targets, on_done,
                                       job["version"],
                                       job["target_osds"])
             return
         self._perf_inc("recovery_bytes_read",
                        sum(len(b) for b in got.values()))
         try:
-            stream = ecutil.repair_shard_stream(
-                self.ec, self.sinfo.chunk_size, lost, got)
-        except (ValueError, KeyError, AssertionError) as ex:
-            dout("osd", 0).write("%s subchunk repair of %s failed: %r",
+            streams = ecutil.compiled_repair_streams(
+                self.ec, plan, self.sinfo.chunk_size, got)
+        except (ValueError, KeyError, AssertionError,
+                ErasureCodeError) as ex:
+            dout("osd", 0).write("%s compiled repair of %s failed: %r",
                                  self.pgid, oid, ex)
-            self._recover_object_full(oid, [lost], on_done,
+            self._recover_object_full(oid, targets, on_done,
                                       job["version"],
                                       job["target_osds"])
             return
         # authoritative metadata from the newest-oi helper: object
         # size/version, the shared HashInfo (it carries EVERY shard's
-        # cumulative crc — including the rebuilt one), user xattrs
+        # cumulative crc — including the rebuilt ones), user xattrs
         best = newest_oi_attrs(rd.shard_attrs.get(oid, {}))
         if best is None:
-            self._recover_object_full(oid, [lost], on_done,
+            self._recover_object_full(oid, targets, on_done,
                                       job["version"],
                                       job["target_osds"])
             return
@@ -1468,9 +1472,24 @@ class ECBackend:
         if version is None:
             version = EVersion(*oi.get("version", (0, 0))) \
                 if oi.get("version") else self._object_prior_version(oid)
-        self._push_repaired_shard(oid, lost, stream, oi.get("size", 0),
-                                  version, hinfo_dict, user_attrs,
-                                  on_done, job["target_osds"])
+        # one push per rebuilt shard; on_done fires once with the
+        # aggregate outcome (the push_rebuilt contract)
+        pending = set(targets)
+        state = {"ok": True, "done": False}
+
+        def agg(shard):
+            def cb(committed):
+                state["ok"] = state["ok"] and bool(committed)
+                pending.discard(shard)
+                if not pending and not state["done"]:
+                    state["done"] = True
+                    on_done(state["ok"])
+            return cb
+
+        for lost in targets:
+            self._push_repaired_shard(
+                oid, lost, streams[lost], oi.get("size", 0), version,
+                hinfo_dict, user_attrs, agg(lost), job["target_osds"])
 
     def _push_repaired_shard(self, oid: str, shard: int, stream: bytes,
                              size: int, version, hinfo_dict,

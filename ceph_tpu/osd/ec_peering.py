@@ -491,35 +491,34 @@ class ECPGPeering:
     def _try_subchunk_rebuild(self, oid: str, targets: dict[int, int],
                               ver: tuple, sources: dict[int, int],
                               on_done) -> bool:
-        """Plan a repair-plane rebuild for a SINGLE lost shard on a
-        regenerating plugin; False -> caller runs the full-chunk
+        """Plan a compiled-program rebuild from the plugin's repair
+        schedule (clay repair planes, lrc local-group chunks, matrix
+        k-survivor decode); False -> caller runs the full-chunk
         gather.  Helper reads carry per-chunk byte extents; replies
-        hold only the repair planes (ref: ErasureCodeClay.cc:400
-        repair; arxiv 1412.3022)."""
+        hold only the plan's planes (ref: ErasureCodeClay.cc:400
+        repair; arxiv 1412.3022, 1906.08602)."""
         from . import ecutil
         from .ec_backend import pg_cid
         from ..store import ObjectId, StoreError
         b = self.st.backend
         ec = b.ec
-        if len(targets) != 1 or not ecutil.supports_subchunk_repair(ec):
-            return False
-        lost = next(iter(targets))
-        avail = {s for s in sources if s != lost}
-        if not ec.is_repair({lost}, avail):
-            return False
-        try:
-            minimum = ec.minimum_to_repair({lost}, avail)
-        except Exception:
+        avail = {s for s in sources if s not in targets}
+        plan = ecutil.repair_plan(ec, set(targets), avail)
+        if plan is None or set(plan.lost) != set(targets):
             return False
         cs = b.sinfo.chunk_size
-        extents = ecutil.repair_chunk_extents(ec, lost, cs)
+        try:
+            byte_extents = plan.byte_extents(cs)
+        except ValueError:
+            return False
         job = {"oid": oid, "targets": targets, "ver": ver,
                "chunks": {}, "attrs": {}, "pending": set(),
                "failed": False, "on_done": on_done, "sources": sources,
-               "repair": {"lost": lost, "helpers": set(minimum),
+               "repair": {"plan": plan,
+                          "helpers": set(plan.helper_ids()),
                           "cs": cs}}
         cid = pg_cid(self.pg)
-        for s in sorted(minimum):
+        for s, extents in sorted(byte_extents.items()):
             if sources[s] != self.d.whoami:
                 continue
             soid = ObjectId(oid, shard=s)
@@ -533,7 +532,7 @@ class ECPGPeering:
                 job["attrs"][s] = self.d.store.getattrs(cid, soid)
             except (StoreError, ValueError):
                 pass
-        remote = {s: sources[s] for s in minimum
+        remote = {s: sources[s] for s in plan.helper_ids()
                   if sources[s] != self.d.whoami
                   and s not in job["chunks"]}
         for s, osd in sorted(remote.items()):
@@ -543,7 +542,8 @@ class ECPGPeering:
             if not self._send(osd, ECSubRead(
                     pgid=self.pg, tid=tid, shard=s,
                     to_read=[], attrs_to_read=[oid],
-                    subchunks={oid: list(extents)}, chunk_size=cs)):
+                    subchunks={oid: list(byte_extents[s])},
+                    chunk_size=cs)):
                 job["pending"].discard(tid)
                 self._chunk_reads.pop(tid, None)
         if not job["pending"]:
@@ -551,14 +551,15 @@ class ECPGPeering:
         return True
 
     def _repair_decode(self, job: dict) -> None:
-        """Finish a sub-chunk repair job: rebuild the lost chunk
-        stream from the helpers' repair planes and push it; any gap
-        falls back to the full-chunk gather wholesale."""
+        """Finish a plan-driven repair job: rebuild the lost chunk
+        streams through the signature's compiled program and push
+        them; any gap falls back to the full-chunk gather wholesale."""
         from . import ecutil
         from .ec_backend import newest_oi_attrs
+        from ..ec.interface import ErasureCodeError
         b = self.st.backend
         rep = job["repair"]
-        oid, lost = job["oid"], rep["lost"]
+        oid, plan = job["oid"], rep["plan"]
 
         def fallback():
             self._rebuild_full(job["oid"], job["targets"], job["ver"],
@@ -575,23 +576,38 @@ class ECPGPeering:
         self.d.perf.inc("recovery_bytes_read",
                         sum(len(v) for v in got.values()))
         try:
-            stream = ecutil.repair_shard_stream(b.ec, rep["cs"], lost,
-                                                got)
-        except (ValueError, KeyError, AssertionError) as ex:
-            self._log(0, "subchunk repair of %s failed: %r", oid, ex)
+            streams = ecutil.compiled_repair_streams(
+                b.ec, plan, rep["cs"], got)
+        except (ValueError, KeyError, AssertionError,
+                ErasureCodeError) as ex:
+            self._log(0, "compiled repair of %s failed: %r", oid, ex)
             fallback()
             return
         # authoritative metadata from the newest-oi helper (the shared
-        # HashInfo carries the rebuilt shard's cumulative crc too)
+        # HashInfo carries the rebuilt shards' cumulative crcs too)
         best = newest_oi_attrs(job["attrs"])
         if best is None:
             fallback()
             return
         _, oi, hinfo_dict, user_attrs = best
-        b._push_repaired_shard(
-            oid, lost, stream, oi.get("size", 0),
-            EVersion(*job["ver"]), hinfo_dict, user_attrs,
-            job["on_done"], target_osds=dict(job["targets"]))
+        on_done = job["on_done"]
+        pending = set(plan.lost)
+        state = {"ok": True, "done": False}
+
+        def agg(shard):
+            def cb(committed):
+                state["ok"] = state["ok"] and bool(committed)
+                pending.discard(shard)
+                if not pending and not state["done"]:
+                    state["done"] = True
+                    on_done(state["ok"])
+            return cb
+
+        for lost in plan.lost:
+            b._push_repaired_shard(
+                oid, lost, streams[lost], oi.get("size", 0),
+                EVersion(*job["ver"]), hinfo_dict, user_attrs,
+                agg(lost), target_osds=dict(job["targets"]))
 
     def on_chunk_reply(self, msg) -> bool:
         """ECSubReadReply routing for peering-owned chunk gathers;

@@ -287,11 +287,46 @@ def decode(sinfo: StripeInfo, ec, to_decode: Mapping[int, bytes],
 def supports_subchunk_repair(ec) -> bool:
     """True when the plugin can rebuild a single shard from partial
     (sub-chunk) helper reads.  Non-regenerating plugins and
-    sub_chunk_count == 1 codes fall back to full-chunk recovery."""
+    sub_chunk_count == 1 codes fall back to full-chunk recovery.
+    (Plan-driven recovery — repair_plan below — supersedes this gate
+    for the OSD paths; it remains the sub-chunk capability probe.)"""
     return (ec.get_sub_chunk_count() > 1
             and hasattr(ec, "is_repair")
             and hasattr(ec, "minimum_to_repair")
             and hasattr(ec, "get_repair_subchunks"))
+
+
+def repair_plan(ec, lost, avail):
+    """The plugin's partial-read repair plan (ec.repair_schedule) for
+    this erasure signature, or None — the caller then takes wholesale
+    full-chunk recovery.  A plan names the helper shards, each
+    helper's sub-chunk extents, and feeds the repair-schedule compiler
+    (ceph_tpu.ec.repairc): clay ships q^(t-1)/q^t repair planes of d
+    helpers, lrc the l whole chunks of the lost shard's local parity
+    group, matrix codes k whole survivor chunks decoded straight to
+    the lost shards."""
+    from ..ec.interface import ErasureCodeError
+    hook = getattr(ec, "repair_schedule", None)
+    if hook is None:
+        return None
+    try:
+        return hook(set(lost), set(avail))
+    except ErasureCodeError:
+        return None
+
+
+def compiled_repair_streams(ec, plan, chunk_size: int,
+                            helper_bufs: Mapping[int, bytes],
+                            backend: str | None = None
+                            ) -> dict[int, bytes]:
+    """Rebuild every lost shard's chunk stream through the plan's
+    compiled program (cached per erasure signature): gather the
+    helpers' plane bytes, one grouped GF(2^8) matmul, scatter.
+    Byte-identical to the interpreted decode path (pinned by the
+    tests/test_repairc.py parity sweep)."""
+    from ..ec.repairc import program_for
+    return program_for(ec, plan).run(helper_bufs, chunk_size,
+                                     backend=backend)
 
 
 def repair_chunk_extents(ec, lost_shard: int,

@@ -73,7 +73,8 @@ from .auth import (KeystoneEngine, KeystoneError, SigV4Error,
                    sign_request,
                    verify as sigv4_verify,
                    verify_presigned as presigned_verify)
-from ..cls.rgw import DL_META, DL_PREFIX, now_str, parse_mtime
+from ..cls.rgw import (DL_META, DL_PREFIX, is_tomb, now_str,
+                       parse_mtime)
 from .datalog import DataLog, is_dl_key, shard_obj, shard_of_key
 from .notify import (EventPusher, TopicStore, ZONE_TRACE_HEADER,
                      _queue_obj, event_matches, format_zone_trace,
@@ -588,7 +589,11 @@ class RGWGateway:
                 if is_dl_key(k):
                     continue    # datalog records share the omap but
                     # are not index entries (multisite change feed)
-                out[k] = json.loads(v)
+                ent = json.loads(v)
+                if is_tomb(ent):
+                    continue    # per-key delete tombstone: the key is
+                    # gone as far as reads/listings are concerned
+                out[k] = ent
         return out
 
     def _index_entry(self, bucket: str, key: str,
@@ -604,7 +609,8 @@ class RGWGateway:
                 return None     # shard object never written: the key
                 # cannot have an entry (same contract as _index)
             raise
-        return json.loads(vals[key]) if key in vals else None
+        ent = json.loads(vals[key]) if key in vals else None
+        return None if is_tomb(ent) else ent
 
     @staticmethod
     def _respond(h, status: int, body: bytes = b"",

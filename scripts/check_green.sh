@@ -207,6 +207,24 @@ run_chaos_smoke() {
     return 0
 }
 
+# Repair-compiler smoke: scripts/repair_bench.py --quick rebuilds an
+# lrc pool after one OSD out and gates the ISSUE-20 contracts —
+# recovery_bytes_read <= l x rebuilt (reads stayed inside the local
+# parity group), every repair-program signature compiled exactly
+# once, data byte-identical.
+run_repair_smoke() {
+    echo "=== check_green: repair-compiler smoke ==="
+    timeout -k 10 180 env JAX_PLATFORMS=cpu \
+        python scripts/repair_bench.py --quick
+    local rc=$?
+    if [ "$rc" -ne 0 ]; then
+        echo "check_green: RED (repair smoke rc=$rc — compiled" \
+             "lrc local-group repair broken) — do not ship" >&2
+        return 1
+    fi
+    return 0
+}
+
 # Serve smoke: the LLM artifact store must stream a sharded
 # checkpoint byte-identical through both readahead policies and
 # fetch random KV pages batched == per-page loop, healthy AND with
@@ -236,13 +254,15 @@ run_crash_smoke || exit 1
 run_multisite_smoke || exit 1
 run_trace_smoke || exit 1
 run_recovery_smoke || exit 1
+run_repair_smoke || exit 1
 run_chaos_smoke || exit 1
 run_serve_smoke || exit 1
 
 if [ "$REPEAT" -gt 1 ] && [ ${#TARGETS[@]} -eq 0 ]; then
     TARGETS=(tests/test_thrasher.py tests/test_thrash_ec.py \
              tests/test_snaptrim.py tests/test_rgw_multisite.py \
-             tests/test_chaos.py tests/test_serve.py)
+             tests/test_chaos.py tests/test_serve.py \
+             tests/test_repairc.py tests/test_ec_subchunk_recovery.py)
 fi
 if [ ${#TARGETS[@]} -eq 0 ]; then
     TARGETS=(tests/)

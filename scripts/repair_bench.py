@@ -1,0 +1,223 @@
+#!/usr/bin/env python
+"""Repair-schedule compiler benchmark — lrc vs clay vs jerasure
+(ISSUE 20, ROADMAP direction 5).
+
+For each code, boots a MiniCluster with an EC pool, runs a
+DETERMINISTIC ChaosRunner fault schedule (an OSD flap plus seeded
+ping loss, under live client IO, invariants checked), then measures
+two rebuilds with wall time + recovery-bytes counters:
+
+  single  one OSD marked out — the locality showcase: lrc repairs
+          from the lost shard's local parity group (l=3 chunk reads),
+          clay from d sub-chunk planes, jerasure from k whole chunks;
+  double  two more OSDs out at once — past every local group's
+          capability, all codes degrade to the global decode.
+
+Gates (also the --quick smoke for check_green.sh, lrc single only):
+
+  1. every seeded object reads back byte-identical after each rebuild;
+  2. lrc single-failure recovery_bytes_read <= l x rebuilt bytes —
+     the counter proof that reads stayed inside the local parity
+     group ((l+1)/k of the full-chunk baseline, l < k);
+  3. compile-once: every per-OSD repair-program cache compiled each
+     erasure signature exactly once (cache stats), and jaxguard saw
+     zero jit recompiles across the run.
+
+Writes REPAIR_r01.json. Run from the repo root:
+    python scripts/repair_bench.py [--quick]
+"""
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import numpy as np                                   # noqa: E402
+
+from ceph_tpu.common import jaxguard                 # noqa: E402
+from ceph_tpu.testing import ChaosRunner, MiniCluster  # noqa: E402
+
+N_OSD = 11          # lrc n=8 chunks + headroom for 3 outs
+N_OBJ = 6
+FAULT_SEED = 7
+RUNNER_SEED = 1
+
+#: name -> (profile, k, single-failure helper-chunk count)
+CODES = {
+    "jerasure": ({"plugin": "jerasure", "technique": "reed_sol_van",
+                  "k": "4", "m": "2",
+                  "crush-failure-domain": "host"}, 4, 4),
+    "clay": ({"plugin": "clay", "k": "4", "m": "2",
+              "crush-failure-domain": "host"}, 4, 5),
+    "lrc": ({"plugin": "lrc", "k": "4", "m": "2", "l": "3",
+             "crush-failure-domain": "host"}, 4, 3),
+}
+
+SCHEDULE = [
+    {"at": 10.0, "action": "kill_osd", "osd": 3, "label": "flap"},
+    {"at": 40.0, "action": "revive_osd", "osd": 3},
+    {"at": 60.0, "action": "drop", "src": "osd.*", "dst": "osd.*",
+     "p": 0.02, "types": ["Ping"], "label": "ping-loss"},
+    {"at": 90.0, "action": "heal", "target": "ping-loss"},
+]
+
+
+def _counters(c) -> tuple[int, int]:
+    read = sum(d.perf._c["recovery_bytes_read"].value
+               for d in c.osds.values())
+    rebuilt = sum(d.perf._c["recovery_bytes_rebuilt"].value
+                  for d in c.osds.values())
+    return read, rebuilt
+
+
+def _pump_until_clean(c, rounds: int = 80) -> None:
+    for _ in range(rounds):
+        c.pump()
+        if all(d.pgs_recovering() == 0 for d in c.osds.values()):
+            return
+    raise TimeoutError("recovery never finished")
+
+
+def _measured_out(c, r, io, objs, ids) -> dict:
+    read0, rebuilt0 = _counters(c)
+    t0 = time.monotonic()
+    r.mon_command({"prefix": "osd out", "ids": list(ids)})
+    _pump_until_clean(c)
+    dt = time.monotonic() - t0
+    for oid, data in objs.items():
+        got = io.read(oid)
+        if got != data:
+            raise AssertionError(f"{oid} corrupted after out={ids}")
+    read1, rebuilt1 = _counters(c)
+    return {"osds_out": list(ids), "rebuild_s": round(dt, 4),
+            "recovery_bytes_read": read1 - read0,
+            "recovery_bytes_rebuilt": rebuilt1 - rebuilt0}
+
+
+def _compile_stats(c, profile_name: str) -> dict:
+    """Aggregate every OSD's repair-program cache accounting and
+    enforce the exactly-one-compile-per-signature contract."""
+    sigs: set[str] = set()
+    hits = 0
+    caches = 0
+    worst = 0
+    for name, d in sorted(c.osds.items()):
+        ec = d._ecs.get(profile_name)
+        cache = getattr(ec, "_repairc_cache", None) if ec else None
+        if cache is None:
+            continue
+        caches += 1
+        st = cache.stats()
+        hits += st["hits"]
+        for sig, n in st["compiles"].items():
+            if n != 1:
+                raise AssertionError(
+                    f"osd.{name} compiled signature {sig} {n} times "
+                    "(want exactly 1)")
+            sigs.add(sig)
+            worst = max(worst, n)
+    return {"signatures": len(sigs), "hits": hits,
+            "osd_caches": caches,
+            "per_signature_compiles_max": worst}
+
+
+def run_code(name: str, chaos: bool, double: bool) -> dict:
+    profile, k, helpers = CODES[name]
+    pname = f"repair_{name}"
+    jaxguard.reset()
+    c = MiniCluster(n_osd=N_OSD, threaded=False, fault_seed=FAULT_SEED)
+    try:
+        c.pump()
+        c.wait_all_up()
+        r = c.rados()
+        r.mon_command({"prefix": "osd erasure-code-profile set",
+                       "name": pname, "profile": dict(profile)})
+        r.pool_create(pname, pg_num=4, pool_type="erasure",
+                      erasure_code_profile=pname)
+        c.pump()
+        io = r.open_ioctx(pname)
+        rng = np.random.default_rng(31)
+        objs = {f"seed{i}": rng.integers(0, 256, 8192 + 37 * i,
+                                         dtype=np.uint8).tobytes()
+                for i in range(N_OBJ)}
+        for oid, data in objs.items():
+            io.write_full(oid, data)
+        c.pump()
+
+        out = {"code": name, "profile": profile}
+        if chaos:
+            rep = ChaosRunner(c, SCHEDULE, rados=r, pool=pname,
+                              seed=RUNNER_SEED).run()
+            out["chaos"] = {"fault_digest": rep["fault_digest"],
+                            "fault_counts": rep["fault_counts"],
+                            "ops_total": rep["ops_total"],
+                            "acked": rep["acked"]}
+
+        out["single"] = single = _measured_out(c, r, io, objs, [0])
+        if single["recovery_bytes_rebuilt"] <= 0:
+            raise AssertionError(f"{name}: single-out rebuilt nothing")
+        ratio = single["recovery_bytes_read"] / \
+            single["recovery_bytes_rebuilt"]
+        single["read_per_rebuilt"] = round(ratio, 3)
+        if name == "lrc" and ratio > 3.0:
+            raise AssertionError(
+                f"lrc single-failure read {ratio:.2f}x rebuilt bytes "
+                "> l=3 — repair left the local parity group")
+        if name == "clay" and ratio >= k:
+            raise AssertionError(
+                f"clay single-failure read {ratio:.2f}x >= k={k} — "
+                "sub-chunk repair did not engage")
+        if double:
+            out["double"] = _measured_out(c, r, io, objs, [1, 2])
+        out["compile"] = _compile_stats(c, pname)
+        jg = jaxguard.stats()
+        recompiles = sum(s["recompiles"] for s in jg.values())
+        if recompiles:
+            raise AssertionError(
+                f"{name}: jaxguard saw {recompiles} jit recompiles")
+        out["jit_recompiles"] = recompiles
+        return out
+    finally:
+        c.shutdown()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="lrc single-failure gates only (CI smoke); "
+                    "no chaos schedule, no artifact")
+    args = ap.parse_args()
+    jaxguard.enable()
+
+    if args.quick:
+        res = run_code("lrc", chaos=False, double=False)
+        s = res["single"]
+        print(f"repair_bench --quick: OK — lrc rebuilt "
+              f"{s['recovery_bytes_rebuilt']} B reading "
+              f"{s['recovery_bytes_read']} B "
+              f"({s['read_per_rebuilt']}x, in-group l=3 <= gate), "
+              f"{res['compile']['signatures']} signatures compiled "
+              "once each")
+        return 0
+
+    results = [run_code(n, chaos=True, double=True) for n in CODES]
+    out = {"bench": "repair", "n_osd": N_OSD, "n_obj": N_OBJ,
+           "fault_seed": FAULT_SEED, "runner_seed": RUNNER_SEED,
+           "schedule": SCHEDULE, "codes": results}
+    path = pathlib.Path(__file__).resolve().parent.parent / \
+        "REPAIR_r01.json"
+    path.write_text(json.dumps(out, indent=1, sort_keys=True) + "\n")
+    for res in results:
+        s, d = res["single"], res["double"]
+        print(f"{res['code']:>9}: single {s['read_per_rebuilt']}x "
+              f"read/rebuilt in {s['rebuild_s']}s, double "
+              f"{d['recovery_bytes_read']} B in {d['rebuild_s']}s, "
+              f"{res['compile']['signatures']} sigs compiled once")
+    print(f"-> {path.name}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

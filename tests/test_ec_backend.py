@@ -22,10 +22,16 @@ PGID = "1.0"
 class Cluster:
     """N OSDs, one EC PG, direct message wiring."""
 
-    def __init__(self, k=K, m=M, plugin="tpu"):
-        self.ec = registry.factory(plugin, {"k": str(k), "m": str(m)})
-        self.k, self.m = k, m
-        n = k + m
+    def __init__(self, k=K, m=M, plugin="tpu", profile=None):
+        self.ec = registry.factory(
+            plugin, dict(profile) if profile is not None
+            else {"k": str(k), "m": str(m)})
+        # layered codes (lrc) have more chunks than k+m: size the
+        # cluster by the plugin's own count and treat every non-data
+        # chunk as parity for the backend's k/m accounting
+        self.k = self.ec.get_data_chunk_count()
+        n = self.ec.get_chunk_count()
+        self.m = n - self.k
         self.stores = []
         self.shards = []
         for osd in range(n):
@@ -33,7 +39,8 @@ class Cluster:
             st.mkfs()
             st.mount()
             self.stores.append(st)
-            self.shards.append(ECPGShard(PGID, osd, st, k, m))
+            self.shards.append(ECPGShard(PGID, osd, st,
+                                         self.k, self.m))
         self.alive = [True] * n
         #: shards whose messages queue instead of delivering inline
         self.deferred: dict[int, list] = {}

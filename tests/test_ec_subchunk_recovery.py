@@ -185,6 +185,104 @@ def test_ecsubread_v2_wire_roundtrip():
     assert got.to_read in ([("a", 0, 0)], [["a", 0, 0]])
 
 
+@pytest.fixture
+def lrc_cl():
+    cl = Cluster(plugin="lrc", profile={"k": "4", "m": "2", "l": "3"})
+    cl.backend.perf = _perf()
+    return cl
+
+
+def test_lrc_recovery_reads_only_local_group(lrc_cl):
+    """Fault-domain-aware LRC recovery: a single lost shard rebuilds
+    from its LOCAL parity group — l helper chunks, counter-verified at
+    l/k of the full-chunk baseline, byte-identical, and no read ever
+    leaves the group (ISSUE 20 acceptance)."""
+    cl = lrc_cl
+    b = cl.backend
+    data = _payload(4 * b.sinfo.stripe_width, 19)
+    assert cl.write("obj", 0, data)
+    ec = cl.ec
+    # a shard in the second local group: all l helpers are remote, so
+    # every helper read crosses the wire and the recorder sees it
+    lost = 5
+    group = ec.local_layer(lost).chunks_as_set
+    pre = cl.stores[lost].read(pg_cid(PGID),
+                               ObjectId("obj", shard=lost), 0, 0)
+    cl.kill(lost)
+    cl.revive(lost, wipe=True)
+    reads = set()
+    real_send = b.send
+
+    def send(shard, msg):
+        if isinstance(msg, ECSubRead):
+            reads.add(shard)
+        return real_send(shard, msg)
+    b.send = send
+    try:
+        assert cl.recover("obj", [lost])
+    finally:
+        b.send = real_send
+    post = cl.stores[lost].read(pg_cid(PGID),
+                                ObjectId("obj", shard=lost), 0, 0)
+    assert post == pre
+    # in-group reads ONLY: the l survivors of the lost shard's local
+    # parity group, never the k-survivor global decode set
+    assert reads == group - {lost}
+    read = _counter(b.perf, "recovery_bytes_read")
+    rebuilt = _counter(b.perf, "recovery_bytes_rebuilt")
+    assert rebuilt == len(pre)
+    l = len(group) - 1
+    assert read == l * len(pre)             # l whole helper chunks
+    assert read < b.k * len(pre)            # strictly beats full path
+    assert cl.read("obj") == data
+    # crc gate: the rebuilt shard passes the full-stream hash check
+    msg = ECSubRead(pgid=PGID, tid=9, shard=lost,
+                    to_read=[("obj", 0, 0)])
+    assert not cl.shards[lost].handle_sub_read(msg).errors
+
+
+def test_lrc_local_parity_shard_recovers_in_group(lrc_cl):
+    """Losing a LOCAL parity chunk (not data) also repairs within its
+    group."""
+    cl = lrc_cl
+    b = cl.backend
+    data = _payload(2 * b.sinfo.stripe_width, 23)
+    assert cl.write("obj", 0, data)
+    lost = 7                                # second group's parity
+    group = cl.ec.local_layer(lost).chunks_as_set
+    pre = cl.stores[lost].read(pg_cid(PGID),
+                               ObjectId("obj", shard=lost), 0, 0)
+    cl.kill(lost)
+    cl.revive(lost, wipe=True)
+    assert cl.recover("obj", [lost])
+    assert cl.stores[lost].read(pg_cid(PGID),
+                                ObjectId("obj", shard=lost),
+                                0, 0) == pre
+    read = _counter(b.perf, "recovery_bytes_read")
+    assert read == (len(group) - 1) * len(pre)
+
+
+def test_lrc_double_failure_takes_full_path(lrc_cl):
+    """Two lost shards in the SAME local group exceed that group's
+    repair capability: recovery degrades to the global decode and the
+    data still comes back byte-identical."""
+    cl = lrc_cl
+    data = _payload(2 * cl.backend.sinfo.stripe_width, 29)
+    assert cl.write("obj", 0, data)
+    pres = {s: cl.stores[s].read(pg_cid(PGID),
+                                 ObjectId("obj", shard=s), 0, 0)
+            for s in (1, 2)}
+    for s in (1, 2):
+        cl.kill(s)
+        cl.revive(s, wipe=True)
+    assert cl.recover("obj", [1, 2])
+    for s in (1, 2):
+        assert cl.stores[s].read(pg_cid(PGID),
+                                 ObjectId("obj", shard=s), 0, 0) \
+            == pres[s]
+    assert cl.read("obj") == data
+
+
 def test_minicluster_clay_osd_out_recovers_with_subchunk_reads():
     """Cluster-level: remap a shard off an OSD in a clay pool; the
     peering rebuild uses repair-plane reads (counter-verified fewer
@@ -226,6 +324,56 @@ def test_minicluster_clay_osd_out_recovers_with_subchunk_reads():
         assert rebuilt > 0
         # strictly fewer bytes than the k whole chunks the full-chunk
         # rebuild would have pulled for the same pushed shards
+        assert read < 4 * rebuilt
+    finally:
+        c.shutdown()
+
+
+def test_minicluster_lrc_osd_out_recovers_within_local_group():
+    """Cluster-level lrc: remap a shard off an OSD; peering rebuilds
+    each pushed shard from its LOCAL parity group (l=3 chunk reads,
+    counter-verified at most (l+1)/k of the full-chunk baseline) and
+    every object reads back intact (ISSUE 20 acceptance)."""
+    from ceph_tpu.testing import MiniCluster
+    c = MiniCluster(n_osd=9, threaded=False)
+    try:
+        c.pump()
+        c.wait_all_up()
+        r = c.rados()
+        r.mon_command({"prefix": "osd erasure-code-profile set",
+                       "name": "lrc423",
+                       "profile": {"plugin": "lrc", "k": "4", "m": "2",
+                                   "l": "3",
+                                   "crush-failure-domain": "host"}})
+        r.pool_create("ecl", pg_num=4, pool_type="erasure",
+                      erasure_code_profile="lrc423")
+        c.pump()
+        io = r.open_ioctx("ecl")
+        rng = np.random.default_rng(23)
+        objs = {f"o{i}": rng.integers(0, 256, 4000 + i,
+                                      dtype=np.uint8).tobytes()
+                for i in range(4)}
+        for oid, data in objs.items():
+            io.write_full(oid, data)
+        c.pump()
+        r.mon_command({"prefix": "osd out", "ids": [0]})
+        for _ in range(40):
+            c.pump()
+            if all(d.pgs_recovering() == 0 for d in c.osds.values()):
+                break
+        else:
+            raise TimeoutError("lrc recovery never finished")
+        for oid, data in objs.items():
+            assert io.read(oid) == data, oid
+        read = sum(d.perf._c["recovery_bytes_read"].value
+                   for d in c.osds.values())
+        rebuilt = sum(d.perf._c["recovery_bytes_rebuilt"].value
+                      for d in c.osds.values())
+        assert rebuilt > 0
+        # local-group repair: l=3 helper chunks per rebuilt shard,
+        # i.e. at most (l+1)/k = 1x rebuilt-chunk volume -- and well
+        # under the k=4 whole chunks of the classic path
+        assert read <= 3 * rebuilt
         assert read < 4 * rebuilt
     finally:
         c.shutdown()

@@ -1,0 +1,261 @@
+"""Repair-schedule compiler (ceph_tpu.ec.repairc; ISSUE 20): the
+exhaustive parity sweep pinning every compiled repair program
+byte-identical to the plugin's interpreted decode, the per-signature
+program cache (compile-once, cost-weighted eviction), the zero-probe
+linearity guard, and the locality/read-fraction contracts of the
+plans themselves."""
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec.interface import ErasureCodeError
+from ceph_tpu.ec.registry import factory
+from ceph_tpu.ec.repairc import (RepairPlan, RepairProgram,
+                                 RepairProgramCache, cache_of,
+                                 compile_program, program_for)
+from ceph_tpu.osd import ecutil
+
+#: the three codes the OSD routes through the compiler, with the
+#: fraction of the k-full-chunk baseline a single-failure plan reads
+PLUGINS = [
+    ("jerasure", {"technique": "reed_sol_van", "k": "4", "m": "2"},
+     1.0),                      # k whole chunks, but decoded DIRECTLY
+    ("clay", {"k": "4", "m": "2"}, 5 / 8),      # d/(k*q) = 5/(4*2)
+    ("lrc", {"k": "4", "m": "2", "l": "3"}, 3 / 4),     # l/k
+]
+
+
+def _object(ec, nstripes=3, seed=7):
+    """Encode a random object; returns (sinfo, shard streams, data)."""
+    k = ec.get_data_chunk_count()
+    cs = ec.get_chunk_size(k * 128)
+    sinfo = ecutil.StripeInfo(k, k * cs)
+    rng = np.random.default_rng(seed)
+    data = rng.integers(0, 256, nstripes * sinfo.stripe_width,
+                        dtype=np.uint8).tobytes()
+    return sinfo, ecutil.encode(sinfo, ec, data), data
+
+
+def _helper_bufs(plan, shards, cs):
+    """Slice each helper's chunk stream down to the plan's extents —
+    exactly the bytes ECSubRead ships (per stripe, plan order)."""
+    byte_ext = plan.byte_extents(cs)
+    out = {}
+    for h in plan.helper_ids():
+        ext = ecutil.expand_stream_extents(byte_ext[h], cs,
+                                           len(shards[h]))
+        out[h] = b"".join(shards[h][o:o + c] for o, c in ext)
+    return out
+
+
+@pytest.mark.parametrize("plugin,profile,frac", PLUGINS)
+def test_parity_sweep_all_signatures(plugin, profile, frac):
+    """EVERY single and double erasure signature with a plan: the
+    compiled program's output — numpy oracle AND device kernel — must
+    equal the original shards byte-for-byte."""
+    ec = factory(plugin, dict(profile))
+    k, n = ec.get_data_chunk_count(), ec.get_chunk_count()
+    sinfo, shards, _ = _object(ec)
+    cs = sinfo.chunk_size
+    planned = 0
+    for r in (1, 2):
+        for lost in itertools.combinations(range(n), r):
+            avail = set(range(n)) - set(lost)
+            plan = ecutil.repair_plan(ec, set(lost), avail)
+            if r == 1:
+                assert plan is not None, (plugin, lost)
+            if plan is None:
+                continue        # no partial plan: full-chunk fallback
+            planned += 1
+            assert set(plan.lost) == set(lost)
+            bufs = _helper_bufs(plan, shards, cs)
+            for backend in ("numpy", None):
+                streams = ecutil.compiled_repair_streams(
+                    ec, plan, cs, bufs, backend=backend)
+                for s in lost:
+                    assert streams[s] == shards[s], \
+                        (plugin, lost, backend)
+    assert planned >= n         # every single failure at minimum
+    if plugin == "jerasure":
+        # matrix codes plan every double signature too
+        assert planned == n + n * (n - 1) // 2
+
+
+@pytest.mark.parametrize("plugin,profile,frac", PLUGINS)
+def test_single_failure_read_fraction(plugin, profile, frac):
+    """The plan's helper-read volume is the code's advertised fraction
+    of the k-full-chunk baseline (the recovery_bytes saving)."""
+    ec = factory(plugin, dict(profile))
+    k, n = ec.get_data_chunk_count(), ec.get_chunk_count()
+    for lost in range(n):
+        plan = ecutil.repair_plan(ec, {lost}, set(range(n)) - {lost})
+        assert plan.read_fraction(k) == pytest.approx(frac), lost
+
+
+def test_lrc_plan_stays_in_local_group():
+    """A single lrc failure reads ONLY the lost shard's local parity
+    group — l helpers, never the k survivors of a global decode."""
+    ec = factory("lrc", {"k": "4", "m": "2", "l": "3"})
+    n = ec.get_chunk_count()
+    for lost in range(n):
+        plan = ecutil.repair_plan(ec, {lost}, set(range(n)) - {lost})
+        group = ec.local_layer(lost).chunks_as_set
+        assert lost in group
+        assert set(plan.helper_ids()) == group - {lost}
+        assert len(plan.helper_ids()) < ec.get_data_chunk_count()
+
+
+def test_compile_once_per_signature():
+    """The cache compiles each signature exactly once; repeats hit."""
+    ec = factory("jerasure",
+                 {"technique": "reed_sol_van", "k": "4", "m": "2"})
+    n = ec.get_chunk_count()
+    sinfo, shards, _ = _object(ec)
+    cs = sinfo.chunk_size
+    for _ in range(3):
+        for lost in range(n):
+            plan = ecutil.repair_plan(ec, {lost},
+                                      set(range(n)) - {lost})
+            bufs = _helper_bufs(plan, shards, cs)
+            streams = ecutil.compiled_repair_streams(ec, plan, cs,
+                                                     bufs)
+            assert streams[lost] == shards[lost]
+    stats = cache_of(ec).stats()
+    assert len(stats["compiles"]) == n
+    assert all(c == 1 for c in stats["compiles"].values()), stats
+    assert stats["hits"] >= 2 * n
+
+
+def test_cache_cost_weighted_eviction():
+    """Programs evict LRU by matrix-byte cost; a re-request after
+    eviction recompiles (compile count 2 is legitimate then)."""
+    ec = factory("jerasure",
+                 {"technique": "reed_sol_van", "k": "4", "m": "2"})
+    n = ec.get_chunk_count()
+    plans = [ecutil.repair_plan(ec, {i}, set(range(n)) - {i})
+             for i in range(n)]
+    one_cost = compile_program(ec, plans[0]).cost()
+    cache = RepairProgramCache(capacity=2 * one_cost)
+    for p in plans[:3]:
+        cache.get_or_compile(ec, p)
+    assert len(cache) == 2                      # plans[0] evicted
+    assert cache.total_cost() <= 2 * one_cost
+    # plans[1] is LRU-refreshed by a hit; inserting plans[3] must
+    # evict plans[2], not it
+    cache.get_or_compile(ec, plans[1])
+    cache.get_or_compile(ec, plans[3])
+    sigs = [p.signature() for p in plans]
+    stats = cache.stats()
+    assert stats["compiles"][sigs[1]] == 1      # still resident
+    cache.get_or_compile(ec, plans[2])          # evicted: recompile
+    stats = cache.stats()
+    assert stats["compiles"][sigs[2]] == 2
+    assert stats["compiles"][sigs[0]] == 1
+
+
+def test_zero_probe_linearity_guard():
+    """A plugin whose repair is affine (non-zero output for all-zero
+    input) must be refused at compile time, not miscompiled."""
+    class Affine:
+        def decode(self, want, chunks, chunk_size):
+            return {i: np.ones(chunk_size, dtype=np.uint8)
+                    for i in want}
+    plan = RepairPlan.make([0], {1: [(0, 1)], 2: [(0, 1)]},
+                           sub_chunk_no=1)
+    with pytest.raises(ErasureCodeError, match="not GF-linear"):
+        compile_program(Affine(), plan)
+
+
+def test_program_shape_and_signature():
+    """Plan normalization + the program's gather/scatter algebra."""
+    plan = RepairPlan.make([3, 1], {0: [(0, 2)], 2: [(1, 1)]},
+                           sub_chunk_no=2)
+    assert plan.lost == (1, 3)
+    assert plan.signature() == "-1-3+0@0:2+2@1:1/2"
+    assert plan.total_planes() == 3
+    assert plan.output_planes() == 4
+    assert plan.byte_extents(8) == {0: [(0, 8)], 2: [(4, 4)]}
+    with pytest.raises(ValueError):
+        plan.byte_extents(7)    # not sub-chunk aligned
+    with pytest.raises(ValueError):
+        RepairPlan.make([0], {0: [(0, 1)]}, 1)  # lost as own helper
+    with pytest.raises(ValueError):
+        RepairPlan.make([0], {1: [(0, 0)]}, 1)  # empty extent
+    # identity program: rebuild = helper plane passthrough
+    prog = RepairProgram(
+        RepairPlan.make([0], {1: [(0, 1)]}, 1),
+        np.eye(1, dtype=np.uint8))
+    assert prog.run({1: b"abcd"}, 2, backend="numpy") == {0: b"abcd"}
+    with pytest.raises(ValueError):
+        prog.run({1: b"abc"}, 2, backend="numpy")   # misaligned
+
+
+def test_clay_single_failure_vs_interpreted_reference():
+    """Clay's compiled repair equals the interpreted repair-plane path
+    (repair_shard_stream) as well as the original bytes — the two
+    reference semantics agree with the compiled one."""
+    ec = factory("clay", {"k": "4", "m": "2"})
+    n = ec.get_chunk_count()
+    sinfo, shards, _ = _object(ec)
+    cs = sinfo.chunk_size
+    for lost in range(n):
+        plan = ecutil.repair_plan(ec, {lost}, set(range(n)) - {lost})
+        bufs = _helper_bufs(plan, shards, cs)
+        compiled = ecutil.compiled_repair_streams(ec, plan, cs, bufs)
+        interp = ecutil.repair_shard_stream(ec, cs, lost, bufs)
+        assert compiled[lost] == interp == shards[lost]
+
+
+def test_lrc_locality_rule_maps_groups_to_fault_domains():
+    """crush-locality lines local parity groups up with CRUSH fault
+    domains: the generated rule picks one rack per group and spreads
+    that group's chunks across hosts inside it — so a single-host loss
+    repairs entirely within one rack (the l ≪ k read stays local)."""
+    from ceph_tpu.crush.wrapper import CrushWrapper
+    ec = factory("lrc", {"k": "4", "m": "2", "l": "3",
+                         "crush-locality": "rack",
+                         "crush-failure-domain": "host"})
+    n = ec.get_chunk_count()
+    # 3 racks x 4 hosts x 1 osd; rack of osd.i is i // 4
+    cw = CrushWrapper()
+    cw.add_bucket("default", "root")
+    for r in range(3):
+        rack = f"rack{r}"
+        cw.add_bucket(rack, "rack")
+        for h in range(4):
+            osd = r * 4 + h
+            host = f"host{osd}"
+            cw.add_bucket(host, "host")
+            cw.insert_item(osd, 1.0, f"osd.{osd}", host)
+            rb = cw.crush.bucket(cw.get_item_id(rack))
+            hid = cw.get_item_id(host)
+            rb.items.append(hid)
+            w = cw.crush.bucket(hid).weight
+            rb.item_weights.append(w)
+            rb.weight += w
+        root = cw.crush.bucket(cw.get_item_id("default"))
+        rid_ = cw.get_item_id(rack)
+        root.items.append(rid_)
+        root.item_weights.append(cw.crush.bucket(rid_).weight)
+        root.weight += cw.crush.bucket(rid_).weight
+    rid = ec.create_rule("lrc_rule", cw)
+    for x in range(8):
+        osds = cw.do_rule(rid, x, n)
+        assert len(osds) == n and len(set(osds)) == n
+        assert all(o >= 0 for o in osds)
+        # each local group's 4 chunks land in ONE rack, and the two
+        # groups land in DIFFERENT racks
+        racks = [{o // 4 for o in osds[g:g + 4]} for g in (0, 4)]
+        assert all(len(r) == 1 for r in racks), (x, osds)
+        assert racks[0] != racks[1], (x, osds)
+
+
+def test_program_for_shares_per_instance_cache():
+    ec = factory("lrc", {"k": "4", "m": "2", "l": "3"})
+    n = ec.get_chunk_count()
+    plan = ecutil.repair_plan(ec, {0}, set(range(n)) - {0})
+    assert program_for(ec, plan) is program_for(ec, plan)
+    # a second plugin instance compiles its own program
+    ec2 = factory("lrc", {"k": "4", "m": "2", "l": "3"})
+    assert program_for(ec2, plan) is not program_for(ec, plan)

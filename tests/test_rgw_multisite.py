@@ -338,6 +338,119 @@ def test_delete_after_bumped_put_replicates(ms):
     assert _wait(lambda: _get_bytes(m2, "dbump", "k") is None)
 
 
+def test_plain_put_replay_after_delete_does_not_resurrect(cluster):
+    """A peer's plain-put record arriving AFTER the local delete of
+    the same key must stay dead: the delete leaves a per-key tombstone
+    whose stamp the late put loses to (regression: the delete removed
+    the index entry outright, so the replayed put landed on an absent
+    key and resurrected the object)."""
+    gw = RGWGateway(cluster.rados(), pool="rgw-tomb")
+    gw._create_bucket("tb")
+    put = {"key": "k", "op": "put", "mode": "plain", "size": 3,
+           "etag": "e1", "mtime": "2026-08-03T12:00:00.000Z",
+           "trace": ["zx"]}
+    assert gw.sync_apply("tb", put, b"v1!", "zx")
+    shard = shard_obj("tb", gw.shard_of("tb", "k"))
+    gw.io.exec(shard, "rgw", "obj_delete_plain", {"key": "k"})
+    assert gw._index_entry("tb", "k") is None
+    assert "k" not in gw._index("tb")   # tombstone hides from listings
+    # a replay of the SAME put (another peer's re-log) must not land
+    assert not gw.sync_apply("tb", put, b"v1!", "zy")
+    assert gw._index_entry("tb", "k") is None
+    # ... nor a different put still stamped before the delete
+    older = dict(put, etag="e2", mtime="2026-08-03T12:00:00.500Z")
+    assert not gw.sync_apply("tb", older, b"v2!", "zy")
+    assert gw._index_entry("tb", "k") is None
+    # deleting the dead key again is a clean no-op
+    out = gw.io.exec(shard, "rgw", "obj_delete_plain", {"key": "k"})
+    assert out["removed"] == []
+    # a LOCAL put revives the key and stamps past the tombstone, so
+    # replicas apply it over their own tombstones
+    out = gw.io.exec(shard, "rgw", "obj_store",
+                     {"key": "k", "mode": "plain", "size": 3,
+                      "etag": "e3", "mtime": "2026-08-03T12:00:01.000Z",
+                      "obj": ".kv3"})
+    assert out["removed"] == []         # tombstone backs no object
+    ent = gw._index_entry("tb", "k")
+    assert ent["etag"] == "e3"
+    raw = gw.io.get_omap_vals_by_keys(shard, ["k"])
+    assert json.loads(raw["k"])["mtime"] > "2026-08-07"
+
+
+def test_sync_del_on_absent_key_leaves_tombstone(cluster):
+    """Third-zone ordering: a replicated delete can arrive BEFORE the
+    put it chased.  It must leave a tombstone on the absent key so the
+    late put still loses; a put strictly newer than the delete wins."""
+    gw = RGWGateway(cluster.rados(), pool="rgw-tomb3")
+    gw._create_bucket("tc")
+    dele = {"key": "k", "op": "del",
+            "mtime": "2026-08-03T12:00:01.000Z", "trace": ["zx"]}
+    assert gw.sync_apply("tc", dele, None, "zx")
+    assert gw._index_entry("tc", "k") is None
+    assert not gw.sync_apply("tc", dele, None, "zy")     # replay
+    late = {"key": "k", "op": "put", "mode": "plain", "size": 3,
+            "etag": "eo", "mtime": "2026-08-03T12:00:00.900Z",
+            "trace": ["zy"]}
+    assert not gw.sync_apply("tc", late, b"old", "zy")
+    assert gw._index_entry("tc", "k") is None
+    # delete-wins-ties: an equal-stamp put was ordered before the
+    # delete on the origin (datalog order), so it must lose here too
+    tied = dict(late, etag="et", mtime=dele["mtime"])
+    assert not gw.sync_apply("tc", tied, b"tie", "zy")
+    assert gw._index_entry("tc", "k") is None
+    fresh = dict(late, etag="ef", mtime="2026-08-03T12:00:01.100Z")
+    assert gw.sync_apply("tc", fresh, b"new", "zy")
+    assert gw._index_entry("tc", "k")["etag"] == "ef"
+
+
+def test_cross_zone_delete_beats_racing_put(ms):
+    """E2E resurrection window: m2 deletes a key while m1's racing
+    (older-stamped) put is still in flight.  Both zones must converge
+    on 'deleted' — the put record reaching m2 after its delete used to
+    land on the absent key and resurrect the object on m2 only."""
+    m1, m2 = ms
+    req(m1, "PUT", "/tdrace")
+    req(m1, "PUT", "/tdrace/k", b"v1")
+    assert _wait(lambda: _get_bytes(m2, "tdrace", "k") == b"v1")
+    # warm the m2->m1 pipeline on THIS bucket before the race: a
+    # round-tripped delete proves m1's incremental cursor for m2's
+    # tdrace log is live — otherwise the cursor gets initialized at
+    # m2's CURRENT head mid-stall (full-sync floor) and would skip
+    # straight past the del record the test depends on
+    req(m1, "PUT", "/tdrace/warm", b"w")
+    assert _wait(lambda: _get_bytes(m2, "tdrace", "warm") == b"w")
+    req(m2, "DELETE", "/tdrace/warm")
+    assert _wait(lambda: _get_bytes(m1, "tdrace", "warm") is None)
+    # stall m1's OUTBOUND pulls: m2's delete stays unseen at m1 while
+    # m1's racing put replicates to m2 (m1 still serves m2's pulls)
+    real = m1.peer_request
+
+    def stall(endpoint, method, path, *a, **k):
+        if path == "/admin/log":
+            raise urllib.error.URLError("stalled")
+        return real(endpoint, method, path, *a, **k)
+    m1.peer_request = stall
+    try:
+        req(m2, "DELETE", "/tdrace/k")  # wall-clock stamp, newest
+        # m1's concurrent overwrite: forced-past stamp bumps to just
+        # above v1 — strictly OLDER than m2's delete
+        m1._now_str = lambda: "2000-01-01T00:00:00.000Z"
+        try:
+            req(m1, "PUT", "/tdrace/k", b"v2-racer")
+        finally:
+            del m1._now_str
+        assert _get_bytes(m1, "tdrace", "k") == b"v2-racer"
+        # m2 pulls the racing put and must refuse it: its tombstone
+        # outranks the put's stamp
+        assert _wait(lambda: m2.sync.caught_up())
+        assert _get_bytes(m2, "tdrace", "k") is None
+    finally:
+        m1.peer_request = real
+    # m1 hears the delete and drops its own racer: converged deleted
+    assert _wait(lambda: _get_bytes(m1, "tdrace", "k") is None)
+    assert _get_bytes(m2, "tdrace", "k") is None
+
+
 def test_forwarded_master_refusal_passes_through(ms):
     """A forwarded metadata op the master answers-and-refuses must
     surface the master's real S3 error: 409 BucketNotEmpty is
